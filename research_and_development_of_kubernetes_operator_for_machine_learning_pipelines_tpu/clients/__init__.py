@@ -1,0 +1,43 @@
+"""External-system clients: protocol interfaces, real REST clients, fakes.
+
+The reference talks to four external systems over the network — the
+Kubernetes API server, the MLflow tracking server, Prometheus, and (via
+Seldon) the inference data plane (SURVEY.md §1).  It binds to concrete SDK
+clients at import time (``mlflow_operator.py:1-13``), which makes it
+untestable without a cluster.  Here every dependency is a small protocol;
+the operator core only sees the protocol, and three implementations exist:
+
+- in-memory fakes (``fakes``) for tests,
+- real REST clients (``kube_rest``, ``mlflow_rest``, ``prom_http``) built on
+  httpx/stdlib, import-guarded so the core never needs cluster SDKs.
+"""
+
+from .base import (
+    AliasNotFound,
+    ApiError,
+    Conflict,
+    KubeClient,
+    MetricsSource,
+    ModelMetrics,
+    ModelVersion,
+    NotFound,
+    RegistryClient,
+    RegistryError,
+)
+from .fakes import FakeKube, FakeMetrics, FakeRegistry
+
+__all__ = [
+    "AliasNotFound",
+    "ApiError",
+    "Conflict",
+    "KubeClient",
+    "MetricsSource",
+    "ModelMetrics",
+    "ModelVersion",
+    "NotFound",
+    "RegistryClient",
+    "RegistryError",
+    "FakeKube",
+    "FakeMetrics",
+    "FakeRegistry",
+]

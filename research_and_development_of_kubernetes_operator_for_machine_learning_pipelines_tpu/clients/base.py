@@ -1,0 +1,183 @@
+"""Protocol interfaces and data types for the operator's external systems.
+
+Shapes mirror what the reference consumes:
+
+- ``ModelVersion``: the two fields the reference reads off MLflow's
+  model-version object — ``version`` (``mlflow_operator.py:95``) and
+  ``source`` (``:126,:132``).
+- ``ModelMetrics``: the six quantities ``get_model_metrics`` computes from
+  PromQL (``:363-417``), with ``None`` meaning "no traffic in the window"
+  exactly as the reference does (``:387-390,:401-404``).
+- ``KubeClient``: the five CustomObjectsApi verbs the reference uses
+  (get/create/replace/patch-status/delete, ``:73,:241-282,:462-477``) plus
+  event emission (``kopf.event`` call sites ``:90,:122,:332,:344,:361``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Protocol, runtime_checkable
+
+
+# ---------------------------------------------------------------------------
+# Errors
+# ---------------------------------------------------------------------------
+
+
+class ApiError(Exception):
+    """Kubernetes API error with an HTTP status code."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class NotFound(ApiError):
+    def __init__(self, message: str = "not found"):
+        super().__init__(404, message)
+
+
+class Conflict(ApiError):
+    """409 — stale resourceVersion on replace.
+
+    The reference propagates resourceVersion (``mlflow_operator.py:256-259``)
+    but never catches the resulting 409s (SURVEY §5 race note); the rebuild's
+    reconciler retries on Conflict.
+    """
+
+    def __init__(self, message: str = "conflict"):
+        super().__init__(409, message)
+
+
+class RegistryError(Exception):
+    """MLflow registry unreachable or returned an unexpected error."""
+
+
+class AliasNotFound(RegistryError):
+    """Alias does not exist on the registered model.
+
+    The reference treats *any* exception from
+    ``get_model_version_by_alias`` as alias-missing
+    (``mlflow_operator.py:58-62``); the rebuild distinguishes a definitive
+    miss (this error -> error status + teardown) from transport errors
+    (``RegistryError`` -> keep last-known-good deployment and retry).
+    """
+
+
+# ---------------------------------------------------------------------------
+# Data types
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelVersion:
+    version: str
+    source: str  # artifact URI as stored by MLflow, e.g. mlflow-artifacts:/1/abc/artifacts/model
+
+
+@dataclass(frozen=True)
+class ModelMetrics:
+    """One predictor's metrics over a window (reference ``:363-417``)."""
+
+    latency_p95: float | None = None
+    error_responses: float = 0.0
+    error_rate: float | None = None
+    latency_avg: float | None = None
+    request_count: float = 0.0
+    feedback_request_count: float = 0.0
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "latency_95th": self.latency_p95,
+            "error_responses": self.error_responses,
+            "error_rate": self.error_rate,
+            "latency_avg": self.latency_avg,
+            "request_count": self.request_count,
+            "feedback_request_count": self.feedback_request_count,
+        }
+
+
+@dataclass(frozen=True)
+class Event:
+    """A Kubernetes Event attached to a CR (reference ``kopf.event`` sites)."""
+
+    type: str  # "Normal" | "Warning"
+    reason: str  # e.g. "TrafficIncrease", "PromotionFailed"
+    message: str
+
+
+@dataclass
+class ObjectRef:
+    group: str
+    version: str
+    namespace: str
+    plural: str
+    name: str
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+
+# The two custom-resource kinds the operator touches.
+MLFLOWMODEL = dict(group="mlflow.nizepart.com", version="v1alpha1", plural="mlflowmodels")
+SELDONDEPLOYMENT = dict(
+    group="machinelearning.seldon.io", version="v1", plural="seldondeployments"
+)
+
+
+# ---------------------------------------------------------------------------
+# Protocols
+# ---------------------------------------------------------------------------
+
+
+@runtime_checkable
+class RegistryClient(Protocol):
+    """Model-registry lookups (MLflow in the reference)."""
+
+    def get_version_by_alias(self, model_name: str, alias: str) -> ModelVersion:
+        """Resolve alias -> ModelVersion.  Raises AliasNotFound / RegistryError."""
+        ...
+
+    def get_version(self, model_name: str, version: str) -> ModelVersion:
+        ...
+
+
+@runtime_checkable
+class KubeClient(Protocol):
+    """Minimal dynamic-object Kubernetes API (CustomObjectsApi equivalent)."""
+
+    def get(self, ref: ObjectRef) -> Mapping[str, Any]:
+        ...
+
+    def create(self, ref: ObjectRef, body: Mapping[str, Any]) -> Mapping[str, Any]:
+        ...
+
+    def replace(self, ref: ObjectRef, body: Mapping[str, Any]) -> Mapping[str, Any]:
+        ...
+
+    def patch_status(self, ref: ObjectRef, status: Mapping[str, Any]) -> Mapping[str, Any]:
+        ...
+
+    def delete(self, ref: ObjectRef) -> None:
+        ...
+
+    def list(self, ref: ObjectRef) -> list[Mapping[str, Any]]:
+        ...
+
+    def emit_event(self, ref: ObjectRef, event: Event) -> None:
+        ...
+
+
+@runtime_checkable
+class MetricsSource(Protocol):
+    """Per-predictor serving metrics (Prometheus in the reference)."""
+
+    def model_metrics(
+        self,
+        deployment_name: str,
+        predictor_name: str,
+        namespace: str,
+        window_s: int = 60,
+    ) -> ModelMetrics:
+        ...

@@ -1,0 +1,213 @@
+"""Deployment manifest construction.
+
+Two backends:
+
+- ``seldon`` — byte-compatible with the reference's SeldonDeployment shape
+  (``mlflow_operator.py:193-238``): ``MLFLOW_SERVER`` graph nodes, protocol
+  ``kfserving``, predictor names ``v<version>``, weighted ``traffic``.
+- ``tpu``    — the north-star first-party data plane: each predictor is our
+  JAX/XLA inference server (``server/``) pinned to a TPU node pool via
+  nodeSelector/tolerations, with mesh shape and topology passed through the
+  container environment.  The Seldon CR shape (predictor list + traffic
+  weights + Istio split) is retained so the promotion loop and metric
+  identity (``deployment_name``/``predictor_name``/``namespace``,
+  ``mlflow_operator.py:367``) are unchanged.
+
+Owner references (``:158-169``) make the cluster GC the deployment when the
+``MlflowModel`` CR is deleted.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping
+
+from ..utils.config import OperatorConfig, TpuSpec, TPU_TOPOLOGIES
+
+SELDON_API_VERSION = "machinelearning.seldon.io/v1"
+MLFLOWMODEL_API_VERSION = "mlflow.nizepart.com/v1alpha1"
+
+
+def owner_reference(name: str, uid: str) -> list[dict[str, Any]]:
+    """Reference ``mlflow_operator.py:162-169``."""
+    return [
+        {
+            "apiVersion": MLFLOWMODEL_API_VERSION,
+            "kind": "MlflowModel",
+            "name": name,
+            "uid": uid,
+            "controller": True,
+            "blockOwnerDeletion": True,
+        }
+    ]
+
+
+def _seldon_predictor(
+    version: str, model_uri: str, traffic: int, config: OperatorConfig
+) -> dict[str, Any]:
+    """Reference-parity predictor (``mlflow_operator.py:195-222``)."""
+    return {
+        "graph": {
+            "name": f"classifier-{version}",
+            "implementation": "MLFLOW_SERVER",
+            "modelUri": model_uri,
+            "envSecretRefName": config.minio_secret,
+            "children": [],
+        },
+        "name": f"v{version}",
+        "replicas": 1,
+        "traffic": traffic,
+    }
+
+
+def _tpu_predictor(
+    version: str,
+    model_uri: str,
+    traffic: int,
+    config: OperatorConfig,
+    deployment_name: str,
+    namespace: str,
+) -> dict[str, Any]:
+    """First-party TPU predictor: our JAX server on a v5e node pool."""
+    tpu: TpuSpec = config.tpu
+    info = TPU_TOPOLOGIES.get(tpu.topology)
+    if info is None:
+        raise ValueError(
+            f"unknown tpuTopology {tpu.topology!r}; known: {sorted(TPU_TOPOLOGIES)}"
+        )
+    accelerator, gke_topology, _chips = info
+    container = {
+        "name": f"tpu-server-{version}",
+        "image": config.server_image,
+        "args": [
+            "--model-uri", model_uri,
+            "--model-name", config.model_name,
+            "--predictor-name", f"v{version}",
+            "--deployment-name", deployment_name,
+            "--namespace", namespace,
+            "--mesh-shape", json.dumps(dict(tpu.mesh_shape)),
+            "--dtype", tpu.dtype,
+            "--max-batch-size", str(tpu.max_batch_size),
+            "--max-batch-delay-ms", str(tpu.max_batch_delay_ms),
+        ],
+        "env": [
+            {"name": "TPU_TOPOLOGY", "value": tpu.topology},
+            {"name": "JAX_PLATFORMS", "value": "tpu"},
+            {
+                "name": "JAX_COMPILATION_CACHE_DIR",
+                "value": tpu.compile_cache_dir or "",
+            },
+        ],
+        "ports": [
+            {"name": "http", "containerPort": 9000},
+            {"name": "metrics", "containerPort": 6000},
+        ],
+        "resources": {
+            "limits": {"google.com/tpu": str(tpu.num_devices)},
+            "requests": {"google.com/tpu": str(tpu.num_devices)},
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/v2/health/ready", "port": 9000},
+            # TPU cold-start: first jit compile can take tens of seconds;
+            # generous window so a canary isn't killed mid-compile
+            # (SURVEY §7 hard part 3).
+            "initialDelaySeconds": 10,
+            "periodSeconds": 5,
+            "failureThreshold": 60,
+        },
+    }
+    if config.minio_secret:
+        container["envFrom"] = [{"secretRef": {"name": config.minio_secret}}]
+    return {
+        "graph": {
+            "name": f"tpu-server-{version}",
+            "implementation": "TRITON_SERVER",  # pre-packaged V2-protocol slot
+            "type": "MODEL",
+            "modelUri": model_uri,
+            "children": [],
+        },
+        "componentSpecs": [
+            {
+                "spec": {
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator": accelerator,
+                        "cloud.google.com/gke-tpu-topology": gke_topology,
+                    },
+                    "tolerations": [
+                        {
+                            "key": "google.com/tpu",
+                            "operator": "Exists",
+                            "effect": "NoSchedule",
+                        }
+                    ],
+                    "containers": [container],
+                }
+            }
+        ],
+        "name": f"v{version}",
+        "replicas": tpu.replicas,
+        "traffic": traffic,
+    }
+
+
+def build_deployment(
+    name: str,
+    namespace: str,
+    owner_uid: str,
+    config: OperatorConfig,
+    current_version: str,
+    new_model_uri: str,
+    traffic_current: int,
+    previous_version: str | None = None,
+    old_model_uri: str | None = None,
+    traffic_prev: int = 0,
+) -> dict[str, Any]:
+    """Build the (Seldon-shaped) deployment manifest for a rollout state.
+
+    Predictor order matches the reference: previous first, current second
+    (``mlflow_operator.py:181-222``); at 100% only the current predictor
+    remains (``:354-358``).
+    """
+    if previous_version is not None and old_model_uri is None:
+        raise ValueError("old_model_uri required when previous_version is set")
+
+    if config.backend == "tpu":
+        make = lambda v, uri, t: _tpu_predictor(v, uri, t, config, name, namespace)
+        protocol = "v2"
+    else:
+        make = lambda v, uri, t: _seldon_predictor(v, uri, t, config)
+        protocol = "kfserving"  # reference :235
+
+    predictors: list[dict[str, Any]] = []
+    if previous_version is not None and traffic_prev > 0:
+        predictors.append(make(previous_version, old_model_uri, traffic_prev))
+    predictors.append(make(current_version, new_model_uri, traffic_current))
+
+    return {
+        "apiVersion": SELDON_API_VERSION,
+        "kind": "SeldonDeployment",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "ownerReferences": owner_reference(name, owner_uid),
+        },
+        "spec": {
+            "name": name,
+            "protocol": protocol,
+            "predictors": predictors,
+        },
+    }
+
+
+def set_traffic(
+    manifest: Mapping[str, Any], weights: Mapping[str, int]
+) -> dict[str, Any]:
+    """Return a copy of ``manifest`` with predictor traffic set from
+    ``weights`` (predictor name -> percent); reference ``:319-327``."""
+    import copy
+
+    out = copy.deepcopy(dict(manifest))
+    for predictor in out["spec"]["predictors"]:
+        if predictor["name"] in weights:
+            predictor["traffic"] = weights[predictor["name"]]
+    return out

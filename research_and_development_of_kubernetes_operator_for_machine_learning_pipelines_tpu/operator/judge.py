@@ -1,0 +1,109 @@
+"""The promotion gate: decide whether the canary earns more traffic.
+
+Reference: ``should_promote_model`` (``mlflow_operator.py:419-460``).
+Semantics preserved with default thresholds:
+
+- any of {p95 latency, error rate, mean latency} being ``None`` on either
+  model refuses promotion (``:430-434``) — both versions must have live
+  traffic in the window;
+- promote only if ALL of:
+    new_p95 <= old_p95 * (1 + tol_p95)        (``:440``)
+    new_err <= old_err * (1 + tol_err)        (``:447``)
+    new_avg <= old_avg * (1 + tol_avg)        (``:454``)
+
+Hardening beyond the reference (opt-in via ``GateThresholds``, see SURVEY
+§3.5(4)):
+
+- ``min_sample_count``: refuse until both predictors served >= N requests in
+  the window, so a 2-request fluke can't drive a promotion;
+- ``error_rate_floor``: absolute slack so a zero-error baseline doesn't
+  deadlock the relative check on the canary's first error.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from ..clients.base import ModelMetrics
+from ..utils.config import GateThresholds
+
+_log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class GateDecision:
+    promote: bool
+    reasons: tuple[str, ...] = ()
+
+    def __bool__(self) -> bool:
+        return self.promote
+
+
+def should_promote(
+    new: ModelMetrics,
+    old: ModelMetrics,
+    thresholds: GateThresholds | None = None,
+    logger: logging.Logger | logging.LoggerAdapter | None = None,
+) -> GateDecision:
+    """Return the gate decision with human-readable refusal reasons."""
+    t = thresholds or GateThresholds()
+    log = logger or _log
+    reasons: list[str] = []
+
+    # Availability check (reference :430-434): all three gating metrics must
+    # be present on both models.
+    for label, n_val, o_val in (
+        ("latency_95th", new.latency_p95, old.latency_p95),
+        ("error_rate", new.error_rate, old.error_rate),
+        ("latency_avg", new.latency_avg, old.latency_avg),
+    ):
+        if n_val is None or o_val is None:
+            reasons.append(f"metric {label} unavailable (no traffic in window)")
+    if reasons:
+        for r in reasons:
+            log.warning(r)
+        return GateDecision(False, tuple(reasons))
+
+    # Hardening: minimum sample count before the gate may pass.
+    if t.min_sample_count > 0:
+        for who, m in (("new", new), ("old", old)):
+            if m.request_count < t.min_sample_count:
+                reasons.append(
+                    f"{who} model has {m.request_count:.0f} samples "
+                    f"< minSampleCount {t.min_sample_count}"
+                )
+        if reasons:
+            for r in reasons:
+                log.warning(r)
+            return GateDecision(False, tuple(reasons))
+
+    # p95 latency (reference :440-444)
+    if new.latency_p95 > old.latency_p95 * (1 + t.latency_p95):
+        reasons.append(
+            f"p95 latency {new.latency_p95:.4f}s exceeds "
+            f"{old.latency_p95:.4f}s * {1 + t.latency_p95:.2f}"
+        )
+
+    # error rate (reference :447-451), with optional absolute floor
+    err_budget = old.error_rate * (1 + t.error_rate)
+    if t.error_rate_floor > 0:
+        err_budget = max(err_budget, t.error_rate_floor)
+    if new.error_rate > err_budget:
+        reasons.append(
+            f"error rate {new.error_rate:.4f} exceeds budget {err_budget:.4f}"
+        )
+
+    # mean latency (reference :454-458)
+    if new.latency_avg > old.latency_avg * (1 + t.latency_avg):
+        reasons.append(
+            f"mean latency {new.latency_avg:.4f}s exceeds "
+            f"{old.latency_avg:.4f}s * {1 + t.latency_avg:.2f}"
+        )
+
+    if reasons:
+        for r in reasons:
+            log.warning(r)
+        return GateDecision(False, tuple(reasons))
+    log.info("promotion gate passed: canary within all thresholds")
+    return GateDecision(True)

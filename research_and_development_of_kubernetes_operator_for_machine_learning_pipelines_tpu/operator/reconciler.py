@@ -1,0 +1,413 @@
+"""Level-triggered reconciler for ``MlflowModel`` resources.
+
+The reference's handler is a ``while True`` loop that never returns
+(``mlflow_operator.py:56``), blocks the whole event loop with synchronous
+network calls, spawns a duplicate loop on every CR edit, and holds promotion
+progress in local variables (SURVEY §3.5(1-3)).  The rebuild inverts that:
+``Reconciler.reconcile`` is a *single step* — read the world, compute the
+next state, apply it, persist it to status, and tell the runtime when to
+call back.  Crash/restart at any point resumes from status.
+
+One step performs at most one state transition, so each call is short and
+the runtime can interleave many resources on one thread.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from ..clients.base import (
+    AliasNotFound,
+    ApiError,
+    Conflict,
+    Event,
+    KubeClient,
+    MetricsSource,
+    ModelVersion,
+    NotFound,
+    ObjectRef,
+    RegistryClient,
+    RegistryError,
+    MLFLOWMODEL,
+    SELDONDEPLOYMENT,
+)
+from ..utils.clock import Clock, SystemClock
+from ..utils.config import OperatorConfig
+from ..utils.logging import model_logger
+from .builder import build_deployment
+from .judge import should_promote
+from .state import Phase, PromotionState
+from .uri import artifact_uri
+
+
+@dataclass
+class ReconcileOutcome:
+    state: PromotionState
+    requeue_after: float  # seconds until the runtime should reconcile again
+    events: list[Event] = field(default_factory=list)
+    applied: bool = False  # whether a deployment manifest was written
+
+
+class Reconciler:
+    """Reconciles one ``MlflowModel`` resource.
+
+    All collaborators are injected protocols (SURVEY §4's fake seams):
+    ``kube`` (API server), ``registry`` (MLflow), ``metrics`` (Prometheus),
+    ``clock`` (pacing).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        namespace: str,
+        kube: KubeClient,
+        registry: RegistryClient,
+        metrics: MetricsSource,
+        clock: Clock | None = None,
+        logger: logging.Logger | logging.LoggerAdapter | None = None,
+    ):
+        self.name = name
+        self.namespace = namespace
+        self.kube = kube
+        self.registry = registry
+        self.metrics = metrics
+        self.clock = clock or SystemClock()
+        self.log = logger or model_logger(name, namespace)
+
+    # -- object refs --------------------------------------------------------
+
+    @property
+    def cr_ref(self) -> ObjectRef:
+        return ObjectRef(namespace=self.namespace, name=self.name, **MLFLOWMODEL)
+
+    @property
+    def deployment_ref(self) -> ObjectRef:
+        return ObjectRef(namespace=self.namespace, name=self.name, **SELDONDEPLOYMENT)
+
+    # -- main entry ----------------------------------------------------------
+
+    def reconcile(self, obj: dict) -> ReconcileOutcome:
+        """One reconcile step for the given CR object (spec+status+metadata)."""
+        state = PromotionState.from_status(obj.get("status"))
+        events: list[Event] = []
+        try:
+            config = OperatorConfig.from_spec(obj.get("spec") or {})
+        except ValueError as e:
+            return self._on_config_error(state, str(e), events)
+
+        # 1. Resolve alias -> version (reference :57-62).
+        try:
+            mv = self.registry.get_version_by_alias(
+                config.model_name, config.model_alias
+            )
+        except AliasNotFound:
+            return self._on_alias_missing(obj, config, state, events)
+        except RegistryError as e:
+            # Transport error: unlike the reference (which tears the
+            # deployment down on *any* registry exception, :61-93), keep the
+            # last-known-good data plane and retry.
+            self.log.warning(f"registry unreachable, keeping current state: {e}")
+            return ReconcileOutcome(state, config.monitoring_interval_s, events)
+
+        # 2. Blocked version (post-rollback hold): don't redeploy a version
+        #    that just failed its SLOs until the alias moves on.
+        if (
+            state.held_version is not None
+            and mv.version == state.held_version
+            and state.phase in (Phase.FAILED, Phase.ROLLED_BACK)
+        ):
+            self._ensure_deployment(obj, config, state)
+            return ReconcileOutcome(state, config.monitoring_interval_s, events)
+
+        # 3. New version detected (reference :97-149).
+        if mv.version != state.current_version:
+            return self._on_new_version(obj, config, state, mv, events)
+
+        # 4. Canary in progress: one gate evaluation (reference :296-352).
+        if state.phase == Phase.CANARY:
+            return self._on_canary_step(obj, config, state, events)
+
+        # 5. Steady state: self-heal the deployment if it vanished, keep
+        #    monitoring the alias.
+        if state.phase in (Phase.STABLE, Phase.FAILED, Phase.ROLLED_BACK):
+            self._ensure_deployment(obj, config, state)
+        return ReconcileOutcome(state, config.monitoring_interval_s, events)
+
+    # -- handlers ------------------------------------------------------------
+
+    def _on_config_error(
+        self, state: PromotionState, message: str, events: list[Event]
+    ) -> ReconcileOutcome:
+        """Invalid spec: surface it on the CR instead of only in operator logs.
+
+        The data plane is deliberately left as-is — a spec typo must not tear
+        down a serving model.  Status error + a Warning event are written only
+        when the message changes, so backoff retries don't spam the stream.
+        """
+        err = f"invalid spec: {message}"
+        new_state = state.with_(error=err)
+        if state.error != err:
+            self._patch_status(new_state)
+            ev = Event("Warning", "InvalidSpec", err)
+            events.append(ev)
+            self.kube.emit_event(self.cr_ref, ev)
+            self.log.error(err)
+        return ReconcileOutcome(new_state, 300.0, events)
+
+    def _on_alias_missing(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        events: list[Event],
+    ) -> ReconcileOutcome:
+        """Reference :64-93: error status, tear down, Warning event."""
+        new_state = state.alias_missing(config.model_alias)
+        if state != new_state:
+            self._patch_status(new_state)
+            self._delete_deployment()
+            ev = Event(
+                "Warning",
+                "AliasNotFound",
+                f"Alias '{config.model_alias}' does not exist.",
+            )
+            events.append(ev)
+            self.kube.emit_event(self.cr_ref, ev)
+            self.log.error(f"Alias '{config.model_alias}' does not exist.")
+        return ReconcileOutcome(new_state, config.monitoring_interval_s, events)
+
+    def _on_new_version(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        mv: ModelVersion,
+        events: list[Event],
+    ) -> ReconcileOutcome:
+        new_state = state.new_version(mv.version, config.canary.initial_traffic)
+        # Apply + persist BEFORE emitting: if the apply fails persistently,
+        # status is unchanged and the next reconcile retries this branch —
+        # emitting first would duplicate the event on every retry.
+        applied = self._apply_for_state(obj, config, new_state, source_of_current=mv)
+        self._patch_status(new_state)
+        ev = Event(
+            "Normal",
+            "NewModelVersionDetected",
+            f"New model version {mv.version} detected.",
+        )
+        events.append(ev)
+        self.kube.emit_event(self.cr_ref, ev)
+        self.log.info(f"New model version detected: {mv.version}")
+        # Canary: go straight to the first gate check (the reference enters
+        # its metrics loop immediately after the initial apply, :296-310).
+        requeue = 0.0 if new_state.phase == Phase.CANARY else config.monitoring_interval_s
+        return ReconcileOutcome(new_state, requeue, events, applied=applied)
+
+    def _on_canary_step(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        events: list[Event],
+    ) -> ReconcileOutcome:
+        canary = config.canary
+        new_m = self.metrics.model_metrics(
+            self.name,
+            f"v{state.current_version}",
+            self.namespace,
+            canary.metrics_window_s,
+        )
+        old_m = self.metrics.model_metrics(
+            self.name,
+            f"v{state.previous_version}",
+            self.namespace,
+            canary.metrics_window_s,
+        )
+        self.log.info(
+            f"Metrics for new model (version {state.current_version}): {new_m.as_dict()}"
+        )
+        self.log.info(
+            f"Metrics for old model (version {state.previous_version}): {old_m.as_dict()}"
+        )
+
+        decision = should_promote(new_m, old_m, config.thresholds, self.log)
+        if decision:
+            new_state = state.promoted_step(canary.step)
+            applied = self._apply_for_state(obj, config, new_state)
+            self._patch_status(new_state)
+            if new_state.phase == Phase.STABLE:
+                ev = Event(
+                    "Normal",
+                    "PromotionComplete",
+                    "New model now receives 100% traffic. "
+                    "Previous model has been removed.",
+                )
+                requeue = config.monitoring_interval_s
+            else:
+                ev = Event(
+                    "Normal",
+                    "TrafficIncrease",
+                    f"Increased traffic to new model to {new_state.traffic_current}%",
+                )
+                requeue = canary.step_interval_s
+            events.append(ev)
+            self.kube.emit_event(self.cr_ref, ev)
+            self.log.info(ev.message)
+            return ReconcileOutcome(new_state, requeue, events, applied=applied)
+
+        # Gate refused.
+        new_state = state.gate_failed()
+        if new_state.attempt < canary.max_attempts:
+            self._patch_status(new_state)
+            self.log.info(
+                f"Attempt {new_state.attempt}/{canary.max_attempts}: metrics do not "
+                f"meet conditions, retrying after {canary.attempt_delay_s} seconds."
+            )
+            return ReconcileOutcome(new_state, canary.attempt_delay_s, events)
+
+        # Max attempts exhausted (reference :341-349).
+        fail_ev = Event(
+            "Warning",
+            "PromotionFailed",
+            f"Metrics did not meet conditions after {canary.max_attempts} attempts, "
+            "stopping promotion.",
+        )
+        events.append(fail_ev)
+        self.kube.emit_event(self.cr_ref, fail_ev)
+        self.log.warning(fail_ev.message)
+
+        if canary.rollback_on_failure:
+            # The rollback the reference left as a TODO (:345).
+            new_state = new_state.rolled_back()
+            applied = self._apply_for_state(obj, config, new_state)
+            self._patch_status(new_state)
+            rb_ev = Event(
+                "Normal",
+                "RollbackComplete",
+                f"Rolled back to version {new_state.current_version}; "
+                f"version {new_state.held_version} is held until the alias moves.",
+            )
+            events.append(rb_ev)
+            self.kube.emit_event(self.cr_ref, rb_ev)
+            self.log.warning(rb_ev.message)
+            return ReconcileOutcome(
+                new_state, config.monitoring_interval_s, events, applied=applied
+            )
+
+        new_state = new_state.halt_failed()
+        self._patch_status(new_state)
+        return ReconcileOutcome(new_state, config.monitoring_interval_s, events)
+
+    # -- deployment application ---------------------------------------------
+
+    def _resolve_uri(self, config: OperatorConfig, version: str) -> str:
+        mv = self.registry.get_version(config.model_name, version)
+        return artifact_uri(mv.source, config.artifact_root)
+
+    def _manifest_for_state(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        source_of_current: ModelVersion | None = None,
+    ) -> dict:
+        if source_of_current is not None and source_of_current.version == state.current_version:
+            new_uri = artifact_uri(source_of_current.source, config.artifact_root)
+        else:
+            new_uri = self._resolve_uri(config, state.current_version)
+        old_uri = None
+        if state.previous_version is not None and state.traffic_prev > 0:
+            old_uri = self._resolve_uri(config, state.previous_version)
+        owner_uid = (obj.get("metadata") or {}).get("uid", f"uid-{self.name}")
+        return build_deployment(
+            name=self.name,
+            namespace=self.namespace,
+            owner_uid=owner_uid,
+            config=config,
+            current_version=state.current_version,
+            new_model_uri=new_uri,
+            traffic_current=state.traffic_current,
+            previous_version=state.previous_version if state.traffic_prev > 0 else None,
+            old_model_uri=old_uri,
+            traffic_prev=state.traffic_prev,
+        )
+
+    def _apply_for_state(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        source_of_current: ModelVersion | None = None,
+    ) -> bool:
+        if state.current_version is None:
+            return False
+        manifest = self._manifest_for_state(obj, config, state, source_of_current)
+        self._apply_deployment(manifest)
+        return True
+
+    def _apply_deployment(self, manifest: dict, max_retries: int = 3) -> None:
+        """Create-or-replace with optimistic-concurrency retry.
+
+        Reference ``apply_seldon_deployment`` (``mlflow_operator.py:244-282``)
+        does get -> inject resourceVersion -> replace, creating on 404 — but a
+        409 from a concurrent writer kills the handler.  Here Conflict causes
+        a re-get and retry.
+        """
+        ref = self.deployment_ref
+        for attempt in range(max_retries):
+            try:
+                existing = self.kube.get(ref)
+            except NotFound:
+                try:
+                    self.kube.create(ref, manifest)
+                    self.log.info("Applied initial SeldonDeployment.")
+                    return
+                except Conflict:
+                    continue  # lost a create race; re-get and replace
+            else:
+                body = dict(manifest)
+                meta = dict(body.get("metadata") or {})
+                rv = (existing.get("metadata") or {}).get("resourceVersion")
+                if rv:
+                    meta["resourceVersion"] = rv
+                body["metadata"] = meta
+                try:
+                    self.kube.replace(ref, body)
+                    return
+                except Conflict:
+                    if attempt == max_retries - 1:
+                        raise
+                    continue
+        raise ApiError(409, f"could not apply {ref.plural}/{ref.name} after retries")
+
+    def _ensure_deployment(
+        self, obj: dict, config: OperatorConfig, state: PromotionState
+    ) -> None:
+        """Self-heal: recreate the deployment if it was deleted out-of-band.
+
+        The reference cannot do this — it only writes on version change — so
+        a deleted SeldonDeployment stays gone until the next alias move.
+        """
+        if state.current_version is None:
+            return
+        try:
+            self.kube.get(self.deployment_ref)
+        except NotFound:
+            self.log.warning("SeldonDeployment missing; recreating (self-heal).")
+            self._apply_for_state(obj, config, state)
+
+    def _delete_deployment(self) -> None:
+        """Reference ``delete_seldon_deployment`` (:462-477): 404 tolerated."""
+        try:
+            self.kube.delete(self.deployment_ref)
+            self.log.info(f"SeldonDeployment '{self.name}' deleted.")
+        except NotFound:
+            pass
+
+    def _patch_status(self, state: PromotionState) -> None:
+        try:
+            self.kube.patch_status(self.cr_ref, state.to_status())
+        except NotFound:
+            # CR deleted mid-step; runtime will stop this reconciler.
+            self.log.info("CR gone; skipping status patch.")

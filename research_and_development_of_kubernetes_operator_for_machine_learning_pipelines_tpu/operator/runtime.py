@@ -1,0 +1,205 @@
+"""Operator runtime: watches ``MlflowModel`` CRs and drives reconcilers.
+
+Replaces kopf's role in the reference (``@kopf.on.create``/``on.update``,
+``mlflow_operator.py:26-27``) with an explicit scheduler:
+
+- one ``Reconciler`` per CR, created/removed as CRs appear/disappear;
+- each reconcile step returns ``requeue_after``; the runtime maintains a
+  per-resource due time instead of per-handler sleep loops — so N edits to a
+  CR never spawn N competing monitors (fixes SURVEY §3.5(1));
+- CR deletion stops the reconciler and deletes its data plane (the reference
+  has no delete handler and leans entirely on ownerReferences GC;
+  we do both — GC in-cluster via ownerReferences, explicit delete here so
+  fakes and non-GC stores behave identically);
+- reconcile errors back off exponentially instead of killing the handler
+  (the reference's unhandled exceptions end monitoring forever, §5).
+
+Deterministic by construction: with a ``FakeClock`` the test advances time
+and calls ``run_until_idle``; with the ``SystemClock`` ``serve`` runs a real
+loop.  If kopf *is* installed, ``kopf_adapter`` (separate module) bridges
+events into this same runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+from ..clients.base import (
+    KubeClient,
+    MetricsSource,
+    NotFound,
+    ObjectRef,
+    RegistryClient,
+    MLFLOWMODEL,
+    SELDONDEPLOYMENT,
+)
+from ..utils.clock import Clock, FakeClock, SystemClock
+from .reconciler import Reconciler
+
+_log = logging.getLogger(__name__)
+
+_MAX_BACKOFF_S = 300.0
+
+
+@dataclass
+class _Entry:
+    reconciler: Reconciler
+    due_at: float
+    failures: int = 0
+
+
+class OperatorRuntime:
+    def __init__(
+        self,
+        kube: KubeClient,
+        registry: RegistryClient,
+        metrics: MetricsSource,
+        clock: Clock | None = None,
+        namespace: str = "",
+        sync_interval_s: float = 5.0,
+    ):
+        self.kube = kube
+        self.registry = registry
+        self.metrics = metrics
+        self.clock = clock or SystemClock()
+        self.namespace = namespace
+        self.sync_interval_s = sync_interval_s
+        self._entries: dict[tuple[str, str], _Entry] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+
+    # -- discovery -----------------------------------------------------------
+
+    def _list_ref(self) -> ObjectRef:
+        return ObjectRef(namespace=self.namespace, name="", **MLFLOWMODEL)
+
+    def sync(self) -> None:
+        """Reconcile the set of reconcilers with the set of CRs."""
+        with self._lock:
+            seen: set[tuple[str, str]] = set()
+            for obj in self.kube.list(self._list_ref()):
+                meta = obj.get("metadata") or {}
+                key = (meta.get("namespace", "default"), meta.get("name", ""))
+                seen.add(key)
+                if key not in self._entries:
+                    ns, name = key
+                    _log.info("tracking MlflowModel %s/%s", ns, name)
+                    self._entries[key] = _Entry(
+                        reconciler=Reconciler(
+                            name=name,
+                            namespace=ns,
+                            kube=self.kube,
+                            registry=self.registry,
+                            metrics=self.metrics,
+                            clock=self.clock,
+                        ),
+                        due_at=self.clock.now(),  # reconcile promptly
+                    )
+            for key in list(self._entries):
+                if key not in seen:
+                    ns, name = key
+                    _log.info("MlflowModel %s/%s deleted; tearing down", ns, name)
+                    entry = self._entries.pop(key)
+                    try:
+                        entry.reconciler._delete_deployment()
+                    except Exception:
+                        _log.exception("teardown of %s/%s failed", ns, name)
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self) -> float | None:
+        """Run every due reconciler once.
+
+        Returns seconds until the next entry is due (None if no entries).
+        Never raises: API-server outages (during discovery or reconcile)
+        back off instead of killing the runtime — the reference's unhandled
+        exceptions silently end monitoring forever (SURVEY §5).
+        """
+        try:
+            self.sync()
+        except Exception:
+            _log.exception("CR discovery failed; retrying next step")
+        now = self.clock.now()
+        with self._lock:
+            due = [(k, e) for k, e in self._entries.items() if e.due_at <= now]
+        for key, entry in due:
+            ns, name = key
+            try:
+                obj = self.kube.get(
+                    ObjectRef(namespace=ns, name=name, **MLFLOWMODEL)
+                )
+                outcome = entry.reconciler.reconcile(dict(obj))
+                entry.failures = 0
+                entry.due_at = self.clock.now() + max(0.0, outcome.requeue_after)
+            except NotFound:
+                continue  # sync() on the next step removes it
+            except Exception:
+                entry.failures += 1
+                backoff = min(_MAX_BACKOFF_S, 2.0 ** entry.failures)
+                entry.due_at = self.clock.now() + backoff
+                _log.exception(
+                    "reconcile of %s/%s failed (attempt %d), backing off %.0fs",
+                    ns,
+                    name,
+                    entry.failures,
+                    backoff,
+                )
+        with self._lock:
+            if not self._entries:
+                return None
+            return max(0.0, min(e.due_at for e in self._entries.values()) - self.clock.now())
+
+    # -- loops ---------------------------------------------------------------
+
+    def run_until_idle(self, max_wall: float = 3600.0, max_steps: int = 10_000) -> None:
+        """Test loop for ``FakeClock``: step, then jump the clock to the next
+        due time, until nothing is due within ``max_wall`` fake-seconds."""
+        if not isinstance(self.clock, FakeClock):
+            raise TypeError("run_until_idle requires a FakeClock")
+        deadline = self.clock.now() + max_wall
+        for _ in range(max_steps):
+            delay = self.step()
+            if delay is None:
+                return
+            if delay > 0:
+                if self.clock.now() + delay > deadline:
+                    return
+                self.clock.advance(delay)
+        raise RuntimeError("run_until_idle did not settle (livelock?)")
+
+    def run_for(self, fake_seconds: float, max_steps: int = 10_000) -> None:
+        """Advance a ``FakeClock`` by ``fake_seconds``, stepping as entries
+        come due."""
+        if not isinstance(self.clock, FakeClock):
+            raise TypeError("run_for requires a FakeClock")
+        deadline = self.clock.now() + fake_seconds
+        for _ in range(max_steps):
+            delay = self.step()
+            remaining = deadline - self.clock.now()
+            if remaining <= 0:
+                return
+            if delay is None:
+                self.clock.advance(remaining)
+                return
+            self.clock.advance(min(delay, remaining) if delay > 0 else 0)
+            if delay == 0:
+                continue
+        raise RuntimeError("run_for did not settle (livelock?)")
+
+    def serve(self) -> None:
+        """Real-time loop (SystemClock)."""
+        _log.info("operator runtime serving (namespace=%r)", self.namespace or "*")
+        while not self._stop.is_set():
+            try:
+                delay = self.step()
+            except Exception:  # belt and braces: serve() must never die
+                _log.exception("runtime step failed")
+                delay = self.sync_interval_s
+            sleep_for = self.sync_interval_s if delay is None else min(delay, self.sync_interval_s)
+            self._stop.wait(max(0.05, sleep_for))
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,199 @@
+"""Serializable canary-promotion state.
+
+The reference keeps promotion progress in local variables of a blocking
+loop (``traffic_current``/``traffic_prev``/``attempt`` at
+``mlflow_operator.py:184-191,:296-352``); an operator restart mid-promotion
+freezes the traffic split forever (SURVEY §3.5(2)).  The rebuild makes the
+entire promotion a value: ``PromotionState`` round-trips through the CR
+status subresource, so any operator instance can pick up a rollout exactly
+where it stopped.
+
+Status keys keep the reference's names where they exist
+(``currentModelVersion`` / ``previousModelVersion`` / ``error``,
+``crd.yaml:26-37``) and add the promotion-progress fields the reference
+never persisted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Mapping
+
+
+class Phase(str, Enum):
+    """Rollout lifecycle.
+
+    IDLE        — no version deployed yet (fresh CR).
+    STABLE      — one version at 100% traffic, monitoring the alias.
+    CANARY      — two predictors live, traffic shifting under the gate.
+    FAILED      — gate failed max_attempts times and rollback is disabled:
+                  weights frozen at last split (reference behavior,
+                  ``mlflow_operator.py:342-349``).
+    ROLLED_BACK — gate failed and rollback restored 100% to the old version
+                  (the reference's TODO at ``:345``, implemented).
+    ERROR       — alias missing: deployment torn down, error recorded
+                  (``:64-93``); self-heals when the alias reappears.
+    """
+
+    IDLE = "Idle"
+    STABLE = "Stable"
+    CANARY = "Canary"
+    FAILED = "Failed"
+    ROLLED_BACK = "RolledBack"
+    ERROR = "Error"
+
+
+@dataclass(frozen=True)
+class PromotionState:
+    phase: Phase = Phase.IDLE
+    current_version: str | None = None
+    previous_version: str | None = None
+    traffic_current: int = 0  # % of traffic on current_version
+    traffic_prev: int = 0  # % of traffic on previous_version
+    attempt: int = 0  # consecutive gate failures at this traffic level
+    held_version: str | None = None  # version blocked after FAILED/ROLLED_BACK
+    error: str | None = None
+
+    # -- transitions (pure; each returns a new state) -----------------------
+
+    def with_(self, **kw: Any) -> "PromotionState":
+        return dataclasses.replace(self, **kw)
+
+    def alias_missing(self, alias: str) -> "PromotionState":
+        """Reference ``:64-93``: error status, versions cleared."""
+        return PromotionState(
+            phase=Phase.ERROR,
+            error=f"Alias '{alias}' does not exist",
+        )
+
+    def new_version(self, version: str, initial_traffic: int) -> "PromotionState":
+        """A different version now carries the alias (reference ``:97-122``).
+
+        With no prior version the new one takes 100% immediately
+        (``:188-191``); otherwise start a canary at ``initial_traffic``
+        (reference hardcodes 10, ``:187``).
+
+        The canary's baseline is the version *currently carrying the majority
+        of traffic* — not blindly ``current_version`` as in the reference
+        (``:101``).  Mid-canary or after a FAILED freeze, ``current_version``
+        is an unproven canary at minority traffic; using it as the baseline
+        would hand ~90% of traffic to a version that never earned it and
+        drop the proven stable version entirely.
+        """
+        if self.current_version is None or self.phase in (Phase.IDLE, Phase.ERROR):
+            return PromotionState(
+                phase=Phase.STABLE,
+                current_version=version,
+                previous_version=None,
+                traffic_current=100,
+                traffic_prev=0,
+            )
+        if (
+            self.previous_version is not None
+            and self.traffic_prev >= self.traffic_current
+        ):
+            baseline = self.previous_version
+        else:
+            baseline = self.current_version
+        if version == baseline:
+            # Alias moved back to the proven version (e.g. reverting a bad
+            # release): no canary needed, it is already trusted.
+            return PromotionState(
+                phase=Phase.STABLE,
+                current_version=version,
+                previous_version=None,
+                traffic_current=100,
+                traffic_prev=0,
+            )
+        return PromotionState(
+            phase=Phase.CANARY,
+            current_version=version,
+            previous_version=baseline,
+            traffic_current=initial_traffic,
+            traffic_prev=100 - initial_traffic,
+            attempt=0,
+        )
+
+    def promoted_step(self, step: int) -> "PromotionState":
+        """Gate passed: shift ``step`` % to the canary (reference ``:311-327``)."""
+        new_cur = min(self.traffic_current + step, 100)
+        new_prev = max(self.traffic_prev - step, 0)
+        if new_cur >= 100:
+            return self.with_(
+                phase=Phase.STABLE,
+                traffic_current=100,
+                traffic_prev=0,
+                previous_version=None,
+                attempt=0,
+            )
+        return self.with_(traffic_current=new_cur, traffic_prev=new_prev, attempt=0)
+
+    def gate_failed(self) -> "PromotionState":
+        return self.with_(attempt=self.attempt + 1)
+
+    def halt_failed(self) -> "PromotionState":
+        """Max attempts exhausted, rollback disabled: freeze (ref ``:342-349``)."""
+        return self.with_(phase=Phase.FAILED, held_version=self.current_version)
+
+    def rolled_back(self) -> "PromotionState":
+        """Max attempts exhausted, rollback enabled: old version back to 100%."""
+        return PromotionState(
+            phase=Phase.ROLLED_BACK,
+            current_version=self.previous_version,
+            previous_version=None,
+            traffic_current=100,
+            traffic_prev=0,
+            held_version=self.current_version,
+        )
+
+    # -- serialization ------------------------------------------------------
+
+    def to_status(self) -> dict[str, Any]:
+        return {
+            "phase": self.phase.value,
+            "currentModelVersion": self.current_version,
+            "previousModelVersion": self.previous_version,
+            "trafficCurrent": self.traffic_current,
+            "trafficPrev": self.traffic_prev,
+            "attempt": self.attempt,
+            "heldVersion": self.held_version,
+            "error": self.error,
+        }
+
+    @classmethod
+    def from_status(cls, status: Mapping[str, Any] | None) -> "PromotionState":
+        if not status:
+            return cls()
+        phase_raw = status.get("phase")
+        current = status.get("currentModelVersion")
+        try:
+            if phase_raw is not None:
+                Phase(phase_raw)
+        except ValueError:
+            # Unknown phase string (written by a newer/older operator):
+            # fall through to reference-status adoption below.
+            phase_raw = None
+        if phase_raw is None:
+            # Status written by the reference operator (versions only,
+            # crd.yaml:26-37): infer a stable single-version deployment so
+            # the rebuild can adopt in-place.
+            phase = Phase.STABLE if current else Phase.IDLE
+            return cls(
+                phase=phase,
+                current_version=current,
+                previous_version=status.get("previousModelVersion"),
+                traffic_current=100 if current else 0,
+                error=status.get("error"),
+            )
+        return cls(
+            phase=Phase(phase_raw),
+            current_version=current,
+            previous_version=status.get("previousModelVersion"),
+            traffic_current=int(status.get("trafficCurrent") or 0),
+            traffic_prev=int(status.get("trafficPrev") or 0),
+            attempt=int(status.get("attempt") or 0),
+            held_version=status.get("heldVersion"),
+            error=status.get("error"),
+        )

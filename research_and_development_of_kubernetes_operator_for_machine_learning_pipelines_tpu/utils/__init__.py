@@ -1,0 +1,21 @@
+"""Cross-cutting utilities: clocks, configuration, logging, tracing."""
+
+from .clock import Clock, FakeClock, SystemClock
+from .config import (
+    CanaryPolicy,
+    GateThresholds,
+    OperatorConfig,
+    ServerConfig,
+    TpuSpec,
+)
+
+__all__ = [
+    "Clock",
+    "FakeClock",
+    "SystemClock",
+    "CanaryPolicy",
+    "GateThresholds",
+    "OperatorConfig",
+    "ServerConfig",
+    "TpuSpec",
+]

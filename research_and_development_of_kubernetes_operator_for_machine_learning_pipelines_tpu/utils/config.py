@@ -1,0 +1,240 @@
+"""Typed configuration parsed from the ``MlflowModel`` CRD spec.
+
+The reference hardcodes every operating parameter as a constant —
+Prometheus URL (``mlflow_operator.py:47``), artifact bucket root
+(``:125``), gate thresholds (``:175-179``), canary step/interval/attempts
+(``:290-294``) — which SURVEY.md §3.5(5) flags as a rebuild obligation.
+Here every one of those constants becomes a spec field with the reference
+value as its default, so an unannotated CR behaves exactly like the
+reference while everything is tunable per-model.
+
+New TPU-native spec fields (north star): ``backend``, ``tpuTopology``,
+``meshShape``, plus server batching knobs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+# Reference defaults (file:line cites into /root/reference/mlflow_operator.py)
+DEFAULT_MONITORING_INTERVAL_S = 60  # :31
+DEFAULT_ARTIFACT_ROOT = "s3://mlflow"  # :125
+DEFAULT_PROMETHEUS_URL = (
+    "http://seldon-monitoring-prometheus.seldon-monitoring.svc.cluster.local:9090"  # :47
+)
+DEFAULT_TRAFFIC_STEP = 10  # :291
+DEFAULT_STEP_INTERVAL_S = 60  # :292
+DEFAULT_MAX_ATTEMPTS = 10  # :293
+DEFAULT_ATTEMPT_DELAY_S = 10  # :294
+DEFAULT_INITIAL_CANARY_TRAFFIC = 10  # :187
+DEFAULT_METRICS_WINDOW_S = 60  # :363 (elapsed_time=60)
+
+# Canonical TPU topology table: CRD tpuTopology value -> (GKE accelerator
+# label, GKE topology label, chip count).  Chip count must equal the mesh
+# device count or the pod's google.com/tpu request is unschedulable.
+TPU_TOPOLOGIES: dict[str, tuple[str, str, int]] = {
+    "v5e-1": ("tpu-v5-lite-podslice", "1x1", 1),
+    "v5e-4": ("tpu-v5-lite-podslice", "2x2", 4),
+    "v5e-8": ("tpu-v5-lite-podslice", "2x4", 8),
+    "v5e-16": ("tpu-v5-lite-podslice", "4x4", 16),
+}
+
+
+@dataclass(frozen=True)
+class GateThresholds:
+    """Relative regression tolerances for the promotion gate.
+
+    Semantics match ``should_promote_model`` (``mlflow_operator.py:175-179``):
+    promote only if new <= old * (1 + threshold) for each metric.
+
+    Hardening extensions beyond the reference (SURVEY §3.5(4)):
+
+    - ``min_sample_count``: both predictors must have served at least this
+      many requests in the window before the gate will pass; avoids judging
+      on noise.  0 keeps reference behavior (any non-None metric counts).
+    - ``error_rate_floor``: absolute error-rate slack.  The reference's
+      purely relative check (``:447``) deadlocks when the old model has 0
+      errors: a single canary error fails ``new <= 0 * 1.02``.  With a
+      floor f, the gate passes if ``new_err <= max(old_err * (1+tol), f)``.
+      0.0 keeps reference behavior.
+    """
+
+    latency_p95: float = 0.05  # :176
+    error_rate: float = 0.02  # :177
+    latency_avg: float = 0.05  # :178
+    min_sample_count: int = 0
+    error_rate_floor: float = 0.0
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "GateThresholds":
+        spec = spec or {}
+        return cls(
+            latency_p95=float(spec.get("latencyP95", spec.get("latency_95th", 0.05))),
+            error_rate=float(spec.get("errorRate", 0.02)),
+            latency_avg=float(spec.get("latencyAvg", 0.05)),
+            min_sample_count=int(spec.get("minSampleCount", 0)),
+            error_rate_floor=float(spec.get("errorRateFloor", 0.0)),
+        )
+
+
+@dataclass(frozen=True)
+class CanaryPolicy:
+    """Traffic-shifting schedule (reference constants at
+    ``mlflow_operator.py:290-294``) plus rollback policy.
+
+    ``rollback_on_failure=False`` reproduces the reference, which stops and
+    leaves weights frozen after ``max_attempts`` gate failures (the rollback
+    is an acknowledged TODO at ``:345``).  True enables the real
+    rollback-on-SLO-breach path (north-star requirement).
+    """
+
+    step: int = DEFAULT_TRAFFIC_STEP
+    step_interval_s: float = DEFAULT_STEP_INTERVAL_S
+    max_attempts: int = DEFAULT_MAX_ATTEMPTS
+    attempt_delay_s: float = DEFAULT_ATTEMPT_DELAY_S
+    initial_traffic: int = DEFAULT_INITIAL_CANARY_TRAFFIC
+    metrics_window_s: int = DEFAULT_METRICS_WINDOW_S
+    rollback_on_failure: bool = False
+    warmup_requests: int = 0  # synthetic warm-up traffic per predictor (0 = off)
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "CanaryPolicy":
+        spec = spec or {}
+        return cls(
+            step=int(spec.get("step", DEFAULT_TRAFFIC_STEP)),
+            step_interval_s=float(spec.get("stepInterval", DEFAULT_STEP_INTERVAL_S)),
+            max_attempts=int(spec.get("maxAttempts", DEFAULT_MAX_ATTEMPTS)),
+            attempt_delay_s=float(spec.get("attemptDelay", DEFAULT_ATTEMPT_DELAY_S)),
+            initial_traffic=int(spec.get("initialTraffic", DEFAULT_INITIAL_CANARY_TRAFFIC)),
+            metrics_window_s=int(spec.get("metricsWindow", DEFAULT_METRICS_WINDOW_S)),
+            rollback_on_failure=bool(spec.get("rollbackOnFailure", False)),
+            warmup_requests=int(spec.get("warmupRequests", 0)),
+        )
+
+    def __post_init__(self):
+        if not (0 < self.step <= 100):
+            raise ValueError(f"canary step must be in (0, 100], got {self.step}")
+        if not (0 < self.initial_traffic <= 100):
+            raise ValueError(
+                f"initialTraffic must be in (0, 100], got {self.initial_traffic}"
+            )
+        if self.max_attempts < 1:
+            raise ValueError("maxAttempts must be >= 1")
+
+
+@dataclass(frozen=True)
+class TpuSpec:
+    """TPU data-plane placement and sharding (north-star CRD additions).
+
+    ``mesh_shape`` maps logical mesh axis names to sizes, e.g.
+    ``{"dp": 1, "tp": 8}`` for a Llama-2-7B tensor-sharded across a v5e-8
+    slice.  ``topology`` selects the node pool (e.g. ``v5e-8``); the builder
+    turns it into nodeSelector/toleration entries.
+    """
+
+    topology: str = "v5e-8"
+    mesh_shape: Mapping[str, int] = field(default_factory=lambda: {"dp": 1, "tp": 8})
+    replicas: int = 1
+    dtype: str = "bfloat16"
+    max_batch_size: int = 32
+    max_batch_delay_ms: float = 5.0
+    compile_cache_dir: str | None = "/tmp/jax_compile_cache"
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
+        spec = spec or {}
+        mesh = dict(spec.get("meshShape") or {"dp": 1, "tp": 8})
+        return cls(
+            topology=str(spec.get("tpuTopology", "v5e-8")),
+            mesh_shape=mesh,
+            replicas=int(spec.get("replicas", 1)),
+            dtype=str(spec.get("dtype", "bfloat16")),
+            max_batch_size=int(spec.get("maxBatchSize", 32)),
+            max_batch_delay_ms=float(spec.get("maxBatchDelayMs", 5.0)),
+            compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
+        )
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for v in self.mesh_shape.values():
+            n *= int(v)
+        return n
+
+
+@dataclass(frozen=True)
+class ServerConfig:
+    """Config for one inference-server process (the data plane)."""
+
+    model_name: str = "model"
+    model_uri: str = ""
+    predictor_name: str = "v1"
+    deployment_name: str = ""
+    namespace: str = "default"
+    host: str = "0.0.0.0"
+    port: int = 9000
+    metrics_port: int = 6000
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+
+
+@dataclass(frozen=True)
+class OperatorConfig:
+    """Full parsed ``MlflowModel`` spec.
+
+    Reference spec fields (``crd.yaml:17-25``): ``modelName``, ``modelAlias``,
+    ``monitoringInterval``, ``minioSecret``.  Everything else is a rebuild
+    addition with reference-equivalent defaults.
+    """
+
+    model_name: str
+    model_alias: str
+    monitoring_interval_s: float = DEFAULT_MONITORING_INTERVAL_S
+    minio_secret: str | None = None
+    backend: str = "seldon"  # "seldon" (reference parity) | "tpu" (first-party)
+    artifact_root: str = DEFAULT_ARTIFACT_ROOT
+    prometheus_url: str = DEFAULT_PROMETHEUS_URL
+    thresholds: GateThresholds = field(default_factory=GateThresholds)
+    canary: CanaryPolicy = field(default_factory=CanaryPolicy)
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+    server_image: str = "tpumlops/jax-server:latest"
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
+        model_name = spec.get("modelName")
+        model_alias = spec.get("modelAlias")
+        if not model_name or not model_alias:
+            raise ValueError("spec.modelName and spec.modelAlias are required")
+        backend = str(spec.get("backend", "seldon"))
+        if backend not in ("seldon", "tpu"):
+            raise ValueError(f"spec.backend must be 'seldon' or 'tpu', got {backend!r}")
+        tpu = TpuSpec.from_spec(spec.get("tpu"))
+        if backend == "tpu":
+            info = TPU_TOPOLOGIES.get(tpu.topology)
+            if info is None:
+                raise ValueError(
+                    f"unknown tpuTopology {tpu.topology!r}; known: "
+                    f"{sorted(TPU_TOPOLOGIES)}"
+                )
+            if tpu.num_devices != info[2]:
+                raise ValueError(
+                    f"meshShape {dict(tpu.mesh_shape)} uses {tpu.num_devices} "
+                    f"devices but tpuTopology {tpu.topology!r} provides "
+                    f"{info[2]} chips; they must match or the pod is "
+                    "unschedulable"
+                )
+        return cls(
+            model_name=str(model_name),
+            model_alias=str(model_alias),
+            monitoring_interval_s=float(
+                spec.get("monitoringInterval", DEFAULT_MONITORING_INTERVAL_S)
+            ),
+            minio_secret=spec.get("minioSecret"),
+            backend=backend,
+            artifact_root=str(spec.get("artifactRoot", DEFAULT_ARTIFACT_ROOT)),
+            prometheus_url=str(spec.get("prometheusUrl", DEFAULT_PROMETHEUS_URL)),
+            thresholds=GateThresholds.from_spec(spec.get("thresholds")),
+            canary=CanaryPolicy.from_spec(spec.get("canary")),
+            tpu=tpu,
+            server_image=str(spec.get("serverImage", "tpumlops/jax-server:latest")),
+        )

@@ -1,0 +1,30 @@
+"""Logging helpers.
+
+The reference configures global INFO logging at import time
+(``mlflow_operator.py:16``) and creates one child logger per model named
+``f"{name}-{namespace}"`` (``:38-41``), prefixing messages with
+``[namespace/name]``.  We keep the per-resource logger convention but make
+the prefix part of the logger itself.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+class _PrefixAdapter(logging.LoggerAdapter):
+    def process(self, msg, kwargs):
+        return f"{self.extra['resource']} {msg}", kwargs
+
+
+def model_logger(name: str, namespace: str) -> logging.LoggerAdapter:
+    """Per-resource logger with the reference's ``[ns/name]`` message prefix."""
+    base = logging.getLogger(f"tpumlops.{namespace}.{name}")
+    return _PrefixAdapter(base, {"resource": f"[{namespace}/{name}]"})
+
+
+def configure(level: int = logging.INFO) -> None:
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )

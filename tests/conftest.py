@@ -1,0 +1,17 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before the first ``import jax`` anywhere in the test session so
+``pjit``/sharding paths are exercised exactly as they would be on a v5e-8
+slice (SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep test-time compiles fast and deterministic.
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,119 @@
+"""Manifest builder: seldon-backend parity (mlflow_operator.py:193-238) and
+the tpu-backend first-party data plane."""
+
+import pytest
+
+from tpumlops.operator.builder import build_deployment, set_traffic
+from tpumlops.utils.config import OperatorConfig
+
+
+def cfg(**extra):
+    return OperatorConfig.from_spec(
+        {"modelName": "iris", "modelAlias": "champion", "minioSecret": "minio-creds", **extra}
+    )
+
+
+def two_version_manifest(config=None):
+    return build_deployment(
+        name="iris",
+        namespace="models",
+        owner_uid="uid-123",
+        config=config or cfg(),
+        current_version="2",
+        new_model_uri="s3://mlflow/1/bbb/artifacts/model",
+        traffic_current=10,
+        previous_version="1",
+        old_model_uri="s3://mlflow/1/aaa/artifacts/model",
+        traffic_prev=90,
+    )
+
+
+def test_seldon_manifest_parity_shape():
+    sd = two_version_manifest()
+    assert sd["apiVersion"] == "machinelearning.seldon.io/v1"
+    assert sd["kind"] == "SeldonDeployment"
+    assert sd["spec"]["protocol"] == "kfserving"  # reference :235
+    assert sd["metadata"]["ownerReferences"][0] == {
+        "apiVersion": "mlflow.nizepart.com/v1alpha1",
+        "kind": "MlflowModel",
+        "name": "iris",
+        "uid": "uid-123",
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }  # reference :162-169
+    # Predictor order: previous first, current second (ref :181-222).
+    prev, cur = sd["spec"]["predictors"]
+    assert prev["name"] == "v1" and prev["traffic"] == 90
+    assert cur["name"] == "v2" and cur["traffic"] == 10
+    assert cur["graph"]["name"] == "classifier-2"
+    assert cur["graph"]["implementation"] == "MLFLOW_SERVER"
+    assert cur["graph"]["modelUri"] == "s3://mlflow/1/bbb/artifacts/model"
+    assert cur["graph"]["envSecretRefName"] == "minio-creds"
+    assert cur["replicas"] == 1
+
+
+def test_single_version_manifest():
+    sd = build_deployment(
+        name="iris",
+        namespace="models",
+        owner_uid="u",
+        config=cfg(),
+        current_version="1",
+        new_model_uri="s3://mlflow/1/aaa/artifacts/model",
+        traffic_current=100,
+    )
+    assert len(sd["spec"]["predictors"]) == 1
+    assert sd["spec"]["predictors"][0]["traffic"] == 100
+
+
+def test_old_uri_required_with_previous_version():
+    with pytest.raises(ValueError):
+        build_deployment(
+            name="iris",
+            namespace="models",
+            owner_uid="u",
+            config=cfg(),
+            current_version="2",
+            new_model_uri="s3://x",
+            traffic_current=10,
+            previous_version="1",
+            traffic_prev=90,
+        )
+
+
+def test_tpu_manifest_places_on_v5e_pool():
+    config = cfg(backend="tpu", tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 8}})
+    sd = two_version_manifest(config)
+    assert sd["spec"]["protocol"] == "v2"
+    cur = sd["spec"]["predictors"][1]
+    pod = cur["componentSpecs"][0]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    container = pod["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"
+    args = " ".join(container["args"])
+    assert "--model-uri s3://mlflow/1/bbb/artifacts/model" in args
+    assert "--predictor-name v2" in args
+    # Metric identity must match the gate's PromQL labels (ref :367).
+    assert "--deployment-name iris" in args
+    assert "--namespace models" in args
+
+
+def test_tpu_unknown_topology_rejected_at_parse():
+    with pytest.raises(ValueError):
+        cfg(backend="tpu", tpu={"tpuTopology": "v99-42"})
+
+
+def test_tpu_mesh_topology_chip_mismatch_rejected():
+    # meshShape devices must equal the topology's chip count, else the
+    # google.com/tpu request can never schedule.
+    with pytest.raises(ValueError, match="must match"):
+        cfg(backend="tpu", tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 4}})
+
+
+def test_set_traffic_rewrites_weights():
+    sd = two_version_manifest()
+    sd2 = set_traffic(sd, {"v1": 80, "v2": 20})
+    assert [p["traffic"] for p in sd2["spec"]["predictors"]] == [80, 20]
+    # original untouched
+    assert [p["traffic"] for p in sd["spec"]["predictors"]] == [90, 10]

@@ -1,0 +1,76 @@
+"""Spec parsing: defaults must equal the reference's hardcoded constants."""
+
+import pytest
+
+from tpumlops.utils.config import CanaryPolicy, GateThresholds, OperatorConfig, TpuSpec
+
+
+def minimal_spec(**extra):
+    return {"modelName": "iris", "modelAlias": "champion", **extra}
+
+
+def test_defaults_match_reference_constants():
+    cfg = OperatorConfig.from_spec(minimal_spec())
+    assert cfg.monitoring_interval_s == 60  # mlflow_operator.py:31
+    assert cfg.artifact_root == "s3://mlflow"  # :125
+    assert "seldon-monitoring" in cfg.prometheus_url  # :47
+    assert cfg.canary.step == 10  # :291
+    assert cfg.canary.step_interval_s == 60  # :292
+    assert cfg.canary.max_attempts == 10  # :293
+    assert cfg.canary.attempt_delay_s == 10  # :294
+    assert cfg.canary.initial_traffic == 10  # :187
+    assert cfg.thresholds.latency_p95 == 0.05  # :176
+    assert cfg.thresholds.error_rate == 0.02  # :177
+    assert cfg.thresholds.latency_avg == 0.05  # :178
+    assert cfg.backend == "seldon"
+    assert cfg.canary.rollback_on_failure is False  # parity: TODO at :345
+
+
+def test_requires_model_name_and_alias():
+    with pytest.raises(ValueError):
+        OperatorConfig.from_spec({"modelName": "iris"})
+    with pytest.raises(ValueError):
+        OperatorConfig.from_spec({"modelAlias": "champion"})
+
+
+def test_invalid_backend_rejected():
+    with pytest.raises(ValueError):
+        OperatorConfig.from_spec(minimal_spec(backend="gpu"))
+
+
+def test_tpu_spec_parsing():
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            backend="tpu",
+            tpu={
+                "tpuTopology": "v5e-8",
+                "meshShape": {"dp": 2, "tp": 4},
+                "maxBatchSize": 64,
+            },
+        )
+    )
+    assert cfg.backend == "tpu"
+    assert cfg.tpu.topology == "v5e-8"
+    assert cfg.tpu.mesh_shape == {"dp": 2, "tp": 4}
+    assert cfg.tpu.num_devices == 8
+    assert cfg.tpu.max_batch_size == 64
+
+
+def test_canary_policy_validation():
+    with pytest.raises(ValueError):
+        CanaryPolicy(step=0)
+    with pytest.raises(ValueError):
+        CanaryPolicy(initial_traffic=0)
+    with pytest.raises(ValueError):
+        CanaryPolicy(max_attempts=0)
+
+
+def test_threshold_overrides():
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            thresholds={"latencyP95": 0.2, "errorRateFloor": 0.01, "minSampleCount": 30}
+        )
+    )
+    assert cfg.thresholds.latency_p95 == 0.2
+    assert cfg.thresholds.error_rate_floor == 0.01
+    assert cfg.thresholds.min_sample_count == 30

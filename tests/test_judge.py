@@ -1,0 +1,64 @@
+"""Promotion-gate parity with should_promote_model (mlflow_operator.py:419-460)
+plus the hardening extensions."""
+
+from tpumlops.clients.base import ModelMetrics
+from tpumlops.operator.judge import should_promote
+from tpumlops.utils.config import GateThresholds
+
+
+def m(p95=0.1, err=0.01, avg=0.05, count=100.0):
+    return ModelMetrics(
+        latency_p95=p95, error_rate=err, latency_avg=avg, request_count=count,
+        error_responses=(err or 0) * count,
+    )
+
+
+def test_promotes_when_all_within_thresholds():
+    assert should_promote(m(), m()).promote
+
+
+def test_refuses_when_any_metric_none_on_new():
+    # Reference :430-434 — both models need live traffic.
+    assert not should_promote(ModelMetrics(), m())
+
+
+def test_refuses_when_any_metric_none_on_old():
+    assert not should_promote(m(), ModelMetrics())
+    d = should_promote(m(), ModelMetrics())
+    assert any("unavailable" in r for r in d.reasons)
+
+
+def test_boundary_is_inclusive():
+    # Reference uses <= (:440,:447,:454): exactly old*(1+tol) still promotes.
+    old = m(p95=0.1, err=0.01, avg=0.05)
+    new = m(p95=0.1 * 1.05, err=0.01 * 1.02, avg=0.05 * 1.05)
+    assert should_promote(new, old).promote
+
+
+def test_p95_regression_refuses():
+    assert not should_promote(m(p95=0.2), m(p95=0.1))
+
+
+def test_error_rate_regression_refuses():
+    assert not should_promote(m(err=0.05), m(err=0.01))
+
+
+def test_avg_latency_regression_refuses():
+    assert not should_promote(m(avg=0.2), m(avg=0.05))
+
+
+def test_zero_error_baseline_deadlock_reproduced_by_default():
+    # Reference behavior: old err=0 means any canary error refuses (:447).
+    assert not should_promote(m(err=0.001), m(err=0.0))
+
+
+def test_error_rate_floor_breaks_deadlock():
+    t = GateThresholds(error_rate_floor=0.01)
+    assert should_promote(m(err=0.005), m(err=0.0), t).promote
+    assert not should_promote(m(err=0.05), m(err=0.0), t).promote
+
+
+def test_min_sample_count_gating():
+    t = GateThresholds(min_sample_count=50)
+    assert not should_promote(m(count=10), m(count=1000), t)
+    assert should_promote(m(count=60), m(count=1000), t).promote

@@ -1,0 +1,328 @@
+"""Integration tests of the canary reconciler against the fake backends
+(promote / hold / fail / rollback paths — SURVEY §4)."""
+
+import pytest
+
+from tpumlops.clients.base import (
+    MLFLOWMODEL,
+    SELDONDEPLOYMENT,
+    ModelMetrics,
+    NotFound,
+    ObjectRef,
+    RegistryError,
+)
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator.reconciler import Reconciler
+from tpumlops.operator.state import Phase, PromotionState
+from tpumlops.utils.clock import FakeClock
+
+NS = "models"
+NAME = "iris"
+
+GOOD = ModelMetrics(
+    latency_p95=0.1, error_rate=0.01, latency_avg=0.05, request_count=500
+)
+BAD = ModelMetrics(
+    latency_p95=0.5, error_rate=0.2, latency_avg=0.4, request_count=500
+)
+
+
+def cr_ref():
+    return ObjectRef(namespace=NS, name=NAME, **MLFLOWMODEL)
+
+
+def sd_ref():
+    return ObjectRef(namespace=NS, name=NAME, **SELDONDEPLOYMENT)
+
+
+def make_world(spec_extra=None):
+    kube = FakeKube()
+    registry = FakeRegistry()
+    metrics = FakeMetrics()
+    clock = FakeClock()
+    spec = {"modelName": "iris", "modelAlias": "champion", "minioSecret": "m"}
+    spec.update(spec_extra or {})
+    kube.create(
+        cr_ref(),
+        {
+            "apiVersion": "mlflow.nizepart.com/v1alpha1",
+            "kind": "MlflowModel",
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": spec,
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec = Reconciler(NAME, NS, kube, registry, metrics, clock)
+    return kube, registry, metrics, clock, rec
+
+
+def reconcile(kube, rec):
+    return rec.reconcile(kube.get(cr_ref()))
+
+
+def test_first_deploy_single_predictor_100(            ):
+    kube, registry, metrics, clock, rec = make_world()
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.STABLE
+    sd = kube.get(sd_ref())
+    assert len(sd["spec"]["predictors"]) == 1
+    assert sd["spec"]["predictors"][0]["name"] == "v1"
+    assert sd["spec"]["predictors"][0]["traffic"] == 100
+    assert sd["spec"]["predictors"][0]["graph"]["modelUri"] == (
+        "s3://mlflow/1/aaa/artifacts/model"
+    )
+    assert kube.event_reasons() == ["NewModelVersionDetected"]
+    # Status persisted for resume.
+    status = kube.get(cr_ref())["status"]
+    assert status["currentModelVersion"] == "1"
+    assert status["phase"] == "Stable"
+
+
+def test_new_version_starts_canary_90_10():
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.CANARY
+    assert out.requeue_after == 0  # straight to the first gate check
+    sd = kube.get(sd_ref())
+    names = {p["name"]: p["traffic"] for p in sd["spec"]["predictors"]}
+    assert names == {"v1": 90, "v2": 10}
+
+
+def full_promotion(kube, registry, metrics, clock, rec):
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, GOOD)
+    outcomes = []
+    for _ in range(20):
+        out = reconcile(kube, rec)
+        outcomes.append(out)
+        if out.state.phase != Phase.CANARY:
+            break
+        clock.advance(out.requeue_after)
+    return outcomes
+
+
+def test_full_promotion_to_100(            ):
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    outcomes = full_promotion(kube, registry, metrics, clock, rec)
+    final = outcomes[-1].state
+    assert final.phase == Phase.STABLE
+    assert final.current_version == "2"
+    assert final.traffic_current == 100
+    sd = kube.get(sd_ref())
+    assert [p["name"] for p in sd["spec"]["predictors"]] == ["v2"]
+    reasons = kube.event_reasons()
+    assert reasons.count("TrafficIncrease") == 8  # 10->90 in steps of 10
+    assert reasons[-1] == "PromotionComplete"
+    # Wall-time floor: 9 gated steps, first immediate, 8 waits of 60s
+    # (reference floor ~9 min at :291-296; ours is 8 intervals = 480s).
+    assert clock.now() == pytest.approx(8 * 60)
+
+
+def test_promotion_resumes_after_operator_restart():
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, GOOD)
+    reconcile(kube, rec)  # deploy canary 90/10
+    reconcile(kube, rec)  # promote to 20/80
+    status = kube.get(cr_ref())["status"]
+    assert status["trafficCurrent"] == 20
+
+    # "Restart": a brand-new reconciler (fresh process) picks up from status.
+    rec2 = Reconciler(NAME, NS, kube, registry, metrics, clock)
+    out = reconcile(kube, rec2)
+    assert out.state.traffic_current == 30  # continued, not restarted at 10
+    sd = kube.get(sd_ref())
+    weights = {p["name"]: p["traffic"] for p in sd["spec"]["predictors"]}
+    assert weights == {"v1": 70, "v2": 30}
+
+
+def test_gate_hold_retries_then_fails_frozen():
+    # Reference parity: after max_attempts failures, PromotionFailed and the
+    # split stays frozen (rollback TODO at :345).
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, BAD)
+    reconcile(kube, rec)  # canary deployed
+    out = None
+    for _ in range(10):
+        out = reconcile(kube, rec)
+        clock.advance(out.requeue_after)
+    assert out.state.phase == Phase.FAILED
+    assert out.state.held_version == "2"
+    reasons = kube.event_reasons()
+    assert "PromotionFailed" in reasons
+    assert "TrafficIncrease" not in reasons
+    sd = kube.get(sd_ref())
+    weights = {p["name"]: p["traffic"] for p in sd["spec"]["predictors"]}
+    assert weights == {"v1": 90, "v2": 10}  # frozen
+    # Held version is not redeployed on subsequent reconciles.
+    out2 = reconcile(kube, rec)
+    assert out2.state.phase == Phase.FAILED
+
+
+def test_rollback_on_slo_breach():
+    # North-star: rollback restores the old version to 100%.
+    kube, registry, metrics, clock, rec = make_world(
+        {"canary": {"rollbackOnFailure": True, "maxAttempts": 3}}
+    )
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, BAD)
+    reconcile(kube, rec)
+    out = None
+    for _ in range(3):
+        out = reconcile(kube, rec)
+        clock.advance(out.requeue_after)
+    assert out.state.phase == Phase.ROLLED_BACK
+    assert out.state.current_version == "1"
+    assert out.state.held_version == "2"
+    sd = kube.get(sd_ref())
+    assert [p["name"] for p in sd["spec"]["predictors"]] == ["v1"]
+    assert sd["spec"]["predictors"][0]["traffic"] == 100
+    assert "RollbackComplete" in kube.event_reasons()
+    # Alias still points at held version 2: do NOT redeploy it.
+    out2 = reconcile(kube, rec)
+    assert out2.state.current_version == "1"
+    # Alias moves to version 3: rollout proceeds again.
+    registry.register("iris", "3", "mlflow-artifacts:/1/ccc/artifacts/model")
+    registry.set_alias("iris", "champion", "3")
+    out3 = reconcile(kube, rec)
+    assert out3.state.phase == Phase.CANARY
+    assert out3.state.current_version == "3"
+    assert out3.state.previous_version == "1"
+
+
+def test_alias_missing_tears_down(            ):
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.drop_alias("iris", "champion")
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.ERROR
+    assert "does not exist" in out.state.error
+    with pytest.raises(NotFound):
+        kube.get(sd_ref())
+    assert "AliasNotFound" in kube.event_reasons()
+    status = kube.get(cr_ref())["status"]
+    assert status["currentModelVersion"] is None  # reference :66-71
+    # Alias reappears -> self-heals (reference keeps polling).
+    registry.set_alias("iris", "champion", "1")
+    out2 = reconcile(kube, rec)
+    assert out2.state.phase == Phase.STABLE
+    kube.get(sd_ref())
+
+
+def test_registry_outage_keeps_deployment():
+    # Improvement over reference (which tears down on ANY exception :58-93):
+    # transient transport errors keep the data plane.
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.fail_next = RegistryError("connection refused")
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.STABLE
+    kube.get(sd_ref())  # still there
+    assert "AliasNotFound" not in kube.event_reasons()
+
+
+def test_self_heal_recreates_deleted_deployment():
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    kube.delete(sd_ref())
+    reconcile(kube, rec)
+    sd = kube.get(sd_ref())
+    assert sd["spec"]["predictors"][0]["name"] == "v1"
+
+
+def test_mid_canary_new_version_supersedes():
+    # Alias moves again mid-canary: the new canary's baseline is the version
+    # still carrying the majority of traffic (v1 at 80%), NOT the unproven
+    # in-flight canary — an improvement over the reference, which would have
+    # promoted the unproven v2 to 90% (:101,:184-187).
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, GOOD)
+    reconcile(kube, rec)
+    reconcile(kube, rec)  # 20/80
+    registry.register("iris", "3", "mlflow-artifacts:/1/ccc/artifacts/model")
+    registry.set_alias("iris", "champion", "3")
+    out = reconcile(kube, rec)
+    assert out.state.current_version == "3"
+    assert out.state.previous_version == "1"
+    assert (out.state.traffic_current, out.state.traffic_prev) == (10, 90)
+
+
+def test_mid_canary_majority_canary_becomes_baseline():
+    # Once the in-flight canary has earned the majority (60/40), it IS the
+    # baseline for the next rollout.
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, GOOD)
+    reconcile(kube, rec)
+    for _ in range(5):  # 20,30,40,50,60
+        reconcile(kube, rec)
+    registry.register("iris", "3", "mlflow-artifacts:/1/ccc/artifacts/model")
+    registry.set_alias("iris", "champion", "3")
+    out = reconcile(kube, rec)
+    assert out.state.previous_version == "2"
+
+
+def test_alias_reverts_to_stable_version_no_canary():
+    # FAILED canary frozen at 10/90; alias reverts to the proven v1:
+    # no self-canary, straight back to stable.
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, BAD)
+    reconcile(kube, rec)
+    out = None
+    for _ in range(10):
+        out = reconcile(kube, rec)
+        clock.advance(out.requeue_after)
+    assert out.state.phase == Phase.FAILED
+    registry.set_alias("iris", "champion", "1")
+    out2 = reconcile(kube, rec)
+    assert out2.state.phase == Phase.STABLE
+    assert out2.state.current_version == "1"
+    sd = kube.get(sd_ref())
+    assert [p["name"] for p in sd["spec"]["predictors"]] == ["v1"]
+
+
+def test_invalid_spec_surfaces_on_status():
+    kube, registry, metrics, clock, rec = make_world()
+    reconcile(kube, rec)
+    # Break the spec in place.
+    ref = cr_ref()
+    obj = kube.get(ref)
+    obj["spec"]["backend"] = "gpu"
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(ref, obj)
+    out = reconcile(kube, rec)
+    status = kube.get(ref)["status"]
+    assert "invalid spec" in status["error"]
+    assert "InvalidSpec" in kube.event_reasons()
+    kube.get(sd_ref())  # data plane NOT torn down by a spec typo
+    # Retry does not re-emit the same event.
+    reconcile(kube, rec)
+    assert kube.event_reasons().count("InvalidSpec") == 1

@@ -1,0 +1,124 @@
+"""Operator runtime: scheduling, multi-resource interleaving, teardown
+(the §3.5(1) fix — no per-handler infinite loops)."""
+
+from tpumlops.clients.base import (
+    MLFLOWMODEL,
+    SELDONDEPLOYMENT,
+    ModelMetrics,
+    NotFound,
+    ObjectRef,
+)
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator.runtime import OperatorRuntime
+from tpumlops.operator.state import Phase
+from tpumlops.utils.clock import FakeClock
+
+import pytest
+
+GOOD = ModelMetrics(latency_p95=0.1, error_rate=0.01, latency_avg=0.05, request_count=500)
+
+
+def make_cr(kube, name, ns="models", spec_extra=None):
+    spec = {"modelName": name, "modelAlias": "champion"}
+    spec.update(spec_extra or {})
+    kube.create(
+        ObjectRef(namespace=ns, name=name, **MLFLOWMODEL),
+        {
+            "apiVersion": "mlflow.nizepart.com/v1alpha1",
+            "kind": "MlflowModel",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": spec,
+        },
+    )
+
+
+def test_runtime_full_canary_with_fake_clock():
+    kube, registry, metrics, clock = FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock()
+    make_cr(kube, "iris")
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rt = OperatorRuntime(kube, registry, metrics, clock)
+
+    rt.step()  # initial deploy
+    sd_ref = ObjectRef(namespace="models", name="iris", **SELDONDEPLOYMENT)
+    assert kube.get(sd_ref)["spec"]["predictors"][0]["traffic"] == 100
+
+    registry.register("iris", "2", "mlflow-artifacts:/1/b/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics("iris", "v1", "models", GOOD)
+    metrics.set_metrics("iris", "v2", "models", GOOD)
+
+    # Version poll fires after monitoringInterval (60s), then the canary
+    # takes 8 x 60s of step intervals: run 10 fake minutes.
+    rt.run_for(10 * 60)
+    sd = kube.get(sd_ref)
+    assert [p["name"] for p in sd["spec"]["predictors"]] == ["v2"]
+    status = kube.get(ObjectRef(namespace="models", name="iris", **MLFLOWMODEL))["status"]
+    assert status["phase"] == Phase.STABLE.value
+    assert kube.event_reasons()[-1] == "PromotionComplete"
+
+
+def test_runtime_interleaves_multiple_resources():
+    kube, registry, metrics, clock = FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock()
+    for name in ("iris", "bert"):
+        make_cr(kube, name)
+        registry.register(name, "1", f"mlflow-artifacts:/1/{name}/artifacts/model")
+        registry.set_alias(name, "champion", "1")
+    rt = OperatorRuntime(kube, registry, metrics, clock)
+    rt.step()
+    for name in ("iris", "bert"):
+        sd = kube.get(ObjectRef(namespace="models", name=name, **SELDONDEPLOYMENT))
+        assert sd["spec"]["predictors"][0]["traffic"] == 100
+
+
+def test_cr_deletion_tears_down_data_plane():
+    kube, registry, metrics, clock = FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock()
+    make_cr(kube, "iris")
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rt = OperatorRuntime(kube, registry, metrics, clock)
+    rt.step()
+    kube.delete(ObjectRef(namespace="models", name="iris", **MLFLOWMODEL))
+    rt.step()
+    with pytest.raises(NotFound):
+        kube.get(ObjectRef(namespace="models", name="iris", **SELDONDEPLOYMENT))
+
+
+def test_reconcile_error_backs_off_not_crashes():
+    kube, registry, metrics, clock = FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock()
+    make_cr(kube, "iris", spec_extra={"modelName": None})  # invalid spec -> ValueError
+    rt = OperatorRuntime(kube, registry, metrics, clock)
+    delay = rt.step()  # must not raise
+    assert delay is not None and delay > 0
+    ref = ObjectRef(namespace="models", name="iris", **MLFLOWMODEL)
+    assert "invalid spec" in kube.get(ref)["status"]["error"]
+    # Fix the spec; runtime recovers after the error requeue elapses.
+    obj = kube.get(ref)
+    obj["spec"]["modelName"] = "iris"
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(ref, obj)
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rt.run_for(305)
+    kube.get(ObjectRef(namespace="models", name="iris", **SELDONDEPLOYMENT))
+
+
+def test_runtime_survives_kube_outage():
+    kube, registry, metrics, clock = FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock()
+    make_cr(kube, "iris")
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rt = OperatorRuntime(kube, registry, metrics, clock)
+
+    # API server starts throwing 500s on list AND get: step() must not raise.
+    from tpumlops.clients.base import ApiError
+
+    real_list, real_get = kube.list, kube.get
+    kube.list = lambda ref: (_ for _ in ()).throw(ApiError(500, "boom"))
+    kube.get = lambda ref: (_ for _ in ()).throw(ApiError(500, "boom"))
+    rt.step()
+    rt.step()
+    # Outage over: runtime recovers and deploys.
+    kube.list, kube.get = real_list, real_get
+    rt.run_for(10)
+    kube.get(ObjectRef(namespace="models", name="iris", **SELDONDEPLOYMENT))

@@ -1,0 +1,149 @@
+"""PromotionState transitions and status round-trips (SURVEY §3.5(2) fix)."""
+
+import pytest
+
+from tpumlops.operator.state import Phase, PromotionState
+
+
+def test_first_version_goes_straight_to_stable():
+    # Reference :188-191 — no previous version means 100% immediately.
+    s = PromotionState().new_version("1", initial_traffic=10)
+    assert s.phase == Phase.STABLE
+    assert s.current_version == "1"
+    assert s.previous_version is None
+    assert (s.traffic_current, s.traffic_prev) == (100, 0)
+
+
+def test_second_version_starts_canary_90_10():
+    # Reference :184-187.
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    assert s.phase == Phase.CANARY
+    assert (s.current_version, s.previous_version) == ("2", "1")
+    assert (s.traffic_current, s.traffic_prev) == (10, 90)
+
+
+def test_promotion_steps_reach_stable():
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    for _ in range(8):
+        s = s.promoted_step(10)
+        assert s.phase == Phase.CANARY
+    s = s.promoted_step(10)
+    assert s.phase == Phase.STABLE
+    assert (s.traffic_current, s.traffic_prev) == (100, 0)
+    assert s.previous_version is None  # old predictor dropped (ref :354-358)
+
+
+def test_step_clamps_at_100():
+    # Reference :316-317 clamps; a step of 30 from 90 lands exactly on 100/0.
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    for _ in range(8):
+        s = s.promoted_step(10)
+    s = s.promoted_step(30)
+    assert (s.traffic_current, s.traffic_prev) == (100, 0)
+
+
+def test_gate_failure_counting_and_halt():
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    s = s.gate_failed().gate_failed()
+    assert s.attempt == 2
+    halted = s.halt_failed()
+    assert halted.phase == Phase.FAILED
+    assert halted.held_version == "2"
+    # Frozen at last split, like the reference after PromotionFailed.
+    assert (halted.traffic_current, halted.traffic_prev) == (10, 90)
+
+
+def test_rollback_restores_old_version():
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    s = s.promoted_step(10)  # 20/80
+    rb = s.rolled_back()
+    assert rb.phase == Phase.ROLLED_BACK
+    assert rb.current_version == "1"
+    assert (rb.traffic_current, rb.traffic_prev) == (100, 0)
+    assert rb.held_version == "2"
+
+
+def test_alias_missing_clears_versions():
+    # Reference :66-71 sets both versions to None plus the error string.
+    s = PromotionState().new_version("1", 10).alias_missing("champion")
+    assert s.phase == Phase.ERROR
+    assert s.current_version is None
+    assert s.previous_version is None
+    assert "champion" in s.error
+
+
+def test_status_roundtrip():
+    s = PromotionState().new_version("1", 10).new_version("2", 10).gate_failed()
+    s2 = PromotionState.from_status(s.to_status())
+    assert s2 == s
+
+
+def test_adopts_reference_written_status():
+    # Status written by the reference operator has only the three fields of
+    # crd.yaml:26-37; we adopt it as a stable single-version deployment.
+    s = PromotionState.from_status(
+        {"currentModelVersion": "7", "previousModelVersion": "6", "error": None}
+    )
+    assert s.phase == Phase.STABLE
+    assert s.current_version == "7"
+    assert s.traffic_current == 100
+
+
+def test_promotion_resumes_from_persisted_traffic():
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    s = s.promoted_step(10).promoted_step(10)  # 30/70
+    resumed = PromotionState.from_status(s.to_status())
+    assert resumed.phase == Phase.CANARY
+    assert (resumed.traffic_current, resumed.traffic_prev) == (30, 70)
+    nxt = resumed.promoted_step(10)
+    assert (nxt.traffic_current, nxt.traffic_prev) == (40, 60)
+
+
+def test_empty_status_is_idle():
+    s = PromotionState.from_status(None)
+    assert s.phase == Phase.IDLE
+    assert s.current_version is None
+
+
+def test_unknown_phase_string_adopted_not_crashed():
+    s = PromotionState.from_status(
+        {"phase": "SomeFuturePhase", "currentModelVersion": "3"}
+    )
+    assert s.phase == Phase.STABLE
+    assert s.current_version == "3"
+
+
+def test_new_version_from_failed_uses_majority_baseline():
+    # FAILED canary frozen at 10/90: the stable 90% version is the baseline
+    # for the next rollout, and the failed canary is dropped.
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    for _ in range(9):
+        s = s.gate_failed()
+    s = s.halt_failed()
+    nxt = s.new_version("3", 10)
+    assert nxt.phase == Phase.CANARY
+    assert (nxt.current_version, nxt.previous_version) == ("3", "1")
+    assert (nxt.traffic_current, nxt.traffic_prev) == (10, 90)
+    assert nxt.held_version is None  # hold cleared by the new rollout
+
+
+def test_new_version_back_to_baseline_is_stable():
+    s = PromotionState().new_version("1", 10).new_version("2", 10)
+    back = s.new_version("1", 10)
+    assert back.phase == Phase.STABLE
+    assert back.current_version == "1"
+    assert back.previous_version is None
+
+
+def test_alias_alias_module_identity():
+    # tpumlops.* and the long package name must be the SAME module objects.
+    import importlib
+
+    import tpumlops.operator.state as short_state
+
+    long_state = importlib.import_module(
+        "research_and_development_of_kubernetes_operator_for_"
+        "machine_learning_pipelines_tpu.operator.state"
+    )
+    assert short_state is long_state
+    assert short_state.Phase is long_state.Phase

@@ -1,0 +1,45 @@
+"""URI normalization parity with the reference (mlflow_operator.py:18-24,:125-135)."""
+
+from tpumlops.operator.uri import artifact_uri, extract_relative_path
+
+
+def test_strips_mlflow_scheme():
+    assert (
+        extract_relative_path("mlflow-artifacts:/1/abc/artifacts/model")
+        == "1/abc/artifacts/model"
+    )
+
+
+def test_strips_leading_slashes():
+    assert extract_relative_path("/1/abc/artifacts/model") == "1/abc/artifacts/model"
+
+
+def test_non_mlflow_uri_passthrough():
+    # Reference only strips the scheme prefix and leading slash.
+    assert extract_relative_path("1/abc/artifacts/model") == "1/abc/artifacts/model"
+
+
+def test_scheme_replaced_only_once():
+    # replace(..., 1) semantics: an (adversarial) path containing the scheme
+    # again keeps the second occurrence.
+    src = "mlflow-artifacts:/a/mlflow-artifacts:/b"
+    assert extract_relative_path(src) == "a/mlflow-artifacts:/b"
+
+
+def test_artifact_uri_reroots_under_bucket():
+    assert (
+        artifact_uri("mlflow-artifacts:/1/abc/artifacts/model")
+        == "s3://mlflow/1/abc/artifacts/model"
+    )
+
+
+def test_artifact_uri_custom_root():
+    assert (
+        artifact_uri("mlflow-artifacts:/1/m", "gs://models")
+        == "gs://models/1/m"
+    )
+
+
+def test_artifact_uri_idempotent():
+    once = artifact_uri("mlflow-artifacts:/1/m")
+    assert artifact_uri(once) == once

@@ -1,0 +1,47 @@
+"""Short import alias for the framework package.
+
+``import tpumlops`` (and any submodule, e.g. ``tpumlops.operator.state``)
+resolves to the *same module objects* as
+``research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu``:
+the top level is aliased via ``sys.modules`` and submodules via a meta-path
+finder, so enum/class identity and module-level state are shared between the
+two names.
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+_SHORT = __name__
+_REAL = "research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu"
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, real_name: str):
+        self._real = real_name
+
+    def create_module(self, spec):
+        # Returning the already-imported real module makes the import system
+        # bind the alias name to the identical object.
+        return importlib.import_module(self._real)
+
+    def exec_module(self, module):
+        pass  # real module already executed
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname.startswith(_SHORT + "."):
+            real = _REAL + fullname[len(_SHORT):]
+            return importlib.util.spec_from_loader(
+                fullname, _AliasLoader(real), is_package=True
+            )
+        return None
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
+
+_pkg = importlib.import_module(_REAL)
+sys.modules[_SHORT] = _pkg
