@@ -1,0 +1,46 @@
+"""Collective helpers used inside ``shard_map``-ped kernels.
+
+XLA emits the actual ICI/DCN traffic; these are thin, named wrappers so
+model code reads as intent (``ring_shift`` for ring attention, etc.).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Shift ``x`` around the mesh-axis ring by ``shift`` hops.
+
+    Device i receives the block from device ``(i - shift) % n``.  On a TPU
+    torus this is nearest-neighbor ICI traffic — the primitive under ring
+    attention and pipelined all-gathers.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_gather_concat(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+    """All-gather shards and concatenate along ``axis`` (tiled=True)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def psum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    return lax.pmean(x, axis_name)
+
+
+def reduce_scatter(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+    """Sum across the axis and leave each device with its shard of the
+    result (the memory-lean half of an all-reduce)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def axis_index(axis_name: str) -> jax.Array:
+    return lax.axis_index(axis_name)

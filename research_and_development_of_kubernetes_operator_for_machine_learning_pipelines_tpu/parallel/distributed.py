@@ -1,0 +1,64 @@
+"""Multi-host initialization (DCN) for multi-host TPU slices.
+
+A v5e predictor larger than one host (e.g. v5e-16) runs as N pods that must
+form one JAX process group before any collective can cross hosts.  In the
+manifests each pod gets ``TPU_WORKER_HOSTNAMES``/coordinator env from the
+GKE TPU webhook; here we translate that into ``jax.distributed.initialize``.
+
+Single-host (or test/CPU) processes are a no-op, so the same server code
+runs everywhere.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize DCN process group if (and only if) multi-host env is set.
+
+    Resolution order: explicit args > environment
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``,
+    or the GKE TPU defaults that jax reads natively).  Returns True when
+    ``jax.distributed.initialize`` was called.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    if num_processes is None and env_np is not None:
+        num_processes = int(env_np)
+    if process_id is None and env_pid is not None:
+        process_id = int(env_pid)
+
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        _log.debug("single-process JAX (no coordinator configured)")
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    _log.info(
+        "jax.distributed initialized: %d processes, this is process %s",
+        num_processes,
+        process_id,
+    )
+    return True

@@ -1,0 +1,80 @@
+"""Device-mesh construction from the CRD's ``meshShape`` field.
+
+A ``meshShape`` like ``{"dp": 1, "tp": 8}`` (see ``TpuSpec``,
+``utils/config.py``) becomes a ``jax.sharding.Mesh`` whose axes drive all
+sharding in the data plane.  Axis names are fixed so model code, server
+engine, and manifests agree:
+
+- ``dp`` — data parallel (batch split; gradients/logits all-reduced)
+- ``tp`` — tensor parallel (heads/mlp split; activations all-reduced over ICI)
+- ``sp`` — sequence/context parallel (ring attention shifts KV blocks)
+- ``pp`` — pipeline parallel (layer groups)
+- ``ep`` — expert parallel (MoE experts)
+
+Mesh axis order matters for ICI locality on a v5e slice: the innermost
+(fastest-varying) axis gets neighboring chips, so ``tp`` — which carries the
+per-layer all-reduces — is placed LAST, mirroring the physical torus.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "dp"
+AXIS_PIPE = "pp"
+AXIS_EXPERT = "ep"
+AXIS_SEQ = "sp"
+AXIS_TENSOR = "tp"
+
+# Outer-to-inner canonical order: collectives-heavy axes innermost so they
+# map onto adjacent chips (ICI hops) rather than across the slice.
+MESH_AXIS_ORDER: tuple[str, ...] = (
+    AXIS_DATA,
+    AXIS_PIPE,
+    AXIS_EXPERT,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+)
+
+
+def build_mesh(
+    mesh_shape: Mapping[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``Mesh`` from ``{axis: size}``.
+
+    Axes are laid out in ``MESH_AXIS_ORDER`` regardless of dict order; axes
+    of size 1 are kept (harmless, makes PartitionSpecs uniform).  The product
+    of sizes must equal the device count.
+    """
+    unknown = set(mesh_shape) - set(MESH_AXIS_ORDER)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)}; known: {list(MESH_AXIS_ORDER)}"
+        )
+    if devices is None:
+        devices = jax.devices()
+    axis_names = tuple(a for a in MESH_AXIS_ORDER if a in mesh_shape)
+    sizes = tuple(int(mesh_shape[a]) for a in axis_names)
+    if any(s < 1 for s in sizes):
+        raise ValueError(f"mesh axis sizes must be >= 1, got {dict(mesh_shape)}")
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != len(devices):
+        raise ValueError(
+            f"meshShape {dict(mesh_shape)} needs {total} devices, "
+            f"have {len(devices)}"
+        )
+    dev_array = np.asarray(devices, dtype=object).reshape(sizes)
+    return Mesh(dev_array, axis_names)
+
+
+def local_mesh(mesh_shape: Mapping[str, int] | None = None) -> Mesh:
+    """Mesh over all local devices; default one ``tp`` axis spanning them."""
+    devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = {AXIS_TENSOR: len(devices)}
+    return build_mesh(mesh_shape, devices)

@@ -1,0 +1,105 @@
+"""Logical-axis sharding rules.
+
+Model code annotates arrays with *logical* axis names (``"batch"``,
+``"heads"``, ``"mlp"`` ...); a ``ShardingRules`` table maps logical axes to
+mesh axes (or to ``None`` = replicated).  Swapping the rules re-shards the
+whole model without touching model code — the standard JAX/TPU recipe
+(scaling-book style): pick a mesh, annotate shardings, let XLA insert the
+collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import AXIS_DATA, AXIS_SEQ, AXIS_TENSOR
+
+# Logical axis names used across the model zoo.
+LOGICAL_BATCH = "batch"
+LOGICAL_SEQ = "seq"
+LOGICAL_EMBED = "embed"  # model/residual dimension
+LOGICAL_HEADS = "heads"  # attention heads (query)
+LOGICAL_KV_HEADS = "kv_heads"  # attention heads (key/value, GQA)
+LOGICAL_HEAD_DIM = "head_dim"
+LOGICAL_MLP = "mlp"  # feed-forward hidden dimension
+LOGICAL_VOCAB = "vocab"
+LOGICAL_EXPERT = "expert"
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Mapping logical axis -> mesh axis (or None for replicated)."""
+
+    rules: Mapping[str, str | None]
+
+    def mesh_axis(self, logical: str | None) -> str | None:
+        if logical is None:
+            return None
+        return self.rules.get(logical)
+
+    def spec(self, logical_axes: tuple[str | None, ...]) -> PartitionSpec:
+        seen: list[str | None] = []
+        for ax in logical_axes:
+            mesh_ax = self.mesh_axis(ax)
+            # A mesh axis may appear at most once in a PartitionSpec; later
+            # occurrences fall back to replication.
+            seen.append(mesh_ax if mesh_ax not in [s for s in seen if s] else None)
+        return PartitionSpec(*seen)
+
+
+# Default rules for transformer serving:
+#  - batch over dp, sequence over sp (ring attention),
+#  - heads/mlp/vocab over tp (Megatron-style column/row splits),
+#  - embed replicated (the residual stream stays whole per chip).
+TRANSFORMER_RULES = ShardingRules(
+    rules={
+        LOGICAL_BATCH: AXIS_DATA,
+        LOGICAL_SEQ: AXIS_SEQ,
+        LOGICAL_EMBED: None,
+        LOGICAL_HEADS: AXIS_TENSOR,
+        LOGICAL_KV_HEADS: AXIS_TENSOR,
+        LOGICAL_HEAD_DIM: None,
+        LOGICAL_MLP: AXIS_TENSOR,
+        LOGICAL_VOCAB: AXIS_TENSOR,
+        LOGICAL_EXPERT: None,
+    }
+)
+
+
+def logical_spec(
+    logical_axes: tuple[str | None, ...], rules: ShardingRules | None = None
+) -> PartitionSpec:
+    return (rules or TRANSFORMER_RULES).spec(logical_axes)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: tuple[str | None, ...],
+    rules: ShardingRules | None = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_spec(logical_axes, rules))
+
+
+def shard_pytree(
+    tree: Any,
+    axes_tree: Any,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+) -> Any:
+    """Device-put a parameter pytree according to a matching pytree of
+    logical-axis tuples (``None`` leaf = fully replicated)."""
+
+    def _put(x, axes):
+        if axes is None:
+            sh = NamedSharding(mesh, PartitionSpec())
+        else:
+            sh = logical_sharding(mesh, axes, rules)
+        return jax.device_put(x, sh)
+
+    return jax.tree.map(
+        _put, tree, axes_tree, is_leaf=lambda t: t is None
+    )

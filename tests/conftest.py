@@ -1,17 +1,27 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before the first ``import jax`` anywhere in the test session so
-``pjit``/sharding paths are exercised exactly as they would be on a v5e-8
-slice (SURVEY.md §4).
+Tests exercise the same ``pjit``/sharding paths as a v5e-8 slice
+(SURVEY.md §4) but on CPU.  Env vars alone are not enough here: the host
+environment may pre-import and initialize JAX on a TPU backend before pytest
+starts, so we switch platforms through ``jax.config`` and drop any
+already-created backends.
 """
 
 import os
 
+# For clean environments where jax is not yet imported.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep test-time compiles fast and deterministic.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+from jax.extend import backend as _jeb  # noqa: E402
+
+_jeb.clear_backends()
+assert len(jax.devices()) == 8, jax.devices()
