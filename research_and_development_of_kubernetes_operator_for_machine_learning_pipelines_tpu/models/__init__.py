@@ -1,0 +1,31 @@
+"""Model zoo backing the five baseline configs (BASELINE.json):
+
+- ``linear``   — sklearn iris logistic-regression (config 0)
+- ``tabular``  — gradient-boosted / generic pyfunc tabular models (config 1)
+- ``resnet``   — ResNet-50 image classifier (config 2)
+- ``bert``     — BERT-base encoder classifier, batched (config 3)
+- ``llama``    — Llama-2 decoder, tensor-parallel over v5e-8 (config 4)
+
+All models are pure-JAX functional: a ``Config`` dataclass, ``init(key, cfg)
+-> params`` (nested dict of arrays), jittable ``apply``-style functions, a
+``param_logical_axes(cfg)`` pytree for mesh sharding, and (where a torch
+twin exists) a ``from_torch`` converter used by the parity tests.
+
+The reference contains no model code at all — its data plane is Seldon's
+generic ``MLFLOW_SERVER`` image (``mlflow_operator.py:198``); this zoo is
+the first-party TPU replacement.
+"""
+
+from . import common
+
+__all__ = ["common", "linear", "tabular", "resnet", "bert", "llama", "registry"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

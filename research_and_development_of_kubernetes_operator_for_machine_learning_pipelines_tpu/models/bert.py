@@ -1,0 +1,287 @@
+"""BERT-base encoder (baseline config 3: batched sentence classification).
+
+Pure-JAX, post-LayerNorm architecture matching HuggingFace ``BertModel``
+semantics exactly (verified by the weight-copy parity test in
+``tests/test_models_bert.py``).  Tensor-parallel ready: QKV/O and MLP
+weights carry logical axes that TRANSFORMER_RULES maps onto the ``tp`` mesh
+axis (Megatron column/row split); under ``jit`` with NamedSharding-placed
+params XLA inserts the ICI all-reduces.
+
+The reference serves BERT-class models through Seldon's generic CPU/GPU
+``MLFLOW_SERVER`` (``mlflow_operator.py:198``); this module is the
+TPU-native predict path behind ``backend: tpu``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .common import dense, gelu, init_dense, layer_norm, take_embedding
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2  # classifier head; 0 disables
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        """Small config for tests/CI."""
+        defaults = dict(
+            vocab_size=512,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            intermediate_size=128,
+            max_position_embeddings=128,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _init_ln(h: int) -> dict:
+    return {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))}
+
+
+def init(key: jax.Array, cfg: BertConfig) -> dict:
+    keys = iter(jax.random.split(key, 8 + 8 * cfg.num_layers))
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    std = 0.02
+
+    def normal(k, shape):
+        return std * jax.random.normal(k, shape, jnp.float32)
+
+    params: dict = {
+        "embeddings": {
+            "word": normal(next(keys), (cfg.vocab_size, h)),
+            "position": normal(next(keys), (cfg.max_position_embeddings, h)),
+            "token_type": normal(next(keys), (cfg.type_vocab_size, h)),
+            "ln": _init_ln(h),
+        },
+        "layers": [],
+        "pooler": init_dense(next(keys), h, h),
+    }
+    for _ in range(cfg.num_layers):
+        layer = {
+            "attn": {
+                "q": init_dense(next(keys), h, h),
+                "k": init_dense(next(keys), h, h),
+                "v": init_dense(next(keys), h, h),
+                "o": init_dense(next(keys), h, h),
+                "ln": _init_ln(h),
+            },
+            "mlp": {
+                "up": init_dense(next(keys), h, i),
+                "down": init_dense(next(keys), i, h),
+                "ln": _init_ln(h),
+            },
+        }
+        params["layers"].append(layer)
+    if cfg.num_labels:
+        params["classifier"] = init_dense(next(keys), h, cfg.num_labels)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _self_attention(p: dict, x: jax.Array, mask_bias: jax.Array, cfg: BertConfig):
+    b, s, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    q = dense(x, p["q"]["w"], p["q"]["b"]).reshape(b, s, nh, hd)
+    k = dense(x, p["k"]["w"], p["k"]["b"]).reshape(b, s, nh, hd)
+    v = dense(x, p["v"]["w"], p["v"]["b"]).reshape(b, s, nh, hd)
+
+    scores = jnp.einsum(
+        "bqnd,bknd->bnqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(hd))
+    scores = scores + mask_bias  # (b, 1, 1, s) additive bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(b, s, h)
+    return dense(ctx, p["o"]["w"], p["o"]["b"])
+
+
+def encode(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+    token_type_ids: jax.Array | None = None,
+    cfg: BertConfig = BertConfig(),
+    dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """Return (sequence_output [B,S,H], pooled_output [B,H])."""
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((b, s), jnp.int32)
+
+    emb = params["embeddings"]
+    positions = jnp.arange(s)[None, :]
+    x = (
+        take_embedding(emb["word"], input_ids)
+        + take_embedding(emb["position"], positions)
+        + take_embedding(emb["token_type"], token_type_ids)
+    ).astype(dtype)
+    x = layer_norm(x, emb["ln"]["scale"], emb["ln"]["bias"], cfg.layer_norm_eps)
+
+    # Additive attention bias in f32: 0 where attend, -1e9 where masked.
+    mask_bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+
+    for layer in params["layers"]:
+        a = _self_attention(layer["attn"], x, mask_bias, cfg)
+        x = layer_norm(
+            x + a,
+            layer["attn"]["ln"]["scale"],
+            layer["attn"]["ln"]["bias"],
+            cfg.layer_norm_eps,
+        )
+        m = dense(x, layer["mlp"]["up"]["w"], layer["mlp"]["up"]["b"])
+        m = gelu(m)
+        m = dense(m, layer["mlp"]["down"]["w"], layer["mlp"]["down"]["b"])
+        x = layer_norm(
+            x + m,
+            layer["mlp"]["ln"]["scale"],
+            layer["mlp"]["ln"]["bias"],
+            cfg.layer_norm_eps,
+        )
+
+    pooled = jnp.tanh(dense(x[:, 0], params["pooler"]["w"], params["pooler"]["b"]))
+    return x, pooled
+
+
+def classify(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+    token_type_ids: jax.Array | None = None,
+    cfg: BertConfig = BertConfig(),
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Sentence-classification logits [B, num_labels]."""
+    _, pooled = encode(params, input_ids, attention_mask, token_type_ids, cfg, dtype)
+    c = params["classifier"]
+    return dense(pooled, c["w"], c["b"]).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Sharding
+# ---------------------------------------------------------------------------
+
+
+def param_logical_axes(params: dict) -> dict:
+    """Logical-axis pytree matching ``params`` (see parallel.sharding)."""
+
+    def attn_axes():
+        return {
+            "q": {"w": ("embed", "heads"), "b": ("heads",)},
+            "k": {"w": ("embed", "heads"), "b": ("heads",)},
+            "v": {"w": ("embed", "heads"), "b": ("heads",)},
+            "o": {"w": ("heads", "embed"), "b": None},
+            "ln": {"scale": None, "bias": None},
+        }
+
+    def mlp_axes():
+        return {
+            "up": {"w": ("embed", "mlp"), "b": ("mlp",)},
+            "down": {"w": ("mlp", "embed"), "b": None},
+            "ln": {"scale": None, "bias": None},
+        }
+
+    axes: dict = {
+        "embeddings": {
+            "word": ("vocab", "embed"),
+            "position": None,
+            "token_type": None,
+            "ln": {"scale": None, "bias": None},
+        },
+        "layers": [
+            {"attn": attn_axes(), "mlp": mlp_axes()} for _ in params["layers"]
+        ],
+        "pooler": {"w": None, "b": None},
+    }
+    if "classifier" in params:
+        axes["classifier"] = {"w": None, "b": None}
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Torch weight import (parity tests / MLflow transformers flavor)
+# ---------------------------------------------------------------------------
+
+
+def from_torch(torch_model, cfg: BertConfig) -> dict:
+    """Convert a HuggingFace ``BertModel`` (or ``BertForSequenceClassification``)
+    state dict to this module's param tree."""
+    sd = {k: v.detach().cpu().numpy() for k, v in torch_model.state_dict().items()}
+    prefix = "bert." if any(k.startswith("bert.") for k in sd) else ""
+
+    def t(name):
+        return jnp.asarray(sd[prefix + name])
+
+    def lin(name):
+        return {"w": t(f"{name}.weight").T, "b": t(f"{name}.bias")}
+
+    def ln(name):
+        return {"scale": t(f"{name}.weight"), "bias": t(f"{name}.bias")}
+
+    params = {
+        "embeddings": {
+            "word": t("embeddings.word_embeddings.weight"),
+            "position": t("embeddings.position_embeddings.weight"),
+            "token_type": t("embeddings.token_type_embeddings.weight"),
+            "ln": ln("embeddings.LayerNorm"),
+        },
+        "layers": [],
+        "pooler": lin("pooler.dense"),
+    }
+    for i in range(cfg.num_layers):
+        base = f"encoder.layer.{i}"
+        params["layers"].append(
+            {
+                "attn": {
+                    "q": lin(f"{base}.attention.self.query"),
+                    "k": lin(f"{base}.attention.self.key"),
+                    "v": lin(f"{base}.attention.self.value"),
+                    "o": lin(f"{base}.attention.output.dense"),
+                    "ln": ln(f"{base}.attention.output.LayerNorm"),
+                },
+                "mlp": {
+                    "up": lin(f"{base}.intermediate.dense"),
+                    "down": lin(f"{base}.output.dense"),
+                    "ln": ln(f"{base}.output.LayerNorm"),
+                },
+            }
+        )
+    if "classifier.weight" in sd:
+        params["classifier"] = {
+            "w": jnp.asarray(sd["classifier.weight"]).T,
+            "b": jnp.asarray(sd["classifier.bias"]),
+        }
+    return params

@@ -1,0 +1,83 @@
+"""Shared building blocks for the pure-JAX model zoo.
+
+Conventions:
+
+- params are nested dicts of ``jnp.ndarray``; layer stacks may carry a
+  leading ``layers`` axis consumed by ``lax.scan``.
+- every initializer takes and splits an explicit PRNG key;
+- compute dtype is a parameter (bfloat16 on TPU to hit the MXU's native
+  tile; params may be kept in float32 and cast at use);
+- matmuls accumulate in float32 via ``preferred_element_type`` so bf16
+  activations do not lose the accumulation precision the MXU provides.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def dense(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:
+    """x @ w (+ b), accumulating in f32 on the MXU regardless of input dtype."""
+    y = jnp.matmul(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def init_dense(key, d_in: int, d_out: int, dtype=jnp.float32) -> dict[str, jax.Array]:
+    scale = 1.0 / jnp.sqrt(d_in)
+    w = jax.random.uniform(key, (d_in, d_out), dtype, -scale, scale)
+    return {"w": w, "b": jnp.zeros((d_out,), dtype)}
+
+
+def layer_norm(
+    x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-12
+) -> jax.Array:
+    """LayerNorm in f32 (mean/var of bf16 activations overflow/underflow
+    easily; normalize in f32, cast back)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    """Exact (erf) GELU — matches torch's default, unlike jax.nn.gelu's
+    tanh approximation default."""
+    return jax.nn.gelu(x, approximate=False)
+
+
+def take_embedding(table: jax.Array, ids: jax.Array, dtype=None) -> jax.Array:
+    out = jnp.take(table, ids, axis=0)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def count_params(params: Any) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def cast_floats(tree: Any, dtype) -> Any:
+    """Cast floating-point leaves to ``dtype`` (ints/bools untouched)."""
+
+    def _cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(_cast, tree)
+
+
+def stack_layers(layer_params: list[dict]) -> dict:
+    """Stack per-layer param dicts along a new leading axis for lax.scan."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *layer_params)

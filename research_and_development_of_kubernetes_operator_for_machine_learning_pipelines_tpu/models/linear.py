@@ -1,0 +1,67 @@
+"""Linear / logistic-regression models (baseline config 0: sklearn iris).
+
+The reference serves sklearn models via Seldon's ``MLFLOW_SERVER``
+(``mlflow_operator.py:198``); here the fitted coefficients are lifted into a
+jittable JAX predict function so even tiny tabular models ride the same
+TPU/XLA path and metric surface as the big ones.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LinearConfig:
+    n_features: int
+    n_classes: int = 1  # 1 => regression or binary-with-sigmoid
+    kind: str = "logistic"  # "logistic" | "linear"
+
+
+def init(key: jax.Array, cfg: LinearConfig) -> dict:
+    k1, _ = jax.random.split(key)
+    out = max(cfg.n_classes, 1)
+    return {
+        "coef": 0.01 * jax.random.normal(k1, (cfg.n_features, out), jnp.float32),
+        "intercept": jnp.zeros((out,), jnp.float32),
+    }
+
+
+def decision_function(params: dict, x: jax.Array) -> jax.Array:
+    return x @ params["coef"] + params["intercept"]
+
+
+def predict_proba(params: dict, x: jax.Array) -> jax.Array:
+    """Class probabilities; matches sklearn LogisticRegression semantics
+    (sigmoid for binary stored as a single column, softmax for multinomial)."""
+    z = decision_function(params, x)
+    if z.shape[-1] == 1:
+        p1 = jax.nn.sigmoid(z)
+        return jnp.concatenate([1.0 - p1, p1], axis=-1)
+    return jax.nn.softmax(z, axis=-1)
+
+
+def predict(params: dict, x: jax.Array, cfg: LinearConfig) -> jax.Array:
+    if cfg.kind == "linear":
+        z = decision_function(params, x)
+        return z[..., 0] if z.shape[-1] == 1 else z
+    return jnp.argmax(predict_proba(params, x), axis=-1)
+
+
+def from_sklearn(model) -> tuple[dict, LinearConfig]:
+    """Convert a fitted sklearn LogisticRegression / LinearRegression."""
+    coef = jnp.asarray(model.coef_, jnp.float32)
+    if coef.ndim == 1:
+        coef = coef[None, :]
+    intercept = jnp.atleast_1d(jnp.asarray(model.intercept_, jnp.float32))
+    kind = "logistic" if hasattr(model, "predict_proba") else "linear"
+    params = {"coef": coef.T, "intercept": intercept}
+    cfg = LinearConfig(
+        n_features=params["coef"].shape[0],
+        n_classes=params["coef"].shape[1],
+        kind=kind,
+    )
+    return params, cfg

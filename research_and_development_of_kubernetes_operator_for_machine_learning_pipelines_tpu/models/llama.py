@@ -1,0 +1,369 @@
+"""Llama-2 decoder (baseline config 4: text-gen, TP-sharded across v5e-8).
+
+Pure-JAX implementation matching HuggingFace ``LlamaForCausalLM`` semantics
+(weight-copy parity test in ``tests/test_models_llama.py``): pre-RMSNorm,
+rotate-half RoPE, grouped-query attention, SwiGLU MLP, untied LM head.
+
+TPU-first design decisions:
+
+- layer params are STACKED on a leading axis and consumed by ``lax.scan`` —
+  one compiled block instead of ``n_layers`` unrolled copies, keeping
+  compile times flat as depth grows;
+- a fixed-capacity KV cache (``max_seq``) with a dynamic write index keeps
+  every shape static under ``jit`` (no data-dependent shapes, SURVEY §7);
+- logical axes put heads/kv_heads/mlp/vocab on the ``tp`` mesh axis
+  (Megatron split) so a v5e-8 mesh shards Llama-2-7B ~0.9 GiB/chip in bf16;
+  XLA inserts the ICI all-reduces at the o/down projections.
+
+The reference has no model code (SURVEY §2.3); this is the rebuild's
+long-context/distributed first-class citizen.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .common import rms_norm
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    intermediate_size: int = 11008
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        defaults = dict(
+            vocab_size=256,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            intermediate_size=128,
+            max_seq=64,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class KVCache(NamedTuple):
+    """Static-shape KV cache: (layers, batch, max_seq, kv_heads, head_dim).
+
+    Capacity is fixed at creation (``max_seq``); ``forward`` rejects chunks
+    larger than capacity and ``generate_greedy`` rejects prompt+new-token
+    totals beyond it.  Writing past capacity via repeated ``decode_step``
+    calls is undefined (dynamic_update_slice clamps) — callers track
+    ``length`` against capacity (the server engine does).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # int32 scalar: number of valid positions
+
+    @classmethod
+    def create(cls, cfg: LlamaConfig, batch: int, dtype=jnp.bfloat16) -> "KVCache":
+        shape = (cfg.num_layers, batch, cfg.max_seq, cfg.num_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Init / torch import
+# ---------------------------------------------------------------------------
+
+
+def init(key: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> dict:
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    L = cfg.num_layers
+    keys = jax.random.split(key, 9)
+    std = 0.02
+
+    def normal(k, shape):
+        return (std * jax.random.normal(k, shape, jnp.float32)).astype(dtype)
+
+    return {
+        "embed": normal(keys[0], (v, h)),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), dtype),
+            "q": normal(keys[1], (L, h, nh * hd)),
+            "k": normal(keys[2], (L, h, nkv * hd)),
+            "v": normal(keys[3], (L, h, nkv * hd)),
+            "o": normal(keys[4], (L, nh * hd, h)),
+            "mlp_norm": jnp.ones((L, h), dtype),
+            "gate": normal(keys[5], (L, h, i)),
+            "up": normal(keys[6], (L, h, i)),
+            "down": normal(keys[7], (L, i, h)),
+        },
+        "final_norm": jnp.ones((h,), dtype),
+        "lm_head": normal(keys[8], (h, v)),
+    }
+
+
+def from_torch(torch_model, cfg: LlamaConfig) -> dict:
+    """Convert a HuggingFace ``LlamaForCausalLM`` state dict."""
+    import numpy as np
+
+    sd = {k: v.detach().cpu().float().numpy() for k, v in torch_model.state_dict().items()}
+
+    def stack(fmt: str, transpose: bool = False):
+        mats = [sd[fmt.format(i)] for i in range(cfg.num_layers)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(np.stack(mats, axis=0))
+
+    return {
+        "embed": jnp.asarray(sd["model.embed_tokens.weight"]),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
+            "q": stack("model.layers.{}.self_attn.q_proj.weight", transpose=True),
+            "k": stack("model.layers.{}.self_attn.k_proj.weight", transpose=True),
+            "v": stack("model.layers.{}.self_attn.v_proj.weight", transpose=True),
+            "o": stack("model.layers.{}.self_attn.o_proj.weight", transpose=True),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight"),
+            "gate": stack("model.layers.{}.mlp.gate_proj.weight", transpose=True),
+            "up": stack("model.layers.{}.mlp.up_proj.weight", transpose=True),
+            "down": stack("model.layers.{}.mlp.down_proj.weight", transpose=True),
+        },
+        "final_norm": jnp.asarray(sd["model.norm.weight"]),
+        "lm_head": jnp.asarray(sd["lm_head.weight"].T),
+    }
+
+
+# ---------------------------------------------------------------------------
+# RoPE (HF rotate-half convention)
+# ---------------------------------------------------------------------------
+
+
+def rope_cos_sin(positions: jax.Array, cfg: LlamaConfig, dtype=jnp.float32):
+    """cos/sin tables for ``positions`` [S] -> each [S, head_dim]."""
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, hd]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, N, D]; cos/sin: [S, D]."""
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return (x * c + _rotate_half(x) * s).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _block(
+    x: jax.Array,
+    lp: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    start: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    mask_bias: jax.Array,
+    cfg: LlamaConfig,
+):
+    """One decoder layer over a fixed-capacity cache.
+
+    x: [B,S,H]; cache_k/v: [B,max_seq,NKV,D]; start: scalar write offset.
+    Returns (y, new_cache_k, new_cache_v).
+    """
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.matmul(xn, lp["q"].astype(xn.dtype), preferred_element_type=jnp.float32)
+    k = jnp.matmul(xn, lp["k"].astype(xn.dtype), preferred_element_type=jnp.float32)
+    v = jnp.matmul(xn, lp["v"].astype(xn.dtype), preferred_element_type=jnp.float32)
+    q = q.astype(x.dtype).reshape(b, s, nh, hd)
+    k = k.astype(x.dtype).reshape(b, s, nkv, hd)
+    v = v.astype(x.dtype).reshape(b, s, nkv, hd)
+
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    # Write this chunk's K/V into the cache at [start : start+s].
+    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, start, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, start, 0, 0))
+
+    # GQA via grouped einsum: q reshaped to [B,S,NKV,G,D] contracts directly
+    # against the [B,T,NKV,D] cache — no materialized repeat of K/V to all
+    # query heads (that broadcast would dominate HBM traffic at decode).
+    group = nh // nkv
+    qg = q.reshape(b, s, nkv, group, hd)
+    kk = cache_k.astype(x.dtype)
+    vv = cache_v.astype(x.dtype)
+
+    scores = jnp.einsum(
+        "bqngd,bknd->bngqk", qg, kk, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(hd))
+    scores = scores + mask_bias[:, None]  # [B or 1, 1, 1, S, T]
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bngqk,bknd->bqngd", probs, vv).reshape(b, s, nh * hd)
+    attn_out = jnp.matmul(
+        ctx, lp["o"].astype(ctx.dtype), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    x = x + attn_out
+
+    xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    gate = jnp.matmul(xn, lp["gate"].astype(xn.dtype), preferred_element_type=jnp.float32)
+    up = jnp.matmul(xn, lp["up"].astype(xn.dtype), preferred_element_type=jnp.float32)
+    act = jax.nn.silu(gate) * up
+    down = jnp.matmul(
+        act.astype(x.dtype), lp["down"].astype(x.dtype), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    return x + down, cache_k, cache_v
+
+
+def forward(
+    params: dict,
+    input_ids: jax.Array,
+    cache: KVCache,
+    cfg: LlamaConfig,
+    dtype=jnp.bfloat16,
+) -> tuple[jax.Array, KVCache]:
+    """Run ``input_ids`` [B,S] through the model starting at ``cache.length``.
+
+    Works for both prefill (S = prompt length, cache.length = 0) and decode
+    (S = 1).  Returns (logits [B,S,vocab] float32, updated cache).
+    """
+    b, s = input_ids.shape
+    if s > cfg.max_seq:
+        raise ValueError(
+            f"sequence chunk of {s} tokens exceeds KV-cache capacity "
+            f"max_seq={cfg.max_seq}"
+        )
+    start = cache.length
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(dtype)
+
+    positions = start + jnp.arange(s)
+    cos, sin = rope_cos_sin(positions, cfg, jnp.float32)
+
+    # Additive mask over the full cache buffer T=max_seq:
+    # query at absolute position p attends keys with pos <= p (and only
+    # positions already written).
+    key_pos = jnp.arange(cfg.max_seq)
+    valid = key_pos[None, :] <= positions[:, None]  # [S, T]
+    mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[None, None, :, :]
+
+    def scan_body(carry, layer_inputs):
+        x = carry
+        lp, ck, cv = layer_inputs
+        y, ck2, cv2 = _block(x, lp, ck, cv, start, cos, sin, mask_bias, cfg)
+        return y, (ck2, cv2)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.matmul(
+        x, params["lm_head"].astype(x.dtype), preferred_element_type=jnp.float32
+    )
+    new_cache = KVCache(k=new_k, v=new_v, length=start + s)
+    return logits, new_cache
+
+
+def prefill(params, input_ids, cfg, dtype=jnp.bfloat16):
+    cache = KVCache.create(cfg, input_ids.shape[0], dtype)
+    return forward(params, input_ids, cache, cfg, dtype)
+
+
+def decode_step(params, token_ids, cache, cfg, dtype=jnp.bfloat16):
+    """One greedy decode step: token_ids [B,1] -> (logits [B,1,V], cache)."""
+    return forward(params, token_ids, cache, cfg, dtype)
+
+
+def generate_greedy(
+    params: dict,
+    prompt_ids: jax.Array,
+    num_new_tokens: int,
+    cfg: LlamaConfig,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Greedy generation with a scanned decode loop (jit-friendly)."""
+    total = prompt_ids.shape[1] + num_new_tokens
+    if total > cfg.max_seq:
+        raise ValueError(
+            f"prompt ({prompt_ids.shape[1]}) + new tokens ({num_new_tokens}) "
+            f"= {total} exceeds KV-cache capacity max_seq={cfg.max_seq}"
+        )
+    logits, cache = prefill(params, prompt_ids, cfg, dtype)
+    next_tok = jnp.argmax(logits[:, -1:, :], axis=-1)
+
+    def body(carry, _):
+        tok, cache = carry
+        logits, cache = decode_step(params, tok, cache, cfg, dtype)
+        nxt = jnp.argmax(logits[:, -1:, :], axis=-1)
+        return (nxt, cache), tok
+
+    (_, _), toks = lax.scan(body, (next_tok, cache), None, length=num_new_tokens)
+    # toks: [num_new, B, 1] -> [B, num_new]
+    return jnp.moveaxis(toks[..., 0], 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Sharding
+# ---------------------------------------------------------------------------
+
+
+def param_logical_axes(cfg: LlamaConfig | None = None) -> dict:
+    """Logical axes (leading ``None`` on stacked layer params = scan axis)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": (None, "embed"),
+            "q": (None, "embed", "heads"),
+            "k": (None, "embed", "kv_heads"),
+            "v": (None, "embed", "kv_heads"),
+            "o": (None, "heads", "embed"),
+            "mlp_norm": (None, "embed"),
+            "gate": (None, "embed", "mlp"),
+            "up": (None, "embed", "mlp"),
+            "down": (None, "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def cache_logical_axes() -> KVCache:
+    """Sharding for the KV cache: kv_heads on tp, batch on dp."""
+    return KVCache(
+        k=(None, "batch", None, "kv_heads", "head_dim"),
+        v=(None, "batch", None, "kv_heads", "head_dim"),
+        length=None,
+    )

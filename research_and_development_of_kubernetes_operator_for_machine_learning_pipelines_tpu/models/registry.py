@@ -1,0 +1,168 @@
+"""Model-family registry: flavor name -> Predictor builder.
+
+The server's loader resolves an MLflow artifact to a *flavor* (sklearn,
+forest, bert, llama, resnet, pyfunc, ...) and asks this registry to build a
+``Predictor`` — the one interface the data plane serves:
+
+- ``predict``   — batched callable; a pure jittable JAX function for native
+  flavors, a host-side Python callable for the pyfunc fallback tier;
+- ``jittable``  — selects the engine path (jit+warmup vs host thread pool);
+- ``example_input`` — builds a representative batch for warmup compilation
+  so the first real request never pays the XLA compile (SURVEY §7 hard
+  part 3, TPU cold-start).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+
+@dataclass
+class Predictor:
+    name: str
+    predict: Callable[..., Any]
+    jittable: bool = True
+    example_input: Callable[[int], Any] | None = None  # batch_size -> inputs
+    metadata: dict = field(default_factory=dict)
+
+
+_BUILDERS: dict[str, Callable[..., Predictor]] = {}
+
+
+def register(flavor: str):
+    def deco(fn: Callable[..., Predictor]):
+        _BUILDERS[flavor] = fn
+        return fn
+
+    return deco
+
+
+def get_builder(flavor: str) -> Callable[..., Predictor]:
+    try:
+        return _BUILDERS[flavor]
+    except KeyError:
+        raise KeyError(
+            f"unknown model flavor {flavor!r}; registered: {sorted(_BUILDERS)}"
+        )
+
+
+def list_flavors() -> list[str]:
+    return sorted(_BUILDERS)
+
+
+# ---------------------------------------------------------------------------
+# Built-in flavors
+# ---------------------------------------------------------------------------
+
+
+@register("sklearn-linear")
+def _build_sklearn_linear(model: Any, **_kw) -> Predictor:
+    from . import linear
+
+    params, cfg = linear.from_sklearn(model)
+    n_feat = cfg.n_features
+
+    def predict(x):
+        return linear.predict(params, x, cfg)
+
+    return Predictor(
+        name="sklearn-linear",
+        predict=predict,
+        jittable=True,
+        example_input=lambda b: np.zeros((b, n_feat), np.float32),
+        metadata={"n_features": n_feat, "n_classes": cfg.n_classes},
+    )
+
+
+@register("sklearn-forest")
+def _build_sklearn_forest(model: Any, **_kw) -> Predictor:
+    from . import tabular
+
+    trees = tabular.from_sklearn_forest(model)
+    n_feat = int(model.n_features_in_)
+
+    def predict(x):
+        return tabular.eval_forest(trees, x)
+
+    return Predictor(
+        name="sklearn-forest",
+        predict=predict,
+        jittable=True,
+        example_input=lambda b: np.zeros((b, n_feat), np.float32),
+        metadata={"n_trees": int(trees.feature.shape[0])},
+    )
+
+
+@register("pyfunc")
+def _build_pyfunc(model: Any, **_kw) -> Predictor:
+    from .tabular import PyFuncPredictor
+
+    wrapped = model if isinstance(model, PyFuncPredictor) else PyFuncPredictor(
+        model.predict if hasattr(model, "predict") else model
+    )
+    return Predictor(name="pyfunc", predict=wrapped, jittable=False)
+
+
+@register("bert-classifier")
+def _build_bert(params: Any, cfg: Any = None, seq_len: int = 128, **_kw) -> Predictor:
+    from . import bert
+
+    cfg = cfg or bert.BertConfig.base()
+
+    def predict(input_ids, attention_mask=None):
+        import jax.numpy as jnp
+
+        return bert.classify(
+            params, input_ids, attention_mask, cfg=cfg, dtype=jnp.bfloat16
+        )
+
+    def example(b):
+        return {
+            "input_ids": np.ones((b, seq_len), np.int32),
+            "attention_mask": np.ones((b, seq_len), np.int32),
+        }
+
+    return Predictor(
+        name="bert-classifier",
+        predict=predict,
+        jittable=True,
+        example_input=example,
+        metadata={"seq_len": seq_len, "num_labels": cfg.num_labels},
+    )
+
+
+@register("resnet-classifier")
+def _build_resnet(params: Any, cfg: Any = None, image_size: int = 224, **_kw) -> Predictor:
+    from . import resnet
+
+    cfg = cfg or resnet.ResNetConfig.resnet50()
+
+    def predict(images):
+        return resnet.forward(params, images, cfg)
+
+    return Predictor(
+        name="resnet-classifier",
+        predict=predict,
+        jittable=True,
+        example_input=lambda b: np.zeros((b, image_size, image_size, 3), np.float32),
+        metadata={"image_size": image_size, "num_classes": cfg.num_classes},
+    )
+
+
+@register("llama-generate")
+def _build_llama(params: Any, cfg: Any, max_new_tokens: int = 64, **_kw) -> Predictor:
+    from . import llama
+
+    def predict(prompt_ids):
+        return llama.generate_greedy(params, prompt_ids, max_new_tokens, cfg)
+
+    return Predictor(
+        name="llama-generate",
+        predict=predict,
+        jittable=True,
+        example_input=lambda b: np.ones((b, 16), np.int32),
+        metadata={"max_new_tokens": max_new_tokens, "max_seq": cfg.max_seq},
+    )

@@ -1,0 +1,141 @@
+"""ResNet-50 image classifier (baseline config 2: canary traffic-shift).
+
+Inference-mode pure-JAX implementation: NHWC layout (TPU-native; conv
+feature maps tile onto the MXU as NHWC), batch-norm folded to scale/bias
+from running statistics at load time — a serving model never updates BN, so
+folding removes 53 elementwise ops from the graph and lets XLA fuse the
+remaining scale/bias straight into the convolutions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+
+    @classmethod
+    def resnet50(cls, **kw) -> "ResNetConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ResNetConfig":
+        defaults = dict(stage_sizes=(1, 1), num_classes=10, width=8)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def _conv(x: jax.Array, w: jax.Array, stride: int = 1, padding="SAME") -> jax.Array:
+    return lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def _scale_bias(x: jax.Array, sb: dict) -> jax.Array:
+    """Folded batch-norm: y = x * scale + bias."""
+    return x * sb["scale"].astype(x.dtype) + sb["bias"].astype(x.dtype)
+
+
+def fold_batchnorm(gamma, beta, mean, var, eps: float = 1e-5) -> dict:
+    """Fold BN running stats into an affine scale/bias pair."""
+    scale = gamma / jnp.sqrt(var + eps)
+    return {"scale": scale, "bias": beta - mean * scale}
+
+
+def _init_conv(key, kh, kw, cin, cout) -> jax.Array:
+    fan_in = kh * kw * cin
+    std = jnp.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+
+
+def _init_bn(c) -> dict:
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def init(key: jax.Array, cfg: ResNetConfig) -> dict:
+    """He-normal random init (BN pre-folded to identity scale/bias)."""
+    n_blocks = sum(cfg.stage_sizes)
+    keys = iter(jax.random.split(key, 3 + 4 * n_blocks + len(cfg.stage_sizes)))
+    w = cfg.width
+    params: dict = {
+        "stem": {"conv": _init_conv(next(keys), 7, 7, 3, w), "bn": _init_bn(w)},
+        "stages": [],
+    }
+    cin = w
+    for si, n in enumerate(cfg.stage_sizes):
+        cmid = w * (2**si)
+        cout = cmid * 4
+        stage = []
+        for bi in range(n):
+            stride = _block_stride(si, bi)
+            block = {
+                "conv1": _init_conv(next(keys), 1, 1, cin, cmid),
+                "bn1": _init_bn(cmid),
+                "conv2": _init_conv(next(keys), 3, 3, cmid, cmid),
+                "bn2": _init_bn(cmid),
+                "conv3": _init_conv(next(keys), 1, 1, cmid, cout),
+                "bn3": _init_bn(cout),
+            }
+            if cin != cout or stride != 1:
+                block["proj"] = _init_conv(next(keys), 1, 1, cin, cout)
+                block["proj_bn"] = _init_bn(cout)
+            stage.append(block)
+            cin = cout
+        params["stages"].append(stage)
+    params["fc"] = {
+        "w": 0.01 * jax.random.normal(next(keys), (cin, cfg.num_classes), jnp.float32),
+        "b": jnp.zeros((cfg.num_classes,)),
+    }
+    return params
+
+
+def _block_stride(stage_index: int, block_index: int) -> int:
+    return 2 if (stage_index > 0 and block_index == 0) else 1
+
+
+def _bottleneck(x: jax.Array, p: dict, stride: int) -> jax.Array:
+    out = jax.nn.relu(_scale_bias(_conv(x, p["conv1"]), p["bn1"]))
+    out = jax.nn.relu(_scale_bias(_conv(out, p["conv2"], stride=stride), p["bn2"]))
+    out = _scale_bias(_conv(out, p["conv3"]), p["bn3"])
+    if "proj" in p:
+        x = _scale_bias(_conv(x, p["proj"], stride=stride), p["proj_bn"])
+    return jax.nn.relu(out + x)
+
+
+def forward(params: dict, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """images [B,H,W,3] float -> logits [B,num_classes] float32."""
+    x = _scale_bias(_conv(images, params["stem"]["conv"], stride=2), params["stem"]["bn"])
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, 3, 3, 1),
+        window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+    for si, stage in enumerate(params["stages"]):
+        for bi, block in enumerate(stage):
+            x = _bottleneck(x, block, _block_stride(si, bi))
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = x @ params["fc"]["w"].astype(x.dtype) + params["fc"]["b"].astype(x.dtype)
+    return logits.astype(jnp.float32)
+
+
+def param_logical_axes(params: dict):
+    """Conv weights replicated (ResNet-50 fits on one chip; DP over batch);
+    only the FC layer is worth sharding at vocab-scale widths, left whole."""
+    return jax.tree.map(lambda _: None, params)

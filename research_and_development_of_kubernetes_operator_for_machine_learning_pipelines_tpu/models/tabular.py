@@ -1,0 +1,135 @@
+"""Tabular models (baseline config 1: gradient-boosted regressor via pyfunc).
+
+Two tiers, mirroring SURVEY §7 hard part 2 (arbitrary pyfunc models are not
+jit-compilable):
+
+- ``TreeEnsemble`` — a TPU-native decision-forest evaluator: trees are
+  flattened to index arrays and traversed with ``max_depth`` rounds of
+  vectorized gathers, so the whole forest is one jittable, batchable XLA
+  program (no per-row Python).  Converters from sklearn forests/GBMs and
+  (when installed) xgboost boosters.
+- ``PyFuncPredictor`` — the fallback tier: wraps any Python ``predict``
+  callable (e.g. an MLflow pyfunc) behind the same interface, running on
+  host CPU while keeping one metric surface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TreeArrays:
+    """One forest flattened to arrays of shape [n_trees, max_nodes].
+
+    Leaf nodes self-loop (left == right == self), so ``max_depth``
+    traversal rounds land every row on its leaf and stay there.
+    """
+
+    feature: jax.Array  # int32 [T, N] feature index tested at node
+    threshold: jax.Array  # f32 [T, N]
+    left: jax.Array  # int32 [T, N] child if x[feat] <= threshold
+    right: jax.Array  # int32 [T, N]
+    value: jax.Array  # f32 [T, N] leaf contribution
+    max_depth: int
+    base_score: float = 0.0
+
+
+def eval_forest(trees: TreeArrays, x: jax.Array) -> jax.Array:
+    """Evaluate the forest: x [B, F] -> [B] summed leaf values.
+
+    Each of ``max_depth`` rounds gathers (feature, threshold, children) for
+    the current node of every (tree, row) pair — pure gathers/selects, TPU
+    VPU-friendly, no data-dependent control flow.
+    """
+    n_trees = trees.feature.shape[0]
+    b = x.shape[0]
+    node = jnp.zeros((n_trees, b), jnp.int32)
+    xt = x.T  # [F, B]
+
+    def step(node):
+        feat = jnp.take_along_axis(trees.feature, node, axis=1)  # [T, B]
+        thr = jnp.take_along_axis(trees.threshold, node, axis=1)  # [T, B]
+        # For tree t, row b: x[b, feat[t, b]]  ==  xt[feat[t, b], b].
+        xv = jnp.take_along_axis(xt, feat, axis=0)  # [T, B]
+        go_left = xv <= thr
+        l = jnp.take_along_axis(trees.left, node, axis=1)
+        r = jnp.take_along_axis(trees.right, node, axis=1)
+        return jnp.where(go_left, l, r)
+
+    for _ in range(trees.max_depth):
+        node = step(node)
+    leaf_vals = jnp.take_along_axis(trees.value, node, axis=1)  # [T, B]
+    return leaf_vals.sum(axis=0) + trees.base_score
+
+
+def from_sklearn_forest(model) -> TreeArrays:
+    """Convert sklearn RandomForest*/GradientBoosting* to TreeArrays."""
+    if not hasattr(model, "estimators_"):
+        raise TypeError(f"unsupported sklearn model {type(model).__name__}")
+    raw = np.asarray(model.estimators_).ravel().tolist()
+    estimators = [e.tree_ for e in raw]
+    # RandomForest averages trees; GradientBoosting sums lr-scaled trees on
+    # top of the init estimator's constant prediction.
+    if type(model).__name__.startswith("RandomForest"):
+        scale, base = 1.0 / len(estimators), 0.0
+    else:
+        scale = float(model.learning_rate)
+        init = getattr(model, "init_", None)
+        base = float(np.ravel(init.constant_)[0]) if hasattr(init, "constant_") else 0.0
+
+    max_nodes = max(t.node_count for t in estimators)
+    max_depth = max(t.max_depth for t in estimators)
+    T = len(estimators)
+    feature = np.zeros((T, max_nodes), np.int32)
+    threshold = np.zeros((T, max_nodes), np.float32)
+    left = np.zeros((T, max_nodes), np.int32)
+    right = np.zeros((T, max_nodes), np.int32)
+    value = np.zeros((T, max_nodes), np.float32)
+    for ti, t in enumerate(estimators):
+        n = t.node_count
+        is_leaf = t.children_left[:n] == -1
+        feature[ti, :n] = np.where(is_leaf, 0, t.feature[:n])
+        threshold[ti, :n] = np.where(is_leaf, 0.0, t.threshold[:n])
+        idx = np.arange(n)
+        left[ti, :n] = np.where(is_leaf, idx, t.children_left[:n])
+        right[ti, :n] = np.where(is_leaf, idx, t.children_right[:n])
+        value[ti, :n] = np.where(is_leaf, t.value[:n, 0, 0] * scale, 0.0)
+    return TreeArrays(
+        feature=jnp.asarray(feature),
+        threshold=jnp.asarray(threshold),
+        left=jnp.asarray(left),
+        right=jnp.asarray(right),
+        value=jnp.asarray(value),
+        max_depth=int(max_depth),
+        base_score=float(base),
+    )
+
+
+def from_xgboost(booster) -> TreeArrays:  # pragma: no cover - xgboost optional
+    """Convert an xgboost Booster (gated: xgboost not in the base image)."""
+    raise NotImplementedError(
+        "xgboost is not available in this environment; use PyFuncPredictor "
+        "or convert via sklearn's GradientBoosting equivalent"
+    )
+
+
+class PyFuncPredictor:
+    """Fallback tier: any Python callable behind the predictor interface.
+
+    Not jittable; runs on host.  Used for MLflow pyfunc artifacts whose
+    flavor has no TPU-native lowering.
+    """
+
+    def __init__(self, predict: Callable[[np.ndarray], np.ndarray], name: str = "pyfunc"):
+        self._predict = predict
+        self.name = name
+        self.jittable = False
+
+    def __call__(self, x: Any) -> np.ndarray:
+        return np.asarray(self._predict(np.asarray(x)))

@@ -1,0 +1,79 @@
+"""Linear/iris (sklearn parity) and ResNet-50 sanity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumlops.models import linear, resnet
+
+
+def test_iris_logistic_regression_parity():
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+
+    X, y = load_iris(return_X_y=True)
+    sk = LogisticRegression(max_iter=500).fit(X, y)
+    params, cfg = linear.from_sklearn(sk)
+
+    proba = np.asarray(linear.predict_proba(params, jnp.asarray(X, jnp.float32)))
+    np.testing.assert_allclose(proba, sk.predict_proba(X), atol=1e-4)
+    pred = np.asarray(linear.predict(params, jnp.asarray(X, jnp.float32), cfg))
+    np.testing.assert_array_equal(pred, sk.predict(X))
+
+
+def test_linear_regression_parity():
+    from sklearn.linear_model import LinearRegression
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(50, 4))
+    y = X @ [1.0, -2.0, 0.5, 3.0] + 0.7
+    sk = LinearRegression().fit(X, y)
+    params, cfg = linear.from_sklearn(sk)
+    pred = np.asarray(linear.predict(params, jnp.asarray(X, jnp.float32), cfg))
+    np.testing.assert_allclose(pred, sk.predict(X), atol=1e-4)
+
+
+def test_resnet_tiny_forward_shape_and_jit():
+    cfg = resnet.ResNetConfig.tiny()
+    params = resnet.init(jax.random.key(0), cfg)
+    imgs = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    logits = jax.jit(lambda p, x: resnet.forward(p, x, cfg))(params, imgs)
+    assert logits.shape == (2, cfg.num_classes)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_resnet50_param_count():
+    # ResNet-50 has ~25.6M params; folded-BN form drops the running stats
+    # but keeps scale/bias, so the count stays in the canonical ballpark.
+    from tpumlops.models.common import count_params
+
+    cfg = resnet.ResNetConfig.resnet50()
+    params = resnet.init(jax.random.key(0), cfg)
+    n = count_params(params)
+    assert 25_000_000 < n < 26_000_000, n
+
+
+def test_fold_batchnorm_matches_torch_eval_bn():
+    import torch
+
+    rng = np.random.default_rng(0)
+    c = 8
+    gamma = rng.normal(size=c).astype(np.float32)
+    beta = rng.normal(size=c).astype(np.float32)
+    mean = rng.normal(size=c).astype(np.float32)
+    var = rng.uniform(0.5, 2.0, size=c).astype(np.float32)
+    x = rng.normal(size=(2, 5, 5, c)).astype(np.float32)
+
+    sb = resnet.fold_batchnorm(
+        jnp.asarray(gamma), jnp.asarray(beta), jnp.asarray(mean), jnp.asarray(var)
+    )
+    ours = np.asarray(jnp.asarray(x) * sb["scale"] + sb["bias"])
+
+    bn = torch.nn.BatchNorm1d(c, eps=1e-5).eval()
+    with torch.no_grad():
+        bn.weight.copy_(torch.tensor(gamma))
+        bn.bias.copy_(torch.tensor(beta))
+        bn.running_mean.copy_(torch.tensor(mean))
+        bn.running_var.copy_(torch.tensor(var))
+        theirs = bn(torch.tensor(x).reshape(-1, c)).numpy().reshape(ours.shape)
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)

@@ -1,0 +1,77 @@
+"""TPU-native tree-ensemble evaluator vs sklearn, and the registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumlops.models import registry, tabular
+
+
+def test_random_forest_parity():
+    from sklearn.datasets import make_regression
+    from sklearn.ensemble import RandomForestRegressor
+
+    X, y = make_regression(n_samples=200, n_features=8, random_state=0)
+    sk = RandomForestRegressor(n_estimators=12, max_depth=6, random_state=0).fit(X, y)
+    trees = tabular.from_sklearn_forest(sk)
+    ours = np.asarray(
+        jax.jit(lambda x: tabular.eval_forest(trees, x))(jnp.asarray(X, jnp.float32))
+    )
+    np.testing.assert_allclose(ours, sk.predict(X), rtol=1e-4, atol=1e-3)
+
+
+def test_gradient_boosting_parity():
+    from sklearn.datasets import make_regression
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    X, y = make_regression(n_samples=150, n_features=5, random_state=1)
+    sk = GradientBoostingRegressor(n_estimators=20, max_depth=3, random_state=1).fit(X, y)
+    trees = tabular.from_sklearn_forest(sk)
+    ours = np.asarray(tabular.eval_forest(trees, jnp.asarray(X, jnp.float32)))
+    np.testing.assert_allclose(ours, sk.predict(X), rtol=1e-4, atol=1e-3)
+
+
+def test_pyfunc_fallback_tier():
+    p = tabular.PyFuncPredictor(lambda x: x.sum(axis=1))
+    out = p(np.ones((3, 4)))
+    np.testing.assert_allclose(out, [4.0, 4.0, 4.0])
+    assert p.jittable is False
+
+
+def test_registry_builds_all_builtin_flavors():
+    flavors = registry.list_flavors()
+    assert {
+        "sklearn-linear",
+        "sklearn-forest",
+        "pyfunc",
+        "bert-classifier",
+        "resnet-classifier",
+        "llama-generate",
+    } <= set(flavors)
+
+
+def test_registry_sklearn_linear_end_to_end():
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+
+    X, y = load_iris(return_X_y=True)
+    sk = LogisticRegression(max_iter=500).fit(X, y)
+    pred = registry.get_builder("sklearn-linear")(sk)
+    assert pred.jittable
+    out = np.asarray(jax.jit(pred.predict)(jnp.asarray(X, jnp.float32)))
+    np.testing.assert_array_equal(out, sk.predict(X))
+    ex = pred.example_input(4)
+    assert ex.shape == (4, X.shape[1])
+
+
+def test_registry_unknown_flavor():
+    import pytest
+
+    with pytest.raises(KeyError, match="unknown model flavor"):
+        registry.get_builder("nope")
+
+
+def test_models_star_import_works():
+    ns = {}
+    exec("from tpumlops.models import *", ns)
+    assert "llama" in ns and "registry" in ns and "tabular" in ns
