@@ -1,0 +1,117 @@
+"""Benchmark of record (driver contract: prints ONE JSON line).
+
+Headline metric — BERT-base batched-inference p99 latency per chip
+(BASELINE.md north star; acceptance config 3).  ``vs_baseline`` compares
+against the reference's data plane: the reference serves models through
+Seldon's CPU ``MLFLOW_SERVER`` pods (its manifests request no GPU —
+``mlflow_operator.py:193-222``), so the baseline is the same BERT-base
+batch on torch/CPU, measured live in this process.  Values > 1 mean the
+TPU path is faster.
+
+Run on the real TPU chip: ``python bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _percentiles(samples: list[float], ps=(50, 99)) -> dict[int, float]:
+    xs = sorted(samples)
+    out = {}
+    for p in ps:
+        idx = min(len(xs) - 1, max(0, round(p / 100 * (len(xs) - 1))))
+        out[p] = xs[idx]
+    return out
+
+
+BATCH = 32
+SEQ = 128
+PIPELINE = 10  # batches in flight per timed run (amortizes host<->device RTT)
+RUNS = 12
+
+
+def bench_tpu() -> dict[int, float]:
+    """Per-batch latency with PIPELINE batches in flight.
+
+    Single-call block_until_ready timing would measure the host<->device
+    round trip (65+ ms through a tunnel in dev environments), not the chip.
+    A serving process keeps the dispatch queue full, so per-batch latency
+    under pipelining is the number that governs throughput and the
+    Prometheus histograms the gate reads.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import bert
+
+    try:  # persistent compile cache across rounds
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    except Exception:
+        pass
+
+    cfg = bert.BertConfig.base()
+    params = bert.init(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (BATCH, SEQ), 0, cfg.vocab_size)
+    mask = jnp.ones((BATCH, SEQ), jnp.int32)
+
+    f = jax.jit(
+        lambda p, i, m: bert.classify(p, i, m, cfg=cfg, dtype=jnp.bfloat16)
+    )
+    f(params, ids, mask).block_until_ready()  # compile
+    samples = []
+    for _ in range(RUNS):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(PIPELINE):
+            out = f(params, ids, mask)
+        out.block_until_ready()
+        samples.append((time.perf_counter() - t0) / PIPELINE)
+    return _percentiles(samples)
+
+
+def bench_torch_cpu(iters: int = 3) -> dict[int, float]:
+    import torch
+    from transformers import BertConfig as HFConfig
+    from transformers import BertForSequenceClassification
+
+    model = BertForSequenceClassification(HFConfig())
+    model.eval()
+    ids = torch.randint(0, 30000, (BATCH, SEQ))
+    with torch.no_grad():
+        model(input_ids=ids)  # warmup
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            model(input_ids=ids)
+            samples.append(time.perf_counter() - t0)
+    return _percentiles(samples)
+
+
+def main() -> None:
+    tpu = bench_tpu()
+    try:
+        ref = bench_torch_cpu()
+        vs_baseline = ref[99] / tpu[99]
+        baseline_ms = ref[99] * 1000
+    except Exception as e:  # torch baseline is best-effort
+        print(f"baseline measurement failed: {e}", file=sys.stderr)
+        vs_baseline = None
+        baseline_ms = None
+    line = {
+        "metric": "bert_base_b32_s128_p99_batch_latency_per_chip",
+        "value": round(tpu[99] * 1000, 3),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+        "p50_ms": round(tpu[50] * 1000, 3),
+        "throughput_seq_per_s": round(BATCH / tpu[50], 1),
+        "baseline_cpu_p99_ms": round(baseline_ms, 1) if baseline_ms else None,
+        "hardware": "TPU v5e (1 chip)",
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

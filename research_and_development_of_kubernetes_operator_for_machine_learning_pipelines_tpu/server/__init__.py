@@ -1,0 +1,29 @@
+"""The first-party TPU inference server (data plane).
+
+The reference delegates all inference to Seldon's prebuilt ``MLFLOW_SERVER``
+container (``mlflow_operator.py:198,:213``) and only manipulates traffic
+weights around it.  This package replaces that outsourced data plane:
+
+- ``loader``   — resolve a model URI to a ``Predictor`` (MLmodel-aware,
+  tiered: TPU-native JAX flavors vs host pyfunc fallback)
+- ``engine``   — jit compilation, batch-bucket warmup, thread-safe dispatch
+- ``batching`` — dynamic request batching with power-of-two padding buckets
+- ``metrics``  — Prometheus histograms with the exact metric names + identity
+  labels the promotion gate queries (``mlflow_operator.py:367-415``)
+- ``app``      — V2 (kfserving) + Seldon-protocol HTTP endpoints
+"""
+
+from .engine import InferenceEngine
+from .metrics import ServerMetrics
+
+__all__ = ["InferenceEngine", "ServerMetrics", "app", "loader", "batching"]
+
+
+def __getattr__(name):
+    if name in ("app", "loader", "batching"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

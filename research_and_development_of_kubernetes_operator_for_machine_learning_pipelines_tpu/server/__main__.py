@@ -1,0 +1,7 @@
+"""``python -m <package>.server`` — container entrypoint for the TPU
+inference server (the builder's generated manifests invoke this)."""
+
+from .app import main
+
+if __name__ == "__main__":
+    main()

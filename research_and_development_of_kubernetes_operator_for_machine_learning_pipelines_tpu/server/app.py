@@ -1,0 +1,314 @@
+"""HTTP inference server: V2 (kfserving) + Seldon protocol + Prometheus.
+
+Serves the protocols the reference's stack expects — the SeldonDeployment
+declares ``protocol: kfserving`` (``mlflow_operator.py:235``), i.e. the V2
+dataplane, and Istio routes raw HTTP between predictor versions — while
+exporting the gate-compatible metrics (see ``metrics.py``).
+
+Endpoints:
+- ``GET  /v2/health/live``, ``GET /v2/health/ready``
+- ``GET  /v2/models/{name}``, ``GET /v2/models/{name}/ready``
+- ``POST /v2/models/{name}/infer``      (V2 JSON tensors)
+- ``POST /api/v1.0/predictions``        (Seldon ndarray compat)
+- ``GET  /metrics``                      (Prometheus exposition)
+
+Single-example requests are cross-request batched by the dynamic batcher;
+client-batched requests run directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+from typing import Any
+
+import numpy as np
+from aiohttp import web
+
+from ..utils.config import ServerConfig, TpuSpec
+from .batching import DynamicBatcher
+from .engine import InferenceEngine
+from .loader import load_predictor
+from .metrics import ServerMetrics
+
+_log = logging.getLogger(__name__)
+
+_V2_TO_NP = {
+    "FP32": np.float32,
+    "FP64": np.float64,
+    "FP16": np.float16,
+    "BF16": np.float32,  # JSON carries floats; cast happens model-side
+    "INT32": np.int32,
+    "INT64": np.int64,
+    "UINT8": np.uint8,
+    "BOOL": np.bool_,
+}
+_NP_TO_V2 = {
+    np.dtype(np.float32): "FP32",
+    np.dtype(np.float64): "FP64",
+    np.dtype(np.float16): "FP16",
+    np.dtype(np.int32): "INT32",
+    np.dtype(np.int64): "INT64",
+    np.dtype(np.uint8): "UINT8",
+    np.dtype(np.bool_): "BOOL",
+}
+
+
+class TpuInferenceServer:
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        metrics: ServerMetrics,
+        model_name: str,
+        max_batch_size: int = 32,
+        max_batch_delay_ms: float = 5.0,
+    ):
+        self.engine = engine
+        self.metrics = metrics
+        self.model_name = model_name
+        self.ready = False
+        self.batcher = DynamicBatcher(
+            run_batch=engine.predict,
+            max_batch_size=max_batch_size,
+            max_batch_delay_ms=max_batch_delay_ms,
+            on_batch=metrics.observe_batch,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def startup(self, warmup: bool = True) -> None:
+        if warmup:
+            self.engine.warmup()
+        self.batcher.start()
+        self.ready = True
+        self.metrics.ready.labels(**self.metrics.identity).set(1)
+
+    def shutdown(self) -> None:
+        self.ready = False
+        self.batcher.stop()
+
+    # -- request handling ----------------------------------------------------
+
+    async def _run(self, inputs: dict[str, np.ndarray]) -> Any:
+        """Dispatch: batch-1 via the dynamic batcher, larger directly."""
+        batch = next(iter(inputs.values())).shape[0]
+        if batch == 1:
+            single = {k: v[0] for k, v in inputs.items()}
+            fut = self.batcher.submit(single)
+            out = await asyncio.wrap_future(fut)
+            return _add_batch_dim(out)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.engine.predict, inputs)
+
+    async def handle_v2_infer(self, request: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            body = await request.json()
+            inputs: dict[str, np.ndarray] = {}
+            for tensor in body.get("inputs", []):
+                dt = _V2_TO_NP.get(tensor.get("datatype", "FP32"))
+                if dt is None:
+                    raise ValueError(f"unsupported datatype {tensor.get('datatype')}")
+                arr = np.asarray(tensor["data"], dtype=dt).reshape(tensor["shape"])
+                inputs[tensor["name"]] = arr
+            if not inputs:
+                raise ValueError("request has no inputs")
+            out = await self._run(inputs)
+            outputs = _to_v2_outputs(out)
+            return web.json_response(
+                {
+                    "model_name": self.model_name,
+                    "id": body.get("id", ""),
+                    "outputs": outputs,
+                }
+            )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            code = 400
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:  # model/runtime failure
+            _log.exception("inference failed")
+            code = 500
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self.metrics.observe_request(time.perf_counter() - t0, code=code)
+
+    async def handle_seldon_predict(self, request: web.Request) -> web.Response:
+        """Seldon-protocol compatibility (``{"data": {"ndarray": ...}}``)."""
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            body = await request.json()
+            data = body.get("data", {})
+            if "ndarray" in data:
+                arr = np.asarray(data["ndarray"], dtype=np.float32)
+            elif "tensor" in data:
+                t = data["tensor"]
+                arr = np.asarray(t["values"], np.float32).reshape(t["shape"])
+            else:
+                raise ValueError("data.ndarray or data.tensor required")
+            out = await self._run({"x": arr})
+            out_arr = np.asarray(out if not isinstance(out, tuple) else out[0])
+            return web.json_response(
+                {"data": {"ndarray": out_arr.tolist()}, "meta": {}}
+            )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            code = 400
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            _log.exception("inference failed")
+            code = 500
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self.metrics.observe_request(time.perf_counter() - t0, code=code)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.exposition(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def handle_live(self, request: web.Request) -> web.Response:
+        return web.json_response({"live": True})
+
+    async def handle_ready(self, request: web.Request) -> web.Response:
+        status = 200 if self.ready else 503
+        return web.json_response({"ready": self.ready}, status=status)
+
+    async def handle_model_metadata(self, request: web.Request) -> web.Response:
+        p = self.engine.predictor
+        return web.json_response(
+            {
+                "name": self.model_name,
+                "platform": "tpumlops-jax",
+                "flavor": p.name,
+                "jittable": p.jittable,
+                "metadata": p.metadata,
+            }
+        )
+
+    # -- app wiring ----------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        name = self.model_name
+        app.router.add_get("/v2/health/live", self.handle_live)
+        app.router.add_get("/v2/health/ready", self.handle_ready)
+        app.router.add_get(f"/v2/models/{name}", self.handle_model_metadata)
+        app.router.add_get(f"/v2/models/{name}/ready", self.handle_ready)
+        app.router.add_post(f"/v2/models/{name}/infer", self.handle_v2_infer)
+        app.router.add_post("/api/v1.0/predictions", self.handle_seldon_predict)
+        app.router.add_get("/metrics", self.handle_metrics)
+
+        async def on_shutdown(_app):
+            self.shutdown()
+
+        app.on_shutdown.append(on_shutdown)
+        return app
+
+
+def _add_batch_dim(out: Any) -> Any:
+    if isinstance(out, tuple):
+        return tuple(_add_batch_dim(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _add_batch_dim(v) for k, v in out.items()}
+    return np.asarray(out)[None, ...]
+
+
+def _to_v2_outputs(out: Any) -> list[dict]:
+    if isinstance(out, dict):
+        items = list(out.items())
+    elif isinstance(out, tuple):
+        items = [(f"output_{i}", o) for i, o in enumerate(out)]
+    else:
+        items = [("output_0", out)]
+    v2 = []
+    for name, arr in items:
+        arr = np.asarray(arr)
+        v2.append(
+            {
+                "name": name,
+                "shape": list(arr.shape),
+                "datatype": _NP_TO_V2.get(arr.dtype, "FP32"),
+                "data": arr.ravel().tolist(),
+            }
+        )
+    return v2
+
+
+# ---------------------------------------------------------------------------
+# CLI (the container entrypoint generated by the manifest builder)
+# ---------------------------------------------------------------------------
+
+
+def build_server(config: ServerConfig, warmup: bool = True) -> TpuInferenceServer:
+    mesh_shape = dict(config.tpu.mesh_shape)
+    predictor = load_predictor(config.model_uri, mesh_shape=mesh_shape)
+    metrics = ServerMetrics(
+        deployment_name=config.deployment_name or config.model_name,
+        predictor_name=config.predictor_name,
+        namespace=config.namespace,
+    )
+    engine = InferenceEngine(
+        predictor,
+        max_batch_size=config.tpu.max_batch_size,
+        on_compile=lambda: metrics.compilations.labels(**metrics.identity).inc(),
+    )
+    server = TpuInferenceServer(
+        engine,
+        metrics,
+        model_name=config.model_name,
+        max_batch_size=config.tpu.max_batch_size,
+        max_batch_delay_ms=config.tpu.max_batch_delay_ms,
+    )
+    server.startup(warmup=warmup)
+    return server
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser("tpumlops-server")
+    ap.add_argument("--model-uri", required=True)
+    ap.add_argument("--model-name", default="model")
+    ap.add_argument("--predictor-name", default="v1")
+    ap.add_argument("--deployment-name", default="")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--mesh-shape", default='{"dp": 1, "tp": 1}')
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--max-batch-size", type=int, default=32)
+    ap.add_argument("--max-batch-delay-ms", type=float, default=5.0)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=9000)
+    args = ap.parse_args(argv)
+
+    from ..parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
+
+    config = ServerConfig(
+        model_name=args.model_name,
+        model_uri=args.model_uri,
+        predictor_name=args.predictor_name,
+        deployment_name=args.deployment_name or args.model_name,
+        namespace=args.namespace,
+        host=args.host,
+        port=args.port,
+        tpu=TpuSpec.from_spec(
+            {
+                "meshShape": json.loads(args.mesh_shape),
+                "dtype": args.dtype,
+                "maxBatchSize": args.max_batch_size,
+                "maxBatchDelayMs": args.max_batch_delay_ms,
+            }
+        ),
+    )
+    logging.basicConfig(level=logging.INFO)
+    server = build_server(config)
+    web.run_app(server.build_app(), host=config.host, port=config.port)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,156 @@
+"""Dynamic request batching with power-of-two padding buckets.
+
+TPU serving economics: the MXU wants large batches, XLA wants few distinct
+shapes.  The batcher bridges both — requests queue briefly
+(``max_batch_delay_ms``), are grouped by trailing shape (so a seq-128 BERT
+batch never pads against a seq-32 one), stacked, padded up to the next
+power-of-two batch bucket, run once, and split back per caller.  Each bucket
+shape compiles exactly once (the engine warms the common ones at startup).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+
+def next_bucket(n: int, max_batch: int) -> int:
+    """Smallest power of two >= n, capped at max_batch."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+def _group_key(inputs: Mapping[str, np.ndarray]) -> tuple:
+    return tuple(sorted((k, v.shape[1:], str(v.dtype)) for k, v in inputs.items()))
+
+
+@dataclass
+class _Item:
+    inputs: dict[str, np.ndarray]  # each [1, ...] (single example, batch dim 1)
+    future: Future
+    enqueued_at: float = field(default_factory=time.perf_counter)
+
+
+class DynamicBatcher:
+    """Collects single-example requests into padded batches.
+
+    ``run_batch(inputs: dict[str, np.ndarray]) -> np.ndarray | tuple`` is
+    called with stacked+padded arrays; outputs are split along axis 0 and
+    delivered to each request's Future.
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable[[dict[str, np.ndarray]], Any],
+        max_batch_size: int = 32,
+        max_batch_delay_ms: float = 5.0,
+        on_batch: Callable[[int, float], None] | None = None,
+    ):
+        self._run_batch = run_batch
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_s = float(max_batch_delay_ms) / 1000.0
+        self._on_batch = on_batch
+        self._queue: queue.Queue[_Item | None] = queue.Queue()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._started = False
+        self._stop = False
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._queue.put(None)
+        if self._started:
+            self._thread.join(timeout=5)
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, inputs: Mapping[str, np.ndarray]) -> Future:
+        """Submit one example (arrays WITHOUT batch dim); returns a Future."""
+        batched = {k: np.asarray(v)[None, ...] for k, v in inputs.items()}
+        fut: Future = Future()
+        self._queue.put(_Item(batched, fut))
+        return fut
+
+    # -- worker side ---------------------------------------------------------
+
+    def _collect(self) -> list[_Item]:
+        first = self._queue.get()
+        if first is None:
+            return []
+        items = [first]
+        deadline = time.perf_counter() + self.max_delay_s
+        key = _group_key(first.inputs)
+        pending: list[_Item] = []
+        while len(items) < self.max_batch_size:
+            timeout = deadline - time.perf_counter()
+            if timeout <= 0:
+                break
+            try:
+                item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                break
+            if item is None:
+                self._stop = True
+                break
+            if _group_key(item.inputs) == key:
+                items.append(item)
+            else:
+                pending.append(item)  # different shape: next batch
+        for p in pending:
+            self._queue.put(p)
+        return items
+
+    def _worker(self) -> None:
+        while not self._stop:
+            items = self._collect()
+            if not items:
+                continue
+            self._execute(items)
+
+    def _execute(self, items: list[_Item]) -> None:
+        n = len(items)
+        bucket = next_bucket(n, self.max_batch_size)
+        try:
+            stacked = {
+                k: np.concatenate([it.inputs[k] for it in items], axis=0)
+                for k in items[0].inputs
+            }
+            if bucket > n:  # pad by repeating the last example (valid data,
+                # so no NaN/inf poisoning from zero-padding odd dtypes)
+                pad = {k: np.repeat(v[-1:], bucket - n, axis=0) for k, v in stacked.items()}
+                stacked = {k: np.concatenate([v, pad[k]], axis=0) for k, v in stacked.items()}
+            queue_age = time.perf_counter() - items[0].enqueued_at
+            out = self._run_batch(stacked)
+            if self._on_batch:
+                self._on_batch(n, queue_age)
+            outputs = _split_outputs(out, n)
+            for i, item in enumerate(items):
+                item.future.set_result(outputs[i])
+        except Exception as e:
+            for item in items:
+                if not item.future.done():
+                    item.future.set_exception(e)
+
+
+def _split_outputs(out: Any, n: int) -> list[Any]:
+    """Split batch-dim-0 outputs (array or tuple/dict of arrays) into n rows."""
+    if isinstance(out, (tuple, list)):
+        parts = [_split_outputs(o, n) for o in out]
+        return [tuple(p[i] for p in parts) for i in range(n)]
+    if isinstance(out, dict):
+        parts = {k: _split_outputs(v, n) for k, v in out.items()}
+        return [{k: v[i] for k, v in parts.items()} for i in range(n)]
+    arr = np.asarray(out)
+    return [arr[i] for i in range(n)]

@@ -1,0 +1,101 @@
+"""Prometheus metrics with Seldon-executor-compatible identity.
+
+The promotion gate queries exactly these series (``mlflow_operator.py``):
+
+- ``seldon_api_executor_client_requests_seconds`` histogram — p95 latency
+  (``:367``), mean latency Δsum/Δcount (``:393-404``), request count (``:407``);
+- ``seldon_api_executor_server_requests_seconds_count`` with a ``code``
+  label — error counting via ``code!="200"`` (``:375``) and a ``service``
+  label for feedback requests (``:410``);
+
+all keyed by ``{deployment_name, predictor_name, namespace}`` (``:367``).
+Emitting the same names and labels means the reference's PromQL — and our
+gate, which preserves it — works against this server unmodified (SURVEY §7
+hard part 4: metric identity).
+
+Beyond gate compatibility the server exports first-party TPU series
+(``tpumlops_*``): batch sizes, queue latency, compile counts.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+# Latency SLOs live in the 1ms-10s range on TPU; buckets chosen to resolve
+# p95/p99 there.
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class ServerMetrics:
+    def __init__(self, deployment_name: str, predictor_name: str, namespace: str):
+        self.registry = CollectorRegistry()
+        self.identity = {
+            "deployment_name": deployment_name,
+            "predictor_name": predictor_name,
+            "namespace": namespace,
+        }
+        ident_labels = list(self.identity)
+
+        self.client_requests = Histogram(
+            "seldon_api_executor_client_requests_seconds",
+            "Inference request latency (gate-compatible identity)",
+            ident_labels,
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.server_requests = Counter(
+            "seldon_api_executor_server_requests_seconds",
+            "Request counts by HTTP code (gate queries code!='200')",
+            ident_labels + ["code", "service"],
+            registry=self.registry,
+        )
+        self.batch_size = Histogram(
+            "tpumlops_batch_size",
+            "Dynamic-batcher batch sizes",
+            ident_labels,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+            registry=self.registry,
+        )
+        self.queue_seconds = Histogram(
+            "tpumlops_queue_seconds",
+            "Time requests spend in the batching queue",
+            ident_labels,
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.compilations = Counter(
+            "tpumlops_compilations_total",
+            "XLA compilations triggered (by bucket signature)",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.ready = Gauge(
+            "tpumlops_model_ready",
+            "1 once the model is loaded and warmed",
+            ident_labels,
+            registry=self.registry,
+        )
+
+    # -- recording helpers ---------------------------------------------------
+
+    def observe_request(self, seconds: float, code: int = 200, service: str = "predictions"):
+        self.client_requests.labels(**self.identity).observe(seconds)
+        self.server_requests.labels(
+            **self.identity, code=str(code), service=service
+        ).inc()
+
+    def observe_batch(self, size: int, queue_seconds: float):
+        self.batch_size.labels(**self.identity).observe(size)
+        self.queue_seconds.labels(**self.identity).observe(queue_seconds)
+
+    def exposition(self) -> bytes:
+        return generate_latest(self.registry)

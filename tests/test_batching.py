@@ -1,0 +1,82 @@
+"""Dynamic batcher unit tests."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpumlops.server.batching import DynamicBatcher, next_bucket, _split_outputs
+
+
+def test_next_bucket_powers_of_two():
+    assert [next_bucket(n, 32) for n in (1, 2, 3, 5, 9, 32, 40)] == [
+        1, 2, 4, 8, 16, 32, 32,
+    ]
+
+
+def test_split_outputs_variants():
+    arr = np.arange(6).reshape(3, 2)
+    assert [list(r) for r in _split_outputs(arr, 3)] == [[0, 1], [2, 3], [4, 5]]
+    tup = _split_outputs((arr, arr * 2), 3)
+    assert list(tup[1][1]) == [4, 6]
+    d = _split_outputs({"a": arr}, 2)
+    assert list(d[0]["a"]) == [0, 1]
+
+
+def test_batcher_batches_concurrent_requests():
+    batch_sizes = []
+
+    def run_batch(inputs):
+        batch_sizes.append(inputs["x"].shape[0])
+        return inputs["x"] * 2
+
+    b = DynamicBatcher(run_batch, max_batch_size=8, max_batch_delay_ms=30)
+    b.start()
+    futs = [b.submit({"x": np.full((2,), i, np.float32)}) for i in range(6)]
+    results = [f.result(timeout=5) for f in futs]
+    b.stop()
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r, np.full((2,), 2 * i))
+    # All 6 should have ridden few batches (padded to a power-of-two bucket).
+    assert sum(batch_sizes) >= 6
+    assert max(batch_sizes) > 1
+    assert all(s in (1, 2, 4, 8) for s in batch_sizes)
+
+
+def test_batcher_groups_by_shape():
+    shapes_seen = []
+
+    def run_batch(inputs):
+        shapes_seen.append(inputs["x"].shape)
+        return inputs["x"].sum(axis=1)
+
+    b = DynamicBatcher(run_batch, max_batch_size=8, max_batch_delay_ms=20)
+    b.start()
+    f1 = b.submit({"x": np.ones((4,), np.float32)})
+    f2 = b.submit({"x": np.ones((6,), np.float32)})  # different trailing shape
+    assert f1.result(5) == 4.0
+    assert f2.result(5) == 6.0
+    b.stop()
+    assert len(shapes_seen) == 2  # never padded across shapes
+
+
+def test_batcher_propagates_exceptions():
+    def run_batch(inputs):
+        raise RuntimeError("boom")
+
+    b = DynamicBatcher(run_batch, max_batch_size=4, max_batch_delay_ms=5)
+    b.start()
+    fut = b.submit({"x": np.ones((2,), np.float32)})
+    with pytest.raises(RuntimeError, match="boom"):
+        fut.result(timeout=5)
+    # Batcher survives and serves the next request.
+    ok_holder = {}
+
+    def run_ok(inputs):
+        return inputs["x"]
+
+    b._run_batch = run_ok
+    fut2 = b.submit({"x": np.ones((2,), np.float32)})
+    np.testing.assert_array_equal(fut2.result(timeout=5), np.ones((2,)))
+    b.stop()

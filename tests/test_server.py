@@ -1,0 +1,313 @@
+"""End-to-end data-plane tests: artifact -> loader -> engine -> HTTP."""
+
+import asyncio
+import json
+import threading
+import time
+
+import httpx
+import numpy as np
+import pytest
+from aiohttp import web
+
+from tpumlops.server.app import TpuInferenceServer, build_server
+from tpumlops.server.engine import InferenceEngine
+from tpumlops.server.loader import (
+    ModelLoadError,
+    load_predictor,
+    resolve_uri,
+    save_native_model,
+    save_sklearn_model,
+)
+from tpumlops.server.metrics import ServerMetrics
+from tpumlops.utils.config import ServerConfig, TpuSpec
+
+
+# ---------------------------------------------------------------------------
+# Harness: run an aiohttp app in a background thread, talk httpx to it.
+# ---------------------------------------------------------------------------
+
+
+class ServerHandle:
+    def __init__(self, server: TpuInferenceServer, port: int):
+        self.server = server
+        self.port = port
+        self.base = f"http://127.0.0.1:{port}"
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+        self._runner = web.AppRunner(self.server.build_app())
+        self._loop.run_until_complete(self._runner.setup())
+        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        self._loop.run_until_complete(site.start())
+        self._loop.run_forever()
+
+    def start(self):
+        self._thread.start()
+        for _ in range(100):
+            try:
+                httpx.get(self.base + "/v2/health/live", timeout=0.5)
+                return self
+            except Exception:
+                time.sleep(0.05)
+        raise RuntimeError("server did not come up")
+
+    def stop(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self.server.shutdown()
+
+
+_PORT = [19300]
+
+
+def serve(server: TpuInferenceServer) -> ServerHandle:
+    _PORT[0] += 1
+    return ServerHandle(server, _PORT[0]).start()
+
+
+@pytest.fixture(scope="module")
+def iris_server(tmp_path_factory):
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+
+    X, y = load_iris(return_X_y=True)
+    sk = LogisticRegression(max_iter=500).fit(X, y)
+    art = tmp_path_factory.mktemp("artifacts") / "iris"
+    save_sklearn_model(art, sk, "sklearn-linear")
+
+    config = ServerConfig(
+        model_name="iris",
+        model_uri=str(art),
+        predictor_name="v1",
+        deployment_name="iris",
+        namespace="models",
+        tpu=TpuSpec.from_spec({"meshShape": {"tp": 1}, "maxBatchSize": 8, "maxBatchDelayMs": 2}),
+    )
+    server = build_server(config)
+    handle = serve(server)
+    yield handle, sk, X, y
+    handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# V2 protocol
+# ---------------------------------------------------------------------------
+
+
+def test_v2_single_infer_matches_sklearn(iris_server):
+    handle, sk, X, y = iris_server
+    row = X[7]
+    resp = httpx.post(
+        handle.base + "/v2/models/iris/infer",
+        json={
+            "inputs": [
+                {
+                    "name": "x",
+                    "shape": [1, 4],
+                    "datatype": "FP32",
+                    "data": [float(v) for v in row],
+                }
+            ]
+        },
+        timeout=30,
+    )
+    assert resp.status_code == 200, resp.text
+    out = resp.json()["outputs"][0]
+    assert out["shape"] == [1]
+    assert out["data"][0] == int(sk.predict(row[None])[0])
+
+
+def test_v2_client_batched_infer(iris_server):
+    handle, sk, X, y = iris_server
+    batch = X[:12]
+    resp = httpx.post(
+        handle.base + "/v2/models/iris/infer",
+        json={
+            "inputs": [
+                {
+                    "name": "x",
+                    "shape": [12, 4],
+                    "datatype": "FP32",
+                    "data": [float(v) for v in batch.ravel()],
+                }
+            ]
+        },
+        timeout=30,
+    )
+    assert resp.status_code == 200
+    out = resp.json()["outputs"][0]
+    np.testing.assert_array_equal(out["data"], sk.predict(batch))
+
+
+def test_concurrent_singles_are_batched(iris_server):
+    handle, sk, X, y = iris_server
+
+    def one(i):
+        return httpx.post(
+            handle.base + "/v2/models/iris/infer",
+            json={
+                "inputs": [
+                    {
+                        "name": "x",
+                        "shape": [1, 4],
+                        "datatype": "FP32",
+                        "data": [float(v) for v in X[i]],
+                    }
+                ]
+            },
+            timeout=30,
+        )
+
+    threads_out = [None] * 16
+
+    def worker(i):
+        threads_out[i] = one(i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    preds = [r.json()["outputs"][0]["data"][0] for r in threads_out]
+    np.testing.assert_array_equal(preds, sk.predict(X[:16]))
+    # The dynamic batcher should have produced at least one multi-example batch.
+    metrics_text = httpx.get(handle.base + "/metrics").text
+    assert "tpumlops_batch_size_bucket" in metrics_text
+
+
+def test_seldon_protocol_compat(iris_server):
+    handle, sk, X, y = iris_server
+    resp = httpx.post(
+        handle.base + "/api/v1.0/predictions",
+        json={"data": {"ndarray": [[float(v) for v in X[3]]]}},
+        timeout=30,
+    )
+    assert resp.status_code == 200
+    assert resp.json()["data"]["ndarray"][0] == int(sk.predict(X[3][None])[0])
+
+
+def test_gate_compatible_metrics_identity(iris_server):
+    handle, *_ = iris_server
+    text = httpx.get(handle.base + "/metrics").text
+    # Exactly the series + labels the promotion gate queries
+    # (mlflow_operator.py:367,:375).
+    assert 'seldon_api_executor_client_requests_seconds_bucket{' in text
+    assert 'deployment_name="iris"' in text
+    assert 'predictor_name="v1"' in text
+    assert 'namespace="models"' in text
+    assert 'seldon_api_executor_server_requests_seconds_total{' in text
+    assert 'code="200"' in text
+
+
+def test_bad_request_400_and_error_metric(iris_server):
+    handle, *_ = iris_server
+    resp = httpx.post(
+        handle.base + "/v2/models/iris/infer",
+        json={"inputs": [{"name": "x", "shape": [1, 4], "datatype": "NOPE", "data": [1, 2, 3, 4]}]},
+        timeout=30,
+    )
+    assert resp.status_code == 400
+    text = httpx.get(handle.base + "/metrics").text
+    assert 'code="400"' in text
+
+
+def test_health_and_metadata(iris_server):
+    handle, *_ = iris_server
+    assert httpx.get(handle.base + "/v2/health/live").status_code == 200
+    assert httpx.get(handle.base + "/v2/health/ready").status_code == 200
+    meta = httpx.get(handle.base + "/v2/models/iris").json()
+    assert meta["flavor"] == "sklearn-linear"
+    assert meta["jittable"] is True
+
+
+# ---------------------------------------------------------------------------
+# Native artifacts + loader
+# ---------------------------------------------------------------------------
+
+
+def test_native_bert_artifact_roundtrip(tmp_path):
+    import jax
+
+    from tpumlops.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    params = bert.init(jax.random.key(0), cfg)
+    art = tmp_path / "bert"
+    save_native_model(
+        art,
+        "bert-classifier",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_position_embeddings": cfg.max_position_embeddings,
+        },
+        builder_kwargs={"seq_len": 16},
+    )
+    pred = load_predictor(str(art))
+    engine = InferenceEngine(pred, max_batch_size=4)
+    engine.warmup([1, 2])
+    ex = pred.example_input(2)
+    out = engine.predict(ex)
+    assert np.asarray(out).shape == (2, cfg.num_labels)
+
+
+def test_native_artifact_with_tp_mesh(tmp_path):
+    import jax
+
+    from tpumlops.models import llama
+
+    cfg = llama.LlamaConfig.tiny(num_kv_heads=4)
+    params = llama.init(jax.random.key(0), cfg)
+    art = tmp_path / "llama"
+    save_native_model(
+        art,
+        "llama-generate",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+        builder_kwargs={"max_new_tokens": 4},
+    )
+    pred = load_predictor(str(art), mesh_shape={"dp": 2, "tp": 4})
+    out = pred.predict(np.ones((2, 8), np.int32))
+    assert np.asarray(out).shape == (2, 4)
+
+
+def test_loader_mirror_resolution(tmp_path, monkeypatch):
+    (tmp_path / "mlflow" / "1" / "m").mkdir(parents=True)
+    monkeypatch.setenv("TPUMLOPS_ARTIFACT_MIRROR", str(tmp_path))
+    p = resolve_uri("s3://mlflow/1/m")
+    assert p == tmp_path / "mlflow" / "1" / "m"
+
+
+def test_loader_s3_without_mirror_is_loud(monkeypatch):
+    monkeypatch.delenv("TPUMLOPS_ARTIFACT_MIRROR", raising=False)
+    with pytest.raises(ModelLoadError, match="TPUMLOPS_ARTIFACT_MIRROR"):
+        resolve_uri("s3://mlflow/1/m")
+
+
+def test_loader_sniffs_forest_flavor(tmp_path):
+    from sklearn.datasets import make_regression
+    from sklearn.ensemble import RandomForestRegressor
+
+    X, y = make_regression(n_samples=50, n_features=4, random_state=0)
+    sk = RandomForestRegressor(n_estimators=5, max_depth=4, random_state=0).fit(X, y)
+    art = tmp_path / "forest"
+    save_sklearn_model(art, sk, "sklearn-forest")
+    pred = load_predictor(str(art))
+    assert pred.name == "sklearn-forest"
+    out = np.asarray(pred.predict(np.asarray(X[:8], np.float32)))
+    np.testing.assert_allclose(out, sk.predict(X[:8]), rtol=1e-4, atol=1e-3)

@@ -20,14 +20,29 @@ _REAL = "research_and_development_of_kubernetes_operator_for_machine_learning_pi
 class _AliasLoader(importlib.abc.Loader):
     def __init__(self, real_name: str):
         self._real = real_name
+        self._orig_spec = None
+        self._orig_path = None
+        self._had_path = False
 
     def create_module(self, spec):
         # Returning the already-imported real module makes the import system
         # bind the alias name to the identical object.
-        return importlib.import_module(self._real)
+        mod = importlib.import_module(self._real)
+        self._orig_spec = getattr(mod, "__spec__", None)
+        self._had_path = hasattr(mod, "__path__")
+        self._orig_path = getattr(mod, "__path__", None)
+        return mod
 
     def exec_module(self, module):
-        pass  # real module already executed
+        # The import system stamps the alias spec (and, because the alias
+        # spec claims is_package, an empty __path__) onto the module;
+        # restore both so the real module stays internally consistent.
+        if self._orig_spec is not None:
+            module.__spec__ = self._orig_spec
+        if self._had_path:
+            module.__path__ = self._orig_path
+        elif hasattr(module, "__path__"):
+            del module.__path__
 
 
 class _AliasFinder(importlib.abc.MetaPathFinder):
