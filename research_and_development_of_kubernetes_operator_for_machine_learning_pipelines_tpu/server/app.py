@@ -93,7 +93,9 @@ class TpuInferenceServer:
     # -- request handling ----------------------------------------------------
 
     async def _run(self, inputs: dict[str, np.ndarray]) -> Any:
-        """Dispatch: batch-1 via the dynamic batcher, larger directly."""
+        """Dispatch: batch-1 via the dynamic batcher, larger directly —
+        but always through the warmed power-of-two buckets, never a raw
+        client batch size (each distinct shape is an XLA compile)."""
         batch = next(iter(inputs.values())).shape[0]
         if batch == 1:
             single = {k: v[0] for k, v in inputs.items()}
@@ -101,7 +103,28 @@ class TpuInferenceServer:
             out = await asyncio.wrap_future(fut)
             return _add_batch_dim(out)
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.engine.predict, inputs)
+        return await loop.run_in_executor(None, self._predict_bucketed, inputs)
+
+    def _predict_bucketed(self, inputs: dict[str, np.ndarray]) -> Any:
+        """Pad a client batch up to the nearest warmed bucket (chunking
+        batches larger than max_batch_size), then slice back."""
+        from .batching import next_bucket
+
+        batch = next(iter(inputs.values())).shape[0]
+        cap = self.batcher.max_batch_size
+        chunks_out = []
+        for start in range(0, batch, cap):
+            chunk = {k: v[start : start + cap] for k, v in inputs.items()}
+            n = next(iter(chunk.values())).shape[0]
+            bucket = next_bucket(n, cap)
+            if bucket > n:
+                chunk = {
+                    k: np.concatenate([v, np.repeat(v[-1:], bucket - n, axis=0)])
+                    for k, v in chunk.items()
+                }
+            out = self.engine.predict(chunk)
+            chunks_out.append(_slice_batch(out, n))
+        return _concat_batches(chunks_out)
 
     async def handle_v2_infer(self, request: web.Request) -> web.Response:
         t0 = time.perf_counter()
@@ -219,6 +242,27 @@ def _add_batch_dim(out: Any) -> Any:
     return np.asarray(out)[None, ...]
 
 
+def _slice_batch(out: Any, n: int) -> Any:
+    if isinstance(out, tuple):
+        return tuple(_slice_batch(o, n) for o in out)
+    if isinstance(out, dict):
+        return {k: _slice_batch(v, n) for k, v in out.items()}
+    return np.asarray(out)[:n]
+
+
+def _concat_batches(chunks: list[Any]) -> Any:
+    if len(chunks) == 1:
+        return chunks[0]
+    first = chunks[0]
+    if isinstance(first, tuple):
+        return tuple(
+            _concat_batches([c[i] for c in chunks]) for i in range(len(first))
+        )
+    if isinstance(first, dict):
+        return {k: _concat_batches([c[k] for c in chunks]) for k in first}
+    return np.concatenate([np.asarray(c) for c in chunks], axis=0)
+
+
 def _to_v2_outputs(out: Any) -> list[dict]:
     if isinstance(out, dict):
         items = list(out.items())
@@ -282,6 +326,13 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--max-batch-delay-ms", type=float, default=5.0)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument(
+        "--metrics-port",
+        type=int,
+        default=6000,
+        help="dedicated /metrics listener (matches the manifest's metrics "
+        "containerPort); 0 disables the second listener",
+    )
     args = ap.parse_args(argv)
 
     from ..parallel.distributed import maybe_initialize_distributed
@@ -307,7 +358,31 @@ def main(argv: list[str] | None = None) -> None:
     )
     logging.basicConfig(level=logging.INFO)
     server = build_server(config)
-    web.run_app(server.build_app(), host=config.host, port=config.port)
+
+    async def _serve() -> None:
+        runner = web.AppRunner(server.build_app())
+        await runner.setup()
+        await web.TCPSite(runner, config.host, config.port).start()
+        if args.metrics_port:
+            # Dedicated /metrics listener on the manifest's metrics port.
+            metrics_app = web.Application()
+            metrics_app.router.add_get("/metrics", server.handle_metrics)
+            mrunner = web.AppRunner(metrics_app)
+            await mrunner.setup()
+            await web.TCPSite(mrunner, config.host, args.metrics_port).start()
+        _log.info(
+            "serving on %s:%d (metrics on %s)",
+            config.host,
+            config.port,
+            args.metrics_port or f"{config.port}/metrics",
+        )
+        while True:
+            await asyncio.sleep(3600)
+
+    try:
+        asyncio.run(_serve())
+    except KeyboardInterrupt:
+        server.shutdown()
 
 
 if __name__ == "__main__":  # pragma: no cover

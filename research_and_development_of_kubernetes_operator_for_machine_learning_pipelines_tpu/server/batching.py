@@ -73,6 +73,16 @@ class DynamicBatcher:
         self._queue.put(None)
         if self._started:
             self._thread.join(timeout=5)
+        # Fail anything still queued (including different-shape items the
+        # collector re-queued) so in-flight HTTP requests get an error
+        # instead of hanging until the server's shutdown timeout.
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item.future.done():
+                item.future.set_exception(RuntimeError("server shutting down"))
 
     # -- client side ---------------------------------------------------------
 

@@ -90,6 +90,10 @@ class InferenceEngine:
             while b <= self.max_batch_size:
                 buckets.append(b)
                 b <<= 1
+            # next_bucket() caps at max_batch_size, so a non-power-of-two cap
+            # is itself a servable bucket and must be warmed too.
+            if buckets[-1] != self.max_batch_size:
+                buckets.append(self.max_batch_size)
         t0 = time.perf_counter()
         for b in buckets:
             ex = self.predictor.example_input(b)

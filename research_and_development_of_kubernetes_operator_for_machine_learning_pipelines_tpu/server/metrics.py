@@ -52,10 +52,16 @@ class ServerMetrics:
             buckets=_LATENCY_BUCKETS,
             registry=self.registry,
         )
-        self.server_requests = Counter(
+        # Histogram, NOT Counter: the gate's PromQL reads the ``_count``
+        # series (``seldon_api_executor_server_requests_seconds_count``,
+        # mlflow_operator.py:375,:383,:410); a Counter would export
+        # ``_total`` and every error query would silently read 0 through
+        # the ``or on() vector(0)`` fallback.
+        self.server_requests = Histogram(
             "seldon_api_executor_server_requests_seconds",
-            "Request counts by HTTP code (gate queries code!='200')",
+            "Request durations by HTTP code (gate queries _count with code!='200')",
             ident_labels + ["code", "service"],
+            buckets=_LATENCY_BUCKETS,
             registry=self.registry,
         )
         self.batch_size = Histogram(
@@ -91,7 +97,7 @@ class ServerMetrics:
         self.client_requests.labels(**self.identity).observe(seconds)
         self.server_requests.labels(
             **self.identity, code=str(code), service=service
-        ).inc()
+        ).observe(seconds)
 
     def observe_batch(self, size: int, queue_seconds: float):
         self.batch_size.labels(**self.identity).observe(size)

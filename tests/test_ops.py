@@ -1,0 +1,105 @@
+"""Pallas kernels vs XLA oracles (interpret mode on CPU) and ring attention
+on the virtual sp mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumlops.ops import attention_reference, flash_attention, rmsnorm, rmsnorm_reference
+from tpumlops.ops.ring_attention import ring_attention_sharded
+from tpumlops.parallel import build_mesh
+
+
+def qkv(b=2, h=3, s=64, d=16, t=None, key=0):
+    t = t or s
+    k1, k2, k3 = jax.random.split(jax.random.key(key), 3)
+    q = jax.random.normal(k1, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(k2, (b, h, t, d), jnp.float32)
+    v = jax.random.normal(k3, (b, h, t, d), jnp.float32)
+    return q, k, v
+
+
+def test_flash_matches_reference_full():
+    q, k, v = qkv()
+    out = flash_attention(q, k, v, interpret=True, block_q=32, block_k=32)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_matches_reference_causal():
+    q, k, v = qkv(s=48)
+    out = flash_attention(q, k, v, causal=True, interpret=True, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_non_divisible_seq_padding():
+    q, k, v = qkv(s=50, t=50)
+    out = flash_attention(q, k, v, interpret=True, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_kv_len_masks_padded_keys():
+    q, k, v = qkv(s=32, t=64)
+    out = flash_attention(q, k, v, kv_len=40, interpret=True, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, kv_len=40)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_io():
+    q, k, v = [x.astype(jnp.bfloat16) for x in qkv(s=32)]
+    out = flash_attention(q, k, v, interpret=True, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_rmsnorm_matches_reference():
+    x = jax.random.normal(jax.random.key(0), (4, 96, 256), jnp.float32)
+    scale = jax.random.normal(jax.random.key(1), (256,)) + 1.0
+    out = rmsnorm(x, scale, interpret=True)
+    ref = rmsnorm_reference(x, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_rmsnorm_non_divisible_rows():
+    x = jax.random.normal(jax.random.key(0), (7, 33), jnp.float32)
+    scale = jnp.ones((33,))
+    out = rmsnorm(x, scale, block_rows=4, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(rmsnorm_reference(x, scale)), atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ring attention over the sp mesh axis
+# ---------------------------------------------------------------------------
+
+
+def test_ring_attention_matches_reference():
+    mesh = build_mesh({"sp": 8})
+    q, k, v = qkv(b=1, h=2, s=64, d=16, key=3)
+    out = ring_attention_sharded(q, k, v, mesh)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_causal_matches_reference():
+    mesh = build_mesh({"sp": 8})
+    q, k, v = qkv(b=1, h=2, s=64, d=16, key=4)
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_jit_with_sp_mesh():
+    mesh = build_mesh({"sp": 4}, jax.devices()[:4])
+    q, k, v = qkv(b=1, h=1, s=32, d=8, key=5)
+    f = jax.jit(lambda q, k, v: ring_attention_sharded(q, k, v, mesh, causal=True))
+    out = f(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

@@ -198,7 +198,10 @@ def test_gate_compatible_metrics_identity(iris_server):
     assert 'deployment_name="iris"' in text
     assert 'predictor_name="v1"' in text
     assert 'namespace="models"' in text
-    assert 'seldon_api_executor_server_requests_seconds_total{' in text
+    # The gate reads the _count series of a histogram (mlflow_operator.py:375);
+    # a Counter would export _total and the error queries would read 0.
+    assert 'seldon_api_executor_server_requests_seconds_count{' in text
+    assert 'seldon_api_executor_server_requests_seconds_sum{' in text
     assert 'code="200"' in text
 
 
