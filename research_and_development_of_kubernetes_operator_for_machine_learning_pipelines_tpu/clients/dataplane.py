@@ -1,0 +1,69 @@
+"""Data-plane client: synthetic warm-up traffic to a predictor.
+
+Solves the zero-traffic deadlock (SURVEY §3.5(4)): a 10%-weight canary may
+never accumulate the samples the gate needs.  The operator POSTs a burst of
+V2 inference requests directly to the canary predictor's service (bypassing
+the Istio split, so the burst cannot skew the stable model's metrics).
+
+The service URL follows Seldon's naming (``<deployment>-<predictor>`` svc in
+the model namespace); override with ``url_template`` for other layouts.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import httpx
+
+_log = logging.getLogger(__name__)
+
+DEFAULT_URL_TEMPLATE = (
+    "http://{deployment}-{predictor}.{namespace}:9000/v2/models/{deployment}/infer"
+)
+
+
+class DataPlaneWarmup:
+    def __init__(
+        self,
+        url_template: str = DEFAULT_URL_TEMPLATE,
+        timeout: float = 2.0,
+        max_wall_s: float = 10.0,
+        example: dict | None = None,
+    ):
+        self.url_template = url_template
+        # Short per-request timeout AND an overall deadline: warmup runs on
+        # the single-threaded reconcile loop, so a hanging canary must never
+        # stall other resources' gate checks (reconciler design contract).
+        self.timeout = timeout
+        self.max_wall_s = max_wall_s
+        # A 1-element FP32 vector by default; model-specific warmup bodies
+        # can be injected per-operator via ``example``.
+        self.example = example or {
+            "inputs": [
+                {"name": "x", "shape": [1, 1], "datatype": "FP32", "data": [0.0]}
+            ]
+        }
+
+    def __call__(
+        self, deployment: str, predictor: str, namespace: str, n: int
+    ) -> int:
+        import time
+
+        url = self.url_template.format(
+            deployment=deployment, predictor=predictor, namespace=namespace
+        )
+        ok = 0
+        deadline = time.monotonic() + self.max_wall_s
+        with httpx.Client(timeout=self.timeout) as client:
+            for _ in range(n):
+                if time.monotonic() > deadline:
+                    _log.info("warmup wall-time budget exhausted")
+                    break
+                try:
+                    resp = client.post(url, json=self.example)
+                    if resp.status_code < 500:
+                        ok += 1
+                except httpx.HTTPError as e:
+                    _log.debug("warmup request failed: %s", e)
+        _log.info("warmup: %d/%d requests reached %s", ok, n, url)
+        return ok

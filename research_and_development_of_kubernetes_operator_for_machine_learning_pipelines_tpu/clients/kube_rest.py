@@ -1,0 +1,143 @@
+"""Kubernetes API client over plain REST (httpx) — no kubernetes SDK.
+
+Speaks the same CustomObjects endpoints the reference uses through
+``kubernetes.client.CustomObjectsApi`` (``mlflow_operator.py:35,:241``),
+with in-cluster auth: ServiceAccount bearer token + cluster CA from the
+standard mounts, API server address from the standard env vars (what
+``config.load_incluster_config()`` reads at ``mlflow_operator.py:13``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Any, Mapping
+
+import httpx
+
+from .base import ApiError, Conflict, Event, NotFound, ObjectRef
+
+_log = logging.getLogger(__name__)
+
+_SA_DIR = Path("/var/run/secrets/kubernetes.io/serviceaccount")
+
+
+class KubeRestClient:
+    def __init__(
+        self,
+        base_url: str | None = None,
+        token: str | None = None,
+        verify: Any = None,
+        timeout: float = 30.0,
+    ):
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in-cluster (KUBERNETES_SERVICE_HOST unset) and no "
+                    "base_url given"
+                )
+            base_url = f"https://{host}:{port}"
+        if token is None and (_SA_DIR / "token").exists():
+            token = (_SA_DIR / "token").read_text().strip()
+        if verify is None:
+            ca = _SA_DIR / "ca.crt"
+            verify = str(ca) if ca.exists() else True
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        self._http = httpx.Client(
+            base_url=base_url, headers=headers, verify=verify, timeout=timeout
+        )
+
+    # -- plumbing ------------------------------------------------------------
+
+    @staticmethod
+    def _path(ref: ObjectRef, name: bool = True) -> str:
+        group_part = (
+            f"/apis/{ref.group}/{ref.version}" if ref.group else f"/api/{ref.version}"
+        )
+        ns_part = f"/namespaces/{ref.namespace}" if ref.namespace else ""
+        name_part = f"/{ref.name}" if name and ref.name else ""
+        return f"{group_part}{ns_part}/{ref.plural}{name_part}"
+
+    @staticmethod
+    def _check(resp: httpx.Response) -> dict:
+        if resp.status_code == 404:
+            raise NotFound(resp.text[:200])
+        if resp.status_code == 409:
+            raise Conflict(resp.text[:200])
+        if resp.status_code >= 400:
+            raise ApiError(resp.status_code, resp.text[:500])
+        return resp.json()
+
+    # -- KubeClient protocol -------------------------------------------------
+
+    def get(self, ref: ObjectRef) -> dict:
+        return self._check(self._http.get(self._path(ref)))
+
+    def list(self, ref: ObjectRef) -> list[dict]:
+        body = self._check(self._http.get(self._path(ref, name=False)))
+        return body.get("items", [])
+
+    def create(self, ref: ObjectRef, body: Mapping[str, Any]) -> dict:
+        return self._check(
+            self._http.post(self._path(ref, name=False), json=dict(body))
+        )
+
+    def replace(self, ref: ObjectRef, body: Mapping[str, Any]) -> dict:
+        return self._check(self._http.put(self._path(ref), json=dict(body)))
+
+    def patch_status(self, ref: ObjectRef, status: Mapping[str, Any]) -> dict:
+        return self._check(
+            self._http.patch(
+                self._path(ref) + "/status",
+                content=json.dumps({"status": dict(status)}),
+                headers={"Content-Type": "application/merge-patch+json"},
+            )
+        )
+
+    def delete(self, ref: ObjectRef) -> None:
+        self._check(self._http.delete(self._path(ref)))
+
+    def emit_event(self, ref: ObjectRef, event: Event) -> None:
+        """Create a corev1 Event attached to the CR (kopf.event equivalent,
+        reference call sites :90,:122,:332,:344,:361)."""
+        import datetime
+
+        now = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"
+        )
+        try:
+            obj = self.get(ref)
+            uid = (obj.get("metadata") or {}).get("uid")
+        except (ApiError, httpx.HTTPError):
+            uid = None
+        body = {
+            "metadata": {"generateName": f"{ref.name}-", "namespace": ref.namespace},
+            "involvedObject": {
+                "apiVersion": ref.api_version,
+                "kind": "MlflowModel",
+                "name": ref.name,
+                "namespace": ref.namespace,
+                **({"uid": uid} if uid else {}),
+            },
+            "type": event.type,
+            "reason": event.reason,
+            "message": event.message,
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+            "source": {"component": "tpumlops-operator"},
+        }
+        # Best-effort end to end: a cosmetic event must never abort a
+        # reconcile step, whether the API rejects it or the transport drops.
+        try:
+            self._check(
+                self._http.post(
+                    f"/api/v1/namespaces/{ref.namespace}/events", json=body
+                )
+            )
+        except (ApiError, httpx.HTTPError) as e:
+            _log.warning("event emission failed: %s", e)

@@ -1,0 +1,81 @@
+"""MLflow registry client over the REST API — no mlflow SDK.
+
+Implements the two calls the reference makes through ``MlflowClient``
+(``mlflow_operator.py:44``): ``get_model_version_by_alias`` (``:59``) and
+``get_model_version`` (``:131``), against MLflow's documented 2.0 REST
+endpoints.  Credentials follow the same convention as the reference's
+deployment (env via the creds secret, ``mlflow-operator-deployment.yaml:21-23``):
+``MLFLOW_TRACKING_URI``, optional ``MLFLOW_TRACKING_USERNAME``/``PASSWORD``
+or ``MLFLOW_TRACKING_TOKEN``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import httpx
+
+from .base import AliasNotFound, ModelVersion, RegistryError
+
+
+class MlflowRestClient:
+    def __init__(self, tracking_uri: str | None = None, timeout: float = 30.0):
+        tracking_uri = tracking_uri or os.environ.get("MLFLOW_TRACKING_URI")
+        if not tracking_uri:
+            raise RuntimeError("MLFLOW_TRACKING_URI not configured")
+        auth = None
+        user = os.environ.get("MLFLOW_TRACKING_USERNAME")
+        password = os.environ.get("MLFLOW_TRACKING_PASSWORD")
+        headers = {}
+        if user and password:
+            auth = (user, password)
+        token = os.environ.get("MLFLOW_TRACKING_TOKEN")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        self._http = httpx.Client(
+            base_url=tracking_uri.rstrip("/"),
+            auth=auth,
+            headers=headers,
+            timeout=timeout,
+        )
+
+    def _get(self, path: str, params: dict) -> dict:
+        try:
+            resp = self._http.get(path, params=params)
+        except httpx.HTTPError as e:
+            raise RegistryError(f"mlflow unreachable: {e}") from e
+        if resp.status_code == 404:
+            raise AliasNotFound(resp.text[:200])
+        if resp.status_code >= 400:
+            body = resp.text[:500]
+            # MLflow reports missing aliases/versions as RESOURCE_DOES_NOT_EXIST.
+            if "RESOURCE_DOES_NOT_EXIST" in body or "not found" in body.lower():
+                raise AliasNotFound(body)
+            raise RegistryError(f"mlflow error {resp.status_code}: {body}")
+        return resp.json()
+
+    @staticmethod
+    def _parse_version(body: dict) -> ModelVersion:
+        mv = body.get("model_version") or {}
+        version = mv.get("version")
+        if version is None:
+            # A 200 without model_version.version must not become the
+            # string "None" and trigger a phantom rollout.
+            raise RegistryError(f"malformed mlflow response: {body!r:.200}")
+        return ModelVersion(version=str(version), source=mv.get("source", ""))
+
+    def get_version_by_alias(self, model_name: str, alias: str) -> ModelVersion:
+        return self._parse_version(
+            self._get(
+                "/api/2.0/mlflow/registered-models/alias",
+                {"name": model_name, "alias": alias},
+            )
+        )
+
+    def get_version(self, model_name: str, version: str) -> ModelVersion:
+        return self._parse_version(
+            self._get(
+                "/api/2.0/mlflow/model-versions/get",
+                {"name": model_name, "version": version},
+            )
+        )

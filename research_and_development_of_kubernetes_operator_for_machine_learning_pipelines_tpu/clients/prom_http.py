@@ -1,0 +1,114 @@
+"""Prometheus metrics source over the HTTP query API — no client SDK.
+
+Runs the exact PromQL the reference runs (``get_model_metrics``,
+``mlflow_operator.py:363-417``): p95 via histogram_quantile over the
+client-requests buckets, error/total counts with the ``or on() vector(0)``
+zero-fallback, mean latency as increase(sum)/increase(count), request and
+feedback counts — keyed by {deployment_name, predictor_name, namespace}.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import httpx
+
+from .base import ModelMetrics
+
+_log = logging.getLogger(__name__)
+
+
+class PrometheusSource:
+    def __init__(self, url: str, timeout: float = 30.0):
+        self._http = httpx.Client(base_url=url.rstrip("/"), timeout=timeout)
+
+    def _query(self, promql: str) -> float | None:
+        try:
+            resp = self._http.get("/api/v1/query", params={"query": promql})
+            resp.raise_for_status()
+            result = resp.json().get("data", {}).get("result", [])
+        except (httpx.HTTPError, ValueError) as e:
+            _log.warning("prometheus query failed: %s", e)
+            return None
+        if not result:
+            return None
+        try:
+            value = float(result[0]["value"][1])
+        except (KeyError, IndexError, TypeError, ValueError):
+            return None
+        return None if value != value else value  # NaN -> None
+
+    def model_metrics(
+        self,
+        deployment_name: str,
+        predictor_name: str,
+        namespace: str,
+        window_s: int = 60,
+    ) -> ModelMetrics:
+        sel = (
+            f'deployment_name="{deployment_name}", '
+            f'predictor_name="{predictor_name}", namespace="{namespace}"'
+        )
+        w = f"{window_s}s"
+
+        # Reference :367-372
+        p95 = self._query(
+            "histogram_quantile(0.95, sum(rate("
+            f"seldon_api_executor_client_requests_seconds_bucket{{{sel}}}[{w}]"
+            ")) by (le))"
+        )
+        # NOTE on None vs 0: every count query below carries PromQL's
+        # ``or on() vector(0)`` fallback, so a *successful* query returns a
+        # real number (possibly 0).  ``_query`` returning None means the
+        # query itself failed (Prometheus unreachable / bad response) — that
+        # must surface as metric-unavailable (None), never as 0, or a
+        # transient Prometheus blip would read as a perfect canary and pass
+        # the gate.
+        # Reference :375-380
+        errors = self._query(
+            "sum(increase("
+            f'seldon_api_executor_server_requests_seconds_count{{code!="200", {sel}}}[{w}]'
+            ")) or on() vector(0)"
+        )
+        # Reference :383-390
+        total = self._query(
+            "sum(increase("
+            f"seldon_api_executor_server_requests_seconds_count{{{sel}}}[{w}]"
+            ")) or on() vector(0)"
+        )
+        if errors is None or total is None:
+            error_rate = None
+        else:
+            error_rate = (errors / total) if total > 0 else None
+        # Reference :393-404
+        lat_sum = self._query(
+            "sum(increase("
+            f"seldon_api_executor_client_requests_seconds_sum{{{sel}}}[{w}]"
+            ")) or on() vector(0)"
+        )
+        lat_count = self._query(
+            "sum(increase("
+            f"seldon_api_executor_client_requests_seconds_count{{{sel}}}[{w}]"
+            ")) or on() vector(0)"
+        )
+        if lat_sum is None or lat_count is None:
+            latency_avg = None
+        else:
+            latency_avg = (lat_sum / lat_count) if lat_count > 0 else None
+        # Reference :410-415
+        feedback = self._query(
+            "sum(increase("
+            f'seldon_api_executor_server_requests_seconds_count{{service="feedback", {sel}}}[{w}]'
+            ")) or on() vector(0)"
+        ) or 0.0
+
+        return ModelMetrics(
+            latency_p95=p95,
+            error_responses=errors if errors is not None else 0.0,
+            error_rate=error_rate,
+            latency_avg=latency_avg,
+            # On query failure request_count reads 0, which the
+            # min_sample_count hardening treats as not-enough-samples (safe).
+            request_count=lat_count if lat_count is not None else 0.0,
+            feedback_request_count=feedback,
+        )

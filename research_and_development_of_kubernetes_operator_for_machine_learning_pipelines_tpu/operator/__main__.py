@@ -1,0 +1,58 @@
+"""``python -m <package>.operator`` — the operator process entrypoint
+(what the operator Deployment manifest runs).
+
+Wires the real REST clients (no cluster SDKs needed) into the runtime:
+Kubernetes in-cluster auth, MLflow from ``MLFLOW_TRACKING_URI`` env (same
+creds-secret convention as the reference,
+``mlflow-operator-deployment.yaml:21-23``), and a per-URL-cached Prometheus
+source honoring each CR's ``spec.prometheusUrl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser("tpumlops-operator")
+    ap.add_argument("--namespace", default="", help="watch one namespace (default all)")
+    ap.add_argument("--sync-interval", type=float, default=5.0)
+    ap.add_argument("--kube-url", default=None, help="API server URL (default in-cluster)")
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    from ..clients.dataplane import DataPlaneWarmup
+    from ..clients.kube_rest import KubeRestClient
+    from ..clients.mlflow_rest import MlflowRestClient
+    from ..clients.prom_http import PrometheusSource
+    from .runtime import OperatorRuntime
+
+    kube = KubeRestClient(base_url=args.kube_url)
+    registry = MlflowRestClient()
+
+    sources: dict[str, PrometheusSource] = {}
+
+    def metrics_factory(url: str) -> PrometheusSource:
+        if url not in sources:
+            sources[url] = PrometheusSource(url)
+        return sources[url]
+
+    runtime = OperatorRuntime(
+        kube=kube,
+        registry=registry,
+        metrics_factory=metrics_factory,
+        warmup=DataPlaneWarmup(),
+        namespace=args.namespace,
+        sync_interval_s=args.sync_interval,
+    )
+    runtime.serve()
+
+
+if __name__ == "__main__":
+    main()

@@ -63,17 +63,29 @@ class Reconciler:
         namespace: str,
         kube: KubeClient,
         registry: RegistryClient,
-        metrics: MetricsSource,
+        metrics: MetricsSource | None = None,
         clock: Clock | None = None,
         logger: logging.Logger | logging.LoggerAdapter | None = None,
+        metrics_factory=None,  # Callable[[str], MetricsSource]; honors spec.prometheusUrl
+        warmup=None,  # Callable[(deployment, predictor, namespace, n)]; synthetic traffic
     ):
         self.name = name
         self.namespace = namespace
         self.kube = kube
         self.registry = registry
         self.metrics = metrics
+        self.metrics_factory = metrics_factory
+        self.warmup = warmup
         self.clock = clock or SystemClock()
         self.log = logger or model_logger(name, namespace)
+        if metrics is None and metrics_factory is None:
+            raise ValueError("either metrics or metrics_factory is required")
+
+    def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
+        """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
+        if self.metrics is not None:
+            return self.metrics
+        return self.metrics_factory(config.prometheus_url)
 
     # -- object refs --------------------------------------------------------
 
@@ -199,6 +211,7 @@ class Reconciler:
         events.append(ev)
         self.kube.emit_event(self.cr_ref, ev)
         self.log.info(f"New model version detected: {mv.version}")
+
         # Canary: go straight to the first gate check (the reference enters
         # its metrics loop immediately after the initial apply, :296-310).
         requeue = 0.0 if new_state.phase == Phase.CANARY else config.monitoring_interval_s
@@ -212,13 +225,14 @@ class Reconciler:
         events: list[Event],
     ) -> ReconcileOutcome:
         canary = config.canary
-        new_m = self.metrics.model_metrics(
+        source = self._metrics_source(config)
+        new_m = source.model_metrics(
             self.name,
             f"v{state.current_version}",
             self.namespace,
             canary.metrics_window_s,
         )
-        old_m = self.metrics.model_metrics(
+        old_m = source.model_metrics(
             self.name,
             f"v{state.previous_version}",
             self.namespace,
@@ -256,7 +270,31 @@ class Reconciler:
             self.log.info(ev.message)
             return ReconcileOutcome(new_state, requeue, events, applied=applied)
 
-        # Gate refused.
+        # Gate refused.  If the refusal is missing metrics (no traffic in the
+        # window — SURVEY §3.5(4) zero-traffic deadlock), send best-effort
+        # synthetic warm-up traffic to the canary before the next attempt.
+        # This runs on gate attempts, NOT at deploy time: right after the
+        # manifest apply the canary pod/service does not exist yet, so a
+        # deploy-time burst would always fail and never be retried.
+        if (
+            canary.warmup_requests > 0
+            and self.warmup is not None
+            and any("unavailable" in r for r in decision.reasons)
+        ):
+            try:
+                self.warmup(
+                    self.name,
+                    f"v{state.current_version}",
+                    self.namespace,
+                    canary.warmup_requests,
+                )
+                self.log.info(
+                    f"sent {canary.warmup_requests} warm-up requests to "
+                    f"v{state.current_version} (gate metrics unavailable)"
+                )
+            except Exception as e:
+                self.log.warning(f"warm-up traffic failed: {e}")
+
         new_state = state.gate_failed()
         if new_state.attempt < canary.max_attempts:
             self._patch_status(new_state)

@@ -56,14 +56,23 @@ class OperatorRuntime:
         self,
         kube: KubeClient,
         registry: RegistryClient,
-        metrics: MetricsSource,
+        metrics: MetricsSource | None = None,
         clock: Clock | None = None,
         namespace: str = "",
         sync_interval_s: float = 5.0,
+        metrics_factory=None,
+        warmup=None,
     ):
+        if metrics is None and metrics_factory is None:
+            raise ValueError(
+                "OperatorRuntime needs metrics or metrics_factory — failing "
+                "here, not on first CR, so misconfiguration dies at startup"
+            )
         self.kube = kube
         self.registry = registry
         self.metrics = metrics
+        self.metrics_factory = metrics_factory
+        self.warmup = warmup
         self.clock = clock or SystemClock()
         self.namespace = namespace
         self.sync_interval_s = sync_interval_s
@@ -95,6 +104,8 @@ class OperatorRuntime:
                             registry=self.registry,
                             metrics=self.metrics,
                             clock=self.clock,
+                            metrics_factory=self.metrics_factory,
+                            warmup=self.warmup,
                         ),
                         due_at=self.clock.now(),  # reconcile promptly
                     )

@@ -1,0 +1,36 @@
+"""Checkpoint/resume for model parameters (SURVEY §5).
+
+The reference's only persisted state is the CR status subresource; model
+weights live in MLflow/MinIO and are pulled fresh by each predictor.  The
+rebuild adds orbax-backed checkpointing for the cases the reference cannot
+cover: sharded params written per-host from a multi-host slice, and local
+warm-restart of a server without re-pulling the artifact store.
+
+``save``/``restore`` round-trip arbitrary param pytrees; ``restore`` can
+restore directly into a sharding (each host reads only its shards).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+
+def save(path: str | Path, tree: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=True)
+
+
+def restore(path: str | Path, template: Any | None = None) -> Any:
+    """Restore a pytree; ``template`` (abstract arrays or a matching pytree,
+    optionally carrying shardings) restores sharded-on-load."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is None:
+            return ckptr.restore(path)
+        return ckptr.restore(path, template)

@@ -1,0 +1,90 @@
+"""Tracing and profiling (SURVEY §5: absent in the reference — added here).
+
+Two layers:
+
+- lightweight spans for the control plane: ``span("reconcile")`` records
+  wall-time stats per name (count/total/max), queryable for logs or export —
+  promotion-loop step timing the reference never had;
+- JAX profiler hooks for the data plane: ``jax_profile(dir)`` wraps
+  ``jax.profiler.trace`` so a server can capture XLA/TPU traces on demand
+  (e.g. via a debug endpoint), and ``annotate`` marks named regions that
+  show up on the TPU timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SpanStats:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class Tracer:
+    def __init__(self):
+        self._stats: dict[str, SpanStats] = defaultdict(SpanStats)
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._stats[name].observe(dt)
+
+    def stats(self) -> dict[str, SpanStats]:
+        with self._lock:
+            return dict(self._stats)
+
+    def report(self) -> str:
+        lines = []
+        for name, s in sorted(self.stats().items()):
+            lines.append(
+                f"{name}: n={s.count} mean={s.mean_s*1e3:.2f}ms "
+                f"max={s.max_s*1e3:.2f}ms total={s.total_s:.3f}s"
+            )
+        return "\n".join(lines)
+
+
+GLOBAL_TRACER = Tracer()
+span = GLOBAL_TRACER.span
+
+
+@contextlib.contextmanager
+def jax_profile(log_dir: str):
+    """Capture a JAX/XLA profile (TensorBoard format) for the duration."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region on the device timeline (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

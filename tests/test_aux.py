@@ -1,0 +1,91 @@
+"""Aux subsystems: tracing spans, orbax checkpoint round-trip, manifests."""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+import tpumlops
+from tpumlops.utils import checkpoint
+from tpumlops.utils.tracing import Tracer
+
+PKG_DIR = Path(tpumlops.__file__).parent
+
+
+def test_tracer_records_spans():
+    tr = Tracer()
+    with tr.span("reconcile"):
+        pass
+    with tr.span("reconcile"):
+        pass
+    with tr.span("gate"):
+        pass
+    stats = tr.stats()
+    assert stats["reconcile"].count == 2
+    assert stats["gate"].count == 1
+    assert "reconcile: n=2" in tr.report()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    tree = {
+        "layer": {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.ones((4,))},
+        "step": jnp.asarray(7),
+    }
+    checkpoint.save(tmp_path / "ckpt", tree)
+    restored = checkpoint.restore(tmp_path / "ckpt")
+    np.testing.assert_array_equal(restored["layer"]["w"], tree["layer"]["w"])
+    np.testing.assert_array_equal(restored["step"], tree["step"])
+
+
+def test_checkpoint_restore_with_sharding_template(tmp_path):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tpumlops.parallel import build_mesh
+
+    tree = {"w": jnp.arange(32.0).reshape(8, 4)}
+    checkpoint.save(tmp_path / "ckpt", tree)
+    mesh = build_mesh({"tp": 8})
+    template = {
+        "w": jax.ShapeDtypeStruct(
+            (8, 4), jnp.float32, sharding=NamedSharding(mesh, PartitionSpec("tp", None))
+        )
+    }
+    restored = checkpoint.restore(tmp_path / "ckpt", template)
+    assert restored["w"].sharding.spec == PartitionSpec("tp", None)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(tree["w"]))
+
+
+def test_manifests_are_valid_yaml_with_expected_fields():
+    crd = list(yaml.safe_load_all((PKG_DIR / "deploy" / "crd.yaml").read_text()))[0]
+    assert crd["spec"]["group"] == "mlflow.nizepart.com"
+    assert crd["spec"]["names"]["shortNames"] == ["mlflowm"]
+    version = crd["spec"]["versions"][0]
+    spec_props = version["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+    # Reference spec fields (crd.yaml:17-25) ...
+    for f in ("modelName", "modelAlias", "monitoringInterval", "minioSecret"):
+        assert f in spec_props, f
+    # ... plus the north-star TPU additions.
+    assert spec_props["backend"]["enum"] == ["seldon", "tpu"]
+    assert "tpuTopology" in spec_props["tpu"]["properties"]
+    assert "meshShape" in spec_props["tpu"]["properties"]
+    status_props = version["schema"]["openAPIV3Schema"]["properties"]["status"]["properties"]
+    for f in ("currentModelVersion", "previousModelVersion", "error",
+              "phase", "trafficCurrent", "heldVersion"):
+        assert f in status_props, f
+    assert version["subresources"] == {"status": {}}
+
+    rbac_docs = list(yaml.safe_load_all((PKG_DIR / "deploy" / "rbac.yaml").read_text()))
+    kinds = [d["kind"] for d in rbac_docs]
+    assert kinds == ["ServiceAccount", "ClusterRole", "ClusterRoleBinding"]
+    rules = rbac_docs[1]["rules"]
+    resources = {r for rule in rules for r in rule["resources"]}
+    assert {"mlflowmodels", "mlflowmodels/status", "seldondeployments",
+            "events", "secrets", "nodes"} <= resources
+
+    dep = list(yaml.safe_load_all(
+        (PKG_DIR / "deploy" / "operator-deployment.yaml").read_text()
+    ))[0]
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert container["envFrom"][0]["secretRef"]["name"] == "mlflow-creds"

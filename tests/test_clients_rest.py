@@ -1,0 +1,250 @@
+"""REST clients against httpx MockTransport: exact paths, PromQL parity,
+and error mapping — no cluster needed."""
+
+import json
+
+import httpx
+import pytest
+
+from tpumlops.clients.base import (
+    AliasNotFound,
+    Conflict,
+    MLFLOWMODEL,
+    ModelMetrics,
+    NotFound,
+    ObjectRef,
+)
+from tpumlops.clients.kube_rest import KubeRestClient
+from tpumlops.clients.mlflow_rest import MlflowRestClient
+from tpumlops.clients.prom_http import PrometheusSource
+
+
+def make_kube(handler):
+    client = KubeRestClient.__new__(KubeRestClient)
+    client._http = httpx.Client(
+        base_url="https://kube", transport=httpx.MockTransport(handler)
+    )
+    return client
+
+
+def ref(name="iris", ns="models"):
+    return ObjectRef(namespace=ns, name=name, **MLFLOWMODEL)
+
+
+def test_kube_paths_and_verbs():
+    seen = []
+
+    def handler(request):
+        seen.append((request.method, request.url.path))
+        return httpx.Response(200, json={"items": []})
+
+    kube = make_kube(handler)
+    kube.get(ref())
+    kube.list(ref())
+    kube.create(ref(), {"spec": {}})
+    kube.replace(ref(), {"spec": {}})
+    kube.patch_status(ref(), {"phase": "Stable"})
+    kube.delete(ref())
+    base = "/apis/mlflow.nizepart.com/v1alpha1/namespaces/models/mlflowmodels"
+    assert seen == [
+        ("GET", f"{base}/iris"),
+        ("GET", base),
+        ("POST", base),
+        ("PUT", f"{base}/iris"),
+        ("PATCH", f"{base}/iris/status"),
+        ("DELETE", f"{base}/iris"),
+    ]
+
+
+def test_kube_error_mapping():
+    def handler(request):
+        if request.method == "GET":
+            return httpx.Response(404, text="nope")
+        return httpx.Response(409, text="stale")
+
+    kube = make_kube(handler)
+    with pytest.raises(NotFound):
+        kube.get(ref())
+    with pytest.raises(Conflict):
+        kube.replace(ref(), {})
+
+
+def test_kube_status_patch_is_merge_patch():
+    bodies = []
+
+    def handler(request):
+        bodies.append((request.headers.get("content-type"), request.content))
+        return httpx.Response(200, json={})
+
+    kube = make_kube(handler)
+    kube.patch_status(ref(), {"trafficCurrent": 30})
+    ctype, content = bodies[0]
+    assert ctype == "application/merge-patch+json"
+    assert json.loads(content) == {"status": {"trafficCurrent": 30}}
+
+
+def test_mlflow_alias_lookup_and_miss():
+    def handler(request):
+        if "alias" in request.url.path:
+            if request.url.params["alias"] == "champion":
+                return httpx.Response(
+                    200,
+                    json={"model_version": {"version": "3", "source": "mlflow-artifacts:/1/x/artifacts/model"}},
+                )
+            return httpx.Response(
+                404, json={"error_code": "RESOURCE_DOES_NOT_EXIST"}
+            )
+        return httpx.Response(
+            200, json={"model_version": {"version": "2", "source": "s"}}
+        )
+
+    client = MlflowRestClient.__new__(MlflowRestClient)
+    client._http = httpx.Client(
+        base_url="http://mlflow", transport=httpx.MockTransport(handler)
+    )
+    mv = client.get_version_by_alias("iris", "champion")
+    assert mv.version == "3"
+    assert mv.source.startswith("mlflow-artifacts:/")
+    with pytest.raises(AliasNotFound):
+        client.get_version_by_alias("iris", "missing")
+    assert client.get_version("iris", "2").version == "2"
+
+
+def test_prometheus_queries_match_reference_promql():
+    queries = []
+
+    def handler(request):
+        q = request.url.params["query"]
+        queries.append(q)
+        value = "0.25"
+        if "histogram_quantile" in q:
+            value = "0.1"
+        if 'code!="200"' in q:
+            value = "2"
+        elif "_count" in q and "service=" not in q:
+            value = "100"
+        return httpx.Response(
+            200,
+            json={"data": {"result": [{"value": [0, value]}]}, "status": "success"},
+        )
+
+    src = PrometheusSource.__new__(PrometheusSource)
+    src._http = httpx.Client(
+        base_url="http://prom", transport=httpx.MockTransport(handler)
+    )
+    m = src.model_metrics("iris", "v2", "models", 60)
+    # Six queries, shaped like mlflow_operator.py:363-417.
+    assert len(queries) == 6
+    assert "histogram_quantile(0.95" in queries[0]
+    assert 'deployment_name="iris"' in queries[0]
+    assert 'predictor_name="v2"' in queries[0]
+    assert "[60s]" in queries[0]
+    assert 'code!="200"' in queries[1]
+    assert "or on() vector(0)" in queries[1]
+    assert 'service="feedback"' in queries[5]
+    assert m.latency_p95 == 0.1
+    assert m.error_responses == 2.0
+    assert m.error_rate == pytest.approx(2 / 100)
+    assert m.request_count == 100.0
+
+
+def test_prometheus_no_traffic_returns_none_metrics():
+    def handler(request):
+        return httpx.Response(200, json={"data": {"result": []}})
+
+    src = PrometheusSource.__new__(PrometheusSource)
+    src._http = httpx.Client(
+        base_url="http://prom", transport=httpx.MockTransport(handler)
+    )
+    m = src.model_metrics("iris", "v2", "models")
+    # Reference semantics: no samples -> gating metrics None (:372,:390,:404).
+    assert m.latency_p95 is None
+    assert m.error_rate is None
+    assert m.latency_avg is None
+
+
+def test_warmup_fires_on_unavailable_gate_metrics():
+    """canary.warmupRequests fires when the gate refuses for lack of
+    samples — NOT at deploy time, when the canary pod cannot exist yet."""
+    from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+    from tpumlops.operator.reconciler import Reconciler
+    from tpumlops.utils.clock import FakeClock
+
+    kube, registry, metrics = FakeKube(), FakeRegistry(), FakeMetrics()
+    kube.create(
+        ref(),
+        {
+            "metadata": {"name": "iris", "namespace": "models"},
+            "spec": {
+                "modelName": "iris",
+                "modelAlias": "champion",
+                "canary": {"warmupRequests": 7},
+            },
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    calls = []
+    rec = Reconciler(
+        "iris", "models", kube, registry, metrics, FakeClock(),
+        warmup=lambda d, p, ns, n: calls.append((d, p, ns, n)),
+    )
+    rec.reconcile(kube.get(ref()))  # first deploy: STABLE, no warmup
+    registry.register("iris", "2", "mlflow-artifacts:/1/b/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    rec.reconcile(kube.get(ref()))  # canary deployed: no warmup yet
+    assert calls == []
+    # First gate attempt: FakeMetrics returns all-None for both predictors,
+    # so the gate refuses with "unavailable" and warmup fires for the canary.
+    rec.reconcile(kube.get(ref()))
+    assert calls == [("iris", "v2", "models", 7)]
+    # Once metrics flow, no more warmup.
+    good = ModelMetrics(
+        latency_p95=0.1, error_rate=0.0, latency_avg=0.05, request_count=100
+    )
+    metrics.set_metrics("iris", "v1", "models", good)
+    metrics.set_metrics("iris", "v2", "models", good)
+    rec.reconcile(kube.get(ref()))
+    assert len(calls) == 1
+
+
+def test_prometheus_query_failure_is_unavailable_not_zero():
+    """A failed component query must yield None (gate refuses), never 0.0
+    (which would read as a perfect canary)."""
+    calls = {"n": 0}
+
+    def handler(request):
+        calls["n"] += 1
+        q = request.url.params["query"]
+        if 'code!="200"' in q:
+            return httpx.Response(503, text="prometheus hiccup")
+        return httpx.Response(200, json={"data": {"result": [{"value": [0, "100"]}]}})
+
+    src = PrometheusSource.__new__(PrometheusSource)
+    src._http = httpx.Client(
+        base_url="http://prom", transport=httpx.MockTransport(handler)
+    )
+    m = src.model_metrics("iris", "v2", "models")
+    assert m.error_rate is None  # NOT 0.0
+
+
+def test_mlflow_malformed_200_raises():
+    from tpumlops.clients.base import RegistryError
+
+    def handler(request):
+        return httpx.Response(200, json={"unexpected": True})
+
+    client = MlflowRestClient.__new__(MlflowRestClient)
+    client._http = httpx.Client(
+        base_url="http://mlflow", transport=httpx.MockTransport(handler)
+    )
+    with pytest.raises(RegistryError, match="malformed"):
+        client.get_version_by_alias("iris", "champion")
+
+
+def test_runtime_requires_metrics_at_startup():
+    from tpumlops.clients.fakes import FakeKube, FakeRegistry
+    from tpumlops.operator.runtime import OperatorRuntime
+
+    with pytest.raises(ValueError, match="metrics"):
+        OperatorRuntime(FakeKube(), FakeRegistry())
