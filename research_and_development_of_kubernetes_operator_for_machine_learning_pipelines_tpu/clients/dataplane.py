@@ -18,7 +18,7 @@ import httpx
 _log = logging.getLogger(__name__)
 
 DEFAULT_URL_TEMPLATE = (
-    "http://{deployment}-{predictor}.{namespace}:9000/v2/models/{deployment}/infer"
+    "http://{deployment}-{predictor}.{namespace}:9000/v2/models/{model}/infer"
 )
 
 
@@ -45,12 +45,22 @@ class DataPlaneWarmup:
         }
 
     def __call__(
-        self, deployment: str, predictor: str, namespace: str, n: int
+        self,
+        deployment: str,
+        predictor: str,
+        namespace: str,
+        n: int,
+        model: str | None = None,
     ) -> int:
         import time
 
+        # The V2 infer route is registered under spec.modelName (server
+        # app.py), which need not equal the deployment/CR name.
         url = self.url_template.format(
-            deployment=deployment, predictor=predictor, namespace=namespace
+            deployment=deployment,
+            predictor=predictor,
+            namespace=namespace,
+            model=model or deployment,
         )
         ok = 0
         deadline = time.monotonic() + self.max_wall_s
@@ -61,9 +71,16 @@ class DataPlaneWarmup:
                     break
                 try:
                     resp = client.post(url, json=self.example)
-                    if resp.status_code < 500:
+                    # Only a handled inference counts: a 404/400 produces no
+                    # request metric, so counting it would report a warmup
+                    # that unblocks nothing.
+                    if 200 <= resp.status_code < 300:
                         ok += 1
+                    else:
+                        _log.debug(
+                            "warmup request to %s got %d", url, resp.status_code
+                        )
                 except httpx.HTTPError as e:
                     _log.debug("warmup request failed: %s", e)
-        _log.info("warmup: %d/%d requests reached %s", ok, n, url)
+        _log.info("warmup: %d/%d requests served by %s", ok, n, url)
         return ok

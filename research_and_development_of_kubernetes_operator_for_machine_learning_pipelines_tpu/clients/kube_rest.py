@@ -25,6 +25,8 @@ _SA_DIR = Path("/var/run/secrets/kubernetes.io/serviceaccount")
 
 
 class KubeRestClient:
+    _token_from_mount = False
+
     def __init__(
         self,
         base_url: str | None = None,
@@ -41,7 +43,11 @@ class KubeRestClient:
                     "base_url given"
                 )
             base_url = f"https://{host}:{port}"
-        if token is None and (_SA_DIR / "token").exists():
+        # Remember whether the token came from the SA mount: bound SA tokens
+        # expire (~1h) and the kubelet rotates the file, so a 401 means
+        # "re-read the mount", not "give up".
+        self._token_from_mount = token is None and (_SA_DIR / "token").exists()
+        if self._token_from_mount:
             token = (_SA_DIR / "token").read_text().strip()
         if verify is None:
             ca = _SA_DIR / "ca.crt"
@@ -52,6 +58,14 @@ class KubeRestClient:
         )
 
     # -- plumbing ------------------------------------------------------------
+
+    def _request(self, method: str, path: str, **kw) -> httpx.Response:
+        resp = self._http.request(method, path, **kw)
+        if resp.status_code == 401 and self._token_from_mount:
+            fresh = (_SA_DIR / "token").read_text().strip()
+            self._http.headers["Authorization"] = f"Bearer {fresh}"
+            resp = self._http.request(method, path, **kw)
+        return resp
 
     @staticmethod
     def _path(ref: ObjectRef, name: bool = True) -> str:
@@ -75,23 +89,24 @@ class KubeRestClient:
     # -- KubeClient protocol -------------------------------------------------
 
     def get(self, ref: ObjectRef) -> dict:
-        return self._check(self._http.get(self._path(ref)))
+        return self._check(self._request("GET", self._path(ref)))
 
     def list(self, ref: ObjectRef) -> list[dict]:
-        body = self._check(self._http.get(self._path(ref, name=False)))
+        body = self._check(self._request("GET", self._path(ref, name=False)))
         return body.get("items", [])
 
     def create(self, ref: ObjectRef, body: Mapping[str, Any]) -> dict:
         return self._check(
-            self._http.post(self._path(ref, name=False), json=dict(body))
+            self._request("POST", self._path(ref, name=False), json=dict(body))
         )
 
     def replace(self, ref: ObjectRef, body: Mapping[str, Any]) -> dict:
-        return self._check(self._http.put(self._path(ref), json=dict(body)))
+        return self._check(self._request("PUT", self._path(ref), json=dict(body)))
 
     def patch_status(self, ref: ObjectRef, status: Mapping[str, Any]) -> dict:
         return self._check(
-            self._http.patch(
+            self._request(
+                "PATCH",
                 self._path(ref) + "/status",
                 content=json.dumps({"status": dict(status)}),
                 headers={"Content-Type": "application/merge-patch+json"},
@@ -99,7 +114,7 @@ class KubeRestClient:
         )
 
     def delete(self, ref: ObjectRef) -> None:
-        self._check(self._http.delete(self._path(ref)))
+        self._check(self._request("DELETE", self._path(ref)))
 
     def emit_event(self, ref: ObjectRef, event: Event) -> None:
         """Create a corev1 Event attached to the CR (kopf.event equivalent,
@@ -135,8 +150,8 @@ class KubeRestClient:
         # reconcile step, whether the API rejects it or the transport drops.
         try:
             self._check(
-                self._http.post(
-                    f"/api/v1/namespaces/{ref.namespace}/events", json=body
+                self._request(
+                    "POST", f"/api/v1/namespaces/{ref.namespace}/events", json=body
                 )
             )
         except (ApiError, httpx.HTTPError) as e:

@@ -44,12 +44,18 @@ class MlflowRestClient:
             resp = self._http.get(path, params=params)
         except httpx.HTTPError as e:
             raise RegistryError(f"mlflow unreachable: {e}") from e
-        if resp.status_code == 404:
-            raise AliasNotFound(resp.text[:200])
         if resp.status_code >= 400:
             body = resp.text[:500]
-            # MLflow reports missing aliases/versions as RESOURCE_DOES_NOT_EXIST.
-            if "RESOURCE_DOES_NOT_EXIST" in body or "not found" in body.lower():
+            # Only MLflow's own structured error for a missing alias/version
+            # may report AliasNotFound — that verdict triggers error status +
+            # deployment teardown (base.py contract).  A bare 404 from an
+            # ingress/proxy (wrong path prefix, upstream down) is an infra
+            # fault and must stay retryable, not tear down a healthy model.
+            try:
+                error_code = resp.json().get("error_code")
+            except ValueError:
+                error_code = None
+            if error_code == "RESOURCE_DOES_NOT_EXIST":
                 raise AliasNotFound(body)
             raise RegistryError(f"mlflow error {resp.status_code}: {body}")
         return resp.json()

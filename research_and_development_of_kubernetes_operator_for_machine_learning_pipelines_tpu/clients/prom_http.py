@@ -96,11 +96,15 @@ class PrometheusSource:
         else:
             latency_avg = (lat_sum / lat_count) if lat_count > 0 else None
         # Reference :410-415
+        # NOTE: a failed query stays 0.0 only because ModelMetrics requires a
+        # float here and nothing gates on feedback count; keep the None-vs-0
+        # distinction if a consumer ever appears.
         feedback = self._query(
             "sum(increase("
             f'seldon_api_executor_server_requests_seconds_count{{service="feedback", {sel}}}[{w}]'
             ")) or on() vector(0)"
-        ) or 0.0
+        )
+        feedback = feedback if feedback is not None else 0.0
 
         return ModelMetrics(
             latency_p95=p95,

@@ -52,14 +52,23 @@ def should_promote(
     reasons: list[str] = []
 
     # Availability check (reference :430-434): all three gating metrics must
-    # be present on both models.
-    for label, n_val, o_val in (
-        ("latency_95th", new.latency_p95, old.latency_p95),
-        ("error_rate", new.error_rate, old.error_rate),
-        ("latency_avg", new.latency_avg, old.latency_avg),
-    ):
-        if n_val is None or o_val is None:
-            reasons.append(f"metric {label} unavailable (no traffic in window)")
+    # be present on both models.  The reason names which model is missing
+    # traffic so the reconciler can aim warm-up requests at that predictor.
+    for who, m in (("new", new), ("old", old)):
+        missing = [
+            label
+            for label, val in (
+                ("latency_95th", m.latency_p95),
+                ("error_rate", m.error_rate),
+                ("latency_avg", m.latency_avg),
+            )
+            if val is None
+        ]
+        if missing:
+            reasons.append(
+                f"metrics {', '.join(missing)} unavailable on {who} model "
+                "(no traffic in window)"
+            )
     if reasons:
         for r in reasons:
             log.warning(r)

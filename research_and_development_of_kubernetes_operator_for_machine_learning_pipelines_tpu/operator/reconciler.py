@@ -276,24 +276,34 @@ class Reconciler:
         # This runs on gate attempts, NOT at deploy time: right after the
         # manifest apply the canary pod/service does not exist yet, so a
         # deploy-time burst would always fail and never be retried.
-        if (
-            canary.warmup_requests > 0
-            and self.warmup is not None
-            and any("unavailable" in r for r in decision.reasons)
-        ):
-            try:
-                self.warmup(
-                    self.name,
-                    f"v{state.current_version}",
-                    self.namespace,
-                    canary.warmup_requests,
-                )
-                self.log.info(
-                    f"sent {canary.warmup_requests} warm-up requests to "
-                    f"v{state.current_version} (gate metrics unavailable)"
-                )
-            except Exception as e:
-                self.log.warning(f"warm-up traffic failed: {e}")
+        if canary.warmup_requests > 0 and self.warmup is not None:
+            # The gate needs BOTH predictors' metrics; warm whichever one the
+            # judge reported as missing traffic (usually the 10% canary, but a
+            # drained stable predictor deadlocks the gate just the same).
+            targets = []
+            if any(
+                "unavailable" in r and "new model" in r for r in decision.reasons
+            ):
+                targets.append(f"v{state.current_version}")
+            if any(
+                "unavailable" in r and "old model" in r for r in decision.reasons
+            ):
+                targets.append(f"v{state.previous_version}")
+            for predictor in targets:
+                try:
+                    self.warmup(
+                        self.name,
+                        predictor,
+                        self.namespace,
+                        canary.warmup_requests,
+                        model=config.model_name,
+                    )
+                    self.log.info(
+                        f"sent {canary.warmup_requests} warm-up requests to "
+                        f"{predictor} (gate metrics unavailable)"
+                    )
+                except Exception as e:
+                    self.log.warning(f"warm-up traffic failed: {e}")
 
         new_state = state.gate_failed()
         if new_state.attempt < canary.max_attempts:

@@ -110,6 +110,47 @@ def test_mlflow_alias_lookup_and_miss():
     assert client.get_version("iris", "2").version == "2"
 
 
+def test_mlflow_bare_404_is_registry_error_not_alias_miss():
+    """An ingress-level 404 (no MLflow error_code) must stay retryable:
+    AliasNotFound triggers teardown of a healthy deployment."""
+    from tpumlops.clients.base import RegistryError
+
+    def handler(request):
+        return httpx.Response(404, text="<html>default backend - 404</html>")
+
+    client = MlflowRestClient.__new__(MlflowRestClient)
+    client._http = httpx.Client(
+        base_url="http://mlflow", transport=httpx.MockTransport(handler)
+    )
+    with pytest.raises(RegistryError):
+        client.get_version_by_alias("iris", "champion")
+
+
+def test_kube_401_refreshes_mounted_sa_token(tmp_path, monkeypatch):
+    """Bound SA tokens rotate on disk (~1h TTL); a 401 re-reads the mount
+    and retries once instead of failing every call until pod restart."""
+    from tpumlops.clients import kube_rest
+
+    (tmp_path / "token").write_text("fresh-token")
+    monkeypatch.setattr(kube_rest, "_SA_DIR", tmp_path)
+    auths = []
+
+    def handler(request):
+        auths.append(request.headers.get("authorization"))
+        if request.headers.get("authorization") != "Bearer fresh-token":
+            return httpx.Response(401, text="Unauthorized")
+        return httpx.Response(200, json={"metadata": {}})
+
+    kube = make_kube(handler)
+    kube._http.headers["Authorization"] = "Bearer stale-token"
+    kube._token_from_mount = True
+    kube.get(ref())
+    assert auths == ["Bearer stale-token", "Bearer fresh-token"]
+    # Subsequent calls use the refreshed token directly.
+    kube.get(ref())
+    assert auths[-1] == "Bearer fresh-token"
+
+
 def test_prometheus_queries_match_reference_promql():
     queries = []
 
@@ -187,17 +228,21 @@ def test_warmup_fires_on_unavailable_gate_metrics():
     calls = []
     rec = Reconciler(
         "iris", "models", kube, registry, metrics, FakeClock(),
-        warmup=lambda d, p, ns, n: calls.append((d, p, ns, n)),
+        warmup=lambda d, p, ns, n, model=None: calls.append((d, p, ns, n, model)),
     )
     rec.reconcile(kube.get(ref()))  # first deploy: STABLE, no warmup
     registry.register("iris", "2", "mlflow-artifacts:/1/b/artifacts/model")
     registry.set_alias("iris", "champion", "2")
     rec.reconcile(kube.get(ref()))  # canary deployed: no warmup yet
     assert calls == []
-    # First gate attempt: FakeMetrics returns all-None for both predictors,
-    # so the gate refuses with "unavailable" and warmup fires for the canary.
+    # First gate attempt: FakeMetrics returns all-None for BOTH predictors,
+    # so the gate refuses with "unavailable" and warmup fires for both the
+    # canary and the drained stable predictor, routed by spec.modelName.
     rec.reconcile(kube.get(ref()))
-    assert calls == [("iris", "v2", "models", 7)]
+    assert calls == [
+        ("iris", "v2", "models", 7, "iris"),
+        ("iris", "v1", "models", 7, "iris"),
+    ]
     # Once metrics flow, no more warmup.
     good = ModelMetrics(
         latency_p95=0.1, error_rate=0.0, latency_avg=0.05, request_count=100
@@ -205,7 +250,12 @@ def test_warmup_fires_on_unavailable_gate_metrics():
     metrics.set_metrics("iris", "v1", "models", good)
     metrics.set_metrics("iris", "v2", "models", good)
     rec.reconcile(kube.get(ref()))
-    assert len(calls) == 1
+    assert len(calls) == 2
+    # And only the predictor that is actually missing traffic gets warmed.
+    metrics.set_metrics("iris", "v2", "models", ModelMetrics())
+    rec.reconcile(kube.get(ref()))
+    assert calls[2] == ("iris", "v2", "models", 7, "iris")
+    assert len(calls) == 3
 
 
 def test_prometheus_query_failure_is_unavailable_not_zero():
