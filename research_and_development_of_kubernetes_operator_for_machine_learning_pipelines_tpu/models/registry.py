@@ -96,6 +96,44 @@ def _build_sklearn_forest(model: Any, **_kw) -> Predictor:
     )
 
 
+@register("xgboost")
+def _build_xgboost(model: Any, **_kw) -> Predictor:
+    """``model`` is a parsed xgboost JSON dict (or a live Booster).
+
+    Fully TPU-native (baseline config 1): trees run as the same flattened
+    gather program as sklearn forests; the objective picks the output
+    transform (sigmoid for ``binary:*``, identity for regression).
+    """
+    from . import tabular
+
+    if isinstance(model, (dict, str, bytes)):
+        trees, objective = tabular.from_xgboost_json(model)
+    else:
+        trees, objective = tabular.from_xgboost(model)
+
+    if objective.startswith("binary:"):
+        def predict(x):
+            import jax
+
+            return jax.nn.sigmoid(tabular.eval_forest(trees, x))
+    else:
+        def predict(x):
+            return tabular.eval_forest(trees, x)
+
+    n_feat = trees.n_features or int(trees.feature.max()) + 1
+    return Predictor(
+        name="xgboost",
+        predict=predict,
+        jittable=True,
+        example_input=lambda b: np.zeros((b, n_feat), np.float32),
+        metadata={
+            "n_trees": int(trees.feature.shape[0]),
+            "n_features": n_feat,
+            "objective": objective,
+        },
+    )
+
+
 @register("pyfunc")
 def _build_pyfunc(model: Any, **_kw) -> Predictor:
     from .tabular import PyFuncPredictor

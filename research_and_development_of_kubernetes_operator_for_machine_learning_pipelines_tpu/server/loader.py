@@ -147,6 +147,24 @@ def save_sklearn_model(path: str | Path, model: Any, flavor: str) -> Path:
     return path
 
 
+def save_xgboost_model(path: str | Path, model_json: dict) -> Path:
+    """Write an MLflow-xgboost-compatible artifact from a parsed JSON model
+    (the dict ``Booster.save_model("model.json")`` produces)."""
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    (path / "model.json").write_text(json.dumps(model_json))
+    (path / "MLmodel").write_text(
+        "flavors:\n"
+        "  xgboost:\n"
+        "    data: model.json\n"
+        "    model_format: json\n"
+        "  python_function:\n"
+        "    loader_module: mlflow.xgboost\n"
+        "    data: model.json\n"
+    )
+    return path
+
+
 # ---------------------------------------------------------------------------
 # Loading
 # ---------------------------------------------------------------------------
@@ -226,6 +244,18 @@ def load_predictor(
         _log.info("loaded native %s model from %s", flavor, path)
         return get_builder(flavor)(params, **kwargs)
 
+    xgb_file = _find_xgboost_file(path)
+    if xgb_file is not None:
+        raw = xgb_file.read_bytes()
+        if not raw.lstrip()[:1] == b"{":
+            raise ModelLoadError(
+                f"{xgb_file.name} is a binary xgboost model (UBJ/legacy); "
+                're-save it as JSON (booster.save_model("model.json")) for '
+                "TPU-native serving, or use the pyfunc tier"
+            )
+        _log.info("loaded xgboost JSON model from %s", xgb_file)
+        return get_builder("xgboost")(json.loads(raw))
+
     if (path / "model.pkl").exists():
         with open(path / "model.pkl", "rb") as f:
             model = pickle.load(f)
@@ -234,8 +264,33 @@ def load_predictor(
         return get_builder(flavor)(model)
 
     raise ModelLoadError(
-        f"{path} is not a recognized artifact (no params.npz or model.pkl)"
+        f"{path} is not a recognized artifact "
+        "(no params.npz, xgboost model file, or model.pkl)"
     )
+
+
+def _find_xgboost_file(path: Path) -> Path | None:
+    """Locate the model file of an MLflow xgboost artifact.
+
+    MLflow's xgboost flavor records the filename in MLmodel as
+    ``data: <file>``; fall back on the conventional names.
+    """
+    mlmodel = path / "MLmodel"
+    if mlmodel.exists():
+        text = mlmodel.read_text()
+        if "xgboost" not in text:
+            return None  # a declared non-xgboost artifact; don't sniff names
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("data:"):
+                cand = path / line.split(":", 1)[1].strip().strip("\"'")
+                if cand.exists():
+                    return cand
+    for name in ("model.json", "model.ubj", "model.xgb", "model.bst"):
+        cand = path / name
+        if cand.exists():
+            return cand
+    return None
 
 
 def _sniff_sklearn_flavor(model: Any) -> str:

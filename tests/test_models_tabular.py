@@ -75,3 +75,179 @@ def test_models_star_import_works():
     ns = {}
     exec("from tpumlops.models import *", ns)
     assert "llama" in ns and "registry" in ns and "tabular" in ns
+
+# ---------------------------------------------------------------------------
+# xgboost JSON format (no xgboost dependency — baseline config 1)
+# ---------------------------------------------------------------------------
+
+
+def _xgb_tree(left, right, split_idx, split_cond):
+    """Build one tree dict in xgboost's JSON schema. Leaves: left==-1 and
+    split_conditions holds the leaf value."""
+    n = len(left)
+    return {
+        "base_weights": [0.0] * n,
+        "categories": [],
+        "categories_nodes": [],
+        "categories_segments": [],
+        "categories_sizes": [],
+        "default_left": [1] * n,
+        "id": 0,
+        "left_children": left,
+        "loss_changes": [0.0] * n,
+        "parents": [2147483647] * n,
+        "right_children": right,
+        "split_conditions": split_cond,
+        "split_indices": split_idx,
+        "split_type": [0] * n,
+        "sum_hessian": [1.0] * n,
+        "tree_param": {
+            "num_deleted": "0",
+            "num_feature": "3",
+            "num_nodes": str(n),
+            "size_leaf_vector": "1",
+        },
+    }
+
+
+def _xgb_model(trees, objective="reg:squarederror", base_score="0.5", num_feature="3"):
+    return {
+        "learner": {
+            "attributes": {},
+            "feature_names": [],
+            "feature_types": [],
+            "gradient_booster": {
+                "model": {
+                    "gbtree_model_param": {
+                        "num_parallel_tree": "1",
+                        "num_trees": str(len(trees)),
+                    },
+                    "iteration_indptr": list(range(len(trees) + 1)),
+                    "tree_info": [0] * len(trees),
+                    "trees": trees,
+                },
+                "name": "gbtree",
+            },
+            "learner_model_param": {
+                "base_score": base_score,
+                "boost_from_average": "1",
+                "num_class": "0",
+                "num_feature": num_feature,
+                "num_target": "1",
+            },
+            "objective": {
+                "name": objective,
+                "reg_loss_param": {"scale_pos_weight": "1"},
+            },
+        },
+        "version": [2, 0, 0],
+    }
+
+
+def _ref_eval_one(tree, x):
+    """Independent recursive reference with xgboost's strict `<` routing."""
+    node = 0
+    while tree["left_children"][node] != -1:
+        if x[tree["split_indices"][node]] < tree["split_conditions"][node]:
+            node = tree["left_children"][node]
+        else:
+            node = tree["right_children"][node]
+    return tree["split_conditions"][node]
+
+
+def _two_tree_model(**kw):
+    # Tree A, depth 2:        f0 < 1.5
+    #                     yes /        \ no
+    #                  f2 < -0.5       leaf 3.0
+    #                 yes /   \ no
+    #              leaf 10   leaf 20
+    tree_a = _xgb_tree(
+        left=[1, 3, -1, -1, -1],
+        right=[2, 4, -1, -1, -1],
+        split_idx=[0, 2, 0, 0, 0],
+        split_cond=[1.5, -0.5, 3.0, 10.0, 20.0],
+    )
+    # Tree B, depth 1: f1 < 0.25 ? leaf -1.0 : leaf 1.0
+    tree_b = _xgb_tree(
+        left=[1, -1, -1],
+        right=[2, -1, -1],
+        split_idx=[1, 0, 0],
+        split_cond=[0.25, -1.0, 1.0],
+    )
+    return _xgb_model([tree_a, tree_b], **kw), [tree_a, tree_b]
+
+
+def test_xgboost_json_matches_reference_traversal():
+    model, trees_json = _two_tree_model()
+    trees, objective = tabular.from_xgboost_json(model)
+    assert objective == "reg:squarederror"
+    assert trees.n_features == 3
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 3)).astype(np.float32) * 2
+    # Include exact-boundary rows: x == split_cond must go RIGHT (strict <).
+    X[0] = [1.5, 0.25, -0.5]
+    X[1] = [1.5 - 1e-6, 0.25 - 1e-6, -0.5 - 1e-6]
+    expected = np.array(
+        [sum(_ref_eval_one(t, row) for t in trees_json) + 0.5 for row in X],
+        np.float32,
+    )
+    got = np.asarray(jax.jit(lambda x: tabular.eval_forest(trees, x))(jnp.asarray(X)))
+    np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-6)
+    # Boundary semantics spelled out: row 0 takes both right branches.
+    assert expected[0] == 3.0 + 1.0 + 0.5
+    assert expected[1] == 10.0 + (-1.0) + 0.5
+
+
+def test_xgboost_binary_logistic_applies_sigmoid_and_logit_base():
+    model, trees_json = _two_tree_model(
+        objective="binary:logistic", base_score="0.2"
+    )
+    pred = registry.get_builder("xgboost")(model)
+    assert pred.jittable
+    assert pred.metadata["objective"] == "binary:logistic"
+    X = np.array([[0.0, 1.0, 0.0], [2.0, -1.0, 0.0]], np.float32)
+    margin = np.array(
+        [sum(_ref_eval_one(t, row) for t in trees_json) for row in X]
+    ) + np.log(0.2 / 0.8)
+    expect = 1.0 / (1.0 + np.exp(-margin))
+    got = np.asarray(pred.predict(jnp.asarray(X)))
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_xgboost_rejects_gblinear_and_multiclass():
+    import pytest
+
+    model, _ = _two_tree_model()
+    model["learner"]["gradient_booster"]["name"] = "gblinear"
+    with pytest.raises(NotImplementedError, match="gblinear"):
+        tabular.from_xgboost_json(model)
+    model, _ = _two_tree_model()
+    model["learner"]["learner_model_param"]["num_class"] = "3"
+    with pytest.raises(NotImplementedError, match="multi-class"):
+        tabular.from_xgboost_json(model)
+
+
+def test_xgboost_artifact_loads_end_to_end(tmp_path):
+    from tpumlops.server.loader import load_predictor, save_xgboost_model
+
+    model, trees_json = _two_tree_model()
+    art = save_xgboost_model(tmp_path / "xgb", model)
+    pred = load_predictor(str(art))
+    assert pred.name == "xgboost"
+    assert pred.example_input(2).shape == (2, 3)
+    X = np.array([[0.0, 1.0, 0.0]], np.float32)
+    expect = sum(_ref_eval_one(t, X[0]) for t in trees_json) + 0.5
+    np.testing.assert_allclose(np.asarray(pred.predict(jnp.asarray(X))), [expect])
+
+
+def test_xgboost_binary_format_is_rejected_with_guidance(tmp_path):
+    import pytest
+
+    from tpumlops.server.loader import ModelLoadError, load_predictor
+
+    art = tmp_path / "xgb-ubj"
+    art.mkdir()
+    (art / "model.ubj").write_bytes(b"\x7fUBJ\x01binarystuff")
+    (art / "MLmodel").write_text("flavors:\n  xgboost:\n    data: model.ubj\n")
+    with pytest.raises(ModelLoadError, match="re-save it as JSON"):
+        load_predictor(str(art))
