@@ -8,6 +8,18 @@ the two matmuls per tile run back-to-back on the MXU.
 Layout: [batch, heads, seq, head_dim]; grid is (batch*heads, q_tiles).
 Tiles default to 128x128 (the MXU native tile).  Causal masking and a
 static ``kv_len`` (for padded keys) fold into the tile mask via iota.
+
+Dispatch policy (measured on TPU v5e, 2026-07): standalone, this kernel
+beats XLA attention at BERT-base shapes (16.9 us vs 29.9 us per op at
+B32/H12/S128/D64).  *Inside* a full encoder forward, however, the XLA
+path wins at every shape tried (S=128: 6.1 vs 6.3 ms; B8/S512: 12.4 vs
+17.0 ms; B2/S2048: 20.8 vs 35.6 ms per forward) because XLA fuses the
+QKV projections, softmax, and context matmul without the layout
+transposes the [B,H,S,D] kernel interface forces.  The model zoo
+therefore keeps XLA attention; this kernel is the building block for
+``ring_attention`` (sequence parallelism), where blockwise
+online-softmax structure is required to overlap compute with the ICI
+ring permute and XLA has no equivalent fusion.
 """
 
 from __future__ import annotations
