@@ -1,0 +1,180 @@
+"""Build, supervise, and drive the native canary router (native/router.cc).
+
+The reference splits canary traffic with Istio weights written into a
+SeldonDeployment and reads latency histograms from the Seldon *executor*
+(``mlflow_operator.py:205,:220,:322-324`` / ``:367-415``).  Outside a
+service mesh — a bare TPU-VM node pool, a dev box, the benchmark harness —
+this framework carries its own executor: ``tpumlops-router``, a C++ epoll
+reverse proxy that does the weighted split and exports the same
+``seldon_api_executor_*`` histogram families the gate queries.
+
+This module is the Python face of that binary:
+
+- :func:`build_router` — compile ``router.cc`` with the system ``g++`` into
+  a content-addressed cache (no pip/cmake involvement; the toolchain is a
+  baseline environment guarantee);
+- :class:`RouterProcess` — spawn/supervise one router instance;
+- :class:`RouterAdmin` — typed admin API (weights, config, metrics) used by
+  tests and by operators running in local/router mode.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+import subprocess
+import time
+import urllib.error
+import urllib.request
+
+_SRC = pathlib.Path(__file__).resolve().parent.parent / "native" / "router.cc"
+
+
+def _cache_dir() -> pathlib.Path:
+    # Per-user, mode-0700 cache — NOT a world-writable /tmp path, where
+    # another local user could pre-plant a binary at the predictable
+    # source-hash name and have us exec it.
+    base = os.environ.get("TPUMLOPS_CACHE") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache"),
+        "tpumlops-native",
+    )
+    path = pathlib.Path(base)
+    path.mkdir(parents=True, exist_ok=True, mode=0o700)
+    return path
+
+
+def build_router(src: pathlib.Path | None = None) -> pathlib.Path:
+    """Compile the router (cached by source hash). Returns the binary path."""
+    src = src or _SRC
+    text = src.read_bytes()
+    tag = hashlib.sha256(text).hexdigest()[:16]
+    cache = _cache_dir()
+    out = cache / f"tpumlops-router-{tag}"
+    # Trust the cached binary only if this user owns it.
+    if out.exists() and out.stat().st_uid == os.getuid():
+        return out
+    tmp = out.with_suffix(f".build{os.getpid()}")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-Wall", "-o", str(tmp), str(src)],
+        check=True,
+        capture_output=True,
+    )
+    tmp.replace(out)
+    return out
+
+
+class RouterAdmin:
+    """Admin-API client for a running router (stdlib urllib; no deps)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1", timeout: float = 5.0):
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def _req(self, path: str, method: str = "GET", body: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read()
+
+    def healthy(self) -> bool:
+        try:
+            return self._req("/router/healthz") == b"ok\n"
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return False
+
+    def get_weights(self) -> dict[str, int]:
+        return json.loads(self._req("/router/weights"))
+
+    def set_weights(self, weights: dict[str, int]) -> None:
+        self._req("/router/weights", "PUT", weights)
+
+    def get_config(self) -> dict:
+        return json.loads(self._req("/router/config"))
+
+    def set_config(
+        self,
+        backends: list[dict],
+        namespace: str | None = None,
+        deployment: str | None = None,
+    ) -> dict:
+        body: dict = {"backends": backends}
+        if namespace:
+            body["namespace"] = namespace
+        if deployment:
+            body["deployment"] = deployment
+        return json.loads(self._req("/router/config", "PUT", body))
+
+    def metrics_text(self) -> str:
+        return self._req("/router/metrics").decode()
+
+
+class RouterProcess:
+    """One supervised router instance.
+
+    >>> with RouterProcess(port=9000, namespace="ns", deployment="bert",
+    ...                    backends={"v1": ("127.0.0.1", 8001, 90),
+    ...                              "v2": ("127.0.0.1", 8002, 10)}) as r:
+    ...     r.admin.set_weights({"v1": 80, "v2": 20})
+    """
+
+    def __init__(
+        self,
+        port: int,
+        backends: dict[str, tuple[str, int, int]],
+        namespace: str = "default",
+        deployment: str = "router",
+        binary: pathlib.Path | None = None,
+    ):
+        self.port = port
+        self.backends = backends
+        self.namespace = namespace
+        self.deployment = deployment
+        self.binary = binary or build_router()
+        self.proc: subprocess.Popen | None = None
+        self.admin = RouterAdmin(port)
+
+    def start(self, wait_s: float = 5.0) -> "RouterProcess":
+        argv = [
+            str(self.binary),
+            "--port", str(self.port),
+            "--namespace", self.namespace,
+            "--deployment", self.deployment,
+        ]
+        for name, (host, port, weight) in self.backends.items():
+            argv += ["--backend", f"{name}={host}:{port}:{weight}"]
+        self.proc = subprocess.Popen(
+            argv, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
+        )
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            if self.admin.healthy():
+                return self
+            if self.proc.poll() is not None:
+                err = self.proc.stderr.read().decode() if self.proc.stderr else ""
+                raise RuntimeError(f"router exited at startup: {err}")
+            time.sleep(0.02)
+        self.stop()
+        raise TimeoutError("router did not become healthy")
+
+    def stop(self) -> None:
+        if self.proc is not None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+            if self.proc.stderr:
+                self.proc.stderr.close()
+            self.proc = None
+
+    def __enter__(self) -> "RouterProcess":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,1255 @@
+// tpumlops-router — native weighted canary router (data-plane executor).
+//
+// The reference outsources traffic splitting to Istio + the Seldon
+// executor: the operator only writes `traffic:` weights into the
+// SeldonDeployment (mlflow_operator.py:205,:220,:322-324) and reads the
+// executor's `seldon_api_executor_*` histograms back from Prometheus
+// (:367-415).  This binary is the first-party equivalent of that pair for
+// the TPU data plane: an HTTP/1.1 reverse proxy that
+//
+//   * splits traffic between predictor versions by smooth weighted
+//     round-robin (nginx algorithm — deterministic, no sampling noise at
+//     a 10% canary split, unlike random pick);
+//   * accepts live weight updates over `/router/weights` (the operator's
+//     promotion loop PUTs here instead of patching an Istio VirtualService);
+//   * emits gate-compatible Prometheus text on `/router/metrics`:
+//     `seldon_api_executor_client_requests_seconds` +
+//     `seldon_api_executor_server_requests_seconds` histograms keyed by
+//     {deployment_name, predictor_name, namespace}, so the reference's
+//     PromQL (and our judge) reads the router exactly as it read Seldon.
+//
+// Design: single-threaded epoll event loop, non-blocking sockets,
+// keep-alive connection pool per backend.  No third-party dependencies —
+// POSIX + libc only.  A single loop saturates far beyond the request
+// rates a per-chip predictor sustains (requests are ms-scale TPU batches),
+// and it makes weight updates and metric reads race-free by construction.
+//
+// Protocol support: HTTP/1.1 with Content-Length or chunked bodies in
+// both directions (chunked responses are framed-forwarded verbatim).
+//
+// Build: g++ -O2 -std=c++17 -o tpumlops-router router.cc
+// (clients/router.py builds and supervises it; tests/test_router.py
+// exercises split ratios, live reweighting, 502s, and the metric surface.)
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <map>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Small utilities
+// ---------------------------------------------------------------------------
+
+double now_s() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+void die(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vfprintf(stderr, fmt, ap);
+  va_end(ap);
+  fputc('\n', stderr);
+  exit(1);
+}
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+std::string lower(std::string s) {
+  for (auto& c : s) c = char(tolower(c));
+  return s;
+}
+
+// Matches server/metrics.py _LATENCY_BUCKETS (gate-compatible histograms).
+const double kBuckets[] = {0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                           0.25,  0.5,    1.0,   2.5,  5.0,   10.0};
+constexpr int kNumBuckets = sizeof(kBuckets) / sizeof(kBuckets[0]);
+
+struct Histogram {
+  uint64_t bucket_counts[kNumBuckets] = {};
+  uint64_t count = 0;
+  double sum = 0.0;
+
+  void observe(double v) {
+    for (int i = 0; i < kNumBuckets; i++)
+      if (v <= kBuckets[i]) bucket_counts[i]++;
+    count++;
+    sum += v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Backend table + smooth weighted round-robin
+// ---------------------------------------------------------------------------
+
+struct Backend {
+  std::string name;  // predictor_name label, e.g. "v3"
+  std::string host;
+  int port = 0;
+  int weight = 0;
+  int swrr_current = 0;  // smooth-WRR running counter
+  sockaddr_in addr{};    // resolved at config time (getaddrinfo)
+
+  Histogram client_latency;                    // client_requests_seconds
+  std::map<std::string, Histogram> by_code;    // server_requests_seconds{code=}
+  std::vector<int> idle_conns;                 // keep-alive pool (fds)
+};
+
+// Resolve host:port once at config time (k8s service names and "localhost"
+// are valid backend hosts, not just dotted quads).  Config-time resolution
+// keeps DNS lookups out of the request path and turns a typo'd host into
+// an immediate 400 instead of per-request 502s the gate would read as a
+// failing canary.
+bool resolve_backend(Backend* b) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", b->port);
+  if (getaddrinfo(b->host.c_str(), portstr, &hints, &res) != 0 || !res)
+    return false;
+  b->addr = *reinterpret_cast<sockaddr_in*>(res->ai_addr);
+  freeaddrinfo(res);
+  return true;
+}
+
+// Backends are shared_ptr so an in-flight request whose backend is removed
+// by a concurrent /router/config replace still has a live object to record
+// its final latency into (the orphaned histogram is then dropped with the
+// last reference — metrics for removed predictors stop being exported,
+// matching Seldon executor behavior when a predictor is deleted).
+using BackendPtr = std::shared_ptr<Backend>;
+
+struct RouterState {
+  std::string ns = "default";
+  std::string deployment = "router";
+  std::vector<BackendPtr> backends;
+  uint64_t proxied_total = 0;
+
+  BackendPtr find(const std::string& name) {
+    for (auto& b : backends)
+      if (b->name == name) return b;
+    return nullptr;
+  }
+
+  // nginx smooth weighted round-robin: deterministic interleave, exact
+  // long-run proportions.  Returns nullptr when all weights are 0.
+  BackendPtr pick() {
+    BackendPtr best;
+    int total = 0;
+    for (auto& b : backends) {
+      if (b->weight <= 0) continue;
+      b->swrr_current += b->weight;
+      total += b->weight;
+      if (!best || b->swrr_current > best->swrr_current) best = b;
+    }
+    if (best) best->swrr_current -= total;
+    return best;
+  }
+};
+
+RouterState g_state;
+
+// ---------------------------------------------------------------------------
+// Minimal JSON: parse flat {"name": int} maps and the config document
+// {"namespace": "...", "deployment": "...",
+//  "backends": [{"name": "...", "host": "...", "port": 1, "weight": 1}, ...]}
+// Hand-rolled because the only JSON this binary sees is its own admin API.
+// ---------------------------------------------------------------------------
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JsonParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+  bool consume(char c) {
+    skip_ws();
+    if (p < end && *p == c) {
+      p++;
+      return true;
+    }
+    ok = false;
+    return false;
+  }
+  bool peek(char c) {
+    skip_ws();
+    return p < end && *p == c;
+  }
+  std::string parse_string() {
+    skip_ws();
+    std::string out;
+    if (p >= end || *p != '"') {
+      ok = false;
+      return out;
+    }
+    p++;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) p++;  // keep escaped char verbatim
+      out += *p++;
+    }
+    if (p < end) p++;  // closing quote
+    else ok = false;
+    return out;
+  }
+  double parse_number() {
+    skip_ws();
+    char* q = nullptr;
+    double v = strtod(p, &q);
+    if (q == p) ok = false;
+    p = q;
+    return v;
+  }
+  // Skip any JSON value (for unknown keys).
+  void skip_value() {
+    skip_ws();
+    if (p >= end) { ok = false; return; }
+    if (*p == '"') { parse_string(); return; }
+    if (*p == '{' || *p == '[') {
+      char open = *p, close = (*p == '{') ? '}' : ']';
+      int depth = 0;
+      bool in_str = false;
+      while (p < end) {
+        char c = *p++;
+        if (in_str) {
+          if (c == '\\' && p < end) p++;
+          else if (c == '"') in_str = false;
+        } else if (c == '"') in_str = true;
+        else if (c == open) depth++;
+        else if (c == close && --depth == 0) return;
+      }
+      ok = false;
+      return;
+    }
+    while (p < end && *p != ',' && *p != '}' && *p != ']') p++;
+  }
+};
+
+bool parse_weights(const std::string& body, std::map<std::string, int>* out) {
+  JsonParser j(body);
+  if (!j.consume('{')) return false;
+  if (j.peek('}')) { j.consume('}'); return j.ok; }
+  while (j.ok) {
+    std::string key = j.parse_string();
+    if (!j.consume(':')) break;
+    int w = int(j.parse_number());
+    if (!j.ok) break;
+    (*out)[key] = w;
+    if (j.peek(',')) { j.consume(','); continue; }
+    j.consume('}');
+    break;
+  }
+  return j.ok;
+}
+
+struct BackendSpec {
+  std::string name, host;
+  int port = 0, weight = 0;
+};
+
+bool parse_config(const std::string& body, std::string* ns, std::string* dep,
+                  std::vector<BackendSpec>* specs) {
+  JsonParser j(body);
+  if (!j.consume('{')) return false;
+  while (j.ok && !j.peek('}')) {
+    std::string key = j.parse_string();
+    if (!j.consume(':')) return false;
+    if (key == "namespace") *ns = j.parse_string();
+    else if (key == "deployment") *dep = j.parse_string();
+    else if (key == "backends") {
+      if (!j.consume('[')) return false;
+      while (j.ok && !j.peek(']')) {
+        if (!j.consume('{')) return false;
+        BackendSpec s;
+        while (j.ok && !j.peek('}')) {
+          std::string k2 = j.parse_string();
+          if (!j.consume(':')) return false;
+          if (k2 == "name") s.name = j.parse_string();
+          else if (k2 == "host") s.host = j.parse_string();
+          else if (k2 == "port") s.port = int(j.parse_number());
+          else if (k2 == "weight") s.weight = int(j.parse_number());
+          else j.skip_value();
+          if (j.peek(',')) j.consume(',');
+        }
+        j.consume('}');
+        specs->push_back(s);
+        if (j.peek(',')) j.consume(',');
+      }
+      j.consume(']');
+    } else {
+      j.skip_value();
+    }
+    if (j.peek(',')) j.consume(',');
+  }
+  j.consume('}');
+  return j.ok;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP message framing
+// ---------------------------------------------------------------------------
+
+// Hard caps: a single misbehaving local client (or backend) must not be
+// able to balloon the router's RSS — the router fronts EVERY predictor, so
+// an OOM kill here takes down the whole data plane.
+constexpr size_t kMaxHeaderBytes = 1 << 20;        // 1 MiB of headers
+constexpr size_t kMaxMessageBytes = 64u << 20;     // 64 MiB framed message
+
+// Incrementally-parsed HTTP/1.1 message (request or response).
+struct HttpMsg {
+  std::string buf;         // raw bytes accumulated so far
+  size_t header_end = 0;   // offset just past "\r\n\r\n" (0 = headers incomplete)
+  // parsed request fields
+  std::string method, path, version;
+  int status = 0;             // for responses
+  std::string request_method;  // for responses: method that elicited this
+  std::unordered_map<std::string, std::string> headers;  // lowercased keys
+  ssize_t content_length = -1;  // -1 = absent
+  bool chunked = false;
+  size_t body_start = 0;
+
+  bool headers_complete() const { return header_end != 0; }
+
+  // Returns false on malformed input.
+  bool try_parse_headers(bool is_request) {
+    size_t pos = buf.find("\r\n\r\n");
+    if (pos == std::string::npos) return true;  // need more bytes
+    header_end = pos + 4;
+    body_start = header_end;
+
+    size_t line_end = buf.find("\r\n");
+    std::string start_line = buf.substr(0, line_end);
+    if (is_request) {
+      size_t sp1 = start_line.find(' ');
+      size_t sp2 = start_line.rfind(' ');
+      if (sp1 == std::string::npos || sp2 == sp1) return false;
+      method = start_line.substr(0, sp1);
+      path = start_line.substr(sp1 + 1, sp2 - sp1 - 1);
+      version = start_line.substr(sp2 + 1);
+    } else {
+      size_t sp1 = start_line.find(' ');
+      if (sp1 == std::string::npos) return false;
+      version = start_line.substr(0, sp1);
+      status = atoi(start_line.c_str() + sp1 + 1);
+    }
+
+    size_t cur = line_end + 2;
+    while (cur < pos) {
+      size_t eol = buf.find("\r\n", cur);
+      if (eol == std::string::npos || eol > pos) break;
+      std::string line = buf.substr(cur, eol - cur);
+      size_t colon = line.find(':');
+      if (colon != std::string::npos) {
+        std::string k = lower(line.substr(0, colon));
+        size_t v0 = colon + 1;
+        while (v0 < line.size() && line[v0] == ' ') v0++;
+        headers[k] = line.substr(v0);
+      }
+      cur = eol + 2;
+    }
+    auto it = headers.find("content-length");
+    if (it != headers.end()) content_length = atoll(it->second.c_str());
+    it = headers.find("transfer-encoding");
+    if (it != headers.end() && lower(it->second).find("chunked") != std::string::npos)
+      chunked = true;
+    return true;
+  }
+
+  // Offset one past the end of the framed message, or -1 while incomplete.
+  // `eof` marks peer close (terminates close-delimited response bodies).
+  // Bytes past this offset belong to the NEXT message on the connection
+  // (keep-alive clients may send request N+1 early) and must not be
+  // forwarded as part of this one.
+  ssize_t message_end(bool is_request, bool eof) const {
+    if (!headers_complete()) return -1;
+    if (!is_request &&
+        (status == 204 || status == 304 || (status >= 100 && status < 200) ||
+         request_method == "HEAD")) {
+      // RFC 7230 §3.3.3: these responses carry no body regardless of
+      // Content-Length/Transfer-Encoding headers (a HEAD response
+      // advertises the length the GET would have had).
+      return ssize_t(body_start);
+    }
+    if (chunked) {
+      // Scan chunk frames from body_start.
+      size_t pos = body_start;
+      while (true) {
+        size_t eol = buf.find("\r\n", pos);
+        if (eol == std::string::npos) return -1;
+        long sz = strtol(buf.c_str() + pos, nullptr, 16);
+        size_t data = eol + 2;
+        if (sz == 0) {
+          // terminator: "0\r\n\r\n", or trailers ending in a blank line
+          size_t term = buf.find("\r\n\r\n", eol);
+          if (term != std::string::npos) return ssize_t(term + 4);
+          return -1;
+        }
+        pos = data + size_t(sz) + 2;  // skip data + CRLF
+        if (pos > buf.size()) return -1;
+      }
+    }
+    if (content_length >= 0) {
+      size_t end = body_start + size_t(content_length);
+      return buf.size() >= end ? ssize_t(end) : -1;
+    }
+    if (is_request) return ssize_t(body_start);  // request without body
+    return eof ? ssize_t(buf.size()) : -1;       // close-delimited response
+  }
+
+  bool complete(bool is_request, bool eof) const {
+    return message_end(is_request, eof) >= 0;
+  }
+
+  void reset() { *this = HttpMsg(); }
+};
+
+std::string http_response(int code, const std::string& reason,
+                          const std::string& content_type,
+                          const std::string& body) {
+  char head[256];
+  snprintf(head, sizeof(head),
+           "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\n"
+           "Connection: keep-alive\r\n\r\n",
+           code, reason.c_str(), content_type.c_str(), body.size());
+  return std::string(head) + body;
+}
+
+// ---------------------------------------------------------------------------
+// Connection state machines
+// ---------------------------------------------------------------------------
+
+enum class FdKind { Listener, Client, Upstream };
+
+struct ClientConn;
+
+struct UpstreamConn {
+  int fd = -1;
+  BackendPtr backend;
+  ClientConn* client = nullptr;  // request being served (null = idle in pool)
+  std::string out;               // bytes to write to backend
+  size_t out_off = 0;
+  HttpMsg resp;
+  bool connecting = false;
+  bool reused = false;  // taken from the keep-alive pool (stale-retry eligible)
+};
+
+struct ClientConn {
+  int fd = -1;
+  HttpMsg req;
+  std::string pending;  // bytes past the current request (next keep-alive req)
+  std::string out;      // bytes to write back to client
+  size_t out_off = 0;
+  UpstreamConn* upstream = nullptr;
+  BackendPtr backend;  // chosen for current request
+  double t_start = 0;  // request receipt time
+  int retries = 0;     // stale pooled-connection retries this request
+  bool closing = false;  // close after out drains
+};
+
+struct FdEntry {
+  FdKind kind;
+  ClientConn* client = nullptr;
+  UpstreamConn* upstream = nullptr;
+  uint32_t gen = 0;  // registration generation (stale-event guard)
+};
+
+int g_epoll = -1;
+std::unordered_map<int, FdEntry> g_fds;
+uint32_t g_gen = 0;
+
+// Events carry (generation << 32 | fd).  Within one epoll_wait batch an
+// earlier event can close an fd whose number the kernel immediately
+// recycles for a new connection; a still-queued event for the OLD socket
+// must not be delivered to the NEW one.  The generation check in the main
+// loop drops such stale events.
+uint64_t event_key(int fd) { return (uint64_t(g_fds[fd].gen) << 32) | uint32_t(fd); }
+
+void epoll_set(int fd, uint32_t events) {
+  epoll_event ev{};
+  ev.events = events;
+  ev.data.u64 = event_key(fd);
+  epoll_ctl(g_epoll, EPOLL_CTL_MOD, fd, &ev);
+}
+
+// Registers fd (caller must have inserted its g_fds entry already).
+void epoll_add(int fd, uint32_t events) {
+  g_fds[fd].gen = ++g_gen;
+  epoll_event ev{};
+  ev.events = events;
+  ev.data.u64 = event_key(fd);
+  epoll_ctl(g_epoll, EPOLL_CTL_ADD, fd, &ev);
+}
+
+void close_upstream(UpstreamConn* u) {
+  if (!u) return;
+  if (u->fd >= 0) {
+    // Scrub the fd from its backend's keep-alive pool: a closed fd number
+    // is recycled by the kernel, and a stale pool entry would alias the
+    // next connection that happens to get the same number.
+    if (u->backend) {
+      auto& pool = u->backend->idle_conns;
+      for (auto it = pool.begin(); it != pool.end(); ++it)
+        if (*it == u->fd) {
+          pool.erase(it);
+          break;
+        }
+    }
+    epoll_ctl(g_epoll, EPOLL_CTL_DEL, u->fd, nullptr);
+    g_fds.erase(u->fd);
+    close(u->fd);
+  }
+  delete u;
+}
+
+void close_client(ClientConn* c) {
+  if (!c) return;
+  if (c->upstream) {
+    c->upstream->client = nullptr;
+    close_upstream(c->upstream);
+    c->upstream = nullptr;
+  }
+  if (c->fd >= 0) {
+    epoll_ctl(g_epoll, EPOLL_CTL_DEL, c->fd, nullptr);
+    g_fds.erase(c->fd);
+    close(c->fd);
+  }
+  delete c;
+}
+
+void client_send(ClientConn* c, const std::string& data) {
+  c->out += data;
+  epoll_set(c->fd, EPOLLIN | EPOLLOUT);
+}
+
+// ---------------------------------------------------------------------------
+// Metrics exposition
+// ---------------------------------------------------------------------------
+
+void emit_histogram(std::string* out, const std::string& family,
+                    const std::string& labels, const Histogram& h) {
+  char line[512];
+  uint64_t cum = 0;
+  for (int i = 0; i < kNumBuckets; i++) {
+    cum = h.bucket_counts[i];
+    snprintf(line, sizeof(line), "%s_bucket{%s,le=\"%g\"} %llu\n", family.c_str(),
+             labels.c_str(), kBuckets[i], (unsigned long long)cum);
+    *out += line;
+  }
+  snprintf(line, sizeof(line), "%s_bucket{%s,le=\"+Inf\"} %llu\n", family.c_str(),
+           labels.c_str(), (unsigned long long)h.count);
+  *out += line;
+  snprintf(line, sizeof(line), "%s_sum{%s} %.9f\n", family.c_str(), labels.c_str(),
+           h.sum);
+  *out += line;
+  snprintf(line, sizeof(line), "%s_count{%s} %llu\n", family.c_str(), labels.c_str(),
+           (unsigned long long)h.count);
+  *out += line;
+}
+
+std::string metrics_text() {
+  std::string out;
+  out += "# TYPE seldon_api_executor_client_requests_seconds histogram\n";
+  for (auto& b : g_state.backends) {
+    char labels[256];
+    snprintf(labels, sizeof(labels),
+             "deployment_name=\"%s\",predictor_name=\"%s\",namespace=\"%s\"",
+             g_state.deployment.c_str(), b->name.c_str(), g_state.ns.c_str());
+    emit_histogram(&out, "seldon_api_executor_client_requests_seconds", labels,
+                   b->client_latency);
+  }
+  out += "# TYPE seldon_api_executor_server_requests_seconds histogram\n";
+  for (auto& b : g_state.backends) {
+    for (auto& [code, hist] : b->by_code) {
+      char labels[320];
+      snprintf(labels, sizeof(labels),
+               "deployment_name=\"%s\",predictor_name=\"%s\",namespace=\"%s\","
+               "code=\"%s\",service=\"predictions\"",
+               g_state.deployment.c_str(), b->name.c_str(), g_state.ns.c_str(),
+               code.c_str());
+      emit_histogram(&out, "seldon_api_executor_server_requests_seconds", labels,
+                     hist);
+    }
+  }
+  out += "# TYPE tpumlops_router_proxied_total counter\n";
+  char line[256];
+  snprintf(line, sizeof(line), "tpumlops_router_proxied_total %llu\n",
+           (unsigned long long)g_state.proxied_total);
+  out += line;
+  return out;
+}
+
+std::string config_json() {
+  std::string out = "{\"namespace\":\"" + g_state.ns + "\",\"deployment\":\"" +
+                    g_state.deployment + "\",\"backends\":[";
+  bool first = true;
+  for (auto& b : g_state.backends) {
+    if (!first) out += ",";
+    first = false;
+    char item[512];
+    snprintf(item, sizeof(item),
+             "{\"name\":\"%s\",\"host\":\"%s\",\"port\":%d,\"weight\":%d}",
+             b->name.c_str(), b->host.c_str(), b->port, b->weight);
+    out += item;
+  }
+  out += "]}";
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Admin endpoints (/router/*)
+// ---------------------------------------------------------------------------
+
+// Drain a backend's keep-alive pool (close_upstream scrubs the pool
+// entry itself; copy the list first since it mutates under us).
+void drain_pool(Backend* b) {
+  std::vector<int> fds = b->idle_conns;
+  for (int fd : fds) {
+    auto it = g_fds.find(fd);
+    if (it != g_fds.end()) close_upstream(it->second.upstream);
+  }
+  b->idle_conns.clear();
+}
+
+// Returns the name of the first unresolvable backend, or "" on success.
+// Two-phase: resolve/validate EVERY spec first, then commit — a rejected
+// update must leave the running config fully intact (the operator treats a
+// 400 as "nothing changed"; a half-applied weight table would silently
+// shift live traffic).
+std::string apply_config(const std::string& ns, const std::string& dep,
+                         const std::vector<BackendSpec>& specs) {
+  struct Staged {
+    BackendPtr survivor;  // null for new backends
+    BackendSpec spec;
+    sockaddr_in addr{};
+    bool addr_changed = false;
+  };
+  std::vector<Staged> staged;
+  for (const auto& s : specs) {
+    Staged st;
+    st.spec = s;
+    st.survivor = g_state.find(s.name);
+    Backend probe;
+    probe.host = !s.host.empty() ? s.host
+                 : st.survivor   ? st.survivor->host
+                                 : "127.0.0.1";
+    probe.port = s.port ? s.port : (st.survivor ? st.survivor->port : 0);
+    st.spec.host = probe.host;
+    st.spec.port = probe.port;
+    st.addr_changed = !st.survivor || probe.host != st.survivor->host ||
+                      probe.port != st.survivor->port;
+    if (st.addr_changed) {
+      if (!resolve_backend(&probe)) return s.name;
+      st.addr = probe.addr;
+    } else {
+      st.addr = st.survivor->addr;
+    }
+    staged.push_back(std::move(st));
+  }
+
+  // Commit. Preserve histograms of surviving backends (promotion changes
+  // weights, not identity; metrics must stay cumulative).
+  std::vector<BackendPtr> next;
+  std::vector<Backend*> repointed;
+  for (auto& st : staged) {
+    if (st.survivor) {
+      st.survivor->host = st.spec.host;
+      st.survivor->port = st.spec.port;
+      if (st.addr_changed) {
+        st.survivor->addr = st.addr;
+        repointed.push_back(st.survivor.get());
+      }
+      st.survivor->weight = st.spec.weight;
+      next.push_back(st.survivor);
+    } else {
+      auto b = std::make_shared<Backend>();
+      b->name = st.spec.name;
+      b->host = st.spec.host;
+      b->port = st.spec.port;
+      b->weight = st.spec.weight;
+      b->addr = st.addr;
+      next.push_back(std::move(b));
+    }
+  }
+  if (!ns.empty()) g_state.ns = ns;
+  if (!dep.empty()) g_state.deployment = dep;
+  // Survivors whose address changed must not reuse sockets to the old
+  // address — pooled conns would silently keep serving the old version.
+  for (Backend* b : repointed) drain_pool(b);
+  // Drop pooled conns of removed backends.
+  std::vector<BackendPtr> removed;
+  for (auto& b : g_state.backends) {
+    bool kept = false;
+    for (auto& n : next)
+      if (n == b) kept = true;
+    if (!kept) removed.push_back(b);
+  }
+  g_state.backends = std::move(next);
+  for (auto& b : removed) drain_pool(b.get());
+  return "";
+}
+
+void handle_admin(ClientConn* c) {
+  const std::string& path = c->req.path;
+  std::string body = c->req.buf.substr(c->req.body_start);
+
+  if (path == "/router/healthz") {
+    client_send(c, http_response(200, "OK", "text/plain", "ok\n"));
+  } else if (path == "/router/metrics") {
+    client_send(c, http_response(200, "OK", "text/plain; version=0.0.4",
+                                 metrics_text()));
+  } else if (path == "/router/config" && c->req.method == "GET") {
+    client_send(c, http_response(200, "OK", "application/json", config_json()));
+  } else if (path == "/router/config") {  // PUT/POST replace
+    std::string ns, dep;
+    std::vector<BackendSpec> specs;
+    if (parse_config(body, &ns, &dep, &specs)) {
+      std::string bad = apply_config(ns, dep, specs);
+      if (bad.empty()) {
+        client_send(c, http_response(200, "OK", "application/json", config_json()));
+      } else {
+        client_send(c, http_response(400, "Bad Request", "text/plain",
+                                     "unresolvable backend host: " + bad + "\n"));
+      }
+    } else {
+      client_send(c, http_response(400, "Bad Request", "text/plain",
+                                   "malformed config\n"));
+    }
+  } else if (path == "/router/weights") {
+    if (c->req.method == "GET") {
+      std::string out = "{";
+      bool first = true;
+      for (auto& b : g_state.backends) {
+        if (!first) out += ",";
+        first = false;
+        out += "\"" + b->name + "\":" + std::to_string(b->weight);
+      }
+      out += "}";
+      client_send(c, http_response(200, "OK", "application/json", out));
+    } else {
+      std::map<std::string, int> w;
+      if (!parse_weights(body, &w)) {
+        client_send(c, http_response(400, "Bad Request", "text/plain",
+                                     "malformed weights\n"));
+      } else {
+        bool unknown = false;
+        for (auto& [name, _] : w)
+          if (!g_state.find(name)) unknown = true;
+        if (unknown) {
+          client_send(c, http_response(404, "Not Found", "text/plain",
+                                       "unknown backend\n"));
+        } else {
+          for (auto& [name, weight] : w) g_state.find(name)->weight = weight;
+          // Reset SWRR counters so the new split takes effect cleanly.
+          for (auto& b : g_state.backends) b->swrr_current = 0;
+          client_send(c, http_response(200, "OK", "application/json", "{}"));
+        }
+      }
+    }
+  } else {
+    client_send(c, http_response(404, "Not Found", "text/plain", "not found\n"));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Proxying
+// ---------------------------------------------------------------------------
+
+void finish_request(const BackendPtr& b, int code, double seconds) {
+  b->client_latency.observe(seconds);
+  b->by_code[std::to_string(code)].observe(seconds);
+  g_state.proxied_total++;
+}
+
+void advance_client(ClientConn* c);  // defined below
+
+void fail_502(ClientConn* c, const char* why) {
+  if (c->backend)
+    finish_request(c->backend, 502, now_s() - c->t_start);
+  client_send(c, http_response(502, "Bad Gateway", "text/plain",
+                               std::string(why) + "\n"));
+  if (c->upstream) {
+    c->upstream->client = nullptr;
+    close_upstream(c->upstream);
+    c->upstream = nullptr;
+  }
+  c->req.reset();
+  // A pipelined next request must still be answered (same contract as the
+  // success path in on_upstream_event).
+  if (!c->pending.empty()) {
+    c->req.buf = std::move(c->pending);
+    c->pending.clear();
+    advance_client(c);
+  }
+}
+
+// Decode a complete chunked body into its raw payload.
+std::string dechunk(const std::string& framed) {
+  std::string out;
+  size_t pos = 0;
+  while (pos < framed.size()) {
+    size_t eol = framed.find("\r\n", pos);
+    if (eol == std::string::npos) break;
+    long sz = strtol(framed.c_str() + pos, nullptr, 16);
+    if (sz <= 0) break;
+    size_t data = eol + 2;
+    if (data + size_t(sz) > framed.size()) break;
+    out.append(framed, data, size_t(sz));
+    pos = data + size_t(sz) + 2;
+  }
+  return out;
+}
+
+// Build the request to forward.  The body is re-framed with an explicit
+// Content-Length (chunked requests are decoded first) and the client's own
+// framing headers are dropped: forwarding a request that carries BOTH
+// Transfer-Encoding and Content-Length verbatim invites request-smuggling
+// desync on the pooled backend connection if the backend frames by the
+// other header than we did.
+std::string build_upstream_request(const HttpMsg& req) {
+  std::string body = req.buf.substr(req.body_start);
+  if (req.chunked) body = dechunk(body);
+  std::string out = req.method + " " + req.path + " HTTP/1.1\r\n";
+  for (auto& [k, v] : req.headers) {
+    if (k == "connection" || k == "keep-alive" || k == "proxy-connection" ||
+        k == "te" || k == "upgrade" || k == "trailer" ||
+        k == "content-length" || k == "transfer-encoding")
+      continue;
+    out += k + ": " + v + "\r\n";
+  }
+  out += "content-length: " + std::to_string(body.size()) + "\r\n";
+  out += "connection: keep-alive\r\n\r\n";
+  out += body;
+  return out;
+}
+
+// Attach the client's buffered request to a backend connection (pooled or
+// fresh).  Assumes c->backend is set.  On fresh-connect failure → 502.
+void connect_upstream(ClientConn* c, bool allow_pool) {
+  BackendPtr b = c->backend;
+  UpstreamConn* u = nullptr;
+  // Reuse a pooled keep-alive connection when available.
+  while (allow_pool && !b->idle_conns.empty()) {
+    int fd = b->idle_conns.back();
+    b->idle_conns.pop_back();
+    auto it = g_fds.find(fd);
+    if (it == g_fds.end()) continue;
+    u = it->second.upstream;
+    u->reused = true;
+    break;
+  }
+  if (!u) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return fail_502(c, "socket() failed");
+    set_nonblock(fd);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr = b->addr;  // resolved at config time
+    int rc = connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) {
+      close(fd);
+      return fail_502(c, "connect failed");
+    }
+    u = new UpstreamConn();
+    u->fd = fd;
+    u->backend = b;
+    u->connecting = (rc < 0);
+    u->reused = false;
+    g_fds[fd] = {FdKind::Upstream, nullptr, u};
+    epoll_add(fd, EPOLLIN | EPOLLOUT);
+  } else {
+    epoll_set(u->fd, EPOLLIN | EPOLLOUT);
+  }
+  u->client = c;
+  u->resp.reset();
+  u->resp.request_method = c->req.method;  // HEAD responses carry no body
+  u->out = build_upstream_request(c->req);
+  u->out_off = 0;
+  c->upstream = u;
+}
+
+void start_proxy(ClientConn* c) {
+  BackendPtr b = g_state.pick();
+  if (!b) {
+    client_send(c, http_response(503, "Service Unavailable", "text/plain",
+                                 "no backend with positive weight\n"));
+    c->req.reset();
+    return;
+  }
+  c->backend = b;
+  c->retries = 0;
+  connect_upstream(c, /*allow_pool=*/true);
+}
+
+// A pooled keep-alive connection can always lose a race with the backend's
+// idle timeout: the backend closes just as we reuse the socket.  If that
+// happens before any response byte arrives, retry the request on a FRESH
+// connection (same backend, so the metric split is unaffected) — standard
+// reverse-proxy behavior; without it a promotion run sees phantom 502s.
+// Returns true if the request was retried (u is gone).
+bool retry_stale_upstream(UpstreamConn* u, ClientConn* c) {
+  if (!u->reused || !u->resp.buf.empty() || c->retries >= 2) return false;
+  c->retries++;
+  c->upstream = nullptr;
+  u->client = nullptr;
+  close_upstream(u);
+  connect_upstream(c, /*allow_pool=*/false);
+  return true;
+}
+
+// Client request fully buffered: admin or proxy.
+void dispatch_request(ClientConn* c) {
+  c->t_start = now_s();
+  if (c->req.path.rfind("/router/", 0) == 0) {
+    handle_admin(c);
+    c->req.reset();
+  } else {
+    start_proxy(c);
+  }
+}
+
+// Dispatch as many fully-buffered requests as possible.  A keep-alive
+// client may send request N+1 before N's response (pipelining); bytes past
+// the current message are held in c->pending and fed back here after each
+// response completes, so nothing is dropped and bodies forwarded upstream
+// are framed exactly (no smuggling of the next request's bytes).
+void advance_client(ClientConn* c) {
+  while (!c->upstream && !c->closing) {
+    if (!c->req.headers_complete()) {
+      if (!c->req.try_parse_headers(/*is_request=*/true)) {
+        client_send(c, http_response(400, "Bad Request", "text/plain",
+                                     "bad request\n"));
+        c->closing = true;
+        return;
+      }
+      if (!c->req.headers_complete()) return;  // need more bytes
+    }
+    ssize_t end = c->req.message_end(/*is_request=*/true, /*eof=*/false);
+    if (end < 0) return;  // body incomplete
+    // Stash bytes of the next message before dispatching this one.
+    if (size_t(end) < c->req.buf.size()) {
+      c->pending.insert(0, c->req.buf.substr(size_t(end)));
+      c->req.buf.resize(size_t(end));
+    }
+    dispatch_request(c);  // resets c->req (admin/503/502) or sets upstream
+    if (c->upstream) return;  // next request advances when the response lands
+    if (c->pending.empty()) return;
+    c->req.buf = std::move(c->pending);
+    c->pending.clear();
+  }
+}
+
+void on_client_readable(ClientConn* c) {
+  char tmp[65536];
+  bool in_flight = c->upstream != nullptr;
+  while (true) {
+    ssize_t n = read(c->fd, tmp, sizeof(tmp));
+    if (n > 0) {
+      // While a request is being proxied, c->req holds the DISPATCHED
+      // message; new bytes belong to the next one.
+      (in_flight ? c->pending : c->req.buf).append(tmp, size_t(n));
+    } else if (n == 0) {
+      close_client(c);
+      return;
+    } else {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      close_client(c);
+      return;
+    }
+  }
+  // Caps: one greedy client must not balloon the router's memory.
+  if (!c->req.headers_complete() && c->req.buf.size() > kMaxHeaderBytes) {
+    client_send(c, http_response(431, "Request Header Fields Too Large",
+                                 "text/plain", "headers too large\n"));
+    c->closing = true;
+    return;
+  }
+  if (c->req.buf.size() > kMaxMessageBytes ||
+      c->pending.size() > kMaxMessageBytes) {
+    client_send(c, http_response(413, "Payload Too Large", "text/plain",
+                                 "request too large\n"));
+    c->closing = true;
+    return;
+  }
+  if (!in_flight) advance_client(c);
+}
+
+void on_client_writable(ClientConn* c) {
+  while (c->out_off < c->out.size()) {
+    ssize_t n = write(c->fd, c->out.data() + c->out_off, c->out.size() - c->out_off);
+    if (n > 0) {
+      c->out_off += size_t(n);
+    } else {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      close_client(c);
+      return;
+    }
+  }
+  c->out.clear();
+  c->out_off = 0;
+  if (c->closing) {
+    close_client(c);
+    return;
+  }
+  epoll_set(c->fd, EPOLLIN);
+}
+
+void on_upstream_event(UpstreamConn* u, uint32_t events) {
+  if (events & (EPOLLERR | EPOLLHUP)) {
+    if (!u->client) {
+      // Idle pooled connection died (close_upstream scrubs the pool entry).
+      close_upstream(u);
+      return;
+    }
+    if (events & EPOLLERR) {
+      ClientConn* c = u->client;
+      if (retry_stale_upstream(u, c)) return;
+      c->upstream = nullptr;
+      u->client = nullptr;
+      close_upstream(u);
+      fail_502(c, "backend connection error");
+      return;
+    }
+    // EPOLLHUP with an active request: drain whatever the backend wrote
+    // before closing — the read path below observes EOF and either
+    // completes a close-delimited response or 502s.
+    events |= EPOLLIN;
+  }
+
+  u->connecting = false;
+
+  if (events & EPOLLOUT) {
+    while (u->out_off < u->out.size()) {
+      ssize_t n = write(u->fd, u->out.data() + u->out_off, u->out.size() - u->out_off);
+      if (n > 0) {
+        u->out_off += size_t(n);
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        ClientConn* c = u->client;
+        if (c && retry_stale_upstream(u, c)) return;
+        u->client = nullptr;
+        if (c) {
+          c->upstream = nullptr;
+          fail_502(c, "backend write failed");
+        }
+        close_upstream(u);
+        return;
+      }
+    }
+    if (u->out_off >= u->out.size()) epoll_set(u->fd, EPOLLIN);
+  }
+
+  if (events & EPOLLIN) {
+    char tmp[65536];
+    bool eof = false;
+    while (true) {
+      ssize_t n = read(u->fd, tmp, sizeof(tmp));
+      if (n > 0) {
+        u->resp.buf.append(tmp, size_t(n));
+      } else if (n == 0) {
+        eof = true;
+        break;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        eof = true;
+        break;
+      }
+    }
+    ClientConn* c = u->client;
+    if (!c) {  // response bytes on an idle conn: stale; drop it
+      close_upstream(u);
+      return;
+    }
+    if (u->resp.buf.size() > kMaxMessageBytes) {
+      u->client = nullptr;
+      c->upstream = nullptr;
+      fail_502(c, "backend response too large");
+      close_upstream(u);
+      return;
+    }
+    if (!u->resp.headers_complete()) u->resp.try_parse_headers(/*is_request=*/false);
+    if (u->resp.headers_complete() && u->resp.complete(/*is_request=*/false, eof)) {
+      double dt = now_s() - c->t_start;
+      finish_request(u->backend, u->resp.status, dt);
+      client_send(c, u->resp.buf);
+      c->req.reset();
+      c->upstream = nullptr;
+      u->client = nullptr;
+      // Return to pool if backend keeps the connection open.  HTTP/1.0
+      // defaults to close (http.server-style backends); HTTP/1.1 to
+      // keep-alive; an explicit Connection header overrides either.
+      // Pool BEFORE advancing the client so a pipelined next request can
+      // reuse this very connection.
+      auto conn_hdr = u->resp.headers.find("connection");
+      bool http10 = u->resp.version == "HTTP/1.0";
+      bool backend_close = eof;
+      if (conn_hdr != u->resp.headers.end()) {
+        std::string cv = lower(conn_hdr->second);
+        backend_close |= cv.find("close") != std::string::npos;
+        if (cv.find("keep-alive") != std::string::npos) http10 = false;
+      }
+      backend_close |= http10;
+      if (backend_close) {
+        close_upstream(u);
+      } else {
+        u->resp.reset();
+        u->backend->idle_conns.push_back(u->fd);
+        epoll_set(u->fd, EPOLLIN);  // observe idle-close
+      }
+      // A pipelined next request may be waiting; dispatch it now.
+      if (!c->pending.empty()) {
+        c->req.buf = std::move(c->pending);
+        c->pending.clear();
+      }
+      advance_client(c);
+      return;
+    }
+    if (eof) {  // EOF before the message completed
+      if (retry_stale_upstream(u, c)) return;
+      u->client = nullptr;
+      c->upstream = nullptr;
+      fail_502(c, "backend EOF mid-response");
+      close_upstream(u);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// main
+// ---------------------------------------------------------------------------
+
+void usage() {
+  die("usage: tpumlops-router --port N [--namespace ns] [--deployment name]\n"
+      "       [--backend name=host:port:weight]...");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 0;
+  std::vector<BackendSpec> specs;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) usage();
+      return argv[++i];
+    };
+    if (a == "--port") port = atoi(next().c_str());
+    else if (a == "--namespace") g_state.ns = next();
+    else if (a == "--deployment") g_state.deployment = next();
+    else if (a == "--backend") {
+      // name=host:port:weight
+      std::string v = next();
+      BackendSpec s;
+      size_t eq = v.find('=');
+      size_t c1 = v.find(':', eq);
+      size_t c2 = v.find(':', c1 + 1);
+      if (eq == std::string::npos || c1 == std::string::npos ||
+          c2 == std::string::npos)
+        usage();
+      s.name = v.substr(0, eq);
+      s.host = v.substr(eq + 1, c1 - eq - 1);
+      s.port = atoi(v.substr(c1 + 1, c2 - c1 - 1).c_str());
+      s.weight = atoi(v.substr(c2 + 1).c_str());
+      specs.push_back(s);
+    } else usage();
+  }
+  if (!port) usage();
+  std::string bad = apply_config("", "", specs);
+  if (!bad.empty()) die("unresolvable backend host for '%s'", bad.c_str());
+
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) die("socket: %s", strerror(errno));
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) < 0)
+    die("bind %d: %s", port, strerror(errno));
+  if (listen(lfd, 512) < 0) die("listen: %s", strerror(errno));
+  set_nonblock(lfd);
+
+  g_epoll = epoll_create1(0);
+  g_fds[lfd] = {FdKind::Listener, nullptr, nullptr};
+  epoll_add(lfd, EPOLLIN);
+
+  fprintf(stderr, "tpumlops-router listening on 127.0.0.1:%d (%zu backends)\n",
+          port, g_state.backends.size());
+
+  epoll_event events[256];
+  while (true) {
+    int n = epoll_wait(g_epoll, events, 256, -1);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      die("epoll_wait: %s", strerror(errno));
+    }
+    for (int i = 0; i < n; i++) {
+      uint64_t key = events[i].data.u64;
+      int fd = int(uint32_t(key));
+      uint32_t gen = uint32_t(key >> 32);
+      auto it = g_fds.find(fd);
+      if (it == g_fds.end() || it->second.gen != gen) continue;  // stale event
+      FdEntry ent = it->second;
+      if (ent.kind == FdKind::Listener) {
+        while (true) {
+          int cfd = accept(lfd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          auto* c = new ClientConn();
+          c->fd = cfd;
+          g_fds[cfd] = {FdKind::Client, c, nullptr};
+          epoll_add(cfd, EPOLLIN);
+        }
+      } else if (ent.kind == FdKind::Client) {
+        ClientConn* c = ent.client;
+        if (events[i].events & (EPOLLERR | EPOLLHUP)) {
+          close_client(c);
+          continue;
+        }
+        if (events[i].events & EPOLLIN) on_client_readable(c);
+        // Re-look up: the readable handler may have closed this conn (and
+        // the fd number may even have been reused for an upstream socket).
+        auto again = g_fds.find(fd);
+        if (again != g_fds.end() && again->second.gen == gen &&
+            again->second.kind == FdKind::Client && again->second.client == c &&
+            ((events[i].events & EPOLLOUT) || !c->out.empty()))
+          on_client_writable(c);
+      } else {
+        on_upstream_event(ent.upstream, events[i].events);
+      }
+    }
+  }
+}

@@ -1,0 +1,340 @@
+"""Native canary router: split ratios, live reweighting, failure paths,
+and the gate-compatible metric surface.
+
+The router replaces the Istio + Seldon-executor pair the reference relies
+on (SURVEY §1 L1); these tests drive the real compiled binary against
+in-process HTTP backends.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
+    RouterProcess,
+    build_router,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Echo(http.server.BaseHTTPRequestHandler):
+    """Replies {"who": <tag>, "echo": <body>} with Content-Length framing."""
+
+    tag = "?"
+
+    def _reply(self, code=200):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        payload = json.dumps({"who": self.tag, "echo": body.decode() or None}).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    do_GET = _reply
+    do_POST = _reply
+
+    def do_HEAD(self):  # noqa: N802
+        # Content-Length advertised, no body sent (RFC 7230 §3.3.3) — the
+        # router must not wait for those bytes.
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", "37")
+        self.end_headers()
+
+    def log_message(self, *a):  # noqa: N802 - silence request logging
+        pass
+
+
+class _Chunked(_Echo):
+    """Replies with a chunked body (no Content-Length) to exercise the
+    router's chunked-framing passthrough."""
+
+    def _reply(self, code=200):
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            self.rfile.read(length)
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        payload = json.dumps({"who": self.tag}).encode()
+        half = len(payload) // 2
+        for part in (payload[:half], payload[half:]):
+            self.wfile.write(f"{len(part):x}\r\n".encode() + part + b"\r\n")
+        self.wfile.write(b"0\r\n\r\n")
+
+    do_GET = _reply
+    do_POST = _reply
+
+
+def start_backend(tag: str, handler=_Echo) -> tuple[http.server.ThreadingHTTPServer, int]:
+    cls = type(f"Backend_{tag}", (handler,), {"tag": tag})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def ask(port: int, path: str = "/predict", body: dict | None = None) -> dict:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data)
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def binary():
+    return build_router()
+
+
+@pytest.fixture()
+def world(binary):
+    srv1, p1 = start_backend("v1")
+    srv2, p2 = start_backend("v2")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", p1, 90), "v2": ("127.0.0.1", p2, 10)},
+        namespace="models",
+        deployment="bert",
+        binary=binary,
+    ).start()
+    yield router
+    router.stop()
+    srv1.shutdown()
+    srv2.shutdown()
+
+
+def test_swrr_split_is_exact(world):
+    hits = {"v1": 0, "v2": 0}
+    for _ in range(100):
+        hits[ask(world.port)["who"]] += 1
+    # Smooth WRR is deterministic: a 90/10 split over 100 requests is exact.
+    assert hits == {"v1": 90, "v2": 10}
+
+
+def test_live_reweight_and_full_shift(world):
+    world.admin.set_weights({"v1": 50, "v2": 50})
+    assert world.admin.get_weights() == {"v1": 50, "v2": 50}
+    hits = {"v1": 0, "v2": 0}
+    for _ in range(10):
+        hits[ask(world.port)["who"]] += 1
+    assert hits == {"v1": 5, "v2": 5}
+
+    # 100/0: canary fully promoted — all traffic to v2.
+    world.admin.set_weights({"v1": 0, "v2": 100})
+    assert all(ask(world.port)["who"] == "v2" for _ in range(10))
+
+
+def test_post_body_is_forwarded(world):
+    out = ask(world.port, body={"inputs": [1, 2, 3]})
+    assert json.loads(out["echo"]) == {"inputs": [1, 2, 3]}
+
+
+def test_unknown_backend_weight_is_404(world):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        world.admin.set_weights({"nope": 3})
+    assert err.value.code == 404
+    # and existing weights were not clobbered
+    assert world.admin.get_weights() == {"v1": 90, "v2": 10}
+
+
+def test_metrics_surface_matches_gate_identity(world):
+    for _ in range(20):
+        ask(world.port)
+    text = world.admin.metrics_text()
+    ident = 'deployment_name="bert",predictor_name="v1",namespace="models"'
+    assert f"seldon_api_executor_client_requests_seconds_count{{{ident}}} 18" in text
+    assert (
+        "seldon_api_executor_server_requests_seconds_count{" + ident
+        + ',code="200",service="predictions"} 18' in text
+    )
+    # le buckets are cumulative and end at +Inf == count
+    assert f'seldon_api_executor_client_requests_seconds_bucket{{{ident},le="+Inf"}} 18' in text
+    # localhost echo latency lands in the smallest buckets; sum must be > 0
+    sum_line = next(
+        line for line in text.splitlines()
+        if line.startswith(f"seldon_api_executor_client_requests_seconds_sum{{{ident}}}")
+    )
+    assert float(sum_line.split()[-1]) > 0
+
+
+def test_dead_backend_gives_502_and_metric(world):
+    dead = free_port()  # nothing listens here
+    world.admin.set_config(
+        [
+            {"name": "v1", "host": "127.0.0.1", "port": dead, "weight": 100},
+            {"name": "v2", "host": "127.0.0.1",
+             "port": world.backends["v2"][1], "weight": 0},
+        ]
+    )
+    with pytest.raises(urllib.error.HTTPError) as err:
+        ask(world.port)
+    assert err.value.code == 502
+    text = world.admin.metrics_text()
+    assert (
+        'seldon_api_executor_server_requests_seconds_count{deployment_name="bert",'
+        'predictor_name="v1",namespace="models",code="502",service="predictions"} 1'
+        in text
+    )
+
+
+def test_config_replace_preserves_histograms(world):
+    for _ in range(4):
+        ask(world.port)
+    cfg = world.admin.get_config()
+    # Replace config keeping v1, dropping v2, adding v3 (same address as v2).
+    v1 = next(b for b in cfg["backends"] if b["name"] == "v1")
+    v2 = next(b for b in cfg["backends"] if b["name"] == "v2")
+    world.admin.set_config(
+        [
+            {**v1, "weight": 50},
+            {"name": "v3", "host": v2["host"], "port": v2["port"], "weight": 50},
+        ]
+    )
+    text = world.admin.metrics_text()
+    ident1 = 'deployment_name="bert",predictor_name="v1",namespace="models"'
+    count = next(
+        line for line in text.splitlines()
+        if line.startswith(f"seldon_api_executor_client_requests_seconds_count{{{ident1}}}")
+    )
+    assert int(count.split()[-1]) >= 3  # v1 history survived the replace
+    assert 'predictor_name="v2"' not in text  # removed backend stops exporting
+    # new backend serves (the v2 server answers, tagged v2, under name v3)
+    hits = {ask(world.port)["who"] for _ in range(4)}
+    assert hits == {"v1", "v2"}
+
+
+def test_chunked_response_passthrough(binary):
+    srv, port = start_backend("chunky", _Chunked)
+    router = RouterProcess(
+        port=free_port(),
+        backends={"c": ("127.0.0.1", port, 100)},
+        binary=binary,
+    ).start()
+    try:
+        assert ask(router.port)["who"] == "chunky"
+        assert ask(router.port, body={"x": 1})["who"] == "chunky"
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_pipelined_requests_both_answered(binary):
+    """Two requests written back-to-back on one socket before any response:
+    the router must frame them exactly (no smuggling into the first body)
+    and answer both in order."""
+    srv, port = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(), backends={"v1": ("127.0.0.1", port, 100)}, binary=binary
+    ).start()
+    try:
+        body = b'{"n":1}'
+        one = (
+            b"POST /predict HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+            + f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+        )
+        with socket.create_connection(("127.0.0.1", router.port), timeout=5) as s:
+            s.sendall(one + one)  # pipelined
+            s.settimeout(5)
+            data = b""
+            while data.count(b'"who"') < 2:
+                chunk = s.recv(65536)
+                assert chunk, f"connection closed early, got: {data!r}"
+                data += chunk
+        assert data.count(b" 200 OK") == 2
+        # each response echoes exactly one framed request body — no smuggling
+        assert data.count(b'{\\"n\\":1}') == 2
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_hostname_backend_resolves(binary):
+    srv, port = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(), backends={"v1": ("localhost", port, 100)}, binary=binary
+    ).start()
+    try:
+        assert ask(router.port)["who"] == "v1"
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_unresolvable_host_rejected_as_400(world):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        world.admin.set_config(
+            [
+                # valid change listed FIRST: a rejected config must not be
+                # half-applied (atomicity — v1's weight stays 90, not 0)
+                {"name": "v1", "host": "127.0.0.1",
+                 "port": world.backends["v1"][1], "weight": 0},
+                {"name": "vX", "host": "no-such-host.invalid", "port": 1, "weight": 1},
+            ]
+        )
+    assert err.value.code == 400
+    # previous config fully intact, including weight VALUES
+    assert world.admin.get_weights() == {"v1": 90, "v2": 10}
+
+
+def test_chunked_request_reframed_upstream(world):
+    """A chunked client request is de-chunked and forwarded with clean
+    Content-Length framing (anti-smuggling)."""
+    body = b'{"q":42}'
+    half = len(body) // 2
+    chunks = b""
+    for part in (body[:half], body[half:]):
+        chunks += f"{len(part):x}\r\n".encode() + part + b"\r\n"
+    chunks += b"0\r\n\r\n"
+    raw = (
+        b"POST /predict HTTP/1.1\r\nHost: x\r\n"
+        b"Transfer-Encoding: chunked\r\n\r\n" + chunks
+    )
+    with socket.create_connection(("127.0.0.1", world.port), timeout=5) as s:
+        s.sendall(raw)
+        s.settimeout(5)
+        data = b""
+        while b'"echo"' not in data:
+            chunk = s.recv(65536)
+            assert chunk
+            data += chunk
+    # backend received the decoded payload, not chunk frames
+    assert b'{\\"q\\":42}' in data
+
+
+def test_head_request_passthrough(world):
+    req = urllib.request.Request(f"http://127.0.0.1:{world.port}/predict", method="HEAD")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.status == 200
+        assert resp.read() == b""  # no body on HEAD
+    # router connection still healthy for a normal request afterwards
+    assert ask(world.port)["who"] in {"v1", "v2"}
+
+
+def test_zero_weight_everywhere_is_503(binary):
+    srv, port = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", port, 0)},
+        binary=binary,
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            ask(router.port)
+        assert err.value.code == 503
+    finally:
+        router.stop()
+        srv.shutdown()
