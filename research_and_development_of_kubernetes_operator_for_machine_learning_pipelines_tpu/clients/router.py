@@ -113,6 +113,208 @@ class RouterAdmin:
         return self._req("/router/metrics").decode()
 
 
+def parse_prometheus_text(text: str) -> dict[tuple[str, frozenset], float]:
+    """Parse Prometheus exposition text into {(name, labelset): value}."""
+    out: dict[tuple[str, frozenset], float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+        except ValueError:
+            continue
+        if "{" in series:
+            name, rest = series.split("{", 1)
+            labels = frozenset(
+                tuple(pair.split("=", 1)) for pair in _split_labels(rest.rstrip("}"))
+            )
+        else:
+            name, labels = series, frozenset()
+        try:
+            out[(name, labels)] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _split_labels(raw: str) -> list[str]:
+    """Split 'a="x",b="y,z"' respecting quoted commas; strips quotes."""
+    parts, cur, in_q = [], "", False
+    for ch in raw:
+        if ch == '"':
+            in_q = not in_q
+            continue
+        if ch == "," and not in_q:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        parts.append(cur)
+    return parts
+
+
+def _histogram_quantile(q: float, buckets: list[tuple[float, float]]) -> float | None:
+    """PromQL histogram_quantile over cumulative (le, count) buckets.
+
+    ``buckets`` must be sorted by le and include the +Inf bucket last.
+    Returns None when the histogram is empty.
+    """
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le, count in buckets:
+        if count >= rank:
+            if le == float("inf"):
+                return prev_le  # PromQL returns the highest finite bound
+            if count == prev_count:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_count) / (count - prev_count)
+        prev_le, prev_count = le, count
+    return buckets[-1][0]
+
+
+class RouterMetricsSource:
+    """``MetricsSource`` over the router's ``/router/metrics`` endpoint.
+
+    In-cluster, Prometheus scrapes the router and the gate runs PromQL
+    (reference ``mlflow_operator.py:363-417``).  In local/router mode there
+    is no Prometheus; this class keeps a short history of scrapes and
+    computes the same six quantities over the requested window from
+    histogram deltas — ``rate()``/``increase()`` semantics, including the
+    reference's "None means no traffic in the window" convention.
+    """
+
+    _CLIENT = "seldon_api_executor_client_requests_seconds"
+    _SERVER = "seldon_api_executor_server_requests_seconds"
+
+    def __init__(self, admin: "RouterAdmin"):
+        self.admin = admin
+        self._snapshots: list[tuple[float, dict]] = []  # (monotonic_t, parsed)
+        self._max_window_s = 60.0  # grows to the largest window requested
+
+    def _scrape(self) -> dict:
+        now = time.monotonic()
+        parsed = parse_prometheus_text(self.admin.metrics_text())
+        self._snapshots.append((now, parsed))
+        # Keep only what any requested window can reach (plus slack) — the
+        # reconciler scrapes several times per second during a canary, and
+        # ten minutes of full parsed snapshots would be thousands of dicts.
+        cutoff = now - (2.0 * self._max_window_s + 10.0)
+        while len(self._snapshots) > 2 and self._snapshots[1][0] < cutoff:
+            self._snapshots.pop(0)
+        return parsed
+
+    def _baseline(self, window_s: float) -> dict:
+        """Newest snapshot at least ``window_s`` old (or empty = since start)."""
+        now = time.monotonic()
+        base: dict = {}
+        for t, snap in self._snapshots[:-1]:
+            if now - t >= window_s:
+                base = snap
+            else:
+                break
+        return base
+
+    def model_metrics(
+        self,
+        deployment_name: str,
+        predictor_name: str,
+        namespace: str,
+        window_s: int = 60,
+    ):
+        from .base import ModelMetrics
+
+        self._max_window_s = max(self._max_window_s, float(window_s))
+        current = self._scrape()
+        base = self._baseline(window_s)
+        ident = {
+            ("deployment_name", deployment_name),
+            ("predictor_name", predictor_name),
+            ("namespace", namespace),
+        }
+
+        def delta(name: str, le: bool = False):
+            """(current - base) per bucket/code over series matching identity.
+
+            Clamped at 0 per series: a counter that went BACKWARD means the
+            series was reset (predictor removed and re-added, router
+            restart) — PromQL's increase() treats that as a reset, and a
+            negative count fed to the gate would make error_rate garbage.
+            """
+            out: dict[str, float] = {}
+            for (n, labels), v in current.items():
+                if n != name or not ident <= labels:
+                    continue
+                ld = dict(labels)
+                key = ld.get("le", "") if le else ld.get("code", "")
+                out[key] = out.get(key, 0.0) + max(0.0, v - base.get((n, labels), 0.0))
+            return out
+
+        bucket_deltas = delta(self._CLIENT + "_bucket", le=True)
+        buckets = sorted(
+            ((float(le), c) for le, c in bucket_deltas.items()),
+            key=lambda x: x[0],
+        )
+        count = delta(self._CLIENT + "_count").get("", 0.0)
+        total_sum = delta(self._CLIENT + "_sum").get("", 0.0)
+
+        by_code = delta(self._SERVER + "_count")
+        server_total = sum(by_code.values())
+        errors = sum(v for code, v in by_code.items() if code != "200")
+
+        return ModelMetrics(
+            latency_p95=_histogram_quantile(0.95, buckets),
+            error_responses=errors,
+            error_rate=(errors / server_total) if server_total > 0 else None,
+            latency_avg=(total_sum / count) if count > 0 else None,
+            request_count=count,
+            feedback_request_count=0.0,
+        )
+
+
+class RouterSync:
+    """Push a SeldonDeployment manifest's traffic split into the router.
+
+    In-cluster the manifest's ``traffic`` weights become Istio
+    VirtualService weights via Seldon's controller; in local/router mode
+    this class is that controller: it maps each predictor to a backend
+    address via ``resolve(predictor_name) -> (host, port)`` and PUTs the
+    router config.  Weights change on every promotion step; addresses and
+    the predictor set change when versions roll.
+    """
+
+    def __init__(self, admin: "RouterAdmin", resolve):
+        self.admin = admin
+        self.resolve = resolve
+
+    def sync_manifest(self, manifest: dict) -> None:
+        spec = manifest.get("spec") or {}
+        meta = manifest.get("metadata") or {}
+        backends = []
+        for pred in spec.get("predictors") or []:
+            name = pred.get("name")
+            host, port = self.resolve(name)
+            backends.append(
+                {
+                    "name": name,
+                    "host": host,
+                    "port": port,
+                    "weight": int(pred.get("traffic", 0)),
+                }
+            )
+        if backends:
+            self.admin.set_config(
+                backends,
+                namespace=meta.get("namespace"),
+                deployment=meta.get("name"),
+            )
+
+
 class RouterProcess:
     """One supervised router instance.
 

@@ -113,6 +113,7 @@ struct Backend {
   int weight = 0;
   int swrr_current = 0;  // smooth-WRR running counter
   sockaddr_in addr{};    // resolved at config time (getaddrinfo)
+  uint32_t addr_epoch = 0;  // bumped on repoint; gates pool admission
 
   Histogram client_latency;                    // client_requests_seconds
   std::map<std::string, Histogram> by_code;    // server_requests_seconds{code=}
@@ -454,6 +455,7 @@ struct ClientConn;
 struct UpstreamConn {
   int fd = -1;
   BackendPtr backend;
+  uint32_t addr_epoch = 0;       // backend->addr_epoch at connect time
   ClientConn* client = nullptr;  // request being served (null = idle in pool)
   std::string out;               // bytes to write to backend
   size_t out_off = 0;
@@ -685,6 +687,8 @@ std::string apply_config(const std::string& ns, const std::string& dep,
       st.survivor->port = st.spec.port;
       if (st.addr_changed) {
         st.survivor->addr = st.addr;
+        st.survivor->addr_epoch++;  // in-flight conns to the old address
+                                    // must not re-enter the pool
         repointed.push_back(st.survivor.get());
       }
       st.survivor->weight = st.spec.weight;
@@ -881,6 +885,7 @@ void connect_upstream(ClientConn* c, bool allow_pool) {
     u = new UpstreamConn();
     u->fd = fd;
     u->backend = b;
+    u->addr_epoch = b->addr_epoch;
     u->connecting = (rc < 0);
     u->reused = false;
     g_fds[fd] = {FdKind::Upstream, nullptr, u};
@@ -1099,13 +1104,23 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
     if (u->resp.headers_complete() && u->resp.complete(/*is_request=*/false, eof)) {
       double dt = now_s() - c->t_start;
       finish_request(u->backend, u->resp.status, dt);
+      // A close-delimited response (no Content-Length, not chunked, not a
+      // no-body status) is forwarded verbatim — the CLIENT can then only
+      // find the body's end by connection close, so close our side too.
+      // (completion that required eof == close-delimited; 204/304/HEAD
+      // complete without it)
+      bool close_delimited =
+          u->resp.message_end(/*is_request=*/false, /*eof=*/false) < 0;
       client_send(c, u->resp.buf);
+      if (close_delimited) c->closing = true;
       c->req.reset();
       c->upstream = nullptr;
       u->client = nullptr;
       // Return to pool if backend keeps the connection open.  HTTP/1.0
       // defaults to close (http.server-style backends); HTTP/1.1 to
-      // keep-alive; an explicit Connection header overrides either.
+      // keep-alive; an explicit Connection header overrides either.  A
+      // conn whose backend was repointed since connect must not re-enter
+      // the pool — it still talks to the OLD address/version.
       // Pool BEFORE advancing the client so a pipelined next request can
       // reuse this very connection.
       auto conn_hdr = u->resp.headers.find("connection");
@@ -1117,6 +1132,7 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
         if (cv.find("keep-alive") != std::string::npos) http10 = false;
       }
       backend_close |= http10;
+      backend_close |= u->addr_epoch != u->backend->addr_epoch;
       if (backend_close) {
         close_upstream(u);
       } else {
