@@ -60,22 +60,36 @@ def _seldon_predictor(
     }
 
 
-def _tpu_predictor(
+COORDINATOR_PORT = 8476  # jax.distributed coordinator (leader pod)
+
+
+def worker_unit_name(deployment_name: str, version: str) -> str:
+    """Name of the pod unit (and its headless Service) for one predictor."""
+    return f"{deployment_name}-v{version}-workers"
+
+
+def _topology_info(config: OperatorConfig):
+    info = TPU_TOPOLOGIES.get(config.tpu.topology)
+    if info is None:
+        raise ValueError(
+            f"unknown tpuTopology {config.tpu.topology!r}; "
+            f"known: {sorted(TPU_TOPOLOGIES)}"
+        )
+    return info
+
+
+def _tpu_pod_spec(
     version: str,
     model_uri: str,
-    traffic: int,
     config: OperatorConfig,
     deployment_name: str,
     namespace: str,
 ) -> dict[str, Any]:
-    """First-party TPU predictor: our JAX server on a v5e node pool."""
+    """Pod spec for one host of a TPU predictor (shared by the predictor's
+    componentSpecs and the multi-host StatefulSet template)."""
     tpu: TpuSpec = config.tpu
-    info = TPU_TOPOLOGIES.get(tpu.topology)
-    if info is None:
-        raise ValueError(
-            f"unknown tpuTopology {tpu.topology!r}; known: {sorted(TPU_TOPOLOGIES)}"
-        )
-    accelerator, gke_topology, _chips = info
+    info = _topology_info(config)
+    accelerator, gke_topology = info.accelerator, info.gke_topology
     container = {
         "name": f"tpu-server-{version}",
         "image": config.server_image,
@@ -103,8 +117,10 @@ def _tpu_predictor(
             {"name": "metrics", "containerPort": 6000},
         ],
         "resources": {
-            "limits": {"google.com/tpu": str(tpu.num_devices)},
-            "requests": {"google.com/tpu": str(tpu.num_devices)},
+            # per-host request: a multi-host slice schedules hosts pods of
+            # chips_per_host each, not one pod asking for the whole slice
+            "limits": {"google.com/tpu": str(info.chips_per_host)},
+            "requests": {"google.com/tpu": str(info.chips_per_host)},
         },
         "readinessProbe": {
             "httpGet": {"path": "/v2/health/ready", "port": 9000},
@@ -116,9 +132,65 @@ def _tpu_predictor(
             "failureThreshold": 60,
         },
     }
+    if info.hosts > 1:
+        unit = worker_unit_name(deployment_name, version)
+        container["env"] += [
+            # pod 0 of the indexed unit hosts the jax.distributed
+            # coordinator; its stable DNS name comes from the headless
+            # Service the materializer creates for the unit
+            {
+                "name": "JAX_COORDINATOR_ADDRESS",
+                "value": f"{unit}-0.{unit}.{namespace}.svc.cluster.local:{COORDINATOR_PORT}",
+            },
+            {"name": "JAX_NUM_PROCESSES", "value": str(info.hosts)},
+            # pod index -> JAX process id (k8s >=1.28 sets this label on
+            # StatefulSet/indexed-Job pods)
+            {
+                "name": "JAX_PROCESS_ID",
+                "valueFrom": {
+                    "fieldRef": {
+                        "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
+                    }
+                },
+            },
+        ]
     if config.minio_secret:
         container["envFrom"] = [{"secretRef": {"name": config.minio_secret}}]
     return {
+        "nodeSelector": {
+            "cloud.google.com/gke-tpu-accelerator": accelerator,
+            "cloud.google.com/gke-tpu-topology": gke_topology,
+        },
+        "tolerations": [
+            {
+                "key": "google.com/tpu",
+                "operator": "Exists",
+                "effect": "NoSchedule",
+            }
+        ],
+        "containers": [container],
+    }
+
+
+def _tpu_predictor(
+    version: str,
+    model_uri: str,
+    traffic: int,
+    config: OperatorConfig,
+    deployment_name: str,
+    namespace: str,
+) -> dict[str, Any]:
+    """First-party TPU predictor: our JAX server on a v5e node pool.
+
+    Multi-host topologies (SURVEY §7 hard part 5) make one predictor =
+    ``hosts`` pods run as an indexed StatefulSet behind a headless Service
+    (see ``build_worker_unit_manifests`` — the reconciler applies those
+    alongside this routing manifest): pod index = JAX process id, pod 0 is
+    the coordinator *and* the only pod routed traffic reaches (followers
+    run the lockstep loop in ``server/multihost.py``).
+    """
+    info = _topology_info(config)
+    predictor: dict[str, Any] = {
         "graph": {
             "name": f"tpu-server-{version}",
             "implementation": "TRITON_SERVER",  # pre-packaged V2-protocol slot
@@ -126,28 +198,137 @@ def _tpu_predictor(
             "modelUri": model_uri,
             "children": [],
         },
-        "componentSpecs": [
-            {
-                "spec": {
-                    "nodeSelector": {
-                        "cloud.google.com/gke-tpu-accelerator": accelerator,
-                        "cloud.google.com/gke-tpu-topology": gke_topology,
-                    },
-                    "tolerations": [
-                        {
-                            "key": "google.com/tpu",
-                            "operator": "Exists",
-                            "effect": "NoSchedule",
-                        }
-                    ],
-                    "containers": [container],
-                }
-            }
-        ],
         "name": f"v{version}",
-        "replicas": tpu.replicas,
+        # data-parallel copies of the predictor — DP in SURVEY §2.3's
+        # inventory (single-host only; multi-host units reject replicas>1
+        # at config parse)
+        "replicas": config.tpu.replicas,
         "traffic": traffic,
     }
+    if info.hosts > 1:
+        # Routing-only predictor: NO componentSpecs, or a Seldon controller
+        # consuming this CR would materialize a second copy of the pods the
+        # operator's StatefulSet already owns (and Deployment pods lack the
+        # pod-index label the env fieldRef needs).  The pod spec lives in
+        # build_worker_unit_manifests' StatefulSet template instead.
+        predictor["tpuWorkerUnit"] = {
+            "name": worker_unit_name(deployment_name, version),
+            "hosts": info.hosts,
+            "chipsPerHost": info.chips_per_host,
+            "coordinatorPort": COORDINATOR_PORT,
+            # the routed Service must select only pod index 0: followers
+            # serve health but no inference frontend, and sending them
+            # traffic would split the unit's metrics identity
+            "serviceSelectorExtra": {
+                "apps.kubernetes.io/pod-index": "0",
+            },
+        }
+    else:
+        predictor["componentSpecs"] = [
+            {
+                "spec": _tpu_pod_spec(
+                    version, model_uri, config, deployment_name, namespace
+                )
+            }
+        ]
+    return predictor
+
+
+def build_worker_unit_manifests(
+    name: str,
+    namespace: str,
+    owner_uid: str,
+    config: OperatorConfig,
+    version: str,
+    model_uri: str,
+) -> list[dict[str, Any]]:
+    """First-party materialization of one multi-host predictor unit.
+
+    The reference outsources pod creation to Seldon's controller; a
+    multi-host TPU slice is beyond what that controller models (N pods =
+    one predictor), so for ``hosts > 1`` the *operator* owns the unit:
+
+    - a headless Service giving every pod a stable DNS name (the
+      coordinator address baked into the pod env resolves to pod-0);
+    - a routed Service selecting pod index 0 only — the leader owns the
+      HTTP frontend, so Istio/router traffic weights keep meaning
+      "percent of requests to this unit" and metric identity stays keyed
+      by one predictor name;
+    - an indexed StatefulSet (``podManagementPolicy: Parallel`` — pods
+      must start together because ``jax.distributed.initialize`` blocks
+      until all N processes join; OrderedReady would deadlock pod-0's
+      readiness against pods that don't exist yet).
+
+    Returns ``[]`` for single-host topologies (Seldon-shaped componentSpecs
+    cover those).
+    """
+    info = _topology_info(config)
+    if info.hosts <= 1:
+        return []
+    unit = worker_unit_name(name, version)
+    labels = {
+        "app": unit,
+        "tpumlops/deployment": name,
+        "tpumlops/predictor": f"v{version}",
+    }
+    owner = owner_reference(name, owner_uid)
+    pod_spec = _tpu_pod_spec(version, model_uri, config, name, namespace)
+    headless = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": unit,
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": owner,
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": unit},
+            # publish addresses before readiness so the coordinator DNS
+            # name resolves while the process group is still forming
+            "publishNotReadyAddresses": True,
+            "ports": [{"name": "coordinator", "port": COORDINATOR_PORT}],
+        },
+    }
+    routed = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{name}-v{version}",
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": owner,
+        },
+        "spec": {
+            "selector": {"app": unit, "apps.kubernetes.io/pod-index": "0"},
+            "ports": [
+                {"name": "http", "port": 9000, "targetPort": 9000},
+                {"name": "metrics", "port": 6000, "targetPort": 6000},
+            ],
+        },
+    }
+    statefulset = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": unit,
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": owner,
+        },
+        "spec": {
+            "serviceName": unit,
+            "replicas": info.hosts,
+            "podManagementPolicy": "Parallel",
+            "selector": {"matchLabels": {"app": unit}},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": pod_spec,
+            },
+        },
+    }
+    return [headless, routed, statefulset]
 
 
 def build_deployment(

@@ -392,9 +392,14 @@ class Reconciler:
             return False
         manifest = self._manifest_for_state(obj, config, state, source_of_current)
         self._apply_deployment(manifest)
+        if config.backend == "tpu":
+            self._sync_worker_units(obj, config, state, source_of_current)
         return True
 
-    def _apply_deployment(self, manifest: dict, max_retries: int = 3) -> None:
+    def _apply_deployment(self, manifest: dict) -> None:
+        self._apply_object(self.deployment_ref, manifest)
+
+    def _apply_object(self, ref: ObjectRef, manifest: dict, max_retries: int = 3) -> None:
         """Create-or-replace with optimistic-concurrency retry.
 
         Reference ``apply_seldon_deployment`` (``mlflow_operator.py:244-282``)
@@ -402,14 +407,13 @@ class Reconciler:
         409 from a concurrent writer kills the handler.  Here Conflict causes
         a re-get and retry.
         """
-        ref = self.deployment_ref
         for attempt in range(max_retries):
             try:
                 existing = self.kube.get(ref)
             except NotFound:
                 try:
                     self.kube.create(ref, manifest)
-                    self.log.info("Applied initial SeldonDeployment.")
+                    self.log.info(f"Created {ref.plural}/{ref.name}.")
                     return
                 except Conflict:
                     continue  # lost a create race; re-get and replace
@@ -429,6 +433,100 @@ class Reconciler:
                     continue
         raise ApiError(409, f"could not apply {ref.plural}/{ref.name} after retries")
 
+    # -- multi-host worker units (SURVEY §7 hard part 5) ---------------------
+
+    _UNIT_KIND_REFS = {
+        "StatefulSet": {"group": "apps", "version": "v1", "plural": "statefulsets"},
+        "Service": {"group": "", "version": "v1", "plural": "services"},
+    }
+
+    def _sync_worker_units(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        source_of_current: ModelVersion | None = None,
+        only_if_missing: bool = False,
+    ) -> None:
+        """Level-triggered: apply the worker units the current state needs,
+        delete any this CR owns that it no longer needs (e.g. the old
+        version's unit after the 100% step drops the predictor).
+
+        The reference outsources all pod materialization to Seldon's
+        controller; a multi-host slice (one predictor = N pods) is beyond
+        that model, so for ``backend: tpu`` the operator owns these
+        first-party.  Single-host topologies produce no units; the sync
+        then only garbage-collects leftovers (e.g. after a topology edit).
+        """
+        from .builder import build_worker_unit_manifests
+
+        owner_uid = (obj.get("metadata") or {}).get("uid", f"uid-{self.name}")
+        desired: list[dict] = []
+        if state.current_version is not None:
+            if (
+                source_of_current is not None
+                and source_of_current.version == state.current_version
+            ):
+                uri = artifact_uri(source_of_current.source, config.artifact_root)
+            else:
+                uri = self._resolve_uri(config, state.current_version)
+            desired += build_worker_unit_manifests(
+                self.name, self.namespace, owner_uid, config,
+                state.current_version, uri,
+            )
+        if state.previous_version is not None and state.traffic_prev > 0:
+            desired += build_worker_unit_manifests(
+                self.name, self.namespace, owner_uid, config,
+                state.previous_version,
+                self._resolve_uri(config, state.previous_version),
+            )
+
+        desired_names: dict[str, set[str]] = {"StatefulSet": set(), "Service": set()}
+        for manifest in desired:
+            kind = manifest["kind"]
+            name = manifest["metadata"]["name"]
+            desired_names[kind].add(name)
+            ref = self._unit_ref(kind, name)
+            if only_if_missing:
+                # steady-state self-heal: recreate what's gone without
+                # rewriting (and rv-bumping) healthy objects every cycle
+                try:
+                    self.kube.get(ref)
+                    continue
+                except NotFound:
+                    self.log.warning(
+                        f"worker-unit {kind} {name} missing; recreating (self-heal)."
+                    )
+            self._apply_object(ref, manifest)
+        self._gc_worker_units(keep=desired_names)
+
+    def _unit_ref(self, kind: str, name: str) -> ObjectRef:
+        return ObjectRef(
+            namespace=self.namespace, name=name, **self._UNIT_KIND_REFS[kind]
+        )
+
+    def _gc_worker_units(self, keep: dict[str, set[str]] | None = None) -> None:
+        keep = keep or {}
+        for kind in self._UNIT_KIND_REFS:
+            try:
+                existing = self.kube.list(self._unit_ref(kind, ""))
+            except ApiError as e:
+                self.log.warning(f"worker-unit GC list of {kind} failed: {e}")
+                continue
+            for found in existing:
+                meta = found.get("metadata") or {}
+                labels = meta.get("labels") or {}
+                if labels.get("tpumlops/deployment") != self.name:
+                    continue  # not ours
+                name = meta.get("name", "")
+                if name in keep.get(kind, set()):
+                    continue
+                try:
+                    self.kube.delete(self._unit_ref(kind, name))
+                    self.log.info(f"Deleted stale worker-unit {kind} {name}.")
+                except NotFound:
+                    pass
+
     def _ensure_deployment(
         self, obj: dict, config: OperatorConfig, state: PromotionState
     ) -> None:
@@ -444,14 +542,28 @@ class Reconciler:
         except NotFound:
             self.log.warning("SeldonDeployment missing; recreating (self-heal).")
             self._apply_for_state(obj, config, state)
+            return
+        if config.backend == "tpu":
+            from .builder import _topology_info
+
+            # the units are separate objects; heal them independently of
+            # the (still-present) routing manifest.  Single-host topologies
+            # have no units — skip the registry round-trips.
+            if _topology_info(config).hosts > 1:
+                self._sync_worker_units(obj, config, state, only_if_missing=True)
 
     def _delete_deployment(self) -> None:
-        """Reference ``delete_seldon_deployment`` (:462-477): 404 tolerated."""
+        """Reference ``delete_seldon_deployment`` (:462-477): 404 tolerated.
+
+        Also tears down any first-party worker units (in-cluster the
+        ownerReferences GC covers them too; explicit delete keeps fakes and
+        non-GC stores equivalent)."""
         try:
             self.kube.delete(self.deployment_ref)
             self.log.info(f"SeldonDeployment '{self.name}' deleted.")
         except NotFound:
             pass
+        self._gc_worker_units()
 
     def _patch_status(self, state: PromotionState) -> None:
         try:

@@ -89,6 +89,10 @@ class TpuInferenceServer:
     def shutdown(self) -> None:
         self.ready = False
         self.batcher.stop()
+        if hasattr(self.engine, "shutdown"):
+            # multi-host leader: release follower processes after the
+            # batcher has drained (no more broadcasts can follow)
+            self.engine.shutdown()
 
     # -- request handling ----------------------------------------------------
 
@@ -289,7 +293,16 @@ def _to_v2_outputs(out: Any) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def build_server(config: ServerConfig, warmup: bool = True) -> TpuInferenceServer:
+def build_server(
+    config: ServerConfig, warmup: bool = True, transport=None
+) -> TpuInferenceServer:
+    """Build the leader-side server.
+
+    ``transport`` (a ``multihost.GroupTransport``) makes this process the
+    leader of a multi-host predictor unit: every engine call is broadcast
+    so follower processes execute it in lockstep (SURVEY §7 hard part 5).
+    Single-host units pass None and run the engine directly.
+    """
     mesh_shape = dict(config.tpu.mesh_shape)
     predictor = load_predictor(config.model_uri, mesh_shape=mesh_shape)
     metrics = ServerMetrics(
@@ -302,6 +315,10 @@ def build_server(config: ServerConfig, warmup: bool = True) -> TpuInferenceServe
         max_batch_size=config.tpu.max_batch_size,
         on_compile=lambda: metrics.compilations.labels(**metrics.identity).inc(),
     )
+    if transport is not None:
+        from .multihost import MultihostEngine
+
+        engine = MultihostEngine(engine, transport)
     server = TpuInferenceServer(
         engine,
         metrics,
@@ -311,6 +328,30 @@ def build_server(config: ServerConfig, warmup: bool = True) -> TpuInferenceServe
     )
     server.startup(warmup=warmup)
     return server
+
+
+def _serve_follower_health(host: str, port: int) -> None:
+    """Minimal live/ready listener for follower pods (daemon thread).
+
+    The StatefulSet template shares one readinessProbe across the unit;
+    followers answer it here so they don't sit NotReady forever."""
+    import threading
+
+    def run() -> None:
+        async def ok(_request: web.Request) -> web.Response:
+            return web.json_response({"role": "follower", "ok": True})
+
+        app = web.Application()
+        app.router.add_get("/v2/health/live", ok)
+        app.router.add_get("/v2/health/ready", ok)
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        loop.run_until_complete(web.TCPSite(runner, host, port).start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True, name="follower-health").start()
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -357,7 +398,33 @@ def main(argv: list[str] | None = None) -> None:
         ),
     )
     logging.basicConfig(level=logging.INFO)
-    server = build_server(config)
+
+    import jax  # deferred: process topology is meaningful only after init
+
+    if jax.process_count() > 1:
+        from .multihost import JaxProcessTransport, follower_loop
+
+        transport = JaxProcessTransport()
+        if not transport.is_leader:
+            # Follower pod of a multi-host predictor unit: no inference
+            # frontend, but it must still answer the unit's shared
+            # readiness probe — joining the process group (init returned)
+            # IS follower-readiness.  Then execute the leader's broadcast
+            # steps until it shuts the unit down.
+            _serve_follower_health(config.host, config.port)
+            predictor = load_predictor(
+                args.model_uri, mesh_shape=dict(config.tpu.mesh_shape)
+            )
+            engine = InferenceEngine(
+                predictor, max_batch_size=config.tpu.max_batch_size
+            )
+            _log.info("follower process %d ready", jax.process_index())
+            follower_loop(engine, transport)
+            return
+    else:
+        transport = None
+
+    server = build_server(config, transport=transport)
 
     async def _serve() -> None:
         runner = web.AppRunner(server.build_app())
@@ -376,13 +443,29 @@ def main(argv: list[str] | None = None) -> None:
             config.port,
             args.metrics_port or f"{config.port}/metrics",
         )
-        while True:
-            await asyncio.sleep(3600)
+        # Kubernetes terminates pods with SIGTERM, not Ctrl-C: without a
+        # handler the multi-host leader would die before broadcasting
+        # OP_SHUTDOWN and its followers would block out their whole grace
+        # period in a dead collective.
+        import signal
+
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except (NotImplementedError, RuntimeError):  # non-main thread
+                pass
+        await stop.wait()
+        _log.info("termination signal; shutting down")
+        await runner.cleanup()  # fires on_shutdown -> server.shutdown()
 
     try:
         asyncio.run(_serve())
     except KeyboardInterrupt:
-        server.shutdown()
+        pass
+    finally:
+        server.shutdown()  # idempotent; covers non-signal exits
 
 
 if __name__ == "__main__":  # pragma: no cover

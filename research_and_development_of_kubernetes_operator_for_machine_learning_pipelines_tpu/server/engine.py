@@ -29,6 +29,20 @@ from ..models.registry import Predictor
 _log = logging.getLogger(__name__)
 
 
+def warmup_buckets(max_batch_size: int) -> list[int]:
+    """Batch buckets to pre-compile: powers of two up to the cap, plus the
+    cap itself when it isn't one (``next_bucket()`` clamps there, so a
+    non-power-of-two cap is a servable bucket and must be warmed too)."""
+    buckets = []
+    b = 1
+    while b <= max_batch_size:
+        buckets.append(b)
+        b <<= 1
+    if buckets[-1] != max_batch_size:
+        buckets.append(max_batch_size)
+    return buckets
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -63,6 +77,12 @@ class InferenceEngine:
 
     # -- public API ----------------------------------------------------------
 
+    @property
+    def wants_warmup(self) -> bool:
+        """True when warmup would actually compile something (jittable
+        predictor with an example-input builder)."""
+        return self._jitted is not None and self.predictor.example_input is not None
+
     def predict(self, inputs: Mapping[str, np.ndarray]) -> Any:
         """Run one already-batched input dict; returns numpy outputs."""
         sig = self._signature(inputs)
@@ -80,26 +100,27 @@ class InferenceEngine:
             out = self._call_predict(inputs)
         return _to_numpy(out)
 
-    def warmup(self, buckets: list[int] | None = None) -> float:
-        """Compile every batch bucket ahead of traffic; returns seconds spent."""
-        if self.predictor.example_input is None or self._jitted is None:
+    def warmup(
+        self,
+        buckets: list[int] | None = None,
+        predict: Callable[[Mapping[str, np.ndarray]], Any] | None = None,
+    ) -> float:
+        """Compile every batch bucket ahead of traffic; returns seconds spent.
+
+        ``predict`` overrides the dispatch path (the multi-host wrapper
+        passes its broadcasting predict so followers warm the same buckets)
+        while bucket policy and example building stay in this one place."""
+        if not self.wants_warmup:
             return 0.0
         if buckets is None:
-            buckets = []
-            b = 1
-            while b <= self.max_batch_size:
-                buckets.append(b)
-                b <<= 1
-            # next_bucket() caps at max_batch_size, so a non-power-of-two cap
-            # is itself a servable bucket and must be warmed too.
-            if buckets[-1] != self.max_batch_size:
-                buckets.append(self.max_batch_size)
+            buckets = warmup_buckets(self.max_batch_size)
+        predict = predict or self.predict
         t0 = time.perf_counter()
         for b in buckets:
             ex = self.predictor.example_input(b)
             if not isinstance(ex, Mapping):
                 ex = {"x": ex}
-            self.predict(ex)
+            predict(ex)
         dt = time.perf_counter() - t0
         _log.info("warmup compiled %d buckets in %.1fs", len(buckets), dt)
         return dt

@@ -1,0 +1,244 @@
+"""Multi-host predictor unit: one predictor = N lockstep server processes.
+
+SURVEY §7 hard part 5: a multi-host TPU slice (e.g. v5e-16 = 4 hosts of 4
+chips) means one *predictor* is N pods that must act as a single unit for
+traffic and health.  The reference never faces this — Seldon's
+``MLFLOW_SERVER`` pods are single-host CPU containers
+(``mlflow_operator.py:195-222``) — but a model tensor-sharded across hosts
+cannot run any step unless every process joins the same XLA collective.
+
+Design (the standard JAX serving shape — all hosts run the same program):
+
+- process 0 (the **leader**) owns the HTTP frontend; the Service only
+  selects the leader pod, so Istio traffic weights keep meaning "percent of
+  requests to this *unit*";
+- processes 1..N-1 (**followers**) run :func:`follower_loop`: block on a
+  broadcast from the leader, execute the same engine call with the same
+  inputs, repeat.  Every process therefore enters each jit'd computation
+  together and the cross-host collectives line up;
+- the broadcast channel is the JAX process group itself
+  (:class:`JaxProcessTransport`, ``broadcast_one_to_all`` over DCN), so no
+  side-channel (Redis/gRPC) is needed; tests use
+  :class:`LocalGroupTransport` (threads + barriers) to run an N-"host"
+  unit inside one process.
+
+Health as a unit: ``jax.distributed.initialize`` blocks until all N
+processes join, and the leader's readiness endpoint only turns ready after
+warmup — so "leader ready" ⇒ "all hosts up and compiled", and the operator
+can keep gating on the one readiness probe the builder emits.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from typing import Any, Mapping, Protocol
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+OP_PREDICT = "predict"
+OP_SHUTDOWN = "shutdown"
+
+# Fixed-size round-1 header: payload byte length as uint32.  Round 2 is the
+# payload itself.  Two rounds because ``broadcast_one_to_all`` needs every
+# process to supply a same-shape buffer, and followers can't know the
+# payload size ahead of time.
+_LEN_DTYPE = np.uint32
+
+
+class GroupTransport(Protocol):
+    """One-to-all broadcast within the predictor unit."""
+
+    @property
+    def is_leader(self) -> bool: ...
+
+    def broadcast(self, payload: bytes | None) -> bytes:
+        """Leader passes ``payload``; followers pass ``None``.  Every
+        process returns the leader's bytes."""
+        ...
+
+
+class JaxProcessTransport:
+    """Broadcast over the JAX process group (DCN collectives).
+
+    Uses ``jax.experimental.multihost_utils.broadcast_one_to_all`` — the
+    same channel the model's own cross-host collectives ride, so transport
+    liveness and compute liveness fail together (no split-brain where the
+    control channel is up but the slice is wedged).
+    """
+
+    def __init__(self) -> None:
+        import jax
+
+        self._process_index = jax.process_index()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._process_index == 0
+
+    def broadcast(self, payload: bytes | None) -> bytes:
+        from jax.experimental import multihost_utils
+
+        if self.is_leader:
+            if payload is None:
+                raise ValueError("leader must supply a payload")
+            buf = np.frombuffer(payload, dtype=np.uint8)
+            n = np.asarray([len(buf)], dtype=_LEN_DTYPE)
+        else:
+            buf = None
+            n = np.zeros(1, dtype=_LEN_DTYPE)
+        n = np.asarray(multihost_utils.broadcast_one_to_all(n))
+        size = int(n[0])
+        if buf is None:
+            buf = np.zeros(size, dtype=np.uint8)
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        return out.tobytes()
+
+
+class LocalGroupTransport:
+    """In-process fake: N threads acting as N hosts (tests / docs).
+
+    Construct one :class:`_LocalGroup` and take a transport per "host".
+    """
+
+    def __init__(self, group: "_LocalGroup", rank: int) -> None:
+        self._group = group
+        self._rank = rank
+
+    @property
+    def is_leader(self) -> bool:
+        return self._rank == 0
+
+    def broadcast(self, payload: bytes | None) -> bytes:
+        return self._group.broadcast(self._rank, payload)
+
+
+class _LocalGroup:
+    def __init__(self, size: int) -> None:
+        self.size = size
+        self._slot: bytes | None = None
+        self._fill = threading.Barrier(size)
+        self._drain = threading.Barrier(size)
+
+    def broadcast(self, rank: int, payload: bytes | None) -> bytes:
+        if rank == 0:
+            if payload is None:
+                raise ValueError("leader must supply a payload")
+            self._slot = payload
+        self._fill.wait()
+        out = self._slot
+        self._drain.wait()
+        assert out is not None
+        return out
+
+    def transports(self) -> list[LocalGroupTransport]:
+        return [LocalGroupTransport(self, r) for r in range(self.size)]
+
+
+# ---------------------------------------------------------------------------
+# Message encoding
+# ---------------------------------------------------------------------------
+
+
+def encode_message(op: str, inputs: Mapping[str, np.ndarray] | None = None) -> bytes:
+    """Pickle is safe here: the channel is the slice's own process group —
+    every peer already runs the same trusted server image."""
+    return pickle.dumps((op, dict(inputs) if inputs is not None else None))
+
+
+def decode_message(raw: bytes) -> tuple[str, dict[str, np.ndarray] | None]:
+    op, inputs = pickle.loads(raw)
+    return op, inputs
+
+
+# ---------------------------------------------------------------------------
+# Leader-side engine wrapper + follower loop
+# ---------------------------------------------------------------------------
+
+
+class MultihostEngine:
+    """Duck-types :class:`InferenceEngine` for the batcher/app; every
+    ``predict`` is first broadcast so followers execute it in lockstep.
+
+    ``warmup`` deliberately routes through ``self.predict`` so followers
+    compile the same batch buckets the leader does — otherwise the first
+    real request would stall N-1 hosts on an XLA compile.
+    """
+
+    def __init__(self, engine: Any, transport: GroupTransport) -> None:
+        if not transport.is_leader:
+            raise ValueError("MultihostEngine is leader-side; followers run follower_loop")
+        self._engine = engine
+        self._transport = transport
+        # The app calls predict from both the batcher thread and the
+        # bucketed-path executor; broadcast+execute must be atomic or the
+        # followers' step order diverges from the leader's.
+        self._step_lock = threading.Lock()
+        self._closed = False
+
+    # pass-throughs the app/batcher use
+    @property
+    def predictor(self):
+        return self._engine.predictor
+
+    @property
+    def max_batch_size(self) -> int:
+        return self._engine.max_batch_size
+
+    def predict(self, inputs: Mapping[str, np.ndarray]) -> Any:
+        with self._step_lock:
+            if self._closed:
+                # After OP_SHUTDOWN the followers have exited their loop; a
+                # further broadcast would wait on peers that are gone and
+                # wedge the leader process instead of letting it terminate.
+                raise RuntimeError("multihost unit is shut down")
+            self._transport.broadcast(encode_message(OP_PREDICT, inputs))
+            return self._engine.predict(inputs)
+
+    def warmup(self, buckets: list[int] | None = None) -> float:
+        # Delegate to the engine's single warmup implementation, routing
+        # dispatch through the broadcasting predict so followers compile
+        # the same buckets the leader does.
+        return self._engine.warmup(buckets, predict=self.predict)
+
+    def shutdown(self) -> None:
+        """Release followers; without this they block on broadcast forever
+        and the pod unit never terminates cleanly."""
+        with self._step_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._transport.broadcast(encode_message(OP_SHUTDOWN))
+
+
+def follower_loop(engine: Any, transport: GroupTransport) -> int:
+    """Run on processes 1..N-1: execute broadcast steps until shutdown.
+
+    Returns the number of predict steps executed (for tests/metrics).
+    """
+    if transport.is_leader:
+        raise ValueError("follower_loop must not run on the leader")
+    steps = 0
+    while True:
+        op, inputs = decode_message(transport.broadcast(None))
+        if op == OP_SHUTDOWN:
+            _log.info("follower received shutdown after %d steps", steps)
+            return steps
+        if op == OP_PREDICT:
+            assert inputs is not None
+            try:
+                engine.predict(inputs)
+            except Exception:
+                # The leader catches the same model error in its HTTP
+                # handler and stays up (app.py returns 500); a follower
+                # that dies instead can never rejoin the formed process
+                # group and would wedge the whole unit on the next
+                # broadcast.  Same step attempted on every host keeps the
+                # group in lockstep whether it raised or not.
+                _log.exception("follower predict step failed; continuing")
+            steps += 1
+        else:  # unknown op: skip rather than desync the group
+            _log.warning("follower ignoring unknown op %r", op)

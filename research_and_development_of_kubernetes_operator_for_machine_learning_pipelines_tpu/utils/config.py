@@ -30,14 +30,40 @@ DEFAULT_ATTEMPT_DELAY_S = 10  # :294
 DEFAULT_INITIAL_CANARY_TRAFFIC = 10  # :187
 DEFAULT_METRICS_WINDOW_S = 60  # :363 (elapsed_time=60)
 
-# Canonical TPU topology table: CRD tpuTopology value -> (GKE accelerator
-# label, GKE topology label, chip count).  Chip count must equal the mesh
-# device count or the pod's google.com/tpu request is unschedulable.
-TPU_TOPOLOGIES: dict[str, tuple[str, str, int]] = {
-    "v5e-1": ("tpu-v5-lite-podslice", "1x1", 1),
-    "v5e-4": ("tpu-v5-lite-podslice", "2x2", 4),
-    "v5e-8": ("tpu-v5-lite-podslice", "2x4", 8),
-    "v5e-16": ("tpu-v5-lite-podslice", "4x4", 16),
+# Canonical TPU topology table: CRD tpuTopology value -> placement facts.
+# Chip count must equal the mesh device count or the pod's google.com/tpu
+# request is unschedulable.  Topologies with hosts > 1 are *multi-host
+# slices*: one predictor = ``hosts`` pods forming one JAX process group
+# (SURVEY §7 hard part 5); the builder emits the unit wiring and the chips
+# request is per-host (``chips_per_host``), not per-slice.
+
+
+@dataclass(frozen=True)
+class TopologyInfo:
+    accelerator: str  # GKE nodeSelector cloud.google.com/gke-tpu-accelerator
+    gke_topology: str  # GKE nodeSelector cloud.google.com/gke-tpu-topology
+    chips: int  # total chips in the slice
+    hosts: int = 1  # VMs in the slice (pods per predictor unit)
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    # tuple-style indexing kept for the original (accelerator, topology,
+    # chips) consumers — exactly 3 elements so legacy 3-way unpacking
+    # (`acc, topo, chips = info`) still works; ``hosts`` is attribute-only
+    def __getitem__(self, i: int):
+        return (self.accelerator, self.gke_topology, self.chips)[i]
+
+
+TPU_TOPOLOGIES: dict[str, TopologyInfo] = {
+    "v5e-1": TopologyInfo("tpu-v5-lite-podslice", "1x1", 1),
+    "v5e-4": TopologyInfo("tpu-v5-lite-podslice", "2x2", 4),
+    "v5e-8": TopologyInfo("tpu-v5-lite-podslice", "2x4", 8),
+    # multi-host slices: 4-chip VMs (ct5lp-hightpu-4t node shape)
+    "v5e-16": TopologyInfo("tpu-v5-lite-podslice", "4x4", 16, hosts=4),
+    "v5e-32": TopologyInfo("tpu-v5-lite-podslice", "4x8", 32, hosts=8),
+    "v5e-64": TopologyInfo("tpu-v5-lite-podslice", "8x8", 64, hosts=16),
 }
 
 
@@ -216,12 +242,19 @@ class OperatorConfig:
                     f"unknown tpuTopology {tpu.topology!r}; known: "
                     f"{sorted(TPU_TOPOLOGIES)}"
                 )
-            if tpu.num_devices != info[2]:
+            if tpu.num_devices != info.chips:
                 raise ValueError(
                     f"meshShape {dict(tpu.mesh_shape)} uses {tpu.num_devices} "
                     f"devices but tpuTopology {tpu.topology!r} provides "
-                    f"{info[2]} chips; they must match or the pod is "
+                    f"{info.chips} chips; they must match or the pod is "
                     "unschedulable"
+                )
+            if info.hosts > 1 and tpu.replicas > 1:
+                raise ValueError(
+                    f"replicas={tpu.replicas} with multi-host topology "
+                    f"{tpu.topology!r} is not supported yet: one worker "
+                    "unit per predictor version; scale out with more "
+                    "MlflowModel CRs or a larger slice"
                 )
         return cls(
             model_name=str(model_name),
