@@ -103,6 +103,7 @@ def _tpu_pod_spec(
             "--dtype", tpu.dtype,
             "--max-batch-size", str(tpu.max_batch_size),
             "--max-batch-delay-ms", str(tpu.max_batch_delay_ms),
+            "--compile-cache-dir", tpu.compile_cache_dir or "",
         ],
         "env": [
             {"name": "TPU_TOPOLOGY", "value": tpu.topology},
@@ -156,7 +157,26 @@ def _tpu_pod_spec(
         ]
     if config.minio_secret:
         container["envFrom"] = [{"secretRef": {"name": config.minio_secret}}]
+    pod: dict[str, Any] = {}
+    if tpu.compile_cache_dir:
+        # Node-local persistent XLA cache (SURVEY §7 hard part 3): hostPath
+        # outlives the pod, so a rescheduled canary — or the *other* version's
+        # pod on the same TPU host — warms up from deserialized executables
+        # instead of recompiling, keeping time-to-ready off the latency gate.
+        container["volumeMounts"] = [
+            {"name": "xla-cache", "mountPath": tpu.compile_cache_dir}
+        ]
+        pod["volumes"] = [
+            {
+                "name": "xla-cache",
+                "hostPath": {
+                    "path": "/var/cache/tpumlops/xla",
+                    "type": "DirectoryOrCreate",
+                },
+            }
+        ]
     return {
+        **pod,
         "nodeSelector": {
             "cloud.google.com/gke-tpu-accelerator": accelerator,
             "cloud.google.com/gke-tpu-topology": gke_topology,

@@ -22,6 +22,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Any
 
@@ -374,11 +375,22 @@ def main(argv: list[str] | None = None) -> None:
         help="dedicated /metrics listener (matches the manifest's metrics "
         "containerPort); 0 disables the second listener",
     )
+    ap.add_argument(
+        "--compile-cache-dir",
+        default=os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache"),
+        help="persistent XLA compile cache (SURVEY §7 hard part 3); "
+        "empty string disables",
+    )
     args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
 
     from ..parallel.distributed import maybe_initialize_distributed
+    from ..utils.compile_cache import enable_persistent_compile_cache
 
     maybe_initialize_distributed()
+    # Before any jit trace (warmup included), so even the first-ever
+    # compile of each batch bucket is persisted for the next pod.
+    enable_persistent_compile_cache(args.compile_cache_dir)
 
     config = ServerConfig(
         model_name=args.model_name,
@@ -397,7 +409,6 @@ def main(argv: list[str] | None = None) -> None:
             }
         ),
     )
-    logging.basicConfig(level=logging.INFO)
 
     import jax  # deferred: process topology is meaningful only after init
 

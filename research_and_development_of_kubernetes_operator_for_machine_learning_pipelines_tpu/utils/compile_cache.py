@@ -1,0 +1,97 @@
+"""Persistent XLA compilation cache (SURVEY §7 hard part 3).
+
+TPU cold-start is the canary killer: the first request into a freshly
+scheduled 10%-traffic predictor triggers a 20–40 s XLA compile, which lands
+in the Prometheus latency window and fails the promotion gate before the
+model has served a single steady-state request.  The reference never faces
+this (its Seldon ``MLFLOW_SERVER`` pods are interpreted CPU Python,
+``mlflow_operator.py:198``); a TPU data plane must solve it.
+
+Two layers of defense:
+
+1. **Warmup before readiness** — the server compiles every batch bucket
+   before answering the readiness probe (``server/app.py``), so no live
+   request ever pays a compile.
+2. **This module** — persists compiled executables to a node-local
+   directory (the manifest builder mounts a ``hostPath`` volume, so the
+   cache survives pod restarts and is shared between the stable and canary
+   pods scheduled on the same TPU host).  Warmup on a warm node then takes
+   ~100 ms of cache deserialization instead of tens of seconds of XLA work,
+   which keeps time-to-ready — and therefore time-to-100%-traffic, the
+   north-star metric — low.
+
+JAX's own defaults are tuned for big training jobs: entries below 1 s of
+compile time are not persisted.  Canary models (iris, xgboost, small BERT
+buckets) compile faster than that, so we lower both floors to zero —
+a cache miss on *any* bucket is a readiness-latency regression here.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_log = logging.getLogger("tpumlops.compile_cache")
+
+
+def enable_persistent_compile_cache(
+    cache_dir: str | None,
+    *,
+    min_compile_time_secs: float = 0.0,
+    max_size_bytes: int = 10 * 1024**3,
+) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns True when enabled.  ``cache_dir`` falsy → disabled (returns
+    False); an unwritable directory logs a warning and disables rather
+    than failing server startup — a cold compile is slow, not fatal.
+    Must run before the first ``jit`` trace to cover warmup compiles.
+
+    ``max_size_bytes`` caps the directory with JAX's LRU eviction: the
+    hostPath volume outlives every pod and cache keys change with each
+    model version, so without a cap the node disk would fill with dead
+    versions' executables until kubelet disk-pressure evicts the very
+    predictors the cache protects.
+    """
+    import jax
+
+    if not cache_dir:
+        return False
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        probe = os.path.join(cache_dir, ".tpumlops-probe")
+        with open(probe, "w"):
+            pass
+        os.remove(probe)
+    except OSError as exc:
+        _log.warning(
+            "compile cache dir %s unusable (%s); continuing without "
+            "persistent cache",
+            cache_dir,
+            exc,
+        )
+        # The manifest also exports JAX_COMPILATION_CACHE_DIR, which JAX
+        # reads as this option's default at import — clear it so "disabled"
+        # really means disabled, not "retry cache I/O on every compile".
+        jax.config.update("jax_compilation_cache_dir", None)
+        return False
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Persist every executable regardless of size/compile time: canary
+    # buckets are small and fast to compile but still too slow for a
+    # latency-gated readiness window.
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs
+    )
+    jax.config.update("jax_compilation_cache_max_size", max_size_bytes)
+    _log.info("persistent compile cache at %s", cache_dir)
+    return True
+
+
+def cache_entry_count(cache_dir: str) -> int:
+    """Number of persisted executables (for tests and the warm-start metric)."""
+    try:
+        return sum(1 for n in os.listdir(cache_dir) if n.endswith("-cache"))
+    except OSError:
+        return 0

@@ -89,3 +89,55 @@ def test_manifests_are_valid_yaml_with_expected_fields():
     ))[0]
     container = dep["spec"]["template"]["spec"]["containers"][0]
     assert container["envFrom"][0]["secretRef"]["name"] == "mlflow-creds"
+
+
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache (SURVEY §7 hard part 3)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_cache_persists_small_executables(tmp_path, monkeypatch):
+    from tpumlops.utils.compile_cache import (
+        cache_entry_count,
+        enable_persistent_compile_cache,
+    )
+
+    d = str(tmp_path / "xla")
+    assert enable_persistent_compile_cache(d)
+    try:
+        # Canary-sized computation: compiles in far under JAX's default 1 s
+        # persistence floor — persisted anyway because we zero the floors.
+        f = jax.jit(lambda x: jnp.tanh(x @ x.T).sum())
+        f(jnp.ones((16, 16), jnp.float32)).block_until_ready()
+        assert cache_entry_count(d) >= 1
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_compile_cache_disabled_or_unwritable_is_nonfatal(tmp_path):
+    from tpumlops.utils.compile_cache import enable_persistent_compile_cache
+
+    assert enable_persistent_compile_cache(None) is False
+    assert enable_persistent_compile_cache("") is False
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file, not a dir")
+    assert enable_persistent_compile_cache(str(blocked)) is False
+
+
+def test_tpu_pod_mounts_node_local_compile_cache():
+    from tests.test_builder import cfg, two_version_manifest
+
+    config = cfg(
+        backend="tpu", tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 8}}
+    )
+    sd = two_version_manifest(config)
+    pod = sd["spec"]["predictors"][1]["componentSpecs"][0]["spec"]
+    container = pod["containers"][0]
+    args = " ".join(container["args"])
+    assert "--compile-cache-dir /tmp/jax_compile_cache" in args
+    (mount,) = container["volumeMounts"]
+    assert mount["mountPath"] == "/tmp/jax_compile_cache"
+    (vol,) = pod["volumes"]
+    assert vol["name"] == mount["name"] == "xla-cache"
+    # hostPath so the cache outlives the pod (canary reschedule = warm start).
+    assert vol["hostPath"]["type"] == "DirectoryOrCreate"
