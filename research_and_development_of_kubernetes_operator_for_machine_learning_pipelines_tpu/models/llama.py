@@ -90,6 +90,33 @@ class KVCache(NamedTuple):
         )
 
 
+class RaggedKVCache(NamedTuple):
+    """Multi-slot KV cache with PER-ROW lengths (continuous batching).
+
+    Shapes match :class:`KVCache` — k/v ``[L, B, T, NKV, D]`` — but
+    ``lengths`` is int32 ``[B]``: each batch row ("slot") sits at its own
+    sequence position, so requests that arrived at different times decode
+    together in one static-shape batched step (``decode_ragged``).  The
+    server's :class:`~..server.generation.GenerationEngine` owns slot
+    assignment; this type is the pure-JAX state it schedules over.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array  # int32 [B]: valid positions per slot
+
+    @classmethod
+    def create(
+        cls, cfg: LlamaConfig, batch: int, dtype=jnp.bfloat16
+    ) -> "RaggedKVCache":
+        shape = (cfg.num_layers, batch, cfg.max_seq, cfg.num_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            lengths=jnp.zeros((batch,), jnp.int32),
+        )
+
+
 # ---------------------------------------------------------------------------
 # Init / torch import
 # ---------------------------------------------------------------------------
@@ -175,9 +202,13 @@ def _rotate_half(x: jax.Array) -> jax.Array:
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x: [B, S, N, D]; cos/sin: [S, D]."""
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    """x: [B, S, N, D]; cos/sin: [S, D] (shared) or [B, S, D] (per-row)."""
+    if cos.ndim == 2:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+    else:
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
     return (x * c + _rotate_half(x) * s).astype(x.dtype)
 
 
@@ -199,11 +230,14 @@ def _block(
 ):
     """One decoder layer over a fixed-capacity cache.
 
-    x: [B,S,H]; cache_k/v: [B,max_seq,NKV,D]; start: scalar write offset.
+    x: [B,S,H]; cache_k/v: [B,max_seq,NKV,D]; start: scalar write offset
+    shared by the batch, or an int32 [B] of per-row offsets (continuous
+    batching: each slot is at its own sequence position).
     Returns (y, new_cache_k, new_cache_v).
     """
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    ragged = getattr(start, "ndim", 0) == 1
 
     xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
     q = jnp.matmul(xn, lp["q"].astype(xn.dtype), preferred_element_type=jnp.float32)
@@ -217,8 +251,17 @@ def _block(
     k = apply_rope(k, cos, sin)
 
     # Write this chunk's K/V into the cache at [start : start+s].
-    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, start, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, start, 0, 0))
+    if ragged:
+        def _write(row_cache, row_kv, row_start):
+            z = jnp.zeros((), row_start.dtype)
+            return lax.dynamic_update_slice(row_cache, row_kv, (row_start, z, z))
+
+        cache_k = jax.vmap(_write)(cache_k, k.astype(cache_k.dtype), start)
+        cache_v = jax.vmap(_write)(cache_v, v.astype(cache_v.dtype), start)
+    else:
+        z = jnp.zeros((), start.dtype) if hasattr(start, "dtype") else 0
+        cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (z, start, z, z))
+        cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (z, start, z, z))
 
     # GQA via grouped einsum: q reshaped to [B,S,NKV,G,D] contracts directly
     # against the [B,T,NKV,D] cache — no materialized repeat of K/V to all
@@ -333,6 +376,92 @@ def generate_greedy(
     (_, _), toks = lax.scan(body, (next_tok, cache), None, length=num_new_tokens)
     # toks: [num_new, B, 1] -> [B, num_new]
     return jnp.moveaxis(toks[..., 0], 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching primitives (per-row positions)
+# ---------------------------------------------------------------------------
+
+
+def decode_ragged(
+    params: dict,
+    token_ids: jax.Array,
+    cache: RaggedKVCache,
+    cfg: LlamaConfig,
+    active: jax.Array | None = None,
+    dtype=jnp.bfloat16,
+) -> tuple[jax.Array, RaggedKVCache]:
+    """One decode step where every batch row is at its OWN position.
+
+    token_ids ``[B, 1]``; each row i writes K/V at ``cache.lengths[i]`` and
+    attends keys ``0..lengths[i]``.  ``active`` (bool ``[B]``) gates the
+    length advance so finished/empty slots don't creep toward capacity;
+    their rows still compute (static shapes — the MXU does not care) and
+    their outputs are ignored by the scheduler.
+
+    Slot-reuse safety: a reused slot's stale K/V beyond the new sequence's
+    current position is never attended — the mask admits ``key_pos <= p``
+    and every position ``<= p`` has been rewritten by the new occupant's
+    prefill insert or a prior decode write (each step writes position ``p``
+    before attending it).
+
+    Returns (logits ``[B, 1, vocab]`` float32, cache with advanced lengths).
+    """
+    b, s = token_ids.shape
+    if s != 1:
+        raise ValueError(f"decode_ragged is single-token: got chunk of {s}")
+    lengths = cache.lengths
+    x = jnp.take(params["embed"], token_ids, axis=0).astype(dtype)
+
+    positions = lengths[:, None]  # [B, 1]
+    cos, sin = rope_cos_sin(positions, cfg, jnp.float32)  # [B, 1, head_dim]
+
+    capacity = cache.k.shape[2]
+    key_pos = jnp.arange(capacity)
+    valid = key_pos[None, None, :] <= positions[:, :, None]  # [B, 1, T]
+    mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[:, None]  # [B,1,1,T]
+
+    def scan_body(carry, layer_inputs):
+        x = carry
+        lp, ck, cv = layer_inputs
+        y, ck2, cv2 = _block(x, lp, ck, cv, lengths, cos, sin, mask_bias, cfg)
+        return y, (ck2, cv2)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.matmul(
+        x, params["lm_head"].astype(x.dtype), preferred_element_type=jnp.float32
+    )
+    advance = (
+        jnp.ones((b,), jnp.int32) if active is None else active.astype(jnp.int32)
+    )
+    return logits, RaggedKVCache(new_k, new_v, lengths + advance)
+
+
+def insert_sequence(
+    cache: RaggedKVCache, seq: KVCache, slot: jax.Array, length: jax.Array
+) -> RaggedKVCache:
+    """Install a prefilled single-sequence cache into batch row ``slot``.
+
+    ``seq`` comes from :func:`prefill` with batch 1 (k/v ``[L,1,Tp,...]``,
+    ``Tp <= capacity``); ``length`` is the sequence's REAL token count —
+    prompt padding beyond it was written by prefill but is progressively
+    overwritten by decode steps before it can ever be attended (see
+    ``decode_ragged``).  ``slot``/``length`` may be traced values, so one
+    compiled insert serves every slot.
+    """
+    slot = jnp.asarray(slot, jnp.int32)
+    z = jnp.zeros((), jnp.int32)
+    k = lax.dynamic_update_slice(
+        cache.k, seq.k.astype(cache.k.dtype), (z, slot, z, z, z)
+    )
+    v = lax.dynamic_update_slice(
+        cache.v, seq.v.astype(cache.v.dtype), (z, slot, z, z, z)
+    )
+    lengths = cache.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
+    return RaggedKVCache(k, v, lengths)
 
 
 # ---------------------------------------------------------------------------

@@ -27,6 +27,10 @@ class Predictor:
     jittable: bool = True
     example_input: Callable[[int], Any] | None = None  # batch_size -> inputs
     metadata: dict = field(default_factory=dict)
+    # Causal-LM handles ({"params", "cfg", "eos_id"?}) for flavors that
+    # support autoregressive decoding: the server builds a continuous-
+    # batching GenerationEngine from these and exposes /generate.
+    causal_lm: dict | None = None
 
 
 _BUILDERS: dict[str, Callable[..., Predictor]] = {}
@@ -191,8 +195,19 @@ def _build_resnet(params: Any, cfg: Any = None, image_size: int = 224, **_kw) ->
 
 
 @register("llama-generate")
-def _build_llama(params: Any, cfg: Any, max_new_tokens: int = 64, **_kw) -> Predictor:
+def _build_llama(
+    params: Any,
+    cfg: Any,
+    max_new_tokens: int = 64,
+    eos_id: int | None = None,
+    **_kw,
+) -> Predictor:
     from . import llama
+
+    # The batch predict path pairs a fixed example prompt length with a
+    # fixed generation budget; both must fit the KV-cache capacity.
+    example_len = min(16, cfg.max_seq // 4)
+    max_new_tokens = min(max_new_tokens, cfg.max_seq - example_len)
 
     def predict(prompt_ids):
         return llama.generate_greedy(params, prompt_ids, max_new_tokens, cfg)
@@ -201,6 +216,7 @@ def _build_llama(params: Any, cfg: Any, max_new_tokens: int = 64, **_kw) -> Pred
         name="llama-generate",
         predict=predict,
         jittable=True,
-        example_input=lambda b: np.ones((b, 16), np.int32),
+        example_input=lambda b: np.ones((b, example_len), np.int32),
         metadata={"max_new_tokens": max_new_tokens, "max_seq": cfg.max_seq},
+        causal_lm={"params": params, "cfg": cfg, "eos_id": eos_id},
     )

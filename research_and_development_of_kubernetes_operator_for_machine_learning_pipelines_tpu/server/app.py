@@ -66,11 +66,13 @@ class TpuInferenceServer:
         model_name: str,
         max_batch_size: int = 32,
         max_batch_delay_ms: float = 5.0,
+        gen_engine=None,
     ):
         self.engine = engine
         self.metrics = metrics
         self.model_name = model_name
         self.ready = False
+        self.gen_engine = gen_engine  # GenerationEngine for causal-LM flavors
         self.batcher = DynamicBatcher(
             run_batch=engine.predict,
             max_batch_size=max_batch_size,
@@ -83,6 +85,8 @@ class TpuInferenceServer:
     def startup(self, warmup: bool = True) -> None:
         if warmup:
             self.engine.warmup()
+        if self.gen_engine is not None:
+            self.gen_engine.start(warmup=warmup)
         self.batcher.start()
         self.ready = True
         self.metrics.ready.labels(**self.metrics.identity).set(1)
@@ -90,6 +94,8 @@ class TpuInferenceServer:
     def shutdown(self) -> None:
         self.ready = False
         self.batcher.stop()
+        if self.gen_engine is not None:
+            self.gen_engine.shutdown()
         if hasattr(self.engine, "shutdown"):
             # multi-host leader: release follower processes after the
             # batcher has drained (no more broadcasts can follow)
@@ -193,6 +199,90 @@ class TpuInferenceServer:
         finally:
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
 
+    async def handle_generate(self, request: web.Request) -> web.Response:
+        """Text generation with continuous batching (causal-LM flavors only).
+
+        Accepts either the simple form ``{"prompt_ids": [[...]], "max_new_tokens": N,
+        "eos_id": E?}`` (``prompt_ids`` may be one sequence or a list of
+        sequences) or a V2-style tensor ``{"inputs": [{"name": "prompt_ids",
+        ...}], "parameters": {"max_new_tokens": N}}``.  Sequences in one
+        request are scheduled independently — they share decode steps with
+        every other in-flight request, not just each other.
+        """
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            if self.gen_engine is None:
+                code = 400
+                return web.json_response(
+                    {"error": f"model {self.model_name} is not a causal LM"},
+                    status=400,
+                )
+            body = await request.json()
+            if "inputs" in body:
+                tensors = {
+                    t["name"]: np.asarray(t["data"], np.int32).reshape(t["shape"])
+                    for t in body["inputs"]
+                }
+                if "prompt_ids" not in tensors:
+                    raise ValueError('missing input tensor "prompt_ids"')
+                rows = tensors["prompt_ids"]
+                if "lengths" in tensors:
+                    # Explicit per-row lengths disambiguate right-padding
+                    # from legitimate trailing 0 tokens.
+                    lens = tensors["lengths"].reshape(-1)
+                    if lens.size != rows.shape[0]:
+                        raise ValueError(
+                            f'"lengths" has {lens.size} entries for '
+                            f"{rows.shape[0]} prompt rows"
+                        )
+                    prompts = [row[: int(n)] for row, n in zip(rows, lens)]
+                else:
+                    # Fallback: strip trailing zeros (document: send
+                    # "lengths" if 0 is a real token in your vocabulary).
+                    prompts = [np.trim_zeros(row, "b") for row in rows]
+                params = body.get("parameters", {})
+            else:
+                raw = body["prompt_ids"]
+                prompts = [raw] if raw and np.isscalar(raw[0]) else list(raw)
+                params = body
+            max_new = int(params.get("max_new_tokens", 16))
+            eos_id = params.get("eos_id")
+            eos_id = int(eos_id) if eos_id is not None else None
+            # Validate every prompt BEFORE admitting any: a bad sibling must
+            # not leave earlier ones generating into abandoned futures.
+            prompts = [self.gen_engine.validate(p, max_new) for p in prompts]
+            futures = [
+                self.gen_engine.submit(p, max_new, eos_id) for p in prompts
+            ]
+            outs = await asyncio.gather(
+                *(asyncio.wrap_future(f) for f in futures)
+            )
+            return web.json_response(
+                {
+                    "model_name": self.model_name,
+                    "id": body.get("id", ""),
+                    "outputs": [
+                        {
+                            "name": f"output_ids_{i}",
+                            "datatype": "INT32",
+                            "shape": [int(o.size)],
+                            "data": o.tolist(),
+                        }
+                        for i, o in enumerate(outs)
+                    ],
+                }
+            )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            code = 400
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            _log.exception("generation failed")
+            code = 500
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self.metrics.observe_request(time.perf_counter() - t0, code=code)
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(
             body=self.metrics.exposition(),
@@ -229,6 +319,8 @@ class TpuInferenceServer:
         app.router.add_get(f"/v2/models/{name}", self.handle_model_metadata)
         app.router.add_get(f"/v2/models/{name}/ready", self.handle_ready)
         app.router.add_post(f"/v2/models/{name}/infer", self.handle_v2_infer)
+        if self.gen_engine is not None:
+            app.router.add_post(f"/v2/models/{name}/generate", self.handle_generate)
         app.router.add_post("/api/v1.0/predictions", self.handle_seldon_predict)
         app.router.add_get("/metrics", self.handle_metrics)
 
@@ -320,12 +412,34 @@ def build_server(
         from .multihost import MultihostEngine
 
         engine = MultihostEngine(engine, transport)
+    gen_engine = None
+    if predictor.causal_lm is not None:
+        if transport is None:
+            from .generation import GenerationEngine
+
+            gen_engine = GenerationEngine(
+                predictor.causal_lm["params"],
+                predictor.causal_lm["cfg"],
+                max_slots=min(config.tpu.max_batch_size, 8),
+                eos_id=predictor.causal_lm.get("eos_id"),
+                on_step=metrics.observe_decode_step,
+                on_tokens=metrics.inc_generated_tokens,
+            )
+        else:
+            # Multi-host units broadcast engine.predict calls only; the
+            # continuous-batching scheduler is single-host for now, so fall
+            # back to the whole-sequence predict path on those units.
+            _log.warning(
+                "continuous batching disabled on multi-host unit; "
+                "/generate not served"
+            )
     server = TpuInferenceServer(
         engine,
         metrics,
         model_name=config.model_name,
         max_batch_size=config.tpu.max_batch_size,
         max_batch_delay_ms=config.tpu.max_batch_delay_ms,
+        gen_engine=gen_engine,
     )
     server.startup(warmup=warmup)
     return server

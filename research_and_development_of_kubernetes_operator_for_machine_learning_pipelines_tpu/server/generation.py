@@ -1,0 +1,379 @@
+"""Continuous-batching text-generation engine (baseline config 4).
+
+The reference serves every model as stateless request/response through
+Seldon's ``MLFLOW_SERVER`` (``mlflow_operator.py:198``) — it has no notion
+of autoregressive decoding.  A TPU data plane serving Llama-class models
+needs one: without cross-request batching, each decode step is a batch-1
+matmul that leaves the MXU ~idle, and chip utilization collapses.
+
+Design (vLLM-style scheduling, TPU-static shapes):
+
+- The engine owns a :class:`~..models.llama.RaggedKVCache` with a fixed
+  number of batch rows ("slots").  Every device computation has a static
+  shape — slot count, cache capacity, and prefill bucket lengths are all
+  fixed at compile time, so XLA compiles each program exactly once.
+- A new request is right-padded to a power-of-two bucket, prefilled as
+  batch 1, and its K/V inserted into a free slot (one fused+donated jit
+  per bucket).  Padding beyond the real length is progressively
+  overwritten by decode writes before it can ever be attended — see
+  ``decode_ragged``'s slot-reuse note.
+- Every scheduler tick runs ONE batched decode step over all slots at
+  their own positions (``lengths`` is per-row).  Requests join and leave
+  between ticks; a slot frees as soon as its request finishes, and the
+  next queued request takes it — no barrier on batch completion
+  ("continuous batching").
+- Inactive slots still compute (the MXU does not care) and advance
+  nothing; their sampled tokens are discarded host-side.
+
+The big cache buffers are donated through both jitted programs, so steady
+state allocates no new HBM per token.  Greedy decoding only — matching
+``llama.generate_greedy`` exactly (tested in float64, where no backend
+fast-math can blur the comparison).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import numpy as np
+
+_log = logging.getLogger("tpumlops.generation")
+
+_MIN_BUCKET = 16
+
+
+def prefill_bucket(length: int, capacity: int) -> int:
+    """Power-of-two prompt bucket (>= _MIN_BUCKET, <= cache capacity)."""
+    from .batching import next_bucket
+
+    return min(max(_MIN_BUCKET, next_bucket(length, capacity)), capacity)
+
+
+@dataclass
+class _Slot:
+    future: Future
+    remaining: int  # new tokens still to produce
+    eos_id: int | None
+    generated: list[int] = field(default_factory=list)
+    t_start: float = 0.0
+
+
+@dataclass
+class _Request:
+    prompt: np.ndarray  # int32 [L]
+    max_new_tokens: int
+    eos_id: int | None
+    future: Future
+
+
+class GenerationEngine:
+    """Schedules concurrent generation requests onto one ragged KV cache.
+
+    ``submit`` is thread-safe and returns a ``concurrent.futures.Future``
+    resolving to the generated token ids (``np.ndarray[int32]``); the
+    aiohttp handler awaits it via ``asyncio.wrap_future``.  All JAX work
+    happens on the single scheduler thread.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        *,
+        max_slots: int = 4,
+        dtype=None,
+        eos_id: int | None = None,
+        on_step: Callable[[int, float], None] | None = None,
+        on_tokens: Callable[[int], None] | None = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        self._params = params
+        self._cfg = cfg
+        self._eos_default = eos_id
+        self._on_step = on_step  # (active_slots, step_seconds) per decode tick
+        self._on_tokens = on_tokens  # (n,) per token delivered to a client
+        self._in_warmup = False  # suppress metrics/counters during warmup
+        self.max_slots = int(max_slots)
+        self.capacity = int(cfg.max_seq)
+        dtype = dtype or jnp.bfloat16
+        self._dtype = dtype
+        self._reset_device_state()
+
+        def _decode(params, toks, k, v, lengths, active):
+            cache = llama.RaggedKVCache(k, v, lengths)
+            logits, cache = llama.decode_ragged(
+                params, toks, cache, cfg, active=active, dtype=dtype
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            # Finished slots keep their last token so their rows stay inert.
+            toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
+            return toks2, cache.k, cache.v, cache.lengths
+
+        self._decode = jax.jit(_decode, donate_argnums=(2, 3))
+
+        def _prefill_insert(params, ids, k, v, lengths, toks, slot, actual_len):
+            logits, seq = llama.prefill(params, ids, cfg, dtype=dtype)
+            cache = llama.insert_sequence(
+                llama.RaggedKVCache(k, v, lengths), seq, slot, actual_len
+            )
+            first = jnp.argmax(logits[0, actual_len - 1]).astype(jnp.int32)
+            toks2 = toks.at[slot, 0].set(first)
+            return cache.k, cache.v, cache.lengths, toks2, first
+
+        # One compiled program per prompt bucket (jit caches by ids shape).
+        self._prefill_insert = jax.jit(_prefill_insert, donate_argnums=(2, 3))
+
+        self._slots: list[_Slot | None] = [None] * self.max_slots
+        self._queue: queue.Queue[_Request | None] = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.tokens_generated = 0
+
+    def _reset_device_state(self) -> None:
+        """(Re)allocate the KV cache and token buffers.
+
+        Also the recovery path after a failed jitted step: donation has
+        already invalidated the old buffers, so continuing with them would
+        raise "Array has been deleted" on every subsequent request."""
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        cache = llama.RaggedKVCache.create(self._cfg, self.max_slots, self._dtype)
+        self._cache_k, self._cache_v = cache.k, cache.v
+        self._lengths = cache.lengths
+        self._tokens = jnp.zeros((self.max_slots, 1), jnp.int32)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> None:
+        if warmup:
+            self._warmup()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="generation-scheduler"
+        )
+        self._thread.start()
+
+    def _warmup(self) -> None:
+        """Compile the decode program and the smallest prefill bucket before
+        readiness, so no live request pays an XLA compile (the persistent
+        compile cache makes this near-instant on a warm node)."""
+        t0 = time.perf_counter()
+        self._in_warmup = True
+        try:
+            self._admit(
+                _Request(
+                    prompt=np.array([1], np.int32),
+                    max_new_tokens=2,
+                    eos_id=None,
+                    future=Future(),
+                )
+            )
+            self._step()
+        finally:
+            self._in_warmup = False
+        # Reset state so warmup tokens never leak into a real response.
+        slot = self._slots[0]
+        if slot is not None:
+            slot.future.cancel()
+        self._slots = [None] * self.max_slots
+        _log.info("generation warmup in %.1fs", time.perf_counter() - t0)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._queue.put(None)  # unblock the scheduler
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        for slot in self._slots:
+            if slot is not None and not slot.future.done():
+                slot.future.cancel()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None and not req.future.done():
+                req.future.cancel()
+
+    # -- client API ----------------------------------------------------------
+
+    def validate(
+        self, prompt_ids: Sequence[int], max_new_tokens: int
+    ) -> np.ndarray:
+        """Check a request without admitting it; returns the int32 prompt.
+
+        Callers batching several prompts into one HTTP request validate ALL
+        of them first, so a bad one rejects the request before any sibling
+        has been admitted and left generating into an abandoned future.
+        """
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        total = prompt.size + max_new_tokens
+        if total > self.capacity:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"= {total} exceeds KV-cache capacity {self.capacity}"
+            )
+        return prompt
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        eos_id: int | None = None,
+    ) -> Future:
+        prompt = self.validate(prompt_ids, max_new_tokens)
+        fut: Future = Future()
+        self._queue.put(
+            _Request(prompt, int(max_new_tokens), eos_id or self._eos_default, fut)
+        )
+        return fut
+
+    def generate(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        eos_id: int | None = None,
+        timeout: float | None = 120.0,
+    ) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(prompt_ids, max_new_tokens, eos_id).result(timeout)
+
+    # -- scheduler -----------------------------------------------------------
+
+    def _free_slot(self) -> int | None:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self, req: _Request) -> None:
+        import jax.numpy as jnp
+
+        slot_idx = self._free_slot()
+        assert slot_idx is not None
+        L = int(req.prompt.size)
+        bucket = prefill_bucket(L, self.capacity)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :L] = req.prompt
+        t0 = time.perf_counter()
+        (
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._tokens,
+            first,
+        ) = self._prefill_insert(
+            self._params,
+            jnp.asarray(ids),
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._tokens,
+            jnp.int32(slot_idx),
+            jnp.int32(L),
+        )
+        slot = _Slot(
+            future=req.future,
+            remaining=req.max_new_tokens,
+            eos_id=req.eos_id,
+            t_start=t0,
+        )
+        self._slots[slot_idx] = slot
+        self._record_token(slot_idx, int(first))
+
+    def _record_token(self, slot_idx: int, token: int) -> None:
+        slot = self._slots[slot_idx]
+        assert slot is not None
+        slot.generated.append(token)
+        slot.remaining -= 1
+        if not self._in_warmup:
+            self.tokens_generated += 1
+            if self._on_tokens is not None:
+                self._on_tokens(1)
+        done = slot.remaining <= 0 or (
+            slot.eos_id is not None and token == slot.eos_id
+        )
+        if done:
+            if not slot.future.cancelled():
+                slot.future.set_result(np.asarray(slot.generated, np.int32))
+            self._slots[slot_idx] = None
+
+    def _step(self) -> None:
+        """One batched decode tick over every occupied slot."""
+        import jax.numpy as jnp
+
+        active_np = np.array([s is not None for s in self._slots])
+        if not active_np.any():
+            return
+        t0 = time.perf_counter()
+        self._tokens, self._cache_k, self._cache_v, self._lengths = self._decode(
+            self._params,
+            self._tokens,
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            jnp.asarray(active_np),
+        )
+        toks = np.asarray(self._tokens)[:, 0]
+        if self._on_step is not None and not self._in_warmup:
+            self._on_step(int(active_np.sum()), time.perf_counter() - t0)
+        for i, was_active in enumerate(active_np):
+            if was_active and self._slots[i] is not None:
+                self._record_token(i, int(toks[i]))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            # Admit as many queued requests as there are free slots.
+            while self._free_slot() is not None:
+                try:
+                    block = all(s is None for s in self._slots)
+                    req = self._queue.get(block=block, timeout=1.0)
+                except queue.Empty:
+                    break
+                if req is None or self._stop.is_set():
+                    return
+                try:
+                    self._admit(req)
+                except Exception as exc:  # keep the scheduler alive
+                    _log.exception("admit failed")
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+                    self._fail_all_and_recover()
+            try:
+                self._step()
+            except Exception:
+                _log.exception("decode step failed")
+                self._fail_all_and_recover()
+
+    def _fail_all_and_recover(self) -> None:
+        """Fail every in-flight sequence and reallocate device state.
+
+        A failed jitted call poisons all slots (their K/V history is part of
+        the donated buffers), and donation has ALREADY invalidated those
+        buffers — reusing them would raise "Array has been deleted" on every
+        later request, bricking the engine while /ready stays green.  Fresh
+        buffers restore service for subsequent requests."""
+        for i, slot in enumerate(self._slots):
+            if slot is not None and not slot.future.done():
+                slot.future.set_exception(
+                    RuntimeError("generation step failed; see server log")
+                )
+            self._slots[i] = None
+        try:
+            self._reset_device_state()
+        except Exception:
+            _log.exception("device state reallocation failed")

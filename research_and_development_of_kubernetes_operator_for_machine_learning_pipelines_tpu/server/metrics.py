@@ -84,6 +84,26 @@ class ServerMetrics:
             ident_labels,
             registry=self.registry,
         )
+        self.generated_tokens = Counter(
+            "tpumlops_generated_tokens_total",
+            "Tokens produced by the continuous-batching generation engine",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.decode_batch = Histogram(
+            "tpumlops_decode_batch_size",
+            "Active slots per continuous-batching decode step",
+            ident_labels,
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+            registry=self.registry,
+        )
+        self.decode_step_seconds = Histogram(
+            "tpumlops_decode_step_seconds",
+            "Wall time of one batched decode step",
+            ident_labels,
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
         self.ready = Gauge(
             "tpumlops_model_ready",
             "1 once the model is loaded and warmed",
@@ -102,6 +122,15 @@ class ServerMetrics:
     def observe_batch(self, size: int, queue_seconds: float):
         self.batch_size.labels(**self.identity).observe(size)
         self.queue_seconds.labels(**self.identity).observe(queue_seconds)
+
+    def observe_decode_step(self, active_slots: int, seconds: float):
+        self.decode_batch.labels(**self.identity).observe(active_slots)
+        self.decode_step_seconds.labels(**self.identity).observe(seconds)
+
+    def inc_generated_tokens(self, n: int = 1):
+        # Separate from observe_decode_step: the first token of every
+        # sequence comes from prefill, not a decode tick.
+        self.generated_tokens.labels(**self.identity).inc(n)
 
     def exposition(self) -> bytes:
         return generate_latest(self.registry)

@@ -56,6 +56,10 @@ def enable_persistent_compile_cache(
     import jax
 
     if not cache_dir:
+        # JAX reads JAX_COMPILATION_CACHE_DIR as this option's import-time
+        # default; clear it so "disabled" really disables, even when the
+        # manifest exported the env var.
+        jax.config.update("jax_compilation_cache_dir", None)
         return False
     try:
         os.makedirs(cache_dir, exist_ok=True)

@@ -314,3 +314,141 @@ def test_loader_sniffs_forest_flavor(tmp_path):
     assert pred.name == "sklearn-forest"
     out = np.asarray(pred.predict(np.asarray(X[:8], np.float32)))
     np.testing.assert_allclose(out, sk.predict(X[:8]), rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# /generate endpoint (continuous batching, causal-LM flavors)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def llm_server(tmp_path_factory):
+    import jax
+
+    from tpumlops.models import llama
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(3), cfg)
+    art = tmp_path_factory.mktemp("artifacts") / "llm"
+    save_native_model(
+        art,
+        "llama-generate",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+    )
+    config = ServerConfig(
+        model_name="llm",
+        model_uri=str(art),
+        predictor_name="v1",
+        deployment_name="llm",
+        namespace="models",
+        tpu=TpuSpec.from_spec({"meshShape": {"tp": 1}, "maxBatchSize": 4}),
+    )
+    server = build_server(config)
+    handle = serve(server)
+    yield handle
+    handle.stop()
+
+
+def test_generate_endpoint_simple_form(llm_server):
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 6},
+        timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    out = resp.json()["outputs"][0]
+    assert out["datatype"] == "INT32"
+    assert out["shape"] == [6]
+    assert len(out["data"]) == 6
+
+
+def test_generate_endpoint_multi_sequence_and_v2_form(llm_server):
+    # two sequences in one request, V2 tensor form (zero-padded rows)
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={
+            "inputs": [
+                {
+                    "name": "prompt_ids",
+                    "datatype": "INT32",
+                    "shape": [2, 4],
+                    "data": [5, 9, 2, 0, 7, 1, 4, 8],
+                }
+            ],
+            "parameters": {"max_new_tokens": 4},
+        },
+        timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    outs = resp.json()["outputs"]
+    assert len(outs) == 2
+    assert all(len(o["data"]) == 4 for o in outs)
+
+
+def test_generate_endpoint_validation_and_metrics(llm_server):
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": list(range(60)), "max_new_tokens": 30},
+        timeout=30,
+    )
+    assert resp.status_code == 400
+    assert "capacity" in resp.json()["error"]
+    text = httpx.get(llm_server.base + "/metrics", timeout=10).text
+    assert "tpumlops_generated_tokens_total" in text
+    assert "tpumlops_decode_step_seconds" in text
+
+
+def test_generate_route_absent_for_non_llm(iris_server):
+    handle, *_ = iris_server
+    resp = httpx.post(
+        handle.base + "/v2/models/iris/generate",
+        json={"prompt_ids": [1], "max_new_tokens": 2},
+        timeout=10,
+    )
+    assert resp.status_code in (404, 405)
+
+
+def test_generate_v2_lengths_tensor_preserves_zero_tokens(llm_server):
+    # Row [5, 0, 9] with lengths=[3]: token 0 is REAL, not padding.
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={
+            "inputs": [
+                {"name": "prompt_ids", "datatype": "INT32", "shape": [1, 4],
+                 "data": [5, 0, 9, 0]},
+                {"name": "lengths", "datatype": "INT32", "shape": [1],
+                 "data": [3]},
+            ],
+            "parameters": {"max_new_tokens": 3},
+        },
+        timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    assert len(resp.json()["outputs"][0]["data"]) == 3
+
+
+def test_generate_batch_validation_is_atomic(llm_server):
+    # Second prompt exceeds capacity -> whole request 400s, and the engine
+    # still serves afterwards (first prompt was never admitted).
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [[1, 2, 3], list(range(1, 61))],
+              "max_new_tokens": 30},
+        timeout=30,
+    )
+    assert resp.status_code == 400
+    ok = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [1, 2, 3], "max_new_tokens": 2},
+        timeout=60,
+    )
+    assert ok.status_code == 200
