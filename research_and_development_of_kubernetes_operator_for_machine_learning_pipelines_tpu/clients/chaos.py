@@ -1,0 +1,87 @@
+"""Fault injection for client protocols (test/chaos harness).
+
+The reference has no fault injection of any kind (SURVEY §5) and its
+promotion loop dies on the first unhandled Prometheus/MLflow exception
+(``mlflow_operator.py`` only try/excepts the alias lookup, ``:58-62``).
+The rebuild's recovery guarantees — reconcile backoff, resumable promotion
+state, alias self-healing — are only guarantees if they're exercised, so
+this wrapper makes any injected client (kube / registry / metrics) fail on
+a script.
+
+``FaultInjector`` proxies every attribute of the wrapped client; scheduled
+faults are consumed per method call:
+
+    metrics = FaultInjector(FakeMetrics())
+    metrics.fail("model_metrics", ApiError(503, "prom down"), times=4)
+    ...
+    metrics.fail_if("apply", lambda ns, name: name == "canary", Conflict(...))
+
+Works against the fakes in tests and equally against the real REST clients
+for in-cluster chaos runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+class FaultInjector:
+    def __init__(self, target: Any):
+        self._target = target
+        self._lock = threading.Lock()
+        self._scheduled: dict[str, list[Exception]] = {}
+        self._conditional: dict[str, list[tuple[Callable[..., bool], Exception]]] = {}
+        self.calls: list[tuple[str, tuple, dict]] = []
+        self.faults_fired: int = 0
+
+    # -- scheduling ----------------------------------------------------------
+
+    def fail(self, method: str, exc: Exception, times: int = 1) -> None:
+        """Fail the next ``times`` calls of ``method`` with ``exc``."""
+        with self._lock:
+            self._scheduled.setdefault(method, []).extend([exc] * times)
+
+    def fail_if(
+        self, method: str, predicate: Callable[..., bool], exc: Exception
+    ) -> None:
+        """Fail any call of ``method`` whose arguments satisfy ``predicate``
+        (checked after scheduled faults; not consumed — fires every time)."""
+        with self._lock:
+            self._conditional.setdefault(method, []).append((predicate, exc))
+
+    def clear(self, method: str | None = None) -> None:
+        with self._lock:
+            if method is None:
+                self._scheduled.clear()
+                self._conditional.clear()
+            else:
+                self._scheduled.pop(method, None)
+                self._conditional.pop(method, None)
+
+    def pending(self, method: str) -> int:
+        with self._lock:
+            return len(self._scheduled.get(method, []))
+
+    # -- proxying ------------------------------------------------------------
+
+    def __getattr__(self, attr: str) -> Any:
+        value = getattr(self._target, attr)
+        if not callable(value):
+            return value
+
+        def wrapper(*args, **kwargs):
+            with self._lock:
+                queued = self._scheduled.get(attr)
+                if queued:
+                    exc = queued.pop(0)
+                    self.faults_fired += 1
+                    raise exc
+                for predicate, exc in self._conditional.get(attr, []):
+                    if predicate(*args, **kwargs):
+                        self.faults_fired += 1
+                        raise exc
+                self.calls.append((attr, args, kwargs))
+            return value(*args, **kwargs)
+
+        return wrapper

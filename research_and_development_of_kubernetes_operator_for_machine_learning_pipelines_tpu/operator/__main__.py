@@ -20,6 +20,12 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--sync-interval", type=float, default=5.0)
     ap.add_argument("--kube-url", default=None, help="API server URL (default in-cluster)")
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument(
+        "--metrics-port",
+        type=int,
+        default=8080,
+        help="operator self-metrics /metrics listener; 0 disables",
+    )
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -32,9 +38,13 @@ def main(argv: list[str] | None = None) -> None:
     from ..clients.mlflow_rest import MlflowRestClient
     from ..clients.prom_http import PrometheusSource
     from .runtime import OperatorRuntime
+    from .telemetry import OperatorTelemetry
 
     kube = KubeRestClient(base_url=args.kube_url)
     registry = MlflowRestClient()
+    telemetry = OperatorTelemetry()
+    if args.metrics_port:
+        telemetry.serve(args.metrics_port)
 
     sources: dict[str, PrometheusSource] = {}
 
@@ -50,6 +60,7 @@ def main(argv: list[str] | None = None) -> None:
         warmup=DataPlaneWarmup(),
         namespace=args.namespace,
         sync_interval_s=args.sync_interval,
+        telemetry=telemetry,
     )
     runtime.serve()
 

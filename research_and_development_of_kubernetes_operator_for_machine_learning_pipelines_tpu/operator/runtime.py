@@ -62,6 +62,7 @@ class OperatorRuntime:
         sync_interval_s: float = 5.0,
         metrics_factory=None,
         warmup=None,
+        telemetry=None,
     ):
         if metrics is None and metrics_factory is None:
             raise ValueError(
@@ -73,6 +74,7 @@ class OperatorRuntime:
         self.metrics = metrics
         self.metrics_factory = metrics_factory
         self.warmup = warmup
+        self.telemetry = telemetry  # OperatorTelemetry | None (SURVEY §5)
         self.clock = clock or SystemClock()
         self.namespace = namespace
         self.sync_interval_s = sync_interval_s
@@ -118,6 +120,8 @@ class OperatorRuntime:
                         entry.reconciler._delete_deployment()
                     except Exception:
                         _log.exception("teardown of %s/%s failed", ns, name)
+                    if self.telemetry is not None:
+                        self.telemetry.forget(ns, name)
 
     # -- stepping ------------------------------------------------------------
 
@@ -138,6 +142,7 @@ class OperatorRuntime:
             due = [(k, e) for k, e in self._entries.items() if e.due_at <= now]
         for key, entry in due:
             ns, name = key
+            t0 = time.perf_counter()
             try:
                 obj = self.kube.get(
                     ObjectRef(namespace=ns, name=name, **MLFLOWMODEL)
@@ -145,12 +150,20 @@ class OperatorRuntime:
                 outcome = entry.reconciler.reconcile(dict(obj))
                 entry.failures = 0
                 entry.due_at = self.clock.now() + max(0.0, outcome.requeue_after)
+                if self.telemetry is not None:
+                    self.telemetry.record_outcome(
+                        ns, name, outcome, time.perf_counter() - t0
+                    )
             except NotFound:
                 continue  # sync() on the next step removes it
             except Exception:
                 entry.failures += 1
                 backoff = min(_MAX_BACKOFF_S, 2.0 ** entry.failures)
                 entry.due_at = self.clock.now() + backoff
+                if self.telemetry is not None:
+                    self.telemetry.record_failure(
+                        ns, name, time.perf_counter() - t0
+                    )
                 _log.exception(
                     "reconcile of %s/%s failed (attempt %d), backing off %.0fs",
                     ns,
@@ -159,6 +172,8 @@ class OperatorRuntime:
                     backoff,
                 )
         with self._lock:
+            if self.telemetry is not None:
+                self.telemetry.set_resource_count(len(self._entries))
             if not self._entries:
                 return None
             return max(0.0, min(e.due_at for e in self._entries.values()) - self.clock.now())

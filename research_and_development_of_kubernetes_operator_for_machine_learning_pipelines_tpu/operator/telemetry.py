@@ -1,0 +1,152 @@
+"""First-party operator metrics.
+
+The reference *consumes* Prometheus but exports nothing about itself
+(SURVEY §5: "the operator exports no metrics of its own") — so an operator
+stuck in backoff, a promotion frozen mid-split, or a reconcile-latency
+regression is invisible until someone reads pod logs.  This module gives
+the control plane the same observability its data plane already has:
+
+- ``tpumlops_operator_reconcile_total{namespace,name,result}`` — steps by
+  outcome (``ok``/``error``);
+- ``tpumlops_operator_reconcile_seconds`` — step latency histogram
+  (the promotion-loop step timing SURVEY §5 calls for);
+- ``tpumlops_operator_phase{...,phase}`` — one-hot rollout phase per CR;
+- ``tpumlops_operator_traffic_percent`` — live canary split per CR
+  (time-to-100% — the north-star metric — is directly readable from this
+  series' history);
+- ``tpumlops_operator_promotions_total{...,outcome}`` — completed /
+  failed / rolled-back rollouts (from the same events the reference posts
+  to Kubernetes, ``mlflow_operator.py:344,:361``);
+- ``tpumlops_operator_resources`` — CRs currently managed.
+
+Wired into ``OperatorRuntime`` (zero-cost when not configured) and served
+by ``python -m <package>.operator --metrics-port``.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from .state import Phase
+
+_STEP_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0)
+
+# Event reasons that terminate a rollout, mapped to a promotion outcome.
+_TERMINAL_REASONS = {
+    "PromotionComplete": "completed",
+    "PromotionFailed": "failed",
+    "RolledBack": "rolled_back",
+}
+
+
+class OperatorTelemetry:
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        ident = ["namespace", "name"]
+        self.reconciles = Counter(
+            "tpumlops_operator_reconcile_total",
+            "Reconcile steps by result",
+            ident + ["result"],
+            registry=self.registry,
+        )
+        self.reconcile_seconds = Histogram(
+            "tpumlops_operator_reconcile_seconds",
+            "Wall time of one reconcile step",
+            ident,
+            buckets=_STEP_BUCKETS,
+            registry=self.registry,
+        )
+        self.phase = Gauge(
+            "tpumlops_operator_phase",
+            "Rollout phase (one-hot per CR)",
+            ident + ["phase"],
+            registry=self.registry,
+        )
+        self.traffic = Gauge(
+            "tpumlops_operator_traffic_percent",
+            "Traffic on the current (new) version",
+            ident,
+            registry=self.registry,
+        )
+        self.promotions = Counter(
+            "tpumlops_operator_promotions_total",
+            "Finished rollouts by outcome",
+            ident + ["outcome"],
+            registry=self.registry,
+        )
+        self.events = Counter(
+            "tpumlops_operator_events_total",
+            "Kubernetes events posted, by reason",
+            ident + ["reason"],
+            registry=self.registry,
+        )
+        self.resources = Gauge(
+            "tpumlops_operator_resources",
+            "MlflowModel resources currently managed",
+            registry=self.registry,
+        )
+
+    # -- recording (called by OperatorRuntime) -------------------------------
+
+    def record_outcome(self, namespace: str, name: str, outcome, seconds: float):
+        """Record a successful reconcile step and its resulting state."""
+        self.reconciles.labels(namespace=namespace, name=name, result="ok").inc()
+        self.reconcile_seconds.labels(namespace=namespace, name=name).observe(seconds)
+        state = outcome.state
+        for phase in Phase:
+            self.phase.labels(
+                namespace=namespace, name=name, phase=phase.value
+            ).set(1.0 if state.phase == phase else 0.0)
+        self.traffic.labels(namespace=namespace, name=name).set(
+            state.traffic_current
+        )
+        for event in outcome.events:
+            self.events.labels(
+                namespace=namespace, name=name, reason=event.reason
+            ).inc()
+            outcome_label = _TERMINAL_REASONS.get(event.reason)
+            if outcome_label:
+                self.promotions.labels(
+                    namespace=namespace, name=name, outcome=outcome_label
+                ).inc()
+
+    def record_failure(self, namespace: str, name: str, seconds: float):
+        self.reconciles.labels(namespace=namespace, name=name, result="error").inc()
+        self.reconcile_seconds.labels(namespace=namespace, name=name).observe(seconds)
+
+    def set_resource_count(self, n: int):
+        self.resources.set(n)
+
+    def forget(self, namespace: str, name: str):
+        """Drop a deleted CR's labeled series so /metrics stops exporting a
+        phantom model (a stale phase=Canary gauge would fire "canary stuck"
+        alerts forever)."""
+        for metric in (self.reconciles, self.promotions, self.events):
+            for labels in list(metric._metrics):  # label-value tuples
+                if labels[: 2] == (namespace, name):
+                    metric.remove(*labels)
+        for metric in (self.reconcile_seconds, self.traffic):
+            try:
+                metric.remove(namespace, name)
+            except KeyError:
+                pass
+        for phase in Phase:
+            try:
+                self.phase.remove(namespace, name, phase.value)
+            except KeyError:
+                pass
+
+    def exposition(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def serve(self, port: int, addr: str = "0.0.0.0"):
+        """Expose /metrics on a daemon-thread HTTP server."""
+        from prometheus_client import start_http_server
+
+        start_http_server(port, addr=addr, registry=self.registry)

@@ -245,6 +245,8 @@ class TpuInferenceServer:
             else:
                 raw = body["prompt_ids"]
                 prompts = [raw] if raw and np.isscalar(raw[0]) else list(raw)
+                if not prompts:
+                    raise ValueError("prompt_ids is empty")
                 params = body
             max_new = int(params.get("max_new_tokens", 16))
             eos_id = params.get("eos_id")

@@ -237,9 +237,9 @@ class GenerationEngine:
     ) -> Future:
         prompt = self.validate(prompt_ids, max_new_tokens)
         fut: Future = Future()
-        self._queue.put(
-            _Request(prompt, int(max_new_tokens), eos_id or self._eos_default, fut)
-        )
+        # None means "use the engine default"; 0 is a legitimate eos token.
+        eos = self._eos_default if eos_id is None else eos_id
+        self._queue.put(_Request(prompt, int(max_new_tokens), eos, fut))
         return fut
 
     def generate(
@@ -345,6 +345,11 @@ class GenerationEngine:
                 except queue.Empty:
                     break
                 if req is None or self._stop.is_set():
+                    # A real request dequeued during shutdown is in neither
+                    # the queue nor a slot — cancel it here or its client
+                    # awaits a future nobody will ever resolve.
+                    if req is not None and not req.future.done():
+                        req.future.cancel()
                     return
                 try:
                     self._admit(req)

@@ -1,0 +1,195 @@
+"""Fault injection against the control plane (SURVEY §5: the reference has
+none, and its promotion loop dies on the first unhandled backend exception
+— only the alias lookup is try/excepted, ``mlflow_operator.py:58-62``).
+
+Each test injects scripted failures through ``FaultInjector`` and asserts
+the rebuild's recovery guarantee: reconcile errors back off and RESUME,
+promotion state survives in status, and the operator's own telemetry
+records what happened.
+"""
+
+import pytest
+
+from tpumlops.clients.base import (
+    ApiError,
+    Conflict,
+    MLFLOWMODEL,
+    ModelMetrics,
+    ObjectRef,
+    RegistryError,
+    SELDONDEPLOYMENT,
+)
+from tpumlops.clients.chaos import FaultInjector
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator.runtime import OperatorRuntime
+from tpumlops.operator.state import Phase
+from tpumlops.operator.telemetry import OperatorTelemetry
+from tpumlops.utils.clock import FakeClock
+
+NS = "models"
+GOOD = ModelMetrics(
+    latency_p95=0.1, error_rate=0.01, latency_avg=0.05, request_count=500
+)
+
+
+def cr_ref(name="iris"):
+    return ObjectRef(namespace=NS, name=name, **MLFLOWMODEL)
+
+
+def sd_ref(name="iris"):
+    return ObjectRef(namespace=NS, name=name, **SELDONDEPLOYMENT)
+
+
+def make_world():
+    kube, registry, metrics, clock = (
+        FakeKube(),
+        FakeRegistry(),
+        FakeMetrics(),
+        FakeClock(),
+    )
+    kube.create(
+        cr_ref(),
+        {
+            "apiVersion": "mlflow.nizepart.com/v1alpha1",
+            "kind": "MlflowModel",
+            "metadata": {"name": "iris", "namespace": NS},
+            "spec": {"modelName": "iris", "modelAlias": "champion"},
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    return kube, registry, metrics, clock
+
+
+def start_canary(kube, registry, metrics, rt):
+    """Deploy v1 stable, then flip the alias to v2 with healthy metrics."""
+    rt.step()
+    registry.register("iris", "2", "mlflow-artifacts:/1/b/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics("iris", "v1", NS, GOOD)
+    metrics.set_metrics("iris", "v2", NS, GOOD)
+
+
+def test_prometheus_outage_mid_promotion_resumes():
+    """Prometheus 503s for several gate reads: the promotion must pause,
+    back off, and still reach 100% — with the outage visible in telemetry."""
+    kube, registry, metrics, clock = make_world()
+    chaotic_metrics = FaultInjector(metrics)
+    telemetry = OperatorTelemetry()
+    rt = OperatorRuntime(
+        kube, registry, chaotic_metrics, clock, telemetry=telemetry
+    )
+    start_canary(kube, registry, metrics, rt)
+    rt.run_for(3 * 60)  # canary underway
+    assert kube.get(cr_ref())["status"]["phase"] == Phase.CANARY.value
+
+    chaotic_metrics.fail(
+        "model_metrics", ApiError(503, "prometheus down"), times=6
+    )
+    rt.run_for(40 * 60)  # generous: outage adds backoff, not failure
+    assert chaotic_metrics.faults_fired == 6
+    status = kube.get(cr_ref())["status"]
+    assert status["phase"] == Phase.STABLE.value
+    assert status["currentModelVersion"] == "2"
+    sd = kube.get(sd_ref())
+    assert [p["name"] for p in sd["spec"]["predictors"]] == ["v2"]
+    # Telemetry saw both the errors and the completed promotion.
+    text = telemetry.exposition().decode()
+    assert 'result="error"' in text
+    assert (
+        'tpumlops_operator_promotions_total{name="iris",namespace="models",'
+        'outcome="completed"} 1.0' in text
+    )
+
+
+def test_registry_outage_mid_promotion_keeps_split_then_finishes():
+    """MLflow unreachable mid-canary: traffic split holds (no teardown, no
+    rollback) and promotion completes once the registry is back."""
+    kube, registry, metrics, clock = make_world()
+    chaotic_registry = FaultInjector(registry)
+    rt = OperatorRuntime(kube, registry, metrics, clock)
+    # Runtime builds reconcilers lazily; swap the registry it hands them.
+    rt.registry = chaotic_registry
+    start_canary(kube, registry, metrics, rt)
+    rt.run_for(3 * 60)
+    weights_before = {
+        p["name"]: p["traffic"]
+        for p in kube.get(sd_ref())["spec"]["predictors"]
+    }
+    assert len(weights_before) == 2
+
+    chaotic_registry.fail(
+        "get_version_by_alias", RegistryError("connection refused"), times=5
+    )
+    rt.run_for(60 * 60)
+    assert chaotic_registry.faults_fired == 5
+    status = kube.get(cr_ref())["status"]
+    assert status["phase"] == Phase.STABLE.value
+    assert status["currentModelVersion"] == "2"
+
+
+def test_kube_conflict_on_apply_is_retried():
+    """A 409 on the SeldonDeployment replace (another writer won) must not
+    kill the rollout: the next reconcile re-reads and re-applies."""
+    kube, registry, metrics, clock = make_world()
+    chaotic_kube = FaultInjector(kube)
+    rt = OperatorRuntime(chaotic_kube, registry, metrics, clock)
+    start_canary(kube, registry, metrics, rt)
+    rt.run_for(2 * 60)
+    chaotic_kube.fail("replace", Conflict("resourceVersion mismatch"), times=2)
+    rt.run_for(45 * 60)
+    assert chaotic_kube.faults_fired == 2
+    status = kube.get(cr_ref())["status"]
+    assert status["phase"] == Phase.STABLE.value
+    sd = kube.get(sd_ref())
+    assert [p["name"] for p in sd["spec"]["predictors"]] == ["v2"]
+
+
+def test_injector_conditional_faults_and_passthrough():
+    metrics = FakeMetrics()
+    metrics.set_metrics("d", "v1", NS, GOOD)
+    inj = FaultInjector(metrics)
+    inj.fail_if(
+        "model_metrics",
+        lambda deployment, predictor, namespace, **kw: predictor == "v2",
+        ApiError(500, "v2 only"),
+    )
+    assert inj.model_metrics("d", "v1", NS).request_count == 500
+    with pytest.raises(ApiError):
+        inj.model_metrics("d", "v2", NS)
+    assert inj.faults_fired == 1
+    assert [c[0] for c in inj.calls] == ["model_metrics"]
+
+
+def test_telemetry_phase_one_hot_and_traffic_gauge():
+    kube, registry, metrics, clock = make_world()
+    telemetry = OperatorTelemetry()
+    rt = OperatorRuntime(kube, registry, metrics, clock, telemetry=telemetry)
+    start_canary(kube, registry, metrics, rt)
+    rt.run_for(2 * 60)
+    text = telemetry.exposition().decode()
+    assert (
+        'tpumlops_operator_phase{name="iris",namespace="models",'
+        'phase="Canary"} 1.0' in text
+    )
+    assert (
+        'tpumlops_operator_phase{name="iris",namespace="models",'
+        'phase="Stable"} 0.0' in text
+    )
+    assert "tpumlops_operator_traffic_percent" in text
+    assert "tpumlops_operator_reconcile_seconds" in text
+    assert "tpumlops_operator_resources 1.0" in text
+
+
+def test_telemetry_forgets_deleted_cr():
+    kube, registry, metrics, clock = make_world()
+    telemetry = OperatorTelemetry()
+    rt = OperatorRuntime(kube, registry, metrics, clock, telemetry=telemetry)
+    start_canary(kube, registry, metrics, rt)
+    rt.run_for(2 * 60)
+    assert 'phase="Canary"} 1.0' in telemetry.exposition().decode()
+    kube.delete(cr_ref())
+    rt.run_for(10)
+    text = telemetry.exposition().decode()
+    assert 'name="iris"' not in text  # no phantom series for a deleted CR
+    assert "tpumlops_operator_resources 0.0" in text

@@ -227,3 +227,20 @@ def test_engine_recovers_after_failed_step(tiny):
         assert engine.generate([5, 9, 2], 4).tolist() == ref
     finally:
         engine.shutdown()
+
+
+def test_engine_eos_zero_is_respected(tiny):
+    """eos_id=0 must not fall back to the engine default (falsy-zero)."""
+    params, cfg = tiny
+    # default eos would never match; explicit 0 must be honored when it
+    # appears in the output
+    ref = _ref(params, cfg, [5, 9, 2], 8)
+    engine = GenerationEngine(
+        params, cfg, max_slots=2, dtype=jnp.float64, eos_id=None
+    )
+    engine.start(warmup=False)
+    try:
+        out = engine.generate([5, 9, 2], 8, eos_id=ref[1]).tolist()
+    finally:
+        engine.shutdown()
+    assert out == ref[: 2]
