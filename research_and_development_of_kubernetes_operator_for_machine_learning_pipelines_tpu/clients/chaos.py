@@ -8,13 +8,16 @@ state, alias self-healing — are only guarantees if they're exercised, so
 this wrapper makes any injected client (kube / registry / metrics) fail on
 a script.
 
-``FaultInjector`` proxies every attribute of the wrapped client; scheduled
-faults are consumed per method call:
+``FaultInjector`` proxies attributes of the wrapped client; its own
+control surface is ``inject_``-prefixed so it can never shadow a wrapped
+method (e.g. ``FakeMetrics.clear``).  Scheduled faults are consumed per
+method call:
 
     metrics = FaultInjector(FakeMetrics())
-    metrics.fail("model_metrics", ApiError(503, "prom down"), times=4)
+    metrics.inject_fail("model_metrics", ApiError(503, "prom down"), times=4)
     ...
-    metrics.fail_if("apply", lambda ns, name: name == "canary", Conflict(...))
+    metrics.inject_fail_if("apply", lambda ns, name: name == "canary",
+                           Conflict(...))
 
 Works against the fakes in tests and equally against the real REST clients
 for in-cluster chaos runs.
@@ -32,17 +35,17 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._scheduled: dict[str, list[Exception]] = {}
         self._conditional: dict[str, list[tuple[Callable[..., bool], Exception]]] = {}
-        self.calls: list[tuple[str, tuple, dict]] = []
+        self.proxy_calls: list[tuple[str, tuple, dict]] = []
         self.faults_fired: int = 0
 
     # -- scheduling ----------------------------------------------------------
 
-    def fail(self, method: str, exc: Exception, times: int = 1) -> None:
+    def inject_fail(self, method: str, exc: Exception, times: int = 1) -> None:
         """Fail the next ``times`` calls of ``method`` with ``exc``."""
         with self._lock:
             self._scheduled.setdefault(method, []).extend([exc] * times)
 
-    def fail_if(
+    def inject_fail_if(
         self, method: str, predicate: Callable[..., bool], exc: Exception
     ) -> None:
         """Fail any call of ``method`` whose arguments satisfy ``predicate``
@@ -50,7 +53,7 @@ class FaultInjector:
         with self._lock:
             self._conditional.setdefault(method, []).append((predicate, exc))
 
-    def clear(self, method: str | None = None) -> None:
+    def inject_clear(self, method: str | None = None) -> None:
         with self._lock:
             if method is None:
                 self._scheduled.clear()
@@ -59,7 +62,7 @@ class FaultInjector:
                 self._scheduled.pop(method, None)
                 self._conditional.pop(method, None)
 
-    def pending(self, method: str) -> int:
+    def inject_pending(self, method: str) -> int:
         with self._lock:
             return len(self._scheduled.get(method, []))
 
@@ -81,7 +84,7 @@ class FaultInjector:
                     if predicate(*args, **kwargs):
                         self.faults_fired += 1
                         raise exc
-                self.calls.append((attr, args, kwargs))
+                self.proxy_calls.append((attr, args, kwargs))
             return value(*args, **kwargs)
 
         return wrapper

@@ -91,34 +91,39 @@ class OperatorTelemetry:
             "MlflowModel resources currently managed",
             registry=self.registry,
         )
+        # Every labeled series this object has minted, keyed by CR, so
+        # forget() can prune with the public remove() API only (no reaching
+        # into prometheus_client internals).
+        self._series: dict[tuple[str, str], set] = {}
+
+    def _child(self, metric, namespace: str, name: str, *extra: str):
+        values = (namespace, name, *extra)
+        self._series.setdefault((namespace, name), set()).add((metric, values))
+        return metric.labels(*values)
 
     # -- recording (called by OperatorRuntime) -------------------------------
 
     def record_outcome(self, namespace: str, name: str, outcome, seconds: float):
         """Record a successful reconcile step and its resulting state."""
-        self.reconciles.labels(namespace=namespace, name=name, result="ok").inc()
-        self.reconcile_seconds.labels(namespace=namespace, name=name).observe(seconds)
+        self._child(self.reconciles, namespace, name, "ok").inc()
+        self._child(self.reconcile_seconds, namespace, name).observe(seconds)
         state = outcome.state
         for phase in Phase:
-            self.phase.labels(
-                namespace=namespace, name=name, phase=phase.value
-            ).set(1.0 if state.phase == phase else 0.0)
-        self.traffic.labels(namespace=namespace, name=name).set(
-            state.traffic_current
-        )
+            self._child(self.phase, namespace, name, phase.value).set(
+                1.0 if state.phase == phase else 0.0
+            )
+        self._child(self.traffic, namespace, name).set(state.traffic_current)
         for event in outcome.events:
-            self.events.labels(
-                namespace=namespace, name=name, reason=event.reason
-            ).inc()
+            self._child(self.events, namespace, name, event.reason).inc()
             outcome_label = _TERMINAL_REASONS.get(event.reason)
             if outcome_label:
-                self.promotions.labels(
-                    namespace=namespace, name=name, outcome=outcome_label
+                self._child(
+                    self.promotions, namespace, name, outcome_label
                 ).inc()
 
     def record_failure(self, namespace: str, name: str, seconds: float):
-        self.reconciles.labels(namespace=namespace, name=name, result="error").inc()
-        self.reconcile_seconds.labels(namespace=namespace, name=name).observe(seconds)
+        self._child(self.reconciles, namespace, name, "error").inc()
+        self._child(self.reconcile_seconds, namespace, name).observe(seconds)
 
     def set_resource_count(self, n: int):
         self.resources.set(n)
@@ -127,18 +132,9 @@ class OperatorTelemetry:
         """Drop a deleted CR's labeled series so /metrics stops exporting a
         phantom model (a stale phase=Canary gauge would fire "canary stuck"
         alerts forever)."""
-        for metric in (self.reconciles, self.promotions, self.events):
-            for labels in list(metric._metrics):  # label-value tuples
-                if labels[: 2] == (namespace, name):
-                    metric.remove(*labels)
-        for metric in (self.reconcile_seconds, self.traffic):
+        for metric, values in self._series.pop((namespace, name), ()):
             try:
-                metric.remove(namespace, name)
-            except KeyError:
-                pass
-        for phase in Phase:
-            try:
-                self.phase.remove(namespace, name, phase.value)
+                metric.remove(*values)
             except KeyError:
                 pass
 

@@ -245,9 +245,9 @@ class TpuInferenceServer:
             else:
                 raw = body["prompt_ids"]
                 prompts = [raw] if raw and np.isscalar(raw[0]) else list(raw)
-                if not prompts:
-                    raise ValueError("prompt_ids is empty")
                 params = body
+            if not prompts:  # covers both forms (zero-row tensor, empty list)
+                raise ValueError("prompt_ids is empty")
             max_new = int(params.get("max_new_tokens", 16))
             eos_id = params.get("eos_id")
             eos_id = int(eos_id) if eos_id is not None else None

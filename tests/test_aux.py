@@ -141,3 +141,20 @@ def test_tpu_pod_mounts_node_local_compile_cache():
     assert vol["name"] == mount["name"] == "xla-cache"
     # hostPath so the cache outlives the pod (canary reschedule = warm start).
     assert vol["hostPath"]["type"] == "DirectoryOrCreate"
+
+
+def test_operator_entrypoint_help():
+    """``python -m tpumlops.operator`` must run through the short alias
+    (runpy needs a get_code-capable loader for __main__ submodules)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "tpumlops.operator", "--help"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=str(PKG_DIR.parent),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--metrics-port" in out.stdout

@@ -83,7 +83,7 @@ def test_prometheus_outage_mid_promotion_resumes():
     rt.run_for(3 * 60)  # canary underway
     assert kube.get(cr_ref())["status"]["phase"] == Phase.CANARY.value
 
-    chaotic_metrics.fail(
+    chaotic_metrics.inject_fail(
         "model_metrics", ApiError(503, "prometheus down"), times=6
     )
     rt.run_for(40 * 60)  # generous: outage adds backoff, not failure
@@ -118,7 +118,7 @@ def test_registry_outage_mid_promotion_keeps_split_then_finishes():
     }
     assert len(weights_before) == 2
 
-    chaotic_registry.fail(
+    chaotic_registry.inject_fail(
         "get_version_by_alias", RegistryError("connection refused"), times=5
     )
     rt.run_for(60 * 60)
@@ -136,7 +136,7 @@ def test_kube_conflict_on_apply_is_retried():
     rt = OperatorRuntime(chaotic_kube, registry, metrics, clock)
     start_canary(kube, registry, metrics, rt)
     rt.run_for(2 * 60)
-    chaotic_kube.fail("replace", Conflict("resourceVersion mismatch"), times=2)
+    chaotic_kube.inject_fail("replace", Conflict("resourceVersion mismatch"), times=2)
     rt.run_for(45 * 60)
     assert chaotic_kube.faults_fired == 2
     status = kube.get(cr_ref())["status"]
@@ -149,7 +149,7 @@ def test_injector_conditional_faults_and_passthrough():
     metrics = FakeMetrics()
     metrics.set_metrics("d", "v1", NS, GOOD)
     inj = FaultInjector(metrics)
-    inj.fail_if(
+    inj.inject_fail_if(
         "model_metrics",
         lambda deployment, predictor, namespace, **kw: predictor == "v2",
         ApiError(500, "v2 only"),
@@ -158,7 +158,7 @@ def test_injector_conditional_faults_and_passthrough():
     with pytest.raises(ApiError):
         inj.model_metrics("d", "v2", NS)
     assert inj.faults_fired == 1
-    assert [c[0] for c in inj.calls] == ["model_metrics"]
+    assert [c[0] for c in inj.proxy_calls] == ["model_metrics"]
 
 
 def test_telemetry_phase_one_hot_and_traffic_gauge():

@@ -230,17 +230,24 @@ def test_engine_recovers_after_failed_step(tiny):
 
 
 def test_engine_eos_zero_is_respected(tiny):
-    """eos_id=0 must not fall back to the engine default (falsy-zero)."""
+    """eos_id=0 must not fall back to the engine default (falsy-zero).
+
+    The engine DEFAULT eos is a token that WOULD stop generation after two
+    tokens; the request overrides it with eos_id=0 (a token that never
+    appears in the greedy output).  With the falsy-zero bug, 0 falls back
+    to the default and generation stops early — so the full-length output
+    proves the override took effect."""
     params, cfg = tiny
-    # default eos would never match; explicit 0 must be honored when it
-    # appears in the output
     ref = _ref(params, cfg, [5, 9, 2], 8)
+    assert 0 not in ref  # precondition for the test to be meaningful
     engine = GenerationEngine(
-        params, cfg, max_slots=2, dtype=jnp.float64, eos_id=None
+        params, cfg, max_slots=2, dtype=jnp.float64, eos_id=ref[1]
     )
     engine.start(warmup=False)
     try:
-        out = engine.generate([5, 9, 2], 8, eos_id=ref[1]).tolist()
+        # default used when eos_id is None -> stops after 2 tokens
+        assert engine.generate([5, 9, 2], 8).tolist() == ref[:2]
+        # explicit 0 must override the default -> full 8 tokens
+        assert engine.generate([5, 9, 2], 8, eos_id=0).tolist() == ref
     finally:
         engine.shutdown()
-    assert out == ref[: 2]

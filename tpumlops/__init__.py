@@ -49,6 +49,16 @@ class _AliasFinder(importlib.abc.MetaPathFinder):
     def find_spec(self, fullname, path=None, target=None):
         if fullname.startswith(_SHORT + "."):
             real = _REAL + fullname[len(_SHORT):]
+            if fullname.rsplit(".", 1)[-1] == "__main__":
+                # runpy (``python -m tpumlops.server``) needs a loader with
+                # get_code(); hand it the real module's own source spec —
+                # identity aliasing is irrelevant for an entrypoint script.
+                real_spec = importlib.util.find_spec(real)
+                if real_spec is not None:
+                    return importlib.util.spec_from_file_location(
+                        fullname, real_spec.origin
+                    )
+                return None
             return importlib.util.spec_from_loader(
                 fullname, _AliasLoader(real), is_package=True
             )
