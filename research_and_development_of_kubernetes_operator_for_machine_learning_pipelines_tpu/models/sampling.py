@@ -1,0 +1,83 @@
+"""Token sampling (temperature / top-k / top-p), jit-friendly and batched.
+
+The reference serves stateless predictors and has no notion of decoding at
+all (SURVEY §2.3: no model code); a first-party text-gen data plane needs
+the standard sampling controls.  Everything here is shape-static and traced
+once: per-row parameters are ARRAYS (``[B]``), so one compiled program
+serves every request mix — greedy rows, hot-temperature rows, and top-p
+rows decode together in the same continuous batch.
+
+Conventions (per row):
+- ``temperature <= 0``  → greedy argmax (the sampling path is still
+  computed — the MXU does not care — and discarded by a ``where``);
+- ``top_k <= 0``        → k filtering disabled;
+- ``top_p >= 1``        → nucleus filtering disabled.
+
+Filtering happens in sorted-logit space: one descending sort per row, a
+rank mask (top-k) AND an exclusive-cumulative-probability mask (top-p,
+"smallest set whose mass >= p" — the first token is always kept), then a
+categorical draw over the surviving logits mapped back through the sort
+permutation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def sample_logits(
+    logits: jax.Array,
+    keys: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Draw one token per row.
+
+    logits ``[B, V]`` (any float dtype); keys ``[B]`` typed PRNG keys;
+    temperature/top_p float ``[B]``; top_k int32 ``[B]``.
+    Returns int32 ``[B]``.
+    """
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Scale: clamp temperature away from zero — greedy rows take the
+    # argmax branch below, this only keeps the math finite.
+    temp = jnp.maximum(temperature.astype(jnp.float32), 1e-6)[:, None]
+    scaled = logits / temp
+
+    order = jnp.argsort(-scaled, axis=-1)  # descending
+    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+
+    ranks = jnp.arange(v)[None, :]
+    k = jnp.where(top_k <= 0, v, top_k).astype(jnp.int32)[:, None]
+    keep_k = ranks < k
+
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # Exclusive cumsum: keep tokens while the mass BEFORE them is < p, so
+    # the smallest prefix reaching p survives (first token always kept).
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    p = jnp.clip(top_p.astype(jnp.float32), 0.0, 1.0)[:, None]
+    keep_p = cum_before < p
+
+    masked = jnp.where(keep_k & keep_p, sorted_logits, _NEG_INF)
+
+    def draw(key, row):
+        return jax.random.categorical(key, row)
+
+    choice = jax.vmap(draw)(keys, masked)  # index into sorted order
+    sampled_tok = jnp.take_along_axis(
+        order, choice[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
+
+    return jnp.where(temperature > 0, sampled_tok, greedy_tok)
+
+
+def split_keys(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Advance a batch of per-row PRNG keys: returns (carry, use)."""
+    pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return pairs[:, 0], pairs[:, 1]

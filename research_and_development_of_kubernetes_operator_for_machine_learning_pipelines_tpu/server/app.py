@@ -251,11 +251,38 @@ class TpuInferenceServer:
             max_new = int(params.get("max_new_tokens", 16))
             eos_id = params.get("eos_id")
             eos_id = int(eos_id) if eos_id is not None else None
+            seed = params.get("seed")
+            sampling = {
+                "temperature": float(params.get("temperature", 0.0)),
+                "top_k": int(params.get("top_k", 0)),
+                "top_p": float(params.get("top_p", 1.0)),
+                "seed": int(seed) if seed is not None else None,
+            }
             # Validate every prompt BEFORE admitting any: a bad sibling must
             # not leave earlier ones generating into abandoned futures.
-            prompts = [self.gen_engine.validate(p, max_new) for p in prompts]
+            prompts = [
+                self.gen_engine.validate(
+                    p,
+                    max_new,
+                    sampling["temperature"],
+                    sampling["top_k"],
+                    sampling["top_p"],
+                    sampling["seed"],
+                )
+                for p in prompts
+            ]
+
+            def row_seed(i: int) -> int | None:
+                # Distinct stream per row, reproducible from the request
+                # seed: identical prompts sampled in one batch must differ.
+                base = sampling["seed"]
+                return None if base is None else (base + i) % (2**63)
+
             futures = [
-                self.gen_engine.submit(p, max_new, eos_id) for p in prompts
+                self.gen_engine.submit(
+                    p, max_new, eos_id, **{**sampling, "seed": row_seed(i)}
+                )
+                for i, p in enumerate(prompts)
             ]
             outs = await asyncio.gather(
                 *(asyncio.wrap_future(f) for f in futures)

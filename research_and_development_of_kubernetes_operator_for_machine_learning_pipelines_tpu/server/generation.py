@@ -60,6 +60,7 @@ class _Slot:
     future: Future
     remaining: int  # new tokens still to produce
     eos_id: int | None
+    sampling: bool = False  # temperature > 0 (selects the decode variant)
     generated: list[int] = field(default_factory=list)
     t_start: float = 0.0
 
@@ -70,6 +71,10 @@ class _Request:
     max_new_tokens: int
     eos_id: int | None
     future: Future
+    temperature: float = 0.0  # <= 0: greedy
+    top_k: int = 0  # <= 0: disabled
+    top_p: float = 1.0  # >= 1: disabled
+    seed: int | None = None  # None: engine-assigned (deterministic counter)
 
 
 class GenerationEngine:
@@ -109,31 +114,68 @@ class GenerationEngine:
         self._dtype = dtype
         self._reset_device_state()
 
-        def _decode(params, toks, k, v, lengths, active):
+        def _decode(params, toks, k, v, lengths, active, keys, temps, tks, tps):
+            from ..models.sampling import sample_logits, split_keys
+
+            cache = llama.RaggedKVCache(k, v, lengths)
+            logits, cache = llama.decode_ragged(
+                params, toks, cache, cfg, active=active, dtype=dtype
+            )
+            keys2, use = split_keys(keys)
+            nxt = sample_logits(logits[:, -1, :], use, temps, tks, tps)
+            # Finished slots keep their last token so their rows stay inert.
+            toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
+            return toks2, cache.k, cache.v, cache.lengths, keys2
+
+        self._decode = jax.jit(_decode, donate_argnums=(2, 3))
+
+        def _decode_greedy(params, toks, k, v, lengths, active):
+            # Hot path when every occupied slot is greedy (the default):
+            # plain argmax — no full-vocab sort/softmax/categorical work.
             cache = llama.RaggedKVCache(k, v, lengths)
             logits, cache = llama.decode_ragged(
                 params, toks, cache, cfg, active=active, dtype=dtype
             )
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-            # Finished slots keep their last token so their rows stay inert.
             toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
             return toks2, cache.k, cache.v, cache.lengths
 
-        self._decode = jax.jit(_decode, donate_argnums=(2, 3))
+        self._decode_greedy = jax.jit(_decode_greedy, donate_argnums=(2, 3))
 
-        def _prefill_insert(params, ids, k, v, lengths, toks, slot, actual_len):
+        def _prefill_insert(
+            params, ids, k, v, lengths, toks, slot, actual_len,
+            keys, temps, tks, tps, slot_key, temp, tk, tp,
+        ):
+            from ..models.sampling import sample_logits
+
             logits, seq = llama.prefill(params, ids, cfg, dtype=dtype)
             cache = llama.insert_sequence(
                 llama.RaggedKVCache(k, v, lengths), seq, slot, actual_len
             )
-            first = jnp.argmax(logits[0, actual_len - 1]).astype(jnp.int32)
+            # Install the slot's sampling state, then draw the first token
+            # with the same per-slot key discipline decode uses.
+            carry, use = jax.random.split(slot_key)
+            keys2 = keys.at[slot].set(carry)
+            temps2 = temps.at[slot].set(temp)
+            tks2 = tks.at[slot].set(tk)
+            tps2 = tps.at[slot].set(tp)
+            row = logits[0, actual_len - 1][None]
+            first = sample_logits(
+                row, use[None], temp[None], tk[None], tp[None]
+            )[0]
             toks2 = toks.at[slot, 0].set(first)
-            return cache.k, cache.v, cache.lengths, toks2, first
+            return (
+                cache.k, cache.v, cache.lengths, toks2,
+                keys2, temps2, tks2, tps2, first,
+            )
 
         # One compiled program per prompt bucket (jit caches by ids shape).
         self._prefill_insert = jax.jit(_prefill_insert, donate_argnums=(2, 3))
 
         self._slots: list[_Slot | None] = [None] * self.max_slots
+        # NOT reset by _reset_device_state: engine-assigned seeds must stay
+        # distinct across a mid-flight recovery.
+        self._seed_counter = 0
         self._queue: queue.Queue[_Request | None] = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -149,10 +191,18 @@ class GenerationEngine:
 
         from ..models import llama
 
+        import jax
+
         cache = llama.RaggedKVCache.create(self._cfg, self.max_slots, self._dtype)
         self._cache_k, self._cache_v = cache.k, cache.v
         self._lengths = cache.lengths
         self._tokens = jnp.zeros((self.max_slots, 1), jnp.int32)
+        # Per-slot sampling state (arrays so one compiled decode serves any
+        # mix of greedy and sampled requests).
+        self._keys = jax.random.split(jax.random.key(0), self.max_slots)
+        self._temps = jnp.zeros((self.max_slots,), jnp.float32)
+        self._topk = jnp.zeros((self.max_slots,), jnp.int32)
+        self._topp = jnp.ones((self.max_slots,), jnp.float32)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -179,7 +229,19 @@ class GenerationEngine:
                     future=Future(),
                 )
             )
-            self._step()
+            self._step()  # greedy decode variant
+            self._slots = [None] * self.max_slots
+            self._admit(
+                _Request(
+                    prompt=np.array([1], np.int32),
+                    max_new_tokens=2,
+                    eos_id=None,
+                    future=Future(),
+                    temperature=1.0,
+                    seed=0,
+                )
+            )
+            self._step()  # sampling decode variant
         finally:
             self._in_warmup = False
         # Reset state so warmup tokens never leak into a real response.
@@ -208,7 +270,13 @@ class GenerationEngine:
     # -- client API ----------------------------------------------------------
 
     def validate(
-        self, prompt_ids: Sequence[int], max_new_tokens: int
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int | None = None,
     ) -> np.ndarray:
         """Check a request without admitting it; returns the int32 prompt.
 
@@ -227,6 +295,16 @@ class GenerationEngine:
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
                 f"= {total} exceeds KV-cache capacity {self.capacity}"
             )
+        if not (0.0 <= float(temperature) <= 100.0):
+            raise ValueError(f"temperature must be in [0, 100], got {temperature}")
+        if int(top_k) < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not (0.0 < float(top_p) <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if seed is not None and not (0 <= int(seed) < 2**63):
+            # jax.random.key takes an int64; reject before admission so one
+            # bad request can't poison the scheduler for everyone else.
+            raise ValueError(f"seed must be in [0, 2**63), got {seed}")
         return prompt
 
     def submit(
@@ -234,12 +312,29 @@ class GenerationEngine:
         prompt_ids: Sequence[int],
         max_new_tokens: int,
         eos_id: int | None = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int | None = None,
     ) -> Future:
-        prompt = self.validate(prompt_ids, max_new_tokens)
+        prompt = self.validate(
+            prompt_ids, max_new_tokens, temperature, top_k, top_p, seed
+        )
         fut: Future = Future()
         # None means "use the engine default"; 0 is a legitimate eos token.
         eos = self._eos_default if eos_id is None else eos_id
-        self._queue.put(_Request(prompt, int(max_new_tokens), eos, fut))
+        self._queue.put(
+            _Request(
+                prompt,
+                int(max_new_tokens),
+                eos,
+                fut,
+                temperature=float(temperature),
+                top_k=int(top_k),
+                top_p=float(top_p),
+                seed=seed,
+            )
+        )
         return fut
 
     def generate(
@@ -248,9 +343,12 @@ class GenerationEngine:
         max_new_tokens: int,
         eos_id: int | None = None,
         timeout: float | None = 120.0,
+        **sampling,
     ) -> np.ndarray:
         """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(prompt_ids, max_new_tokens, eos_id).result(timeout)
+        return self.submit(
+            prompt_ids, max_new_tokens, eos_id, **sampling
+        ).result(timeout)
 
     # -- scheduler -----------------------------------------------------------
 
@@ -269,12 +367,25 @@ class GenerationEngine:
         bucket = prefill_bucket(L, self.capacity)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :L] = req.prompt
+        import jax
+
+        if req.seed is None:
+            # Engine-assigned: deterministic per engine instance, distinct
+            # per request.
+            self._seed_counter += 1
+            seed = self._seed_counter
+        else:
+            seed = int(req.seed)
         t0 = time.perf_counter()
         (
             self._cache_k,
             self._cache_v,
             self._lengths,
             self._tokens,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
             first,
         ) = self._prefill_insert(
             self._params,
@@ -285,11 +396,20 @@ class GenerationEngine:
             self._tokens,
             jnp.int32(slot_idx),
             jnp.int32(L),
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+            jax.random.key(seed),
+            jnp.float32(req.temperature),
+            jnp.int32(req.top_k),
+            jnp.float32(req.top_p),
         )
         slot = _Slot(
             future=req.future,
             remaining=req.max_new_tokens,
             eos_id=req.eos_id,
+            sampling=req.temperature > 0,
             t_start=t0,
         )
         self._slots[slot_idx] = slot
@@ -320,14 +440,39 @@ class GenerationEngine:
         if not active_np.any():
             return
         t0 = time.perf_counter()
-        self._tokens, self._cache_k, self._cache_v, self._lengths = self._decode(
-            self._params,
-            self._tokens,
-            self._cache_k,
-            self._cache_v,
-            self._lengths,
-            jnp.asarray(active_np),
-        )
+        if any(s is not None and s.sampling for s in self._slots):
+            (
+                self._tokens,
+                self._cache_k,
+                self._cache_v,
+                self._lengths,
+                self._keys,
+            ) = self._decode(
+                self._params,
+                self._tokens,
+                self._cache_k,
+                self._cache_v,
+                self._lengths,
+                jnp.asarray(active_np),
+                self._keys,
+                self._temps,
+                self._topk,
+                self._topp,
+            )
+        else:
+            (
+                self._tokens,
+                self._cache_k,
+                self._cache_v,
+                self._lengths,
+            ) = self._decode_greedy(
+                self._params,
+                self._tokens,
+                self._cache_k,
+                self._cache_v,
+                self._lengths,
+                jnp.asarray(active_np),
+            )
         toks = np.asarray(self._tokens)[:, 0]
         if self._on_step is not None and not self._in_warmup:
             self._on_step(int(active_np.sum()), time.perf_counter() - t0)

@@ -210,18 +210,19 @@ def test_engine_recovers_after_failed_step(tiny):
         assert engine.generate([5, 9, 2], 4).tolist() == ref
 
         # Sabotage one decode step, then confirm in-flight fails + recovery.
-        real_decode = engine._decode
+        # (greedy traffic takes the _decode_greedy variant)
+        real_decode = engine._decode_greedy
         calls = {"n": 0}
 
         def bomb(*a, **kw):
             calls["n"] += 1
             raise RuntimeError("injected XLA failure")
 
-        engine._decode = bomb
+        engine._decode_greedy = bomb
         fut = engine.submit([7, 1, 4], 5)
         with pytest.raises(RuntimeError):
             fut.result(timeout=30)
-        engine._decode = real_decode
+        engine._decode_greedy = real_decode
         assert calls["n"] >= 1
         # Engine must serve fresh requests after recovery.
         assert engine.generate([5, 9, 2], 4).tolist() == ref
@@ -249,5 +250,132 @@ def test_engine_eos_zero_is_respected(tiny):
         assert engine.generate([5, 9, 2], 8).tolist() == ref[:2]
         # explicit 0 must override the default -> full 8 tokens
         assert engine.generate([5, 9, 2], 8, eos_id=0).tolist() == ref
+    finally:
+        engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Sampling (temperature / top-k / top-p / seed)
+# ---------------------------------------------------------------------------
+
+
+def test_sample_logits_greedy_and_filters(tiny):
+    import jax
+
+    from tpumlops.models.sampling import sample_logits
+
+    logits = jnp.asarray(
+        [[0.1, 3.0, 2.0, -1.0, 0.5]] * 4, jnp.float32
+    )
+    keys = jax.random.split(jax.random.key(7), 4)
+    zeros = jnp.zeros((4,), jnp.float32)
+    # temperature 0 -> argmax regardless of key
+    out = sample_logits(logits, keys, zeros, jnp.zeros((4,), jnp.int32), jnp.ones((4,), jnp.float32))
+    assert out.tolist() == [1, 1, 1, 1]
+    # top_k=1 -> argmax even at high temperature
+    out = sample_logits(
+        logits, keys, zeros + 5.0, jnp.ones((4,), jnp.int32), jnp.ones((4,), jnp.float32)
+    )
+    assert out.tolist() == [1, 1, 1, 1]
+    # tiny top_p -> only the most probable token survives
+    out = sample_logits(
+        logits, keys, zeros + 5.0, jnp.zeros((4,), jnp.int32), zeros + 1e-6
+    )
+    assert out.tolist() == [1, 1, 1, 1]
+
+
+def test_sample_logits_topk_mask_never_leaks(tiny):
+    import jax
+
+    from tpumlops.models.sampling import sample_logits
+
+    logits = jnp.asarray([[1.0, 0.9, -5.0, -5.0, -5.0]], jnp.float32)
+    drawn = set()
+    for i in range(64):
+        keys = jax.random.split(jax.random.key(i), 1)
+        tok = sample_logits(
+            logits,
+            keys,
+            jnp.asarray([10.0], jnp.float32),  # hot: flattens distribution
+            jnp.asarray([2], jnp.int32),
+            jnp.asarray([1.0], jnp.float32),
+        )
+        drawn.add(int(tok[0]))
+    assert drawn == {0, 1}  # tokens outside top-2 must never appear
+
+
+def test_engine_seeded_sampling_matches_reference_loop(tiny):
+    """Seeded sampled generation is slot-independent and reproducible:
+    the engine (continuous batching, shared decode steps) must equal a
+    hand-rolled loop using the same per-slot key discipline."""
+    import jax
+
+    from tpumlops.models.sampling import sample_logits
+
+    params, cfg = tiny
+    prompt, n, seed = [5, 9, 2], 7, 1234
+    temp, tk, tp = 0.9, 4, 0.95
+
+    # Reference loop (batch 1, unpadded).
+    key = jax.random.key(seed)
+    logits, cache = llama.prefill(
+        params, jnp.asarray([prompt], jnp.int32), cfg, dtype=jnp.float64
+    )
+    key, use = jax.random.split(key)
+    t_ = jnp.asarray([temp], jnp.float32)
+    k_ = jnp.asarray([tk], jnp.int32)
+    p_ = jnp.asarray([tp], jnp.float32)
+    tok = sample_logits(logits[:, -1, :], use[None], t_, k_, p_)
+    ref = [int(tok[0])]
+    for _ in range(n - 1):
+        logits, cache = llama.decode_step(
+            params, tok[:, None], cache, cfg, dtype=jnp.float64
+        )
+        key, use = jax.random.split(key)
+        tok = sample_logits(logits[:, -1, :], use[None], t_, k_, p_)
+        ref.append(int(tok[0]))
+
+    engine = GenerationEngine(params, cfg, max_slots=3, dtype=jnp.float64)
+    engine.start(warmup=True)
+    try:
+        # A concurrent greedy request shares decode steps with the sampled
+        # one — per-slot keys must keep the sampled stream unaffected.
+        other = engine.submit([7, 1, 4], 9)
+        out = engine.generate(
+            prompt, n, temperature=temp, top_k=tk, top_p=tp, seed=seed
+        ).tolist()
+        other.result(timeout=60)
+        # Reproducible: same seed, same stream.
+        out2 = engine.generate(
+            prompt, n, temperature=temp, top_k=tk, top_p=tp, seed=seed
+        ).tolist()
+    finally:
+        engine.shutdown()
+    assert out == ref
+    assert out2 == out
+
+
+def test_engine_sampling_validation():
+    cfg = llama.LlamaConfig.tiny(max_seq=32)
+    params = llama.init(jax.random.key(1), cfg, dtype=jnp.float64)
+    engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float64)
+    with pytest.raises(ValueError, match="temperature"):
+        engine.submit([1, 2], 4, temperature=-1.0)
+    with pytest.raises(ValueError, match="top_p"):
+        engine.submit([1, 2], 4, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        engine.submit([1, 2], 4, top_k=-2)
+
+
+def test_engine_seed_validation_and_greedy_variant(tiny):
+    params, cfg = tiny
+    engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float64)
+    with pytest.raises(ValueError, match="seed"):
+        engine.submit([1, 2], 4, seed=2**63)
+    engine.start(warmup=True)
+    try:
+        # All-greedy traffic must take the argmax variant and stay exact.
+        ref = _ref(params, cfg, [5, 9, 2], 5)
+        assert engine.generate([5, 9, 2], 5).tolist() == ref
     finally:
         engine.shutdown()

@@ -452,3 +452,39 @@ def test_generate_batch_validation_is_atomic(llm_server):
         timeout=60,
     )
     assert ok.status_code == 200
+
+
+def test_generate_endpoint_sampling_seeded_reproducible(llm_server):
+    body = {
+        "prompt_ids": [5, 9, 2],
+        "max_new_tokens": 6,
+        "temperature": 0.8,
+        "top_k": 8,
+        "top_p": 0.9,
+        "seed": 42,
+    }
+    r1 = httpx.post(llm_server.base + "/v2/models/llm/generate", json=body, timeout=60)
+    r2 = httpx.post(llm_server.base + "/v2/models/llm/generate", json=body, timeout=60)
+    assert r1.status_code == r2.status_code == 200, r1.text
+    assert r1.json()["outputs"][0]["data"] == r2.json()["outputs"][0]["data"]
+    bad = dict(body, top_p=0)
+    r3 = httpx.post(llm_server.base + "/v2/models/llm/generate", json=bad, timeout=30)
+    assert r3.status_code == 400
+    assert "top_p" in r3.json()["error"]
+
+
+def test_generate_batch_same_prompt_seeded_rows_differ(llm_server):
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={
+            "prompt_ids": [[5, 9, 2], [5, 9, 2], [5, 9, 2]],
+            "max_new_tokens": 8,
+            "temperature": 1.5,
+            "seed": 7,
+        },
+        timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    outs = [tuple(o["data"]) for o in resp.json()["outputs"]]
+    # Identical prompts in one seeded batch must get distinct streams.
+    assert len(set(outs)) > 1
