@@ -44,6 +44,14 @@ def bench_tpu() -> dict[int, float]:
     per-batch latency converges (10 -> 12.6 ms, 64 -> 6.95 ms, 128 ->
     6.47 ms) toward the ~6.1 ms pure device time measured with a
     CSE-proof on-device loop; 64 is a realistic loaded-server queue depth.
+
+    Variants measured on chip and REJECTED (b32/s128, p50 per batch):
+    XLA einsum attention 7.47 ms beats both a prefolded fused-QKV matmul
+    (7.89 ms — XLA already merges the three projections) and the Pallas
+    flash kernel (9.56 ms — at s=128 the whole KV fits one block, so
+    flash's streaming machinery is pure overhead; it wins at 8k, see
+    ops/flash_attention.py).  bf16 classify here is compute-bound at
+    ~55% MXU, so remaining headroom is numerics (int8), not scheduling.
     """
     import jax
     import jax.numpy as jnp

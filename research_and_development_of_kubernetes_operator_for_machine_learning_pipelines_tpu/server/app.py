@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import logging
 import os
@@ -278,6 +279,16 @@ class TpuInferenceServer:
                 base = sampling["seed"]
                 return None if base is None else (base + i) % (2**63)
 
+            if params.get("stream"):
+                if len(prompts) != 1:
+                    raise ValueError("stream=true supports exactly one prompt")
+                codebox = {"code": 200}
+                try:
+                    return await self._stream_generation(
+                        request, prompts[0], max_new, eos_id, sampling, codebox
+                    )
+                finally:
+                    code = codebox["code"]
             futures = [
                 self.gen_engine.submit(
                     p, max_new, eos_id, **{**sampling, "seed": row_seed(i)}
@@ -311,6 +322,71 @@ class TpuInferenceServer:
             return web.json_response({"error": str(e)}, status=500)
         finally:
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
+
+    async def _stream_generation(
+        self, request, prompt, max_new, eos_id, sampling, codebox
+    ) -> web.StreamResponse:
+        """SSE token stream: one ``data:`` event per token, then a final
+        event with the full sequence.  Client disconnect cancels the
+        request's future, which frees its engine slot at the next tick.
+
+        The HTTP status line is committed as 200 before the outcome is
+        known, so the gate-visible request metric takes ``codebox["code"]``
+        instead (500 on engine failure, 499 on cancel/disconnect): a broken
+        engine serving only streams must still trip the canary gate's
+        error-rate query."""
+        loop = asyncio.get_running_loop()
+        tokens: asyncio.Queue = asyncio.Queue()
+
+        def on_token(t: int) -> None:  # scheduler thread -> event loop
+            loop.call_soon_threadsafe(tokens.put_nowait, int(t))
+
+        fut = self.gen_engine.submit(
+            prompt, max_new, eos_id, **sampling, on_token=on_token
+        )
+        fut.add_done_callback(
+            lambda f: loop.call_soon_threadsafe(tokens.put_nowait, None)
+        )
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        emitted: list[int] = []
+        try:
+            while True:
+                item = await tokens.get()
+                if item is None:
+                    break
+                emitted.append(item)
+                payload = json.dumps({"index": len(emitted) - 1, "token": item})
+                await resp.write(f"data: {payload}\n\n".encode())
+            if fut.cancelled():
+                codebox["code"] = 499
+                final = {"done": True, "error": "generation cancelled"}
+            elif fut.exception() is not None:
+                codebox["code"] = 500
+                final = {"done": True, "error": str(fut.exception())}
+            else:
+                final = {"done": True, "output_ids": fut.result().tolist()}
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+        except ConnectionResetError:
+            # Client went away mid-stream: free the engine slot and end
+            # quietly (the outer handler must not try to write JSON to a
+            # response that already started streaming).
+            fut.cancel()
+            codebox["code"] = 499
+        except asyncio.CancelledError:
+            fut.cancel()  # frees the slot at the next scheduler tick
+            codebox["code"] = 499
+            raise
+        finally:
+            with contextlib.suppress(Exception):
+                await resp.write_eof()
+        return resp
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(

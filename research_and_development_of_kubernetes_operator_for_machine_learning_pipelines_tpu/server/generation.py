@@ -45,6 +45,22 @@ import numpy as np
 
 _log = logging.getLogger("tpumlops.generation")
 
+
+def _safe_resolve(fut: Future, value) -> None:
+    """set_result tolerating a concurrent client-side cancel (TOCTOU: the
+    cancelled() check and set_result are not atomic across threads)."""
+    try:
+        fut.set_result(value)
+    except Exception:  # InvalidStateError: client cancelled in the gap
+        pass
+
+
+def _safe_fail(fut: Future, exc: Exception) -> None:
+    try:
+        fut.set_exception(exc)
+    except Exception:
+        pass
+
 _MIN_BUCKET = 16
 
 
@@ -61,6 +77,7 @@ class _Slot:
     remaining: int  # new tokens still to produce
     eos_id: int | None
     sampling: bool = False  # temperature > 0 (selects the decode variant)
+    on_token: Callable[[int], None] | None = None  # streaming callback
     generated: list[int] = field(default_factory=list)
     t_start: float = 0.0
 
@@ -75,6 +92,7 @@ class _Request:
     top_k: int = 0  # <= 0: disabled
     top_p: float = 1.0  # >= 1: disabled
     seed: int | None = None  # None: engine-assigned (deterministic counter)
+    on_token: Callable[[int], None] | None = None  # streaming callback
 
 
 class GenerationEngine:
@@ -173,8 +191,13 @@ class GenerationEngine:
         self._prefill_insert = jax.jit(_prefill_insert, donate_argnums=(2, 3))
 
         self._slots: list[_Slot | None] = [None] * self.max_slots
-        # NOT reset by _reset_device_state: engine-assigned seeds must stay
-        # distinct across a mid-flight recovery.
+        # Engine-assigned sampling keys: fold a per-boot nonce so unseeded
+        # requests never collide with the user-visible seed space (and never
+        # replay the same streams after a pod restart).  NOT reset by
+        # _reset_device_state: streams stay distinct across a recovery.
+        import os as _os
+
+        self._boot_key = jax.random.key(int.from_bytes(_os.urandom(7), "little"))
         self._seed_counter = 0
         self._queue: queue.Queue[_Request | None] = queue.Queue()
         self._stop = threading.Event()
@@ -316,6 +339,7 @@ class GenerationEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int | None = None,
+        on_token: Callable[[int], None] | None = None,
     ) -> Future:
         prompt = self.validate(
             prompt_ids, max_new_tokens, temperature, top_k, top_p, seed
@@ -333,6 +357,7 @@ class GenerationEngine:
                 top_k=int(top_k),
                 top_p=float(top_p),
                 seed=seed,
+                on_token=on_token,
             )
         )
         return fut
@@ -370,12 +395,12 @@ class GenerationEngine:
         import jax
 
         if req.seed is None:
-            # Engine-assigned: deterministic per engine instance, distinct
-            # per request.
+            # Engine-assigned: distinct per request, disjoint from any
+            # user-specified jax.random.key(seed) stream.
             self._seed_counter += 1
-            seed = self._seed_counter
+            slot_key = jax.random.fold_in(self._boot_key, self._seed_counter)
         else:
-            seed = int(req.seed)
+            slot_key = jax.random.key(int(req.seed))
         t0 = time.perf_counter()
         (
             self._cache_k,
@@ -400,7 +425,7 @@ class GenerationEngine:
             self._temps,
             self._topk,
             self._topp,
-            jax.random.key(seed),
+            slot_key,
             jnp.float32(req.temperature),
             jnp.int32(req.top_k),
             jnp.float32(req.top_p),
@@ -410,6 +435,7 @@ class GenerationEngine:
             remaining=req.max_new_tokens,
             eos_id=req.eos_id,
             sampling=req.temperature > 0,
+            on_token=req.on_token,
             t_start=t0,
         )
         self._slots[slot_idx] = slot
@@ -418,18 +444,27 @@ class GenerationEngine:
     def _record_token(self, slot_idx: int, token: int) -> None:
         slot = self._slots[slot_idx]
         assert slot is not None
+        if slot.future.cancelled():
+            # Client gone (stream disconnect / shutdown): free the slot
+            # instead of decoding tokens nobody will read.
+            self._slots[slot_idx] = None
+            return
         slot.generated.append(token)
         slot.remaining -= 1
         if not self._in_warmup:
             self.tokens_generated += 1
             if self._on_tokens is not None:
                 self._on_tokens(1)
+            if slot.on_token is not None:
+                try:
+                    slot.on_token(token)
+                except Exception:
+                    _log.exception("on_token callback failed")
         done = slot.remaining <= 0 or (
             slot.eos_id is not None and token == slot.eos_id
         )
         if done:
-            if not slot.future.cancelled():
-                slot.future.set_result(np.asarray(slot.generated, np.int32))
+            _safe_resolve(slot.future, np.asarray(slot.generated, np.int32))
             self._slots[slot_idx] = None
 
     def _step(self) -> None:
@@ -501,7 +536,7 @@ class GenerationEngine:
                 except Exception as exc:  # keep the scheduler alive
                     _log.exception("admit failed")
                     if not req.future.done():
-                        req.future.set_exception(exc)
+                        _safe_fail(req.future, exc)
                     self._fail_all_and_recover()
             try:
                 self._step()
@@ -519,8 +554,9 @@ class GenerationEngine:
         buffers restore service for subsequent requests."""
         for i, slot in enumerate(self._slots):
             if slot is not None and not slot.future.done():
-                slot.future.set_exception(
-                    RuntimeError("generation step failed; see server log")
+                _safe_fail(
+                    slot.future,
+                    RuntimeError("generation step failed; see server log"),
                 )
             self._slots[i] = None
         try:

@@ -379,3 +379,35 @@ def test_engine_seed_validation_and_greedy_variant(tiny):
         assert engine.generate([5, 9, 2], 5).tolist() == ref
     finally:
         engine.shutdown()
+
+
+def test_engine_streaming_callback_and_cancel_frees_slot(tiny):
+    """on_token fires per token; cancelling the future mid-generation frees
+    the slot instead of decoding to max_new_tokens."""
+    import threading
+
+    params, cfg = tiny
+    engine = GenerationEngine(params, cfg, max_slots=1, dtype=jnp.float64)
+    engine.start(warmup=True)
+    seen = []
+    three = threading.Event()
+    fut_box = {}
+
+    def on_token(t):
+        seen.append(t)
+        if len(seen) == 3:
+            fut_box["fut"].cancel()
+            three.set()
+
+    try:
+        fut = engine.submit([5, 9, 2], 50, on_token=on_token)
+        fut_box["fut"] = fut
+        assert three.wait(timeout=60)
+        # The slot must free well before 50 tokens; the next request on the
+        # single-slot engine proves capacity was reclaimed.
+        ref = _ref(params, cfg, [7, 1, 4], 4)
+        assert engine.generate([7, 1, 4], 4, timeout=60).tolist() == ref
+        assert len(seen) < 50
+        assert fut.cancelled()
+    finally:
+        engine.shutdown()

@@ -488,3 +488,40 @@ def test_generate_batch_same_prompt_seeded_rows_differ(llm_server):
     outs = [tuple(o["data"]) for o in resp.json()["outputs"]]
     # Identical prompts in one seeded batch must get distinct streams.
     assert len(set(outs)) > 1
+
+
+def test_generate_streaming_sse(llm_server):
+    # Non-streaming reference (greedy = deterministic).
+    ref = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 6},
+        timeout=60,
+    ).json()["outputs"][0]["data"]
+
+    events = []
+    with httpx.stream(
+        "POST",
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 6, "stream": True},
+        timeout=60,
+    ) as resp:
+        assert resp.status_code == 200
+        assert resp.headers["content-type"].startswith("text/event-stream")
+        for line in resp.iter_lines():
+            if line.startswith("data: "):
+                events.append(json.loads(line[len("data: "):]))
+    *toks, final = events
+    assert [e["token"] for e in toks] == ref
+    assert [e["index"] for e in toks] == list(range(6))
+    assert final == {"done": True, "output_ids": ref}
+
+
+def test_generate_streaming_rejects_multi_prompt(llm_server):
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [[1, 2], [3, 4]], "max_new_tokens": 2,
+              "stream": True},
+        timeout=30,
+    )
+    assert resp.status_code == 400
+    assert "one prompt" in resp.json()["error"]
