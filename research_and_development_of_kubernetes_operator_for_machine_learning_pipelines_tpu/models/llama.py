@@ -29,6 +29,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from .common import rms_norm
+from .quantization import dequantize_tensor, is_quantized
+
+
+def _mat(w, dtype):
+    """Weight leaf -> matmul operand: raw array or int8 {"q8","scale"}.
+
+    The dequantize is elementwise on the operand, so XLA fuses it into the
+    matmul's HBM read — int8 bytes stream from memory, bf16 enters the MXU.
+    """
+    return dequantize_tensor(w, dtype) if is_quantized(w) else w.astype(dtype)
 
 
 @dataclass(frozen=True)
@@ -240,9 +250,9 @@ def _block(
     ragged = getattr(start, "ndim", 0) == 1
 
     xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = jnp.matmul(xn, lp["q"].astype(xn.dtype), preferred_element_type=jnp.float32)
-    k = jnp.matmul(xn, lp["k"].astype(xn.dtype), preferred_element_type=jnp.float32)
-    v = jnp.matmul(xn, lp["v"].astype(xn.dtype), preferred_element_type=jnp.float32)
+    q = jnp.matmul(xn, _mat(lp["q"], xn.dtype), preferred_element_type=jnp.float32)
+    k = jnp.matmul(xn, _mat(lp["k"], xn.dtype), preferred_element_type=jnp.float32)
+    v = jnp.matmul(xn, _mat(lp["v"], xn.dtype), preferred_element_type=jnp.float32)
     q = q.astype(x.dtype).reshape(b, s, nh, hd)
     k = k.astype(x.dtype).reshape(b, s, nkv, hd)
     v = v.astype(x.dtype).reshape(b, s, nkv, hd)
@@ -278,16 +288,16 @@ def _block(
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bngqk,bknd->bqngd", probs, vv).reshape(b, s, nh * hd)
     attn_out = jnp.matmul(
-        ctx, lp["o"].astype(ctx.dtype), preferred_element_type=jnp.float32
+        ctx, _mat(lp["o"], ctx.dtype), preferred_element_type=jnp.float32
     ).astype(x.dtype)
     x = x + attn_out
 
     xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-    gate = jnp.matmul(xn, lp["gate"].astype(xn.dtype), preferred_element_type=jnp.float32)
-    up = jnp.matmul(xn, lp["up"].astype(xn.dtype), preferred_element_type=jnp.float32)
+    gate = jnp.matmul(xn, _mat(lp["gate"], xn.dtype), preferred_element_type=jnp.float32)
+    up = jnp.matmul(xn, _mat(lp["up"], xn.dtype), preferred_element_type=jnp.float32)
     act = jax.nn.silu(gate) * up
     down = jnp.matmul(
-        act.astype(x.dtype), lp["down"].astype(x.dtype), preferred_element_type=jnp.float32
+        act.astype(x.dtype), _mat(lp["down"], x.dtype), preferred_element_type=jnp.float32
     ).astype(x.dtype)
     return x + down, cache_k, cache_v
 
@@ -334,7 +344,7 @@ def forward(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = jnp.matmul(
-        x, params["lm_head"].astype(x.dtype), preferred_element_type=jnp.float32
+        x, _mat(params["lm_head"], x.dtype), preferred_element_type=jnp.float32
     )
     new_cache = KVCache(k=new_k, v=new_v, length=start + s)
     return logits, new_cache
@@ -432,7 +442,7 @@ def decode_ragged(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = jnp.matmul(
-        x, params["lm_head"].astype(x.dtype), preferred_element_type=jnp.float32
+        x, _mat(params["lm_head"], x.dtype), preferred_element_type=jnp.float32
     )
     advance = (
         jnp.ones((b,), jnp.int32) if active is None else active.astype(jnp.int32)

@@ -104,6 +104,7 @@ def _tpu_pod_spec(
             "--max-batch-size", str(tpu.max_batch_size),
             "--max-batch-delay-ms", str(tpu.max_batch_delay_ms),
             "--compile-cache-dir", tpu.compile_cache_dir or "",
+            "--quantize", tpu.quantize,
         ],
         "env": [
             {"name": "TPU_TOPOLOGY", "value": tpu.topology},

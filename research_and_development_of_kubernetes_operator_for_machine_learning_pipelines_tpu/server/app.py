@@ -373,16 +373,23 @@ class TpuInferenceServer:
             else:
                 final = {"done": True, "output_ids": fut.result().tolist()}
             await resp.write(f"data: {json.dumps(final)}\n\n".encode())
-        except ConnectionResetError:
-            # Client went away mid-stream: free the engine slot and end
-            # quietly (the outer handler must not try to write JSON to a
-            # response that already started streaming).
+        except (ConnectionError, OSError):
+            # Client/transport went away mid-stream: free the engine slot
+            # and end quietly (the outer handler must not try to write JSON
+            # to a response that already started streaming).
             fut.cancel()
             codebox["code"] = 499
         except asyncio.CancelledError:
             fut.cancel()  # frees the slot at the next scheduler tick
             codebox["code"] = 499
             raise
+        except Exception:
+            # Anything else: still cancel (or the slot decodes to
+            # max_new_tokens for nobody) and swallow — the status line is
+            # out, so a JSON error body can't be started.
+            _log.exception("stream failed mid-generation")
+            fut.cancel()
+            codebox["code"] = 500
         finally:
             with contextlib.suppress(Exception):
                 await resp.write_eof()
@@ -502,7 +509,9 @@ def build_server(
     Single-host units pass None and run the engine directly.
     """
     mesh_shape = dict(config.tpu.mesh_shape)
-    predictor = load_predictor(config.model_uri, mesh_shape=mesh_shape)
+    predictor = load_predictor(
+        config.model_uri, mesh_shape=mesh_shape, quantize=config.tpu.quantize
+    )
     metrics = ServerMetrics(
         deployment_name=config.deployment_name or config.model_name,
         predictor_name=config.predictor_name,
@@ -595,6 +604,12 @@ def main(argv: list[str] | None = None) -> None:
         "containerPort); 0 disables the second listener",
     )
     ap.add_argument(
+        "--quantize",
+        default="none",
+        choices=["none", "int8"],
+        help="weight-only quantization (int8 halves decode HBM traffic)",
+    )
+    ap.add_argument(
         "--compile-cache-dir",
         default=os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache"),
         help="persistent XLA compile cache (SURVEY §7 hard part 3); "
@@ -625,6 +640,7 @@ def main(argv: list[str] | None = None) -> None:
                 "dtype": args.dtype,
                 "maxBatchSize": args.max_batch_size,
                 "maxBatchDelayMs": args.max_batch_delay_ms,
+                "quantize": args.quantize,
             }
         ),
     )
@@ -643,7 +659,9 @@ def main(argv: list[str] | None = None) -> None:
             # steps until it shuts the unit down.
             _serve_follower_health(config.host, config.port)
             predictor = load_predictor(
-                args.model_uri, mesh_shape=dict(config.tpu.mesh_shape)
+                args.model_uri,
+                mesh_shape=dict(config.tpu.mesh_shape),
+                quantize=config.tpu.quantize,
             )
             engine = InferenceEngine(
                 predictor, max_batch_size=config.tpu.max_batch_size

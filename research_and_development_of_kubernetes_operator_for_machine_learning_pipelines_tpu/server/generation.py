@@ -91,7 +91,7 @@ class _Request:
     temperature: float = 0.0  # <= 0: greedy
     top_k: int = 0  # <= 0: disabled
     top_p: float = 1.0  # >= 1: disabled
-    seed: int | None = None  # None: engine-assigned (deterministic counter)
+    seed: int | None = None  # None: engine-assigned (boot-nonce fold_in)
     on_token: Callable[[int], None] | None = None  # streaming callback
 
 

@@ -217,6 +217,7 @@ def load_predictor(
     model_uri: str,
     flavor: str | None = None,
     mesh_shape: dict | None = None,
+    quantize: str | None = None,
 ) -> Predictor:
     path = resolve_uri(model_uri)
     cfg_file = path / "config.json"
@@ -238,11 +239,35 @@ def load_predictor(
             n_devices *= int(v)
         if mesh_shape and n_devices > 1:
             params = _shard_for_flavor(flavor, params, cfg, mesh_shape)
+        if quantize and quantize != "none":
+            # After sharding: the jitted quantizer preserves input shardings
+            # and computes per-channel scales with an on-mesh reduction.
+            if flavor != "llama-generate":
+                raise ModelLoadError(
+                    f"quantize={quantize!r} is only supported for the "
+                    f"llama-generate flavor (decode is HBM-bound); "
+                    f"{flavor!r} serves prefill-style batches"
+                )
+            if quantize != "int8":
+                raise ModelLoadError(f"unknown quantize mode {quantize!r}")
+            from ..models.quantization import quantize_llama
+
+            params = quantize_llama(params)
+            _log.info("quantized %s weights to int8 (weight-only)", flavor)
         kwargs = dict(meta.get("builder_kwargs", {}))
         if cfg is not None:
             kwargs["cfg"] = cfg
         _log.info("loaded native %s model from %s", flavor, path)
         return get_builder(flavor)(params, **kwargs)
+
+    if quantize and quantize != "none":
+        # Only the native llama path got here without raising; every other
+        # artifact kind serves prefill-style batches where weight-only int8
+        # buys nothing (compute-bound) — reject loudly instead of ignoring.
+        raise ModelLoadError(
+            f"quantize={quantize!r} is only supported for the "
+            "llama-generate flavor (decode is HBM-bound)"
+        )
 
     xgb_file = _find_xgboost_file(path)
     if xgb_file is not None:

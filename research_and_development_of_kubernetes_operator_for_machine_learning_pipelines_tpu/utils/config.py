@@ -149,6 +149,17 @@ class CanaryPolicy:
             raise ValueError("maxAttempts must be >= 1")
 
 
+def _parse_quantize(value) -> str:
+    """Reject bad quantize values at reconcile time — a typo'd CR field must
+    surface in status, not as a pod CrashLoopBackOff at argparse."""
+    mode = str(value).lower()
+    if mode not in ("none", "int8"):
+        raise ValueError(
+            f"spec.tpu.quantize must be 'none' or 'int8', got {value!r}"
+        )
+    return mode
+
+
 @dataclass(frozen=True)
 class TpuSpec:
     """TPU data-plane placement and sharding (north-star CRD additions).
@@ -166,6 +177,7 @@ class TpuSpec:
     max_batch_size: int = 32
     max_batch_delay_ms: float = 5.0
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
+    quantize: str = "none"  # "none" | "int8" (weight-only, decode HBM relief)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
@@ -179,6 +191,7 @@ class TpuSpec:
             max_batch_size=int(spec.get("maxBatchSize", 32)),
             max_batch_delay_ms=float(spec.get("maxBatchDelayMs", 5.0)),
             compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
+            quantize=_parse_quantize(spec.get("quantize", "none")),
         )
 
     @property

@@ -74,3 +74,13 @@ def test_threshold_overrides():
     assert cfg.thresholds.latency_p95 == 0.2
     assert cfg.thresholds.error_rate_floor == 0.01
     assert cfg.thresholds.min_sample_count == 30
+
+
+def test_tpu_quantize_validated_at_parse():
+    import pytest
+
+    from tpumlops.utils.config import TpuSpec
+
+    assert TpuSpec.from_spec({"quantize": "INT8"}).quantize == "int8"
+    with pytest.raises(ValueError, match="quantize"):
+        TpuSpec.from_spec({"quantize": "int4"})
