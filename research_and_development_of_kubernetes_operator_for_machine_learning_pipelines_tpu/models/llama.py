@@ -237,12 +237,21 @@ def _block(
     sin: jax.Array,
     mask_bias: jax.Array,
     cfg: LlamaConfig,
+    window: int | None = None,
 ):
     """One decoder layer over a fixed-capacity cache.
 
     x: [B,S,H]; cache_k/v: [B,max_seq,NKV,D]; start: scalar write offset
     shared by the batch, or an int32 [B] of per-row offsets (continuous
     batching: each slot is at its own sequence position).
+
+    ``window`` (static) restricts ATTENTION to cache positions
+    ``[0, window)`` while writes still land in the full buffer — decode's
+    HBM floor is dominated by streaming the cache, so reading only a
+    bucket that covers every row's current position instead of the full
+    static capacity cuts that traffic proportionally.  Callers guarantee
+    ``start + s <= window`` for every attended row; ``mask_bias``'s key
+    axis must already be ``window``-sized.
     Returns (y, new_cache_k, new_cache_v).
     """
     b, s, h = x.shape
@@ -278,8 +287,10 @@ def _block(
     # query heads (that broadcast would dominate HBM traffic at decode).
     group = nh // nkv
     qg = q.reshape(b, s, nkv, group, hd)
-    kk = cache_k.astype(x.dtype)
-    vv = cache_v.astype(x.dtype)
+    kk = cache_k if window is None else cache_k[:, :window]
+    vv = cache_v if window is None else cache_v[:, :window]
+    kk = kk.astype(x.dtype)
+    vv = vv.astype(x.dtype)
 
     scores = jnp.einsum(
         "bqngd,bknd->bngqk", qg, kk, preferred_element_type=jnp.float32
@@ -400,6 +411,7 @@ def decode_ragged(
     cfg: LlamaConfig,
     active: jax.Array | None = None,
     dtype=jnp.bfloat16,
+    window: int | None = None,
 ) -> tuple[jax.Array, RaggedKVCache]:
     """One decode step where every batch row is at its OWN position.
 
@@ -415,6 +427,14 @@ def decode_ragged(
     prefill insert or a prior decode write (each step writes position ``p``
     before attending it).
 
+    ``window`` (STATIC int) bounds the attended cache prefix: callers pass
+    a power-of-two bucket ``> max(lengths of active rows)`` so each window
+    value compiles once but short sequences stop paying full-capacity
+    cache reads.  Writes are unaffected (full buffer).  Measured on a v5e
+    chip (1.35B shape, 8 slots at position 256, capacity 1024):
+    window=512 is 1.11x over full-capacity in bf16, and composes with
+    int8 weights to 1.24x (625 -> 772 tok/s).
+
     Returns (logits ``[B, 1, vocab]`` float32, cache with advanced lengths).
     """
     b, s = token_ids.shape
@@ -427,14 +447,19 @@ def decode_ragged(
     cos, sin = rope_cos_sin(positions, cfg, jnp.float32)  # [B, 1, head_dim]
 
     capacity = cache.k.shape[2]
-    key_pos = jnp.arange(capacity)
-    valid = key_pos[None, None, :] <= positions[:, :, None]  # [B, 1, T]
-    mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[:, None]  # [B,1,1,T]
+    if window is None:
+        window = capacity
+    window = min(int(window), capacity)
+    key_pos = jnp.arange(window)
+    valid = key_pos[None, None, :] <= positions[:, :, None]  # [B, 1, W]
+    mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[:, None]  # [B,1,1,W]
 
     def scan_body(carry, layer_inputs):
         x = carry
         lp, ck, cv = layer_inputs
-        y, ck2, cv2 = _block(x, lp, ck, cv, lengths, cos, sin, mask_bias, cfg)
+        y, ck2, cv2 = _block(
+            x, lp, ck, cv, lengths, cos, sin, mask_bias, cfg, window=window
+        )
         return y, (ck2, cv2)
 
     x, (new_k, new_v) = lax.scan(

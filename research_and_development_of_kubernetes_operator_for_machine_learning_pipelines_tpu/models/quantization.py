@@ -48,7 +48,10 @@ def quantize_tensor(w: jax.Array) -> dict[str, jax.Array]:
 
 
 def dequantize_tensor(q: dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
-    return (q["q8"].astype(dtype) * q["scale"].astype(dtype)).astype(dtype)
+    # Multiply in f32 and round ONCE into the target dtype: casting the
+    # scale to bf16 first would round twice (~2x the weight error) for the
+    # same fused HBM traffic.
+    return (q["q8"].astype(jnp.float32) * q["scale"]).astype(dtype)
 
 
 def is_quantized(leaf: Any) -> bool:

@@ -78,6 +78,7 @@ class _Slot:
     eos_id: int | None
     sampling: bool = False  # temperature > 0 (selects the decode variant)
     on_token: Callable[[int], None] | None = None  # streaming callback
+    prompt_len: int = 0  # for the decode attention window (host mirror)
     generated: list[int] = field(default_factory=list)
     t_start: float = 0.0
 
@@ -132,12 +133,15 @@ class GenerationEngine:
         self._dtype = dtype
         self._reset_device_state()
 
-        def _decode(params, toks, k, v, lengths, active, keys, temps, tks, tps):
+        def _decode(
+            params, toks, k, v, lengths, active, keys, temps, tks, tps, window
+        ):
             from ..models.sampling import sample_logits, split_keys
 
             cache = llama.RaggedKVCache(k, v, lengths)
             logits, cache = llama.decode_ragged(
-                params, toks, cache, cfg, active=active, dtype=dtype
+                params, toks, cache, cfg, active=active, dtype=dtype,
+                window=window,
             )
             keys2, use = split_keys(keys)
             nxt = sample_logits(logits[:, -1, :], use, temps, tks, tps)
@@ -145,20 +149,28 @@ class GenerationEngine:
             toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
             return toks2, cache.k, cache.v, cache.lengths, keys2
 
-        self._decode = jax.jit(_decode, donate_argnums=(2, 3))
+        # ``window`` is static: one compiled program per power-of-two bucket
+        # of the longest active sequence (short traffic stops paying
+        # full-capacity cache reads — decode's dominant HBM term).
+        self._decode = jax.jit(
+            _decode, donate_argnums=(2, 3), static_argnums=(10,)
+        )
 
-        def _decode_greedy(params, toks, k, v, lengths, active):
+        def _decode_greedy(params, toks, k, v, lengths, active, window):
             # Hot path when every occupied slot is greedy (the default):
             # plain argmax — no full-vocab sort/softmax/categorical work.
             cache = llama.RaggedKVCache(k, v, lengths)
             logits, cache = llama.decode_ragged(
-                params, toks, cache, cfg, active=active, dtype=dtype
+                params, toks, cache, cfg, active=active, dtype=dtype,
+                window=window,
             )
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
             toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
             return toks2, cache.k, cache.v, cache.lengths
 
-        self._decode_greedy = jax.jit(_decode_greedy, donate_argnums=(2, 3))
+        self._decode_greedy = jax.jit(
+            _decode_greedy, donate_argnums=(2, 3), static_argnums=(6,)
+        )
 
         def _prefill_insert(
             params, ids, k, v, lengths, toks, slot, actual_len,
@@ -238,9 +250,18 @@ class GenerationEngine:
         self._thread.start()
 
     def _warmup(self) -> None:
-        """Compile the decode program and the smallest prefill bucket before
-        readiness, so no live request pays an XLA compile (the persistent
-        compile cache makes this near-instant on a warm node)."""
+        """Compile every decode program before readiness, so no live request
+        pays an XLA compile (the persistent compile cache makes this
+        near-instant on a warm node).
+
+        "Every" means both decode variants (greedy / sampling) at EVERY
+        power-of-two attention-window bucket up to capacity — window is a
+        static jit arg, so each bucket is its own executable and a lazily
+        compiled one would stall the single scheduler thread (and every
+        in-flight stream) for seconds the first time traffic crosses a
+        bucket boundary."""
+        import jax.numpy as jnp
+
         t0 = time.perf_counter()
         self._in_warmup = True
         try:
@@ -252,7 +273,7 @@ class GenerationEngine:
                     future=Future(),
                 )
             )
-            self._step()  # greedy decode variant
+            self._step()  # greedy decode variant, smallest window
             self._slots = [None] * self.max_slots
             self._admit(
                 _Request(
@@ -264,7 +285,46 @@ class GenerationEngine:
                     seed=0,
                 )
             )
-            self._step()  # sampling decode variant
+            self._step()  # sampling decode variant, smallest window
+            # Remaining window buckets, both variants, on inert state
+            # (active all-False advances nothing; warmup resets state after).
+            inactive = jnp.zeros((self.max_slots,), bool)
+            window = prefill_bucket(1, self.capacity)
+            while window < self.capacity:
+                window = min(window * 2, self.capacity)
+                (
+                    self._tokens,
+                    self._cache_k,
+                    self._cache_v,
+                    self._lengths,
+                ) = self._decode_greedy(
+                    self._params,
+                    self._tokens,
+                    self._cache_k,
+                    self._cache_v,
+                    self._lengths,
+                    inactive,
+                    window,
+                )
+                (
+                    self._tokens,
+                    self._cache_k,
+                    self._cache_v,
+                    self._lengths,
+                    self._keys,
+                ) = self._decode(
+                    self._params,
+                    self._tokens,
+                    self._cache_k,
+                    self._cache_v,
+                    self._lengths,
+                    inactive,
+                    self._keys,
+                    self._temps,
+                    self._topk,
+                    self._topp,
+                    window,
+                )
         finally:
             self._in_warmup = False
         # Reset state so warmup tokens never leak into a real response.
@@ -436,6 +496,7 @@ class GenerationEngine:
             eos_id=req.eos_id,
             sampling=req.temperature > 0,
             on_token=req.on_token,
+            prompt_len=L,
             t_start=t0,
         )
         self._slots[slot_idx] = slot
@@ -474,6 +535,14 @@ class GenerationEngine:
         active_np = np.array([s is not None for s in self._slots])
         if not active_np.any():
             return
+        # Attention window: smallest bucket covering every active row's
+        # next write position (prompt + tokens emitted so far).
+        needed = max(
+            s.prompt_len + len(s.generated)
+            for s in self._slots
+            if s is not None
+        )
+        window = prefill_bucket(needed, self.capacity)
         t0 = time.perf_counter()
         if any(s is not None and s.sampling for s in self._slots):
             (
@@ -493,6 +562,7 @@ class GenerationEngine:
                 self._temps,
                 self._topk,
                 self._topp,
+                window,
             )
         else:
             (
@@ -507,6 +577,7 @@ class GenerationEngine:
                 self._cache_v,
                 self._lengths,
                 jnp.asarray(active_np),
+                window,
             )
         toks = np.asarray(self._tokens)[:, 0]
         if self._on_step is not None and not self._in_warmup:

@@ -411,3 +411,37 @@ def test_engine_streaming_callback_and_cancel_frees_slot(tiny):
         assert fut.cancelled()
     finally:
         engine.shutdown()
+
+
+def test_windowed_decode_matches_full_capacity(tiny):
+    """window only trims the attended prefix — logits must be exact."""
+    params, cfg = tiny
+    cache = _fresh_cache(cfg, 2)
+    toks = np.zeros((2, 1), np.int32)
+    cache = _admit(params, cfg, cache, toks, [5, 9, 2], 0)
+    cache = _admit(params, cfg, cache, toks, [7, 1, 4, 8], 1)
+    active = np.array([True, True])
+    lw_full, _ = llama.decode_ragged(
+        params, jnp.asarray(toks), cache, cfg, jnp.asarray(active),
+        dtype=jnp.float64,
+    )
+    lw_win, _ = llama.decode_ragged(
+        params, jnp.asarray(toks), cache, cfg, jnp.asarray(active),
+        dtype=jnp.float64, window=16,
+    )
+    assert jnp.array_equal(lw_full, lw_win)
+
+
+def test_warmup_compiles_all_window_buckets(tiny):
+    """No live request may pay a decode compile: after warmup, every
+    power-of-two window bucket of both variants is already compiled."""
+    params, cfg = tiny  # capacity 64 -> buckets 16, 32, 64
+    engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float64)
+    engine.start(warmup=True)
+    try:
+        greedy_sizes = engine._decode_greedy._cache_size()
+        sampling_sizes = engine._decode._cache_size()
+        assert greedy_sizes >= 3, greedy_sizes
+        assert sampling_sizes >= 3, sampling_sizes
+    finally:
+        engine.shutdown()

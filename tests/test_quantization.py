@@ -121,3 +121,19 @@ def test_quantize_with_tp_sharding():
         / (jnp.linalg.norm(lq[0, -1]) * jnp.linalg.norm(lf[0, -1]))
     )
     assert cos > 0.999, cos
+
+
+def test_dequantize_bf16_single_rounding():
+    """The dequant product must round once (f32 multiply -> bf16), not
+    twice (bf16 scale then bf16 multiply)."""
+    w = jax.random.normal(jax.random.key(5), (64, 128), jnp.float32) * 0.02
+    q = quantize_tensor(w)
+    good = dequantize_tensor(q, jnp.bfloat16).astype(jnp.float32)
+    double_rounded = (
+        q["q8"].astype(jnp.bfloat16) * q["scale"].astype(jnp.bfloat16)
+    ).astype(jnp.float32)
+    err_good = float(jnp.abs(good - w).max())
+    err_double = float(jnp.abs(double_rounded - w).max())
+    assert err_good <= err_double
+    # And bf16 dequant stays within int8 quantization error + bf16 ulp.
+    assert err_good <= float(q["scale"].max()) / 2 + 0.01 * float(jnp.abs(w).max())
