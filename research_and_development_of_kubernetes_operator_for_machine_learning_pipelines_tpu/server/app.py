@@ -522,31 +522,29 @@ def build_server(
         max_batch_size=config.tpu.max_batch_size,
         on_compile=lambda: metrics.compilations.labels(**metrics.identity).inc(),
     )
+    channel = None
     if transport is not None:
         from .multihost import MultihostEngine
 
         engine = MultihostEngine(engine, transport)
+        channel = engine.channel
     gen_engine = None
     if predictor.causal_lm is not None:
-        if transport is None:
-            from .generation import GenerationEngine
+        from .generation import GenerationEngine
 
-            gen_engine = GenerationEngine(
-                predictor.causal_lm["params"],
-                predictor.causal_lm["cfg"],
-                max_slots=min(config.tpu.max_batch_size, 8),
-                eos_id=predictor.causal_lm.get("eos_id"),
-                on_step=metrics.observe_decode_step,
-                on_tokens=metrics.inc_generated_tokens,
-            )
-        else:
-            # Multi-host units broadcast engine.predict calls only; the
-            # continuous-batching scheduler is single-host for now, so fall
-            # back to the whole-sequence predict path on those units.
-            _log.warning(
-                "continuous batching disabled on multi-host unit; "
-                "/generate not served"
-            )
+        # On a multi-host unit the scheduler runs leader-side only; every
+        # device call is broadcast on the unit's channel so followers
+        # replay it in lockstep (their GenerationEngine is built in
+        # main()'s follower path and driven by follower_loop).
+        gen_engine = GenerationEngine(
+            predictor.causal_lm["params"],
+            predictor.causal_lm["cfg"],
+            max_slots=min(config.tpu.max_batch_size, 8),
+            eos_id=predictor.causal_lm.get("eos_id"),
+            on_step=metrics.observe_decode_step,
+            on_tokens=metrics.inc_generated_tokens,
+            channel=channel,
+        )
     server = TpuInferenceServer(
         engine,
         metrics,
@@ -666,8 +664,18 @@ def main(argv: list[str] | None = None) -> None:
             engine = InferenceEngine(
                 predictor, max_batch_size=config.tpu.max_batch_size
             )
+            gen_engine = None
+            if predictor.causal_lm is not None:
+                from .generation import GenerationEngine
+
+                # Not started: driven entirely by replayed leader ops.
+                gen_engine = GenerationEngine(
+                    predictor.causal_lm["params"],
+                    predictor.causal_lm["cfg"],
+                    max_slots=min(config.tpu.max_batch_size, 8),
+                )
             _log.info("follower process %d ready", jax.process_index())
-            follower_loop(engine, transport)
+            follower_loop(engine, transport, gen_engine=gen_engine)
             return
     else:
         transport = None

@@ -115,6 +115,7 @@ class GenerationEngine:
         eos_id: int | None = None,
         on_step: Callable[[int, float], None] | None = None,
         on_tokens: Callable[[int], None] | None = None,
+        channel=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -126,6 +127,9 @@ class GenerationEngine:
         self._eos_default = eos_id
         self._on_step = on_step  # (active_slots, step_seconds) per decode tick
         self._on_tokens = on_tokens  # (n,) per token delivered to a client
+        # multihost.UnitChannel: leader broadcasts every device call so
+        # follower processes replay it in lockstep (None = single-host).
+        self._channel = channel
         self._in_warmup = False  # suppress metrics/counters during warmup
         self.max_slots = int(max_slots)
         self.capacity = int(cfg.max_seq)
@@ -287,44 +291,16 @@ class GenerationEngine:
             )
             self._step()  # sampling decode variant, smallest window
             # Remaining window buckets, both variants, on inert state
-            # (active all-False advances nothing; warmup resets state after).
-            inactive = jnp.zeros((self.max_slots,), bool)
+            # (active all-False advances nothing; warmup resets state
+            # after).  Dispatched, not raw: followers of a multihost unit
+            # must compile the same buckets or the first bucket crossing
+            # stalls the whole slice.
+            inactive = np.zeros((self.max_slots,), bool)
             window = prefill_bucket(1, self.capacity)
             while window < self.capacity:
                 window = min(window * 2, self.capacity)
-                (
-                    self._tokens,
-                    self._cache_k,
-                    self._cache_v,
-                    self._lengths,
-                ) = self._decode_greedy(
-                    self._params,
-                    self._tokens,
-                    self._cache_k,
-                    self._cache_v,
-                    self._lengths,
-                    inactive,
-                    window,
-                )
-                (
-                    self._tokens,
-                    self._cache_k,
-                    self._cache_v,
-                    self._lengths,
-                    self._keys,
-                ) = self._decode(
-                    self._params,
-                    self._tokens,
-                    self._cache_k,
-                    self._cache_v,
-                    self._lengths,
-                    inactive,
-                    self._keys,
-                    self._temps,
-                    self._topk,
-                    self._topp,
-                    window,
-                )
+                self._dispatch_step(inactive, window, False)
+                self._dispatch_step(inactive, window, True)
         finally:
             self._in_warmup = False
         # Reset state so warmup tokens never leak into a real response.
@@ -444,7 +420,7 @@ class GenerationEngine:
         return None
 
     def _admit(self, req: _Request) -> None:
-        import jax.numpy as jnp
+        import jax
 
         slot_idx = self._free_slot()
         assert slot_idx is not None
@@ -452,7 +428,6 @@ class GenerationEngine:
         bucket = prefill_bucket(L, self.capacity)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :L] = req.prompt
-        import jax
 
         if req.seed is None:
             # Engine-assigned: distinct per request, disjoint from any
@@ -462,6 +437,50 @@ class GenerationEngine:
         else:
             slot_key = jax.random.key(int(req.seed))
         t0 = time.perf_counter()
+        first = self._dispatch_admit(
+            ids, slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p
+        )
+        slot = _Slot(
+            future=req.future,
+            remaining=req.max_new_tokens,
+            eos_id=req.eos_id,
+            sampling=req.temperature > 0,
+            on_token=req.on_token,
+            prompt_len=L,
+            t_start=t0,
+        )
+        self._slots[slot_idx] = slot
+        self._record_token(slot_idx, int(first))
+
+    def _dispatch_admit(self, ids, slot_idx, L, slot_key, temp, tk, tp):
+        """Broadcast (multihost) then run the prefill+insert device call."""
+        import jax
+
+        if self._channel is None:
+            return self._device_admit(ids, slot_idx, L, slot_key, temp, tk, tp)
+        from .multihost import OP_GEN_ADMIT, encode_message
+
+        payload = encode_message(
+            OP_GEN_ADMIT,
+            {
+                "ids": ids,
+                "slot": int(slot_idx),
+                "length": int(L),
+                # typed keys don't pickle portably; ship the raw key data
+                "key_data": np.asarray(jax.random.key_data(slot_key)),
+                "temp": float(temp),
+                "tk": int(tk),
+                "tp": float(tp),
+            },
+        )
+        return self._channel.run(
+            payload,
+            lambda: self._device_admit(ids, slot_idx, L, slot_key, temp, tk, tp),
+        )
+
+    def _device_admit(self, ids, slot_idx, L, slot_key, temp, tk, tp):
+        import jax.numpy as jnp
+
         (
             self._cache_k,
             self._cache_v,
@@ -486,21 +505,26 @@ class GenerationEngine:
             self._topk,
             self._topp,
             slot_key,
-            jnp.float32(req.temperature),
-            jnp.int32(req.top_k),
-            jnp.float32(req.top_p),
+            jnp.float32(temp),
+            jnp.int32(tk),
+            jnp.float32(tp),
         )
-        slot = _Slot(
-            future=req.future,
-            remaining=req.max_new_tokens,
-            eos_id=req.eos_id,
-            sampling=req.temperature > 0,
-            on_token=req.on_token,
-            prompt_len=L,
-            t_start=t0,
-        )
-        self._slots[slot_idx] = slot
-        self._record_token(slot_idx, int(first))
+        return first
+
+    def replay_admit(self, ids, slot, length, key_data, temp, tk, tp) -> None:
+        """Follower side of :meth:`_dispatch_admit` (multihost lockstep)."""
+        import jax
+
+        slot_key = jax.random.wrap_key_data(np.asarray(key_data))
+        self._device_admit(ids, slot, length, slot_key, temp, tk, tp)
+
+    def replay_step(self, active, window, sampling) -> None:
+        """Follower side of a decode tick (multihost lockstep)."""
+        self._device_step(np.asarray(active), int(window), bool(sampling))
+
+    def replay_reset(self) -> None:
+        """Follower side of :meth:`_fail_all_and_recover`'s device reset."""
+        self._reset_device_state()
 
     def _record_token(self, slot_idx: int, token: int) -> None:
         slot = self._slots[slot_idx]
@@ -530,8 +554,6 @@ class GenerationEngine:
 
     def _step(self) -> None:
         """One batched decode tick over every occupied slot."""
-        import jax.numpy as jnp
-
         active_np = np.array([s is not None for s in self._slots])
         if not active_np.any():
             return
@@ -544,7 +566,33 @@ class GenerationEngine:
         )
         window = prefill_bucket(needed, self.capacity)
         t0 = time.perf_counter()
-        if any(s is not None and s.sampling for s in self._slots):
+        sampling = any(s is not None and s.sampling for s in self._slots)
+        self._dispatch_step(active_np, window, sampling)
+        toks = np.asarray(self._tokens)[:, 0]
+        if self._on_step is not None and not self._in_warmup:
+            self._on_step(int(active_np.sum()), time.perf_counter() - t0)
+        for i, was_active in enumerate(active_np):
+            if was_active and self._slots[i] is not None:
+                self._record_token(i, int(toks[i]))
+
+    def _dispatch_step(self, active_np, window, sampling) -> None:
+        if self._channel is None:
+            self._device_step(active_np, window, sampling)
+            return
+        from .multihost import OP_GEN_STEP, encode_message
+
+        payload = encode_message(
+            OP_GEN_STEP,
+            {"active": active_np, "window": int(window), "sampling": bool(sampling)},
+        )
+        self._channel.run(
+            payload, lambda: self._device_step(active_np, window, sampling)
+        )
+
+    def _device_step(self, active_np, window, sampling) -> None:
+        import jax.numpy as jnp
+
+        if sampling:
             (
                 self._tokens,
                 self._cache_k,
@@ -579,12 +627,6 @@ class GenerationEngine:
                 jnp.asarray(active_np),
                 window,
             )
-        toks = np.asarray(self._tokens)[:, 0]
-        if self._on_step is not None and not self._in_warmup:
-            self._on_step(int(active_np.sum()), time.perf_counter() - t0)
-        for i, was_active in enumerate(active_np):
-            if was_active and self._slots[i] is not None:
-                self._record_token(i, int(toks[i]))
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -630,6 +672,22 @@ class GenerationEngine:
                     RuntimeError("generation step failed; see server log"),
                 )
             self._slots[i] = None
+        if self._channel is not None:
+            # Followers replayed the op that just failed here; their buffers
+            # are invalidated (or their state now diverges).  Broadcast the
+            # reset so every host drops to the same fresh state — otherwise
+            # each subsequent replayed step runs with disagreeing
+            # lengths/cache shards and silently corrupts tokens.
+            from .multihost import OP_GEN_RESET, encode_message
+
+            try:
+                self._channel.run(
+                    encode_message(OP_GEN_RESET, {}),
+                    self._reset_device_state,
+                )
+                return
+            except Exception:
+                _log.exception("broadcasting gen reset failed")
         try:
             self._reset_device_state()
         except Exception:

@@ -41,6 +41,9 @@ _log = logging.getLogger(__name__)
 
 OP_PREDICT = "predict"
 OP_SHUTDOWN = "shutdown"
+OP_GEN_ADMIT = "gen_admit"  # continuous-batching prefill+insert (replayed)
+OP_GEN_STEP = "gen_step"  # continuous-batching decode tick (replayed)
+OP_GEN_RESET = "gen_reset"  # leader recovered from a failed step: drop state
 
 # Fixed-size round-1 header: payload byte length as uint32.  Round 2 is the
 # payload itself.  Two rounds because ``broadcast_one_to_all`` needs every
@@ -159,6 +162,39 @@ def decode_message(raw: bytes) -> tuple[str, dict[str, np.ndarray] | None]:
 # ---------------------------------------------------------------------------
 
 
+class UnitChannel:
+    """Serialized broadcast+execute for every leader-side dispatcher.
+
+    Cross-host collectives only line up if every process enters the same
+    jitted programs in the same order.  Follower order is broadcast order,
+    so the leader must make (broadcast, execute) atomic — and with BOTH the
+    batcher's predict path and the generation scheduler dispatching device
+    work, they must share one lock.  ``run`` is that critical section.
+    """
+
+    def __init__(self, transport: GroupTransport) -> None:
+        self.transport = transport
+        self.lock = threading.RLock()
+        self.closed = False
+
+    def run(self, payload: bytes, fn):
+        with self.lock:
+            if self.closed:
+                # After OP_SHUTDOWN the followers have exited their loop; a
+                # further broadcast would wait on peers that are gone and
+                # wedge the leader process instead of letting it terminate.
+                raise RuntimeError("multihost unit is shut down")
+            self.transport.broadcast(payload)
+            return fn()
+
+    def close_with(self, payload: bytes) -> None:
+        with self.lock:
+            if self.closed:
+                return
+            self.closed = True
+            self.transport.broadcast(payload)
+
+
 class MultihostEngine:
     """Duck-types :class:`InferenceEngine` for the batcher/app; every
     ``predict`` is first broadcast so followers execute it in lockstep.
@@ -168,16 +204,18 @@ class MultihostEngine:
     real request would stall N-1 hosts on an XLA compile.
     """
 
-    def __init__(self, engine: Any, transport: GroupTransport) -> None:
+    def __init__(
+        self,
+        engine: Any,
+        transport: GroupTransport,
+        channel: UnitChannel | None = None,
+    ) -> None:
         if not transport.is_leader:
             raise ValueError("MultihostEngine is leader-side; followers run follower_loop")
         self._engine = engine
         self._transport = transport
-        # The app calls predict from both the batcher thread and the
-        # bucketed-path executor; broadcast+execute must be atomic or the
-        # followers' step order diverges from the leader's.
-        self._step_lock = threading.Lock()
-        self._closed = False
+        # Shared with the generation scheduler (see UnitChannel).
+        self.channel = channel or UnitChannel(transport)
 
     # pass-throughs the app/batcher use
     @property
@@ -189,14 +227,10 @@ class MultihostEngine:
         return self._engine.max_batch_size
 
     def predict(self, inputs: Mapping[str, np.ndarray]) -> Any:
-        with self._step_lock:
-            if self._closed:
-                # After OP_SHUTDOWN the followers have exited their loop; a
-                # further broadcast would wait on peers that are gone and
-                # wedge the leader process instead of letting it terminate.
-                raise RuntimeError("multihost unit is shut down")
-            self._transport.broadcast(encode_message(OP_PREDICT, inputs))
-            return self._engine.predict(inputs)
+        return self.channel.run(
+            encode_message(OP_PREDICT, inputs),
+            lambda: self._engine.predict(inputs),
+        )
 
     def warmup(self, buckets: list[int] | None = None) -> float:
         # Delegate to the engine's single warmup implementation, routing
@@ -207,17 +241,15 @@ class MultihostEngine:
     def shutdown(self) -> None:
         """Release followers; without this they block on broadcast forever
         and the pod unit never terminates cleanly."""
-        with self._step_lock:
-            if self._closed:
-                return
-            self._closed = True
-            self._transport.broadcast(encode_message(OP_SHUTDOWN))
+        self.channel.close_with(encode_message(OP_SHUTDOWN))
 
 
-def follower_loop(engine: Any, transport: GroupTransport) -> int:
+def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None) -> int:
     """Run on processes 1..N-1: execute broadcast steps until shutdown.
 
-    Returns the number of predict steps executed (for tests/metrics).
+    ``gen_engine`` (a non-started GenerationEngine) replays the leader's
+    continuous-batching device calls for causal-LM units.
+    Returns the number of steps executed (for tests/metrics).
     """
     if transport.is_leader:
         raise ValueError("follower_loop must not run on the leader")
@@ -227,18 +259,43 @@ def follower_loop(engine: Any, transport: GroupTransport) -> int:
         if op == OP_SHUTDOWN:
             _log.info("follower received shutdown after %d steps", steps)
             return steps
-        if op == OP_PREDICT:
-            assert inputs is not None
-            try:
+        try:
+            if op == OP_PREDICT:
+                assert inputs is not None
                 engine.predict(inputs)
-            except Exception:
-                # The leader catches the same model error in its HTTP
-                # handler and stays up (app.py returns 500); a follower
-                # that dies instead can never rejoin the formed process
-                # group and would wedge the whole unit on the next
-                # broadcast.  Same step attempted on every host keeps the
-                # group in lockstep whether it raised or not.
-                _log.exception("follower predict step failed; continuing")
-            steps += 1
-        else:  # unknown op: skip rather than desync the group
-            _log.warning("follower ignoring unknown op %r", op)
+            elif op == OP_GEN_ADMIT:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_admit(**inputs)
+            elif op == OP_GEN_STEP:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_step(**inputs)
+            elif op == OP_GEN_RESET:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_reset()
+            else:  # unknown op: skip rather than desync the group
+                _log.warning("follower ignoring unknown op %r", op)
+        except Exception:
+            # The leader catches the same model error in its HTTP handler
+            # and stays up (app.py returns 500); a follower that dies
+            # instead can never rejoin the formed process group and would
+            # wedge the whole unit on the next broadcast.  Same step
+            # attempted on every host keeps the group in lockstep whether
+            # it raised or not.
+            _log.exception("follower step %r failed; continuing", op)
+            if op in (OP_GEN_ADMIT, OP_GEN_STEP) and gen_engine is not None:
+                # A failed jitted gen call has invalidated this host's
+                # donated cache buffers; without fresh ones every later
+                # replay raises "Array has been deleted" and gets skipped —
+                # and a host that skips jitted steps the leader executes
+                # wedges the slice on the next cross-host collective.
+                # Fresh buffers keep the follower ENTERING every program;
+                # diverged slot contents self-heal on slot reuse (admit
+                # rewrites lengths/tokens/cache for its slot on all hosts).
+                try:
+                    gen_engine.replay_reset()
+                except Exception:
+                    _log.exception("follower gen-state reset failed")
+        steps += 1
