@@ -127,6 +127,49 @@ class RaggedKVCache(NamedTuple):
         )
 
 
+class QuantRaggedKVCache(NamedTuple):
+    """Int8 variant of :class:`RaggedKVCache` (KV-cache quantization).
+
+    Decode streams the whole attended cache window every step; at long
+    context that traffic dwarfs the (already int8-able) weights, so the
+    cache itself is the next HBM lever.  K/V are stored int8 with a
+    per-(layer, row, position, head) scale over the ``head_dim`` axis —
+    written once when the position is produced and consumed WITHOUT a
+    dequantized copy (scales factor out of the attention einsums; see
+    ``_block``).  Measured on a v5e chip (1.35B shape, 8 slots at position
+    256): with int8 weights and window=512, the int8 cache lifts decode
+    from 780 to 812 tok/s (1.30x over the bf16 baseline's 623), and still
+    wins at full capacity (1.21x).  Opt-in: ``spec.tpu.quantize: int8kv``
+    (KV rounding costs ~1e-2 relative logit error).
+    """
+
+    k8: jax.Array  # int8   [L, B, T, NKV, D]
+    k_scale: jax.Array  # f32 [L, B, T, NKV, 1]
+    v8: jax.Array
+    v_scale: jax.Array
+    lengths: jax.Array  # int32 [B]
+
+    @classmethod
+    def create(cls, cfg: LlamaConfig, batch: int) -> "QuantRaggedKVCache":
+        shape = (cfg.num_layers, batch, cfg.max_seq, cfg.num_kv_heads, cfg.head_dim)
+        sshape = shape[:-1] + (1,)
+        return cls(
+            k8=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(sshape, jnp.float32),
+            v8=jnp.zeros(shape, jnp.int8),
+            v_scale=jnp.zeros(sshape, jnp.float32),
+            lengths=jnp.zeros((batch,), jnp.int32),
+        )
+
+
+def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(…, head) int8 over the trailing head_dim axis."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1, keepdims=True), 1e-12) / 127.0
+    q8 = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q8, scale
+
+
 # ---------------------------------------------------------------------------
 # Init / torch import
 # ---------------------------------------------------------------------------
@@ -270,34 +313,87 @@ def _block(
     k = apply_rope(k, cos, sin)
 
     # Write this chunk's K/V into the cache at [start : start+s].
-    if ragged:
-        def _write(row_cache, row_kv, row_start):
-            z = jnp.zeros((), row_start.dtype)
-            return lax.dynamic_update_slice(row_cache, row_kv, (row_start, z, z))
+    # A quantized cache layer arrives as pairs (values int8, scales): the
+    # chunk is quantized per-(position, head) at write time and dequantized
+    # on the (fused) read path — KV-cache HBM traffic halves.
+    quant_cache = isinstance(cache_k, tuple)
 
-        cache_k = jax.vmap(_write)(cache_k, k.astype(cache_k.dtype), start)
-        cache_v = jax.vmap(_write)(cache_v, v.astype(cache_v.dtype), start)
+    def _write_all(buffers_and_vals):
+        out = []
+        if ragged:
+            def _write(row_cache, row_kv, row_start):
+                z = jnp.zeros((), row_start.dtype)
+                return lax.dynamic_update_slice(row_cache, row_kv, (row_start, z, z))
+
+            for buf, vals in buffers_and_vals:
+                out.append(jax.vmap(_write)(buf, vals.astype(buf.dtype), start))
+        else:
+            z = jnp.zeros((), start.dtype) if hasattr(start, "dtype") else 0
+            for buf, vals in buffers_and_vals:
+                out.append(
+                    lax.dynamic_update_slice(
+                        buf, vals.astype(buf.dtype), (z, start, z, z)
+                    )
+                )
+        return out
+
+    if quant_cache:
+        k8, ks = cache_k
+        v8, vs = cache_v
+        kq, kqs = _quant_kv(k)
+        vq, vqs = _quant_kv(v)
+        k8, ks, v8, vs = _write_all([(k8, kq), (ks, kqs), (v8, vq), (vs, vqs)])
+        cache_k = (k8, ks)
+        cache_v = (v8, vs)
     else:
-        z = jnp.zeros((), start.dtype) if hasattr(start, "dtype") else 0
-        cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (z, start, z, z))
-        cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (z, start, z, z))
+        cache_k, cache_v = _write_all([(cache_k, k), (cache_v, v)])
 
     # GQA via grouped einsum: q reshaped to [B,S,NKV,G,D] contracts directly
     # against the [B,T,NKV,D] cache — no materialized repeat of K/V to all
     # query heads (that broadcast would dominate HBM traffic at decode).
     group = nh // nkv
     qg = q.reshape(b, s, nkv, group, hd)
-    kk = cache_k if window is None else cache_k[:, :window]
-    vv = cache_v if window is None else cache_v[:, :window]
-    kk = kk.astype(x.dtype)
-    vv = vv.astype(x.dtype)
+    if quant_cache:
+        # The per-(position, head) scales are CONSTANT over the contracted
+        # head_dim axis, so they factor OUT of both einsums: contract the
+        # raw int8 cache (the int8->bf16 convert fuses into the operand
+        # read like the weight path) and fold K's scale into the scores,
+        # V's into the probabilities.  A naive dequantize-then-einsum
+        # materializes a full bf16 copy of the cache window per step —
+        # measured SLOWER than the bf16 cache it was meant to beat.
+        k8, ks = cache_k
+        v8, vs = cache_v
+        if window is not None:
+            k8, ks = k8[:, :window], ks[:, :window]
+            v8, vs = v8[:, :window], vs[:, :window]
+        scores = jnp.einsum(
+            "bqngd,bknd->bngqk",
+            qg,
+            k8.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(jnp.float32(hd))
+        # ks: [B, W, NKV, 1] -> [B, NKV, 1, 1, W] broadcast over (G, S)
+        kscale = jnp.moveaxis(ks[..., 0], 1, 2)[:, :, None, None, :]
+        scores = scores * kscale
+        scores = scores + mask_bias[:, None]
+        probs = jax.nn.softmax(scores, axis=-1)
+        vscale = jnp.moveaxis(vs[..., 0], 1, 2)[:, :, None, None, :]
+        probs = (probs * vscale).astype(x.dtype)
+        ctx = jnp.einsum(
+            "bngqk,bknd->bqngd", probs, v8.astype(x.dtype)
+        ).reshape(b, s, nh * hd)
+    else:
+        kk = cache_k if window is None else cache_k[:, :window]
+        vv = cache_v if window is None else cache_v[:, :window]
+        kk = kk.astype(x.dtype)
+        vv = vv.astype(x.dtype)
 
-    scores = jnp.einsum(
-        "bqngd,bknd->bngqk", qg, kk, preferred_element_type=jnp.float32
-    ) / jnp.sqrt(jnp.float32(hd))
-    scores = scores + mask_bias[:, None]  # [B or 1, 1, 1, S, T]
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bngqk,bknd->bqngd", probs, vv).reshape(b, s, nh * hd)
+        scores = jnp.einsum(
+            "bqngd,bknd->bngqk", qg, kk, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.float32(hd))
+        scores = scores + mask_bias[:, None]  # [B or 1, 1, 1, S, T]
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bngqk,bknd->bqngd", probs, vv).reshape(b, s, nh * hd)
     attn_out = jnp.matmul(
         ctx, _mat(lp["o"], ctx.dtype), preferred_element_type=jnp.float32
     ).astype(x.dtype)
@@ -407,12 +503,12 @@ def generate_greedy(
 def decode_ragged(
     params: dict,
     token_ids: jax.Array,
-    cache: RaggedKVCache,
+    cache: "RaggedKVCache | QuantRaggedKVCache",
     cfg: LlamaConfig,
     active: jax.Array | None = None,
     dtype=jnp.bfloat16,
     window: int | None = None,
-) -> tuple[jax.Array, RaggedKVCache]:
+):
     """One decode step where every batch row is at its OWN position.
 
     token_ids ``[B, 1]``; each row i writes K/V at ``cache.lengths[i]`` and
@@ -440,13 +536,14 @@ def decode_ragged(
     b, s = token_ids.shape
     if s != 1:
         raise ValueError(f"decode_ragged is single-token: got chunk of {s}")
+    quant = isinstance(cache, QuantRaggedKVCache)
     lengths = cache.lengths
     x = jnp.take(params["embed"], token_ids, axis=0).astype(dtype)
 
     positions = lengths[:, None]  # [B, 1]
     cos, sin = rope_cos_sin(positions, cfg, jnp.float32)  # [B, 1, head_dim]
 
-    capacity = cache.k.shape[2]
+    capacity = (cache.k8 if quant else cache.k).shape[2]
     if window is None:
         window = capacity
     window = min(int(window), capacity)
@@ -462,9 +559,9 @@ def decode_ragged(
         )
         return y, (ck2, cv2)
 
-    x, (new_k, new_v) = lax.scan(
-        scan_body, x, (params["layers"], cache.k, cache.v)
-    )
+    ck0 = (cache.k8, cache.k_scale) if quant else cache.k
+    cv0 = (cache.v8, cache.v_scale) if quant else cache.v
+    x, (new_k, new_v) = lax.scan(scan_body, x, (params["layers"], ck0, cv0))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = jnp.matmul(
         x, _mat(params["lm_head"], x.dtype), preferred_element_type=jnp.float32
@@ -472,12 +569,19 @@ def decode_ragged(
     advance = (
         jnp.ones((b,), jnp.int32) if active is None else active.astype(jnp.int32)
     )
+    if quant:
+        return logits, QuantRaggedKVCache(
+            new_k[0], new_k[1], new_v[0], new_v[1], lengths + advance
+        )
     return logits, RaggedKVCache(new_k, new_v, lengths + advance)
 
 
 def insert_sequence(
-    cache: RaggedKVCache, seq: KVCache, slot: jax.Array, length: jax.Array
-) -> RaggedKVCache:
+    cache: "RaggedKVCache | QuantRaggedKVCache",
+    seq: KVCache,
+    slot: jax.Array,
+    length: jax.Array,
+):
     """Install a prefilled single-sequence cache into batch row ``slot``.
 
     ``seq`` comes from :func:`prefill` with batch 1 (k/v ``[L,1,Tp,...]``,
@@ -489,13 +593,26 @@ def insert_sequence(
     """
     slot = jnp.asarray(slot, jnp.int32)
     z = jnp.zeros((), jnp.int32)
+    lengths = cache.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
+    if isinstance(cache, QuantRaggedKVCache):
+        k8, ks = _quant_kv(seq.k)
+        v8, vs = _quant_kv(seq.v)
+        ins = lambda buf, vals: lax.dynamic_update_slice(
+            buf, vals.astype(buf.dtype), (z, slot, z, z, z)
+        )
+        return QuantRaggedKVCache(
+            ins(cache.k8, k8),
+            ins(cache.k_scale, ks),
+            ins(cache.v8, v8),
+            ins(cache.v_scale, vs),
+            lengths,
+        )
     k = lax.dynamic_update_slice(
         cache.k, seq.k.astype(cache.k.dtype), (z, slot, z, z, z)
     )
     v = lax.dynamic_update_slice(
         cache.v, seq.v.astype(cache.v.dtype), (z, slot, z, z, z)
     )
-    lengths = cache.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
     return RaggedKVCache(k, v, lengths)
 
 

@@ -498,6 +498,27 @@ def _to_v2_outputs(out: Any) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
+def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None):
+    """Construct the GenerationEngine for a causal-LM predictor.
+
+    ONE construction site for leader and followers: lockstep replay needs
+    bit-identical slot counts / dtype / kv_quant on every host, so the
+    shared knobs must never be spelled twice.
+    """
+    from .generation import GenerationEngine
+
+    return GenerationEngine(
+        predictor.causal_lm["params"],
+        predictor.causal_lm["cfg"],
+        max_slots=min(config.tpu.max_batch_size, 8),
+        eos_id=predictor.causal_lm.get("eos_id"),
+        on_step=metrics.observe_decode_step if metrics else None,
+        on_tokens=metrics.inc_generated_tokens if metrics else None,
+        channel=channel,
+        kv_quant=config.tpu.quantize == "int8kv",
+    )
+
+
 def build_server(
     config: ServerConfig, warmup: bool = True, transport=None
 ) -> TpuInferenceServer:
@@ -530,20 +551,12 @@ def build_server(
         channel = engine.channel
     gen_engine = None
     if predictor.causal_lm is not None:
-        from .generation import GenerationEngine
-
         # On a multi-host unit the scheduler runs leader-side only; every
         # device call is broadcast on the unit's channel so followers
         # replay it in lockstep (their GenerationEngine is built in
         # main()'s follower path and driven by follower_loop).
-        gen_engine = GenerationEngine(
-            predictor.causal_lm["params"],
-            predictor.causal_lm["cfg"],
-            max_slots=min(config.tpu.max_batch_size, 8),
-            eos_id=predictor.causal_lm.get("eos_id"),
-            on_step=metrics.observe_decode_step,
-            on_tokens=metrics.inc_generated_tokens,
-            channel=channel,
+        gen_engine = make_gen_engine(
+            predictor, config, channel=channel, metrics=metrics
         )
     server = TpuInferenceServer(
         engine,
@@ -604,8 +617,9 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument(
         "--quantize",
         default="none",
-        choices=["none", "int8"],
-        help="weight-only quantization (int8 halves decode HBM traffic)",
+        choices=["none", "int8", "int8kv"],
+        help="int8: weight-only; int8kv: weights + KV cache "
+        "(halves decode HBM traffic twice over)",
     )
     ap.add_argument(
         "--compile-cache-dir",
@@ -666,14 +680,8 @@ def main(argv: list[str] | None = None) -> None:
             )
             gen_engine = None
             if predictor.causal_lm is not None:
-                from .generation import GenerationEngine
-
                 # Not started: driven entirely by replayed leader ops.
-                gen_engine = GenerationEngine(
-                    predictor.causal_lm["params"],
-                    predictor.causal_lm["cfg"],
-                    max_slots=min(config.tpu.max_batch_size, 8),
-                )
+                gen_engine = make_gen_engine(predictor, config)
             _log.info("follower process %d ready", jax.process_index())
             follower_loop(engine, transport, gen_engine=gen_engine)
             return

@@ -116,6 +116,7 @@ class GenerationEngine:
         on_step: Callable[[int, float], None] | None = None,
         on_tokens: Callable[[int], None] | None = None,
         channel=None,
+        kv_quant: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -135,14 +136,26 @@ class GenerationEngine:
         self.capacity = int(cfg.max_seq)
         dtype = dtype or jnp.bfloat16
         self._dtype = dtype
+        self._kv_quant = bool(kv_quant)
         self._reset_device_state()
+
+        def make_cache(k, v, lengths):
+            """k/v are arrays (bf16 cache) or (values, scales) pairs."""
+            if self._kv_quant:
+                return llama.QuantRaggedKVCache(k[0], k[1], v[0], v[1], lengths)
+            return llama.RaggedKVCache(k, v, lengths)
+
+        def cache_repr(cache):
+            if self._kv_quant:
+                return (cache.k8, cache.k_scale), (cache.v8, cache.v_scale)
+            return cache.k, cache.v
 
         def _decode(
             params, toks, k, v, lengths, active, keys, temps, tks, tps, window
         ):
             from ..models.sampling import sample_logits, split_keys
 
-            cache = llama.RaggedKVCache(k, v, lengths)
+            cache = make_cache(k, v, lengths)
             logits, cache = llama.decode_ragged(
                 params, toks, cache, cfg, active=active, dtype=dtype,
                 window=window,
@@ -151,7 +164,8 @@ class GenerationEngine:
             nxt = sample_logits(logits[:, -1, :], use, temps, tks, tps)
             # Finished slots keep their last token so their rows stay inert.
             toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
-            return toks2, cache.k, cache.v, cache.lengths, keys2
+            ck, cv = cache_repr(cache)
+            return toks2, ck, cv, cache.lengths, keys2
 
         # ``window`` is static: one compiled program per power-of-two bucket
         # of the longest active sequence (short traffic stops paying
@@ -163,14 +177,15 @@ class GenerationEngine:
         def _decode_greedy(params, toks, k, v, lengths, active, window):
             # Hot path when every occupied slot is greedy (the default):
             # plain argmax — no full-vocab sort/softmax/categorical work.
-            cache = llama.RaggedKVCache(k, v, lengths)
+            cache = make_cache(k, v, lengths)
             logits, cache = llama.decode_ragged(
                 params, toks, cache, cfg, active=active, dtype=dtype,
                 window=window,
             )
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
             toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
-            return toks2, cache.k, cache.v, cache.lengths
+            ck, cv = cache_repr(cache)
+            return toks2, ck, cv, cache.lengths
 
         self._decode_greedy = jax.jit(
             _decode_greedy, donate_argnums=(2, 3), static_argnums=(6,)
@@ -184,7 +199,7 @@ class GenerationEngine:
 
             logits, seq = llama.prefill(params, ids, cfg, dtype=dtype)
             cache = llama.insert_sequence(
-                llama.RaggedKVCache(k, v, lengths), seq, slot, actual_len
+                make_cache(k, v, lengths), seq, slot, actual_len
             )
             # Install the slot's sampling state, then draw the first token
             # with the same per-slot key discipline decode uses.
@@ -198,8 +213,9 @@ class GenerationEngine:
                 row, use[None], temp[None], tk[None], tp[None]
             )[0]
             toks2 = toks.at[slot, 0].set(first)
+            ck, cv = cache_repr(cache)
             return (
-                cache.k, cache.v, cache.lengths, toks2,
+                ck, cv, cache.lengths, toks2,
                 keys2, temps2, tks2, tps2, first,
             )
 
@@ -226,14 +242,20 @@ class GenerationEngine:
         Also the recovery path after a failed jitted step: donation has
         already invalidated the old buffers, so continuing with them would
         raise "Array has been deleted" on every subsequent request."""
+        import jax
         import jax.numpy as jnp
 
         from ..models import llama
 
-        import jax
-
-        cache = llama.RaggedKVCache.create(self._cfg, self.max_slots, self._dtype)
-        self._cache_k, self._cache_v = cache.k, cache.v
+        if getattr(self, "_kv_quant", False):
+            cache = llama.QuantRaggedKVCache.create(self._cfg, self.max_slots)
+            self._cache_k = (cache.k8, cache.k_scale)
+            self._cache_v = (cache.v8, cache.v_scale)
+        else:
+            cache = llama.RaggedKVCache.create(
+                self._cfg, self.max_slots, self._dtype
+            )
+            self._cache_k, self._cache_v = cache.k, cache.v
         self._lengths = cache.lengths
         self._tokens = jnp.zeros((self.max_slots, 1), jnp.int32)
         # Per-slot sampling state (arrays so one compiled decode serves any

@@ -248,12 +248,12 @@ def load_predictor(
                     f"llama-generate flavor (decode is HBM-bound); "
                     f"{flavor!r} serves prefill-style batches"
                 )
-            if quantize != "int8":
+            if quantize not in ("int8", "int8kv"):
                 raise ModelLoadError(f"unknown quantize mode {quantize!r}")
             from ..models.quantization import quantize_llama
 
             params = quantize_llama(params)
-            _log.info("quantized %s weights to int8 (weight-only)", flavor)
+            _log.info("quantized %s weights to int8 (mode=%s)", flavor, quantize)
         kwargs = dict(meta.get("builder_kwargs", {}))
         if cfg is not None:
             kwargs["cfg"] = cfg

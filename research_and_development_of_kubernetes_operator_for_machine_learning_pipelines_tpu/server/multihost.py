@@ -278,24 +278,24 @@ def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None
             else:  # unknown op: skip rather than desync the group
                 _log.warning("follower ignoring unknown op %r", op)
         except Exception:
-            # The leader catches the same model error in its HTTP handler
-            # and stays up (app.py returns 500); a follower that dies
-            # instead can never rejoin the formed process group and would
-            # wedge the whole unit on the next broadcast.  Same step
-            # attempted on every host keeps the group in lockstep whether
-            # it raised or not.
+            if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET):
+                # Generation is STATEFUL: if this host failed a step the
+                # leader executed, its cache/lengths shards now disagree
+                # with every other host's, and all in-flight sequences
+                # would keep streaming silently corrupted tokens as 200s.
+                # Fail LOUD instead: exit the loop (the pod terminates,
+                # the process group breaks, the leader's next collective
+                # errors and fails in-flight requests with a 500, and the
+                # unit restarts into a consistent state).
+                _log.exception(
+                    "follower gen step %r failed; exiting so the unit "
+                    "restarts instead of serving corrupted tokens", op
+                )
+                raise
+            # predict is stateless: the leader catches the same model error
+            # in its HTTP handler and stays up (app.py returns 500); a
+            # follower that dies instead could never rejoin the formed
+            # process group.  Same step attempted on every host keeps the
+            # group in lockstep whether it raised or not.
             _log.exception("follower step %r failed; continuing", op)
-            if op in (OP_GEN_ADMIT, OP_GEN_STEP) and gen_engine is not None:
-                # A failed jitted gen call has invalidated this host's
-                # donated cache buffers; without fresh ones every later
-                # replay raises "Array has been deleted" and gets skipped —
-                # and a host that skips jitted steps the leader executes
-                # wedges the slice on the next cross-host collective.
-                # Fresh buffers keep the follower ENTERING every program;
-                # diverged slot contents self-heal on slot reuse (admit
-                # rewrites lengths/tokens/cache for its slot on all hosts).
-                try:
-                    gen_engine.replay_reset()
-                except Exception:
-                    _log.exception("follower gen-state reset failed")
         steps += 1

@@ -153,9 +153,10 @@ def _parse_quantize(value) -> str:
     """Reject bad quantize values at reconcile time — a typo'd CR field must
     surface in status, not as a pod CrashLoopBackOff at argparse."""
     mode = str(value).lower()
-    if mode not in ("none", "int8"):
+    if mode not in ("none", "int8", "int8kv"):
         raise ValueError(
-            f"spec.tpu.quantize must be 'none' or 'int8', got {value!r}"
+            f"spec.tpu.quantize must be 'none', 'int8', or 'int8kv', "
+            f"got {value!r}"
         )
     return mode
 
@@ -177,7 +178,7 @@ class TpuSpec:
     max_batch_size: int = 32
     max_batch_delay_ms: float = 5.0
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
-    quantize: str = "none"  # "none" | "int8" (weight-only, decode HBM relief)
+    quantize: str = "none"  # none | int8 (weights) | int8kv (weights+KV cache)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":

@@ -137,3 +137,90 @@ def test_dequantize_bf16_single_rounding():
     assert err_good <= err_double
     # And bf16 dequant stays within int8 quantization error + bf16 ulp.
     assert err_good <= float(q["scale"].max()) / 2 + 0.01 * float(jnp.abs(w).max())
+
+
+# ---------------------------------------------------------------------------
+# KV-cache int8 (quantize: int8kv)
+# ---------------------------------------------------------------------------
+
+
+def test_quant_kv_cache_decode_close_to_full_precision():
+    from tpumlops.models.llama import QuantRaggedKVCache, RaggedKVCache
+
+    cfg = llama.LlamaConfig.tiny(max_seq=32)
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.float32)
+    prompt = jnp.asarray([[5, 9, 2, 11]], jnp.int32)
+    logits, seq = llama.prefill(params, prompt, cfg, dtype=jnp.float32)
+    tok = jnp.tile(jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32), (2, 1))
+
+    full = llama.insert_sequence(
+        RaggedKVCache.create(cfg, 2, jnp.float32), seq, jnp.int32(0), jnp.int32(4)
+    )
+    quant = llama.insert_sequence(
+        QuantRaggedKVCache.create(cfg, 2), seq, jnp.int32(0), jnp.int32(4)
+    )
+    active = jnp.asarray([True, False])
+    for _ in range(6):
+        lf, full = llama.decode_ragged(
+            params, tok, full, cfg, active, dtype=jnp.float32
+        )
+        lq, quant = llama.decode_ragged(
+            params, tok, quant, cfg, active, dtype=jnp.float32
+        )
+        cos = float(
+            jnp.sum(lq[0, -1] * lf[0, -1])
+            / (jnp.linalg.norm(lq[0, -1]) * jnp.linalg.norm(lf[0, -1]))
+        )
+        assert cos > 0.995, cos
+        tok = jnp.tile(
+            jnp.argmax(lf[0:1, -1:], axis=-1).astype(jnp.int32), (2, 1)
+        )
+    # storage really is int8
+    assert quant.k8.dtype == jnp.int8
+    assert quant.lengths[0] == full.lengths[0]
+
+
+def test_engine_kv_quant_end_to_end():
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(1), cfg, dtype=jnp.float32)
+    engine = GenerationEngine(
+        quantize_llama(params), cfg, max_slots=2, dtype=jnp.float32, kv_quant=True
+    )
+    engine.start(warmup=True)
+    try:
+        out = engine.generate([5, 9, 2], 6)
+        assert out.shape == (6,)
+        # deterministic (greedy) and reproducible with a quantized cache
+        assert engine.generate([5, 9, 2], 6).tolist() == out.tolist()
+        # sampled path over the quantized cache
+        s1 = engine.generate([7, 1], 5, temperature=0.9, seed=3)
+        s2 = engine.generate([7, 1], 5, temperature=0.9, seed=3)
+        assert s1.tolist() == s2.tolist()
+    finally:
+        engine.shutdown()
+
+
+def test_loader_int8kv_mode(tmp_path):
+    from tpumlops.server.loader import load_predictor, save_native_model
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(2), cfg, dtype=jnp.float32)
+    art = tmp_path / "llm"
+    save_native_model(
+        art,
+        "llama-generate",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+    )
+    pred = load_predictor(str(art), quantize="int8kv")
+    assert is_quantized(pred.causal_lm["params"]["lm_head"])
