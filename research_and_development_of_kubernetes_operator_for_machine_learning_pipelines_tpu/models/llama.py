@@ -164,10 +164,10 @@ class QuantRaggedKVCache(NamedTuple):
 
 def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Per-(…, head) int8 over the trailing head_dim axis."""
-    x32 = x.astype(jnp.float32)
-    scale = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1, keepdims=True), 1e-12) / 127.0
-    q8 = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
-    return q8, scale
+    from .quantization import quantize_tensor
+
+    q = quantize_tensor(x, axis=-1)
+    return q["q8"], q["scale"]
 
 
 # ---------------------------------------------------------------------------

@@ -38,10 +38,15 @@ import jax
 import jax.numpy as jnp
 
 
-def quantize_tensor(w: jax.Array) -> dict[str, jax.Array]:
-    """Symmetric per-output-channel int8: reduce |max| over axis -2."""
+def quantize_tensor(w: jax.Array, axis: int = -2) -> dict[str, jax.Array]:
+    """Symmetric int8 with the |max| reduced over ``axis`` (kept at rank).
+
+    ``axis=-2`` (default) is per-output-channel for ``[..., in, out]``
+    weights; the KV cache uses ``axis=-1`` (per position+head over
+    head_dim).  ONE implementation of the scheme — epsilon, rounding, and
+    clip live here only."""
     w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
     scale = jnp.maximum(amax, 1e-12) / 127.0
     q8 = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return {"q8": q8, "scale": scale}

@@ -74,6 +74,9 @@ class TpuInferenceServer:
         self.model_name = model_name
         self.ready = False
         self.gen_engine = gen_engine  # GenerationEngine for causal-LM flavors
+        import threading
+
+        self._profile_lock = threading.Lock()
         self.batcher = DynamicBatcher(
             run_batch=engine.predict,
             max_batch_size=max_batch_size,
@@ -395,6 +398,47 @@ class TpuInferenceServer:
                 await resp.write_eof()
         return resp
 
+    async def handle_profile(self, request: web.Request) -> web.Response:
+        """Capture a JAX/XLA device trace (SURVEY §5: the reference has no
+        profiling anywhere; the TPU data plane gets ``jax.profiler``).
+
+        ``POST /debug/profile {"duration_s": 3}`` records device + host
+        activity for the window and returns the trace directory (TensorBoard
+        / xprof readable; always under ``/tmp/tpumlops-profile`` — the
+        endpoint is unauthenticated, so no caller-chosen paths).  One
+        capture at a time."""
+        import math
+
+        import jax
+
+        try:
+            body = await request.json() if request.can_read_body else {}
+            duration = float(body.get("duration_s", 3.0))
+            if not math.isfinite(duration):
+                raise ValueError(f"duration_s must be finite, got {duration}")
+            duration = min(max(duration, 0.1), 60.0)
+            out_dir = f"/tmp/tpumlops-profile/{self.model_name}-{int(time.time())}"
+            if not self._profile_lock.acquire(blocking=False):
+                return web.json_response(
+                    {"error": "a profile capture is already running"}, status=409
+                )
+            try:
+                try:
+                    jax.profiler.start_trace(out_dir)
+                    await asyncio.sleep(duration)
+                finally:
+                    with contextlib.suppress(Exception):
+                        # raises "no session" when start_trace itself failed
+                        jax.profiler.stop_trace()
+            finally:
+                self._profile_lock.release()
+            return web.json_response({"trace_dir": out_dir, "duration_s": duration})
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            _log.exception("profile capture failed")
+            return web.json_response({"error": str(e)}, status=500)
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(
             body=self.metrics.exposition(),
@@ -435,6 +479,7 @@ class TpuInferenceServer:
             app.router.add_post(f"/v2/models/{name}/generate", self.handle_generate)
         app.router.add_post("/api/v1.0/predictions", self.handle_seldon_predict)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_post("/debug/profile", self.handle_profile)
 
         async def on_shutdown(_app):
             self.shutdown()

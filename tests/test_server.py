@@ -525,3 +525,31 @@ def test_generate_streaming_rejects_multi_prompt(llm_server):
     )
     assert resp.status_code == 400
     assert "one prompt" in resp.json()["error"]
+
+
+def test_debug_profile_endpoint(iris_server):
+    handle, *_ = iris_server
+    resp = httpx.post(
+        handle.base + "/debug/profile",
+        json={"duration_s": 0.2},
+        timeout=30,
+    )
+    assert resp.status_code == 200, resp.text
+    out = resp.json()
+    # paths are server-chosen (unauthenticated endpoint: no client dirs)
+    assert out["trace_dir"].startswith("/tmp/tpumlops-profile/")
+    import os
+
+    found = []
+    for root, _dirs, files in os.walk(out["trace_dir"]):
+        found += files
+    assert found, "trace directory is empty"
+    # non-finite durations rejected; the lock is released afterwards
+    bad = httpx.post(
+        handle.base + "/debug/profile", json={"duration_s": "nan"}, timeout=10
+    )
+    assert bad.status_code == 400
+    again = httpx.post(
+        handle.base + "/debug/profile", json={"duration_s": 0.1}, timeout=30
+    )
+    assert again.status_code == 200
