@@ -105,6 +105,7 @@ def _tpu_pod_spec(
             "--max-batch-delay-ms", str(tpu.max_batch_delay_ms),
             "--compile-cache-dir", tpu.compile_cache_dir or "",
             "--quantize", tpu.quantize,
+            "--prefill-chunk", str(tpu.prefill_chunk or 0),
         ],
         "env": [
             {"name": "TPU_TOPOLOGY", "value": tpu.topology},

@@ -413,6 +413,8 @@ class TpuInferenceServer:
 
         try:
             body = await request.json() if request.can_read_body else {}
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
             duration = float(body.get("duration_s", 3.0))
             if not math.isfinite(duration):
                 raise ValueError(f"duration_s must be finite, got {duration}")
@@ -561,6 +563,7 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
         on_tokens=metrics.inc_generated_tokens if metrics else None,
         channel=channel,
         kv_quant=config.tpu.quantize == "int8kv",
+        prefill_chunk=config.tpu.prefill_chunk,
     )
 
 
@@ -660,6 +663,13 @@ def main(argv: list[str] | None = None) -> None:
         "containerPort); 0 disables the second listener",
     )
     ap.add_argument(
+        "--prefill-chunk",
+        type=int,
+        default=0,
+        help="chunked prefill size (0 = whole-prompt); long prompts stop "
+        "stalling in-flight decode streams",
+    )
+    ap.add_argument(
         "--quantize",
         default="none",
         choices=["none", "int8", "int8kv"],
@@ -698,6 +708,7 @@ def main(argv: list[str] | None = None) -> None:
                 "maxBatchSize": args.max_batch_size,
                 "maxBatchDelayMs": args.max_batch_delay_ms,
                 "quantize": args.quantize,
+                "prefillChunk": args.prefill_chunk or None,
             }
         ),
     )

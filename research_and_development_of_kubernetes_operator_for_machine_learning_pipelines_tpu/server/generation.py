@@ -84,6 +84,15 @@ class _Slot:
 
 
 @dataclass
+class _PrefillProgress:
+    """A chunked admission in flight (one at a time)."""
+
+    req: _Request
+    chunks: list  # padded [1, C] int32 arrays
+    next_idx: int = 0
+
+
+@dataclass
 class _Request:
     prompt: np.ndarray  # int32 [L]
     max_new_tokens: int
@@ -117,6 +126,7 @@ class GenerationEngine:
         on_tokens: Callable[[int], None] | None = None,
         channel=None,
         kv_quant: bool = False,
+        prefill_chunk: int | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -137,6 +147,24 @@ class GenerationEngine:
         dtype = dtype or jnp.bfloat16
         self._dtype = dtype
         self._kv_quant = bool(kv_quant)
+        # Chunked prefill: split prompts into fixed-size chunks so (a) one
+        # compiled program serves every prompt length and (b) the scheduler
+        # interleaves a decode tick between chunks — a long prompt no
+        # longer stalls in-flight streams' token cadence for its whole
+        # prefill.  None = whole-prompt bucketed prefill (fused, fastest
+        # time-to-first-token when nothing else is decoding).
+        self._prefill_chunk_size = int(prefill_chunk) if prefill_chunk else None
+        if self._prefill_chunk_size is not None:
+            C = self._prefill_chunk_size
+            if C <= 0:
+                raise ValueError(f"prefill_chunk must be positive, got {C}")
+            if self.capacity % C != 0:
+                # Padding the last chunk must never spill past capacity
+                # (clamped cache writes would silently corrupt the prompt).
+                raise ValueError(
+                    f"prefill_chunk {C} must divide KV capacity "
+                    f"{self.capacity}"
+                )
         self._reset_device_state()
 
         def make_cache(k, v, lengths):
@@ -222,7 +250,48 @@ class GenerationEngine:
         # One compiled program per prompt bucket (jit caches by ids shape).
         self._prefill_insert = jax.jit(_prefill_insert, donate_argnums=(2, 3))
 
+        def _prefill_one_chunk(params, ids, sk, sv, slen):
+            seq = llama.KVCache(sk, sv, slen)
+            logits, seq = llama.forward(params, ids, seq, cfg, dtype=dtype)
+            return logits[0], seq.k, seq.v, seq.length
+
+        self._prefill_one_chunk = jax.jit(
+            _prefill_one_chunk, donate_argnums=(2, 3)
+        )
+
+        def _insert_only(
+            last_logits, k, v, lengths, toks, slot, actual_len,
+            keys, temps, tks, tps, slot_key, temp, tk, tp, sk, sv, last_idx,
+        ):
+            from ..models.sampling import sample_logits
+
+            seq = llama.KVCache(sk, sv, jnp.zeros((), jnp.int32))
+            cache = llama.insert_sequence(
+                make_cache(k, v, lengths), seq, slot, actual_len
+            )
+            carry, use = jax.random.split(slot_key)
+            keys2 = keys.at[slot].set(carry)
+            temps2 = temps.at[slot].set(temp)
+            tks2 = tks.at[slot].set(tk)
+            tps2 = tps.at[slot].set(tp)
+            row = last_logits[last_idx][None]
+            first = sample_logits(
+                row, use[None], temp[None], tk[None], tp[None]
+            )[0]
+            toks2 = toks.at[slot, 0].set(first)
+            ck, cv = cache_repr(cache)
+            return (
+                ck, cv, cache.lengths, toks2,
+                keys2, temps2, tks2, tps2, first,
+            )
+
+        self._insert_only = jax.jit(_insert_only, donate_argnums=(1, 2))
+
         self._slots: list[_Slot | None] = [None] * self.max_slots
+        self._pending: _PrefillProgress | None = None
+        # Chunked-prefill scratch (leader and follower both thread the
+        # in-progress sequence cache through here; one admission at a time).
+        self._seq_state = None  # (last_logits, seq_k, seq_v, seq_len)
         # Engine-assigned sampling keys: fold a per-boot nonce so unseeded
         # requests never collide with the user-visible seed space (and never
         # replay the same streams after a pod restart).  NOT reset by
@@ -291,7 +360,7 @@ class GenerationEngine:
         t0 = time.perf_counter()
         self._in_warmup = True
         try:
-            self._admit(
+            self._admit_now(
                 _Request(
                     prompt=np.array([1], np.int32),
                     max_new_tokens=2,
@@ -301,7 +370,7 @@ class GenerationEngine:
             )
             self._step()  # greedy decode variant, smallest window
             self._slots = [None] * self.max_slots
-            self._admit(
+            self._admit_now(
                 _Request(
                     prompt=np.array([1], np.int32),
                     max_new_tokens=2,
@@ -337,6 +406,13 @@ class GenerationEngine:
         self._queue.put(None)  # unblock the scheduler
         if self._thread is not None:
             self._thread.join(timeout=30)
+        if self._pending is not None:
+            # A chunked admission in flight is in neither the queue nor a
+            # slot; cancel it or its client awaits forever.
+            if not self._pending.req.future.done():
+                self._pending.req.future.cancel()
+            self._pending = None
+            self._seq_state = None
         for slot in self._slots:
             if slot is not None and not slot.future.done():
                 slot.future.cancel()
@@ -451,13 +527,9 @@ class GenerationEngine:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :L] = req.prompt
 
-        if req.seed is None:
-            # Engine-assigned: distinct per request, disjoint from any
-            # user-specified jax.random.key(seed) stream.
-            self._seed_counter += 1
-            slot_key = jax.random.fold_in(self._boot_key, self._seed_counter)
-        else:
-            slot_key = jax.random.key(int(req.seed))
+        # Engine-assigned keys are distinct per request and disjoint from
+        # any user-specified jax.random.key(seed) stream (see _slot_key_for).
+        slot_key = self._slot_key_for(req)
         t0 = time.perf_counter()
         first = self._dispatch_admit(
             ids, slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p
@@ -473,6 +545,18 @@ class GenerationEngine:
         )
         self._slots[slot_idx] = slot
         self._record_token(slot_idx, int(first))
+
+    def _admit_now(self, req: _Request) -> None:
+        """Synchronous admission (warmup): runs the whole chunked pipeline
+        at once when chunking is enabled, else the fused path."""
+        if self._prefill_chunk_size is None:
+            self._admit(req)
+            return
+        self._pending = _PrefillProgress(
+            req=req, chunks=self._split_chunks(req.prompt)
+        )
+        while self._pending is not None:
+            self._chunk_tick()
 
     def _dispatch_admit(self, ids, slot_idx, L, slot_key, temp, tk, tp):
         """Broadcast (multihost) then run the prefill+insert device call."""
@@ -543,6 +627,154 @@ class GenerationEngine:
     def replay_step(self, active, window, sampling) -> None:
         """Follower side of a decode tick (multihost lockstep)."""
         self._device_step(np.asarray(active), int(window), bool(sampling))
+
+    # -- chunked prefill (one compiled chunk shape; decode interleaves) ------
+
+    def _split_chunks(self, prompt: np.ndarray) -> list:
+        C = self._prefill_chunk_size
+        L = int(prompt.size)
+        n = -(-L // C)
+        padded = np.zeros((n * C,), np.int32)
+        padded[:L] = prompt
+        return [padded[i * C : (i + 1) * C][None, :] for i in range(n)]
+
+    def _dispatch_chunk(self, ids: np.ndarray, fresh: bool) -> None:
+        if self._channel is None:
+            self._device_chunk(ids, fresh)
+            return
+        from .multihost import OP_GEN_CHUNK, encode_message
+
+        payload = encode_message(OP_GEN_CHUNK, {"ids": ids, "fresh": bool(fresh)})
+        self._channel.run(payload, lambda: self._device_chunk(ids, fresh))
+
+    def _device_chunk(self, ids: np.ndarray, fresh: bool) -> None:
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        if fresh:
+            seq = llama.KVCache.create(self._cfg, 1, self._dtype)
+            self._seq_state = (None, seq.k, seq.v, seq.length)
+        _, sk, sv, slen = self._seq_state
+        logits0, sk, sv, slen = self._prefill_one_chunk(
+            self._params, jnp.asarray(ids), sk, sv, slen
+        )
+        self._seq_state = (logits0, sk, sv, slen)
+
+    def replay_chunk(self, ids, fresh) -> None:
+        self._device_chunk(np.asarray(ids), bool(fresh))
+
+    def _dispatch_insert(self, slot_idx, L, slot_key, temp, tk, tp, last_idx):
+        import jax
+
+        if self._channel is None:
+            return self._device_insert(
+                slot_idx, L, slot_key, temp, tk, tp, last_idx
+            )
+        from .multihost import OP_GEN_INSERT, encode_message
+
+        payload = encode_message(
+            OP_GEN_INSERT,
+            {
+                "slot": int(slot_idx),
+                "length": int(L),
+                "key_data": np.asarray(jax.random.key_data(slot_key)),
+                "temp": float(temp),
+                "tk": int(tk),
+                "tp": float(tp),
+                "last_idx": int(last_idx),
+            },
+        )
+        return self._channel.run(
+            payload,
+            lambda: self._device_insert(
+                slot_idx, L, slot_key, temp, tk, tp, last_idx
+            ),
+        )
+
+    def _device_insert(self, slot_idx, L, slot_key, temp, tk, tp, last_idx):
+        import jax.numpy as jnp
+
+        last_logits, sk, sv, _slen = self._seq_state
+        self._seq_state = None
+        (
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._tokens,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+            first,
+        ) = self._insert_only(
+            last_logits,
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._tokens,
+            jnp.int32(slot_idx),
+            jnp.int32(L),
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+            slot_key,
+            jnp.float32(temp),
+            jnp.int32(tk),
+            jnp.float32(tp),
+            sk,
+            sv,
+            jnp.int32(last_idx),
+        )
+        return first
+
+    def replay_insert(self, slot, length, key_data, temp, tk, tp, last_idx):
+        import jax
+
+        slot_key = jax.random.wrap_key_data(np.asarray(key_data))
+        self._device_insert(slot, length, slot_key, temp, tk, tp, last_idx)
+
+    def _slot_key_for(self, req: _Request):
+        import jax
+
+        if req.seed is None:
+            self._seed_counter += 1
+            return jax.random.fold_in(self._boot_key, self._seed_counter)
+        return jax.random.key(int(req.seed))
+
+    def _chunk_tick(self) -> None:
+        """Advance the in-flight chunked admission by ONE chunk; on the
+        final chunk, install the sequence into its slot."""
+        prog = self._pending
+        assert prog is not None
+        ids = prog.chunks[prog.next_idx]
+        self._dispatch_chunk(ids, fresh=prog.next_idx == 0)
+        prog.next_idx += 1
+        if prog.next_idx < len(prog.chunks):
+            return
+        req = prog.req
+        self._pending = None
+        slot_idx = self._free_slot()
+        assert slot_idx is not None  # reserved by the admission policy
+        L = int(req.prompt.size)
+        C = self._prefill_chunk_size
+        slot_key = self._slot_key_for(req)
+        t0 = time.perf_counter()
+        first = self._dispatch_insert(
+            slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p,
+            last_idx=(L - 1) - C * (len(prog.chunks) - 1),
+        )
+        self._slots[slot_idx] = _Slot(
+            future=req.future,
+            remaining=req.max_new_tokens,
+            eos_id=req.eos_id,
+            sampling=req.temperature > 0,
+            on_token=req.on_token,
+            prompt_len=L,
+            t_start=t0,
+        )
+        self._record_token(slot_idx, int(first))
 
     def replay_reset(self) -> None:
         """Follower side of :meth:`_fail_all_and_recover`'s device reset."""
@@ -652,32 +884,60 @@ class GenerationEngine:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            # Admit as many queued requests as there are free slots.
-            while self._free_slot() is not None:
-                try:
-                    block = all(s is None for s in self._slots)
-                    req = self._queue.get(block=block, timeout=1.0)
-                except queue.Empty:
-                    break
-                if req is None or self._stop.is_set():
-                    # A real request dequeued during shutdown is in neither
-                    # the queue nor a slot — cancel it here or its client
-                    # awaits a future nobody will ever resolve.
-                    if req is not None and not req.future.done():
-                        req.future.cancel()
-                    return
-                try:
-                    self._admit(req)
-                except Exception as exc:  # keep the scheduler alive
-                    _log.exception("admit failed")
-                    if not req.future.done():
-                        _safe_fail(req.future, exc)
-                    self._fail_all_and_recover()
+            if not self._admit_phase():
+                return  # shutdown sentinel
             try:
                 self._step()
             except Exception:
                 _log.exception("decode step failed")
                 self._fail_all_and_recover()
+
+    def _admit_phase(self) -> bool:
+        """Admission work for one scheduler iteration.
+
+        Fused mode drains every free slot; chunked mode advances the
+        in-flight admission by ONE chunk (or starts a new one), so the
+        decode tick that follows is never more than one chunk of prefill
+        away — in-flight streams keep their token cadence under long
+        prompts.  Returns False on the shutdown sentinel."""
+        if self._pending is not None:
+            prog = self._pending  # _chunk_tick clears _pending on finish
+            try:
+                self._chunk_tick()
+            except Exception as exc:
+                _log.exception("chunked prefill failed")
+                self._pending = None
+                self._seq_state = None
+                if not prog.req.future.done():
+                    _safe_fail(prog.req.future, exc)
+                self._fail_all_and_recover()
+            return True
+        while self._free_slot() is not None:
+            try:
+                idle = all(s is None for s in self._slots)
+                req = self._queue.get(block=idle, timeout=1.0)
+            except queue.Empty:
+                break
+            if req is None or self._stop.is_set():
+                # A real request dequeued during shutdown is in neither
+                # the queue nor a slot — cancel it here or its client
+                # awaits a future nobody will ever resolve.
+                if req is not None and not req.future.done():
+                    req.future.cancel()
+                return False
+            if self._prefill_chunk_size is not None:
+                self._pending = _PrefillProgress(
+                    req=req, chunks=self._split_chunks(req.prompt)
+                )
+                return True  # first chunk runs next iteration's admit phase
+            try:
+                self._admit(req)
+            except Exception as exc:  # keep the scheduler alive
+                _log.exception("admit failed")
+                if not req.future.done():
+                    _safe_fail(req.future, exc)
+                self._fail_all_and_recover()
+        return True
 
     def _fail_all_and_recover(self) -> None:
         """Fail every in-flight sequence and reallocate device state.

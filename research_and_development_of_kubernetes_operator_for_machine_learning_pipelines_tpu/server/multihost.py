@@ -44,6 +44,8 @@ OP_SHUTDOWN = "shutdown"
 OP_GEN_ADMIT = "gen_admit"  # continuous-batching prefill+insert (replayed)
 OP_GEN_STEP = "gen_step"  # continuous-batching decode tick (replayed)
 OP_GEN_RESET = "gen_reset"  # leader recovered from a failed step: drop state
+OP_GEN_CHUNK = "gen_chunk"  # chunked-prefill: one prompt chunk (replayed)
+OP_GEN_INSERT = "gen_insert"  # chunked-prefill: install sequence into slot
 
 # Fixed-size round-1 header: payload byte length as uint32.  Round 2 is the
 # payload itself.  Two rounds because ``broadcast_one_to_all`` needs every
@@ -275,10 +277,18 @@ def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None
                 if gen_engine is None:
                     raise RuntimeError("GEN op on a unit without a gen engine")
                 gen_engine.replay_reset()
+            elif op == OP_GEN_CHUNK:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_chunk(**inputs)
+            elif op == OP_GEN_INSERT:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_insert(**inputs)
             else:  # unknown op: skip rather than desync the group
                 _log.warning("follower ignoring unknown op %r", op)
         except Exception:
-            if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET):
+            if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET, OP_GEN_CHUNK, OP_GEN_INSERT):
                 # Generation is STATEFUL: if this host failed a step the
                 # leader executed, its cache/lengths shards now disagree
                 # with every other host's, and all in-flight sequences

@@ -149,6 +149,15 @@ class CanaryPolicy:
             raise ValueError("maxAttempts must be >= 1")
 
 
+def _parse_prefill_chunk(value) -> int | None:
+    if not value:
+        return None
+    chunk = int(value)
+    if chunk <= 0:
+        raise ValueError(f"spec.tpu.prefillChunk must be positive, got {value!r}")
+    return chunk
+
+
 def _parse_quantize(value) -> str:
     """Reject bad quantize values at reconcile time — a typo'd CR field must
     surface in status, not as a pod CrashLoopBackOff at argparse."""
@@ -179,6 +188,7 @@ class TpuSpec:
     max_batch_delay_ms: float = 5.0
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
     quantize: str = "none"  # none | int8 (weights) | int8kv (weights+KV cache)
+    prefill_chunk: int | None = None  # chunked prefill (decode interleaving)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
@@ -193,6 +203,7 @@ class TpuSpec:
             max_batch_delay_ms=float(spec.get("maxBatchDelayMs", 5.0)),
             compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
             quantize=_parse_quantize(spec.get("quantize", "none")),
+            prefill_chunk=_parse_prefill_chunk(spec.get("prefillChunk")),
         )
 
     @property

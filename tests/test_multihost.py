@@ -564,3 +564,60 @@ def test_multihost_gen_reset_broadcast_on_leader_failure():
     np.testing.assert_array_equal(
         np.asarray(leader._tokens), np.asarray(follower._tokens)
     )
+
+
+def test_multihost_chunked_prefill_lockstep():
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+    from tpumlops.server.multihost import OP_SHUTDOWN, UnitChannel
+
+    jax.config.update("jax_enable_x64", True)
+    try:
+        cfg = llama.LlamaConfig.tiny(max_seq=64)
+        params = llama.init(jax.random.key(0), cfg, dtype=jnp.float64)
+        prompt = list(range(2, 23))  # 3 chunks of 8
+        ref = np.asarray(
+            llama.generate_greedy(
+                params, jnp.asarray([prompt], jnp.int32), 5, cfg,
+                dtype=jnp.float64,
+            )
+        )[0].tolist()
+
+        group = _LocalGroup(2)
+        transports = group.transports()
+        channel = UnitChannel(transports[0])
+        leader = GenerationEngine(
+            params, cfg, max_slots=2, dtype=jnp.float64,
+            channel=channel, prefill_chunk=8,
+        )
+        follower = GenerationEngine(
+            params, cfg, max_slots=2, dtype=jnp.float64, prefill_chunk=8
+        )
+        result = {}
+
+        def run():
+            result["steps"] = follower_loop(
+                _engine(), transports[1], gen_engine=follower
+            )
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        leader.start(warmup=True)
+        try:
+            out = leader.generate(prompt, 5).tolist()
+        finally:
+            leader.shutdown()
+            channel.close_with(encode_message(OP_SHUTDOWN))
+        th.join(timeout=30)
+        assert out == ref
+        np.testing.assert_array_equal(
+            np.asarray(leader._lengths), np.asarray(follower._lengths)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(leader._tokens), np.asarray(follower._tokens)
+        )
+    finally:
+        jax.config.update("jax_enable_x64", False)
