@@ -213,6 +213,155 @@ def _shard_for_flavor(flavor: str, params: Any, cfg: Any, mesh_shape: dict) -> A
     return shard_pytree(params, axes, mesh)
 
 
+def _finish_native(
+    flavor: str,
+    params: Any,
+    cfg: Any,
+    builder_kwargs: dict,
+    mesh_shape: dict | None,
+    quantize: str | None,
+) -> Predictor:
+    """Shared tail for JAX-native param trees: shard, quantize, build."""
+    n_devices = 1
+    for v in (mesh_shape or {}).values():
+        n_devices *= int(v)
+    if mesh_shape and n_devices > 1:
+        params = _shard_for_flavor(flavor, params, cfg, mesh_shape)
+    if quantize and quantize != "none":
+        # After sharding: the jitted quantizer preserves input shardings
+        # and computes per-channel scales with an on-mesh reduction.
+        if flavor != "llama-generate":
+            raise ModelLoadError(
+                f"quantize={quantize!r} is only supported for the "
+                f"llama-generate flavor (decode is HBM-bound); "
+                f"{flavor!r} serves prefill-style batches"
+            )
+        if quantize not in ("int8", "int8kv"):
+            raise ModelLoadError(f"unknown quantize mode {quantize!r}")
+        from ..models.quantization import quantize_llama
+
+        params = quantize_llama(params)
+        _log.info("quantized %s weights to int8 (mode=%s)", flavor, quantize)
+    kwargs = dict(builder_kwargs)
+    if cfg is not None:
+        kwargs["cfg"] = cfg
+    return get_builder(flavor)(params, **kwargs)
+
+
+def _find_hf_checkpoint(path: Path) -> Path | None:
+    """Locate a HuggingFace checkpoint inside an MLflow transformers
+    artifact (or a bare checkpoint directory).
+
+    MLflow's transformers flavor stores the pipeline under ``model/`` (the
+    MLmodel declares ``flavors.transformers``); a directory counts as a
+    checkpoint when it has an HF ``config.json`` (with ``model_type``)
+    plus weights."""
+    candidates = [path, path / "model", path / "pipeline"]
+    candidates += [p for p in sorted(path.iterdir()) if p.is_dir()] if path.is_dir() else []
+    seen = set()
+    for cand in candidates:
+        if cand in seen or not cand.is_dir():
+            continue
+        seen.add(cand)
+        cfg_file = cand / "config.json"
+        if not cfg_file.exists():
+            continue
+        try:
+            hf_cfg = json.loads(cfg_file.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(hf_cfg, dict) or "model_type" not in hf_cfg:
+            continue
+        weight_markers = (
+            "pytorch_model.bin",
+            "model.safetensors",
+            # sharded checkpoints (the norm at 7B+) ship an index file
+            "model.safetensors.index.json",
+            "pytorch_model.bin.index.json",
+        )
+        if any((cand / w).exists() for w in weight_markers):
+            return cand
+    return None
+
+
+def _load_transformers(hf_dir: Path):
+    """HF checkpoint -> (flavor, JAX params, config) via the from_torch
+    converters (weight-copy parity tested in tests/test_models_*).
+
+    Params are cast to bf16 for serving (matmuls accumulate in f32
+    model-side); a 7B checkpoint would not fit HBM in the f32 torch
+    loads produce."""
+    import jax
+    import jax.numpy as jnp
+
+    hf_cfg = json.loads((hf_dir / "config.json").read_text())
+    model_type = hf_cfg.get("model_type")
+
+    if model_type == "llama":
+        from transformers import LlamaForCausalLM
+
+        from ..models import llama
+
+        scaling = hf_cfg.get("rope_scaling")
+        if scaling:
+            # Our RoPE is plain theta-based; serving a llama3/linear-scaled
+            # checkpoint with it would produce silently degraded tokens.
+            raise ModelLoadError(
+                f"rope_scaling {scaling!r} is not supported by the "
+                "TPU-native llama (plain RoPE only)"
+            )
+        tm = LlamaForCausalLM.from_pretrained(hf_dir)
+        cfg = llama.LlamaConfig(
+            vocab_size=int(hf_cfg["vocab_size"]),
+            hidden_size=int(hf_cfg["hidden_size"]),
+            num_layers=int(hf_cfg["num_hidden_layers"]),
+            num_heads=int(hf_cfg["num_attention_heads"]),
+            num_kv_heads=int(
+                hf_cfg.get("num_key_value_heads")
+                or hf_cfg["num_attention_heads"]
+            ),
+            intermediate_size=int(hf_cfg["intermediate_size"]),
+            max_seq=int(hf_cfg.get("max_position_embeddings", 4096)),
+            rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+            rms_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
+        )
+        params = llama.from_torch(tm, cfg)
+        flavor = "llama-generate"
+    elif model_type == "bert":
+        from transformers import BertForSequenceClassification
+
+        from ..models import bert
+
+        tm = BertForSequenceClassification.from_pretrained(hf_dir)
+        cfg = bert.BertConfig(
+            vocab_size=int(hf_cfg["vocab_size"]),
+            hidden_size=int(hf_cfg["hidden_size"]),
+            num_layers=int(hf_cfg["num_hidden_layers"]),
+            num_heads=int(hf_cfg["num_attention_heads"]),
+            intermediate_size=int(hf_cfg["intermediate_size"]),
+            max_position_embeddings=int(
+                hf_cfg.get("max_position_embeddings", 512)
+            ),
+            type_vocab_size=int(hf_cfg.get("type_vocab_size", 2)),
+            layer_norm_eps=float(hf_cfg.get("layer_norm_eps", 1e-12)),
+            num_labels=int(getattr(tm.config, "num_labels", 2)),
+        )
+        params = bert.from_torch(tm, cfg)
+        flavor = "bert-classifier"
+    else:
+        raise ModelLoadError(
+            f"unsupported transformers model_type {model_type!r} "
+            "(supported: llama, bert)"
+        )
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32
+        else x,
+        params,
+    )
+    return flavor, params, cfg
+
+
 def load_predictor(
     model_uri: str,
     flavor: str | None = None,
@@ -234,31 +383,21 @@ def load_predictor(
 
         params = jax.tree.map(jnp.asarray, params)
         cfg = _build_config(flavor, meta.get("config", {}))
-        n_devices = 1
-        for v in (mesh_shape or {}).values():
-            n_devices *= int(v)
-        if mesh_shape and n_devices > 1:
-            params = _shard_for_flavor(flavor, params, cfg, mesh_shape)
-        if quantize and quantize != "none":
-            # After sharding: the jitted quantizer preserves input shardings
-            # and computes per-channel scales with an on-mesh reduction.
-            if flavor != "llama-generate":
-                raise ModelLoadError(
-                    f"quantize={quantize!r} is only supported for the "
-                    f"llama-generate flavor (decode is HBM-bound); "
-                    f"{flavor!r} serves prefill-style batches"
-                )
-            if quantize not in ("int8", "int8kv"):
-                raise ModelLoadError(f"unknown quantize mode {quantize!r}")
-            from ..models.quantization import quantize_llama
-
-            params = quantize_llama(params)
-            _log.info("quantized %s weights to int8 (mode=%s)", flavor, quantize)
-        kwargs = dict(meta.get("builder_kwargs", {}))
-        if cfg is not None:
-            kwargs["cfg"] = cfg
         _log.info("loaded native %s model from %s", flavor, path)
-        return get_builder(flavor)(params, **kwargs)
+        return _finish_native(
+            flavor,
+            params,
+            cfg,
+            dict(meta.get("builder_kwargs", {})),
+            mesh_shape,
+            quantize,
+        )
+
+    hf_dir = _find_hf_checkpoint(path)
+    if hf_dir is not None:
+        flavor, params, cfg = _load_transformers(hf_dir)
+        _log.info("loaded transformers %s model from %s", flavor, hf_dir)
+        return _finish_native(flavor, params, cfg, {}, mesh_shape, quantize)
 
     if quantize and quantize != "none":
         # Only the native llama path got here without raising; every other

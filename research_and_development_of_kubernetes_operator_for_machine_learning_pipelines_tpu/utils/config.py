@@ -150,6 +150,10 @@ class CanaryPolicy:
 
 
 def _parse_prefill_chunk(value) -> int | None:
+    """Positivity is checkable here; divisibility into the model's KV
+    capacity is not (max_seq lives in the artifact, not the CR) — that
+    check runs at server startup, where a violation fails readiness with
+    a clear error in the pod log."""
     if not value:
         return None
     chunk = int(value)
