@@ -663,6 +663,13 @@ def main(argv: list[str] | None = None) -> None:
         "containerPort); 0 disables the second listener",
     )
     ap.add_argument(
+        "--drain-s",
+        type=float,
+        default=3.0,
+        help="seconds to keep serving (NotReady) after SIGTERM before "
+        "teardown, so rolling steps don't 503 their request tail",
+    )
+    ap.add_argument(
         "--prefill-chunk",
         type=int,
         default=0,
@@ -777,7 +784,19 @@ def main(argv: list[str] | None = None) -> None:
             except (NotImplementedError, RuntimeError):  # non-main thread
                 pass
         await stop.wait()
-        _log.info("termination signal; shutting down")
+        # Drain before teardown.  The work here is done by the SLEEP:
+        # Kubernetes removes a Terminating pod from endpoints while we keep
+        # serving the tail of in-flight/raced requests — without the window
+        # every rolling canary step 503s that tail, which the gate reads as
+        # an error-rate spike on whichever version was being replaced.
+        # Flipping readiness is supplementary (it answers kubelet probes and
+        # any readiness-polling balancer during LONG drains; the default
+        # probe needs minutes of failures to act within a 3s window).
+        server.ready = False
+        _log.info(
+            "termination signal; draining %.1fs before shutdown", args.drain_s
+        )
+        await asyncio.sleep(max(0.0, args.drain_s))
         await runner.cleanup()  # fires on_shutdown -> server.shutdown()
 
     try:

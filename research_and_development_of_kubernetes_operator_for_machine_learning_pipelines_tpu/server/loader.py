@@ -327,6 +327,10 @@ def _load_transformers(hf_dir: Path):
         )
         params = llama.from_torch(tm, cfg)
         flavor = "llama-generate"
+        eos = hf_cfg.get("eos_token_id")
+        if isinstance(eos, list):  # some checkpoints ship a list of eos ids
+            eos = eos[0] if eos else None
+        builder_kwargs = {"eos_id": int(eos)} if eos is not None else {}
     elif model_type == "bert":
         from transformers import BertForSequenceClassification
 
@@ -348,6 +352,7 @@ def _load_transformers(hf_dir: Path):
         )
         params = bert.from_torch(tm, cfg)
         flavor = "bert-classifier"
+        builder_kwargs = {}
     else:
         raise ModelLoadError(
             f"unsupported transformers model_type {model_type!r} "
@@ -359,7 +364,7 @@ def _load_transformers(hf_dir: Path):
         else x,
         params,
     )
-    return flavor, params, cfg
+    return flavor, params, cfg, builder_kwargs
 
 
 def load_predictor(
@@ -395,9 +400,11 @@ def load_predictor(
 
     hf_dir = _find_hf_checkpoint(path)
     if hf_dir is not None:
-        flavor, params, cfg = _load_transformers(hf_dir)
+        flavor, params, cfg, builder_kwargs = _load_transformers(hf_dir)
         _log.info("loaded transformers %s model from %s", flavor, hf_dir)
-        return _finish_native(flavor, params, cfg, {}, mesh_shape, quantize)
+        return _finish_native(
+            flavor, params, cfg, builder_kwargs, mesh_shape, quantize
+        )
 
     if quantize and quantize != "none":
         # Only the native llama path got here without raising; every other

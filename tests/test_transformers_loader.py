@@ -150,3 +150,11 @@ def test_transformers_rope_scaling_rejected(tmp_path):
     (art / "pytorch_model.bin").write_bytes(b"")
     with pytest.raises(ModelLoadError, match="rope_scaling"):
         load_predictor(str(art))
+
+
+def test_transformers_llama_eos_propagates(tiny_llama_artifact):
+    art, _ = tiny_llama_artifact
+    pred = load_predictor(str(art))
+    # HF LlamaConfig default eos_token_id=2 must reach the causal_lm handles
+    # (or /generate never stops at EOS and burns the full token budget).
+    assert pred.causal_lm["eos_id"] == 2
