@@ -158,3 +158,24 @@ def test_operator_entrypoint_help():
     )
     assert out.returncode == 0, out.stderr
     assert "--metrics-port" in out.stdout
+
+
+def test_example_crs_parse_through_operator_config():
+    """The shipped example CRs must round-trip through the real spec parser
+    (a drifting example is worse than none)."""
+    from tpumlops.utils.config import OperatorConfig
+
+    for name in ("iris-seldon.yaml", "llama-tpu.yaml"):
+        doc = yaml.safe_load((PKG_DIR / "deploy" / "examples" / name).read_text())
+        cfg = OperatorConfig.from_spec(doc["spec"])
+        assert cfg.model_name
+    # Field names must really land (unknown keys silently default!).
+    assert cfg.backend == "tpu"
+    assert cfg.tpu.quantize == "int8kv"
+    assert cfg.tpu.prefill_chunk == 256
+    assert cfg.tpu.mesh_shape == {"dp": 1, "tp": 8}
+    assert cfg.thresholds.min_sample_count == 50
+    assert cfg.thresholds.error_rate_floor == 0.005
+    assert cfg.canary.rollback_on_failure is True
+    assert cfg.canary.warmup_requests == 20
+    assert cfg.canary.attempt_delay_s == 10
