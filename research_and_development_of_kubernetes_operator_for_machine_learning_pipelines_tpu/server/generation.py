@@ -392,6 +392,26 @@ class GenerationEngine:
                 window = min(window * 2, self.capacity)
                 self._dispatch_step(inactive, window, False)
                 self._dispatch_step(inactive, window, True)
+            # Fused-prefill buckets: each power-of-two prompt bucket is its
+            # own executable (the padded ids shape is static), so admit one
+            # dummy prompt per bucket — otherwise the first live request at
+            # a larger bucket pays the XLA compile on the single scheduler
+            # thread and stalls every in-flight stream.  Chunked prefill
+            # runs one fixed-size program per chunk; no sweep needed there.
+            if self._prefill_chunk_size is None:
+                bucket = _MIN_BUCKET
+                while bucket < self.capacity:
+                    bucket = min(bucket * 2, self.capacity)
+                    # max_new_tokens=1 resolves at admission, so the slot
+                    # frees itself inside _admit — no cleanup needed.
+                    self._admit_now(
+                        _Request(
+                            prompt=np.ones((bucket,), np.int32),
+                            max_new_tokens=1,
+                            eos_id=None,
+                            future=Future(),
+                        )
+                    )
         finally:
             self._in_warmup = False
         # Reset state so warmup tokens never leak into a real response.
@@ -441,9 +461,23 @@ class GenerationEngine:
         of them first, so a bad one rejects the request before any sibling
         has been admitted and left generating into an abandoned future.
         """
-        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        try:
+            # int64 first: ids >= 2**31 would raise OverflowError straight
+            # from an int32 asarray, and that escaped to clients as a 500.
+            prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
+        except (OverflowError, ValueError, TypeError) as e:
+            raise ValueError(f"prompt ids must be integers: {e}") from None
         if prompt.size == 0:
             raise ValueError("empty prompt")
+        vocab = int(getattr(self._cfg, "vocab_size", 0))
+        if int(prompt.min()) < 0 or (vocab and int(prompt.max()) >= vocab):
+            # Out-of-range ids would silently clamp in jnp.take and return
+            # garbage completions as 200s; reject at the door instead.
+            raise ValueError(
+                f"prompt ids must be in [0, {vocab}), got range "
+                f"[{int(prompt.min())}, {int(prompt.max())}]"
+            )
+        prompt = prompt.astype(np.int32)
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         total = prompt.size + max_new_tokens
@@ -454,8 +488,12 @@ class GenerationEngine:
             )
         if not (0.0 <= float(temperature) <= 100.0):
             raise ValueError(f"temperature must be in [0, 100], got {temperature}")
-        if int(top_k) < 0:
-            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not (0 <= int(top_k) < 2**31):
+            # top_k is lowered to jnp.int32 in _admit; an out-of-range value
+            # passing validation would raise OverflowError inside the jitted
+            # step and _fail_all_and_recover would kill every in-flight
+            # request over one malformed one.
+            raise ValueError(f"top_k must be in [0, 2**31), got {top_k}")
         if not (0.0 < float(top_p) <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if seed is not None and not (0 <= int(seed) < 2**63):

@@ -367,6 +367,33 @@ def test_engine_sampling_validation():
         engine.submit([1, 2], 4, top_k=-2)
 
 
+def test_engine_validation_rejects_hostile_inputs():
+    """ADVICE round 1: malformed requests must 400 at validate(), never
+    reach the jitted step (where an OverflowError would fail every
+    in-flight request via _fail_all_and_recover)."""
+    cfg = llama.LlamaConfig.tiny(max_seq=32)
+    params = llama.init(jax.random.key(1), cfg, dtype=jnp.float64)
+    engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float64)
+    # top_k beyond int32: passed validation before, then overflowed in _admit.
+    with pytest.raises(ValueError, match="top_k"):
+        engine.validate([1, 2], 4, top_k=2**31)
+    with pytest.raises(ValueError, match="top_k"):
+        engine.validate([1, 2], 4, top_k=2**40)
+    assert engine.validate([1, 2], 4, top_k=2**31 - 1).tolist() == [1, 2]
+    # Out-of-vocab ids silently clamp in jnp.take -> garbage 200s.
+    with pytest.raises(ValueError, match="prompt ids"):
+        engine.validate([cfg.vocab_size], 4)
+    with pytest.raises(ValueError, match="prompt ids"):
+        engine.validate([-1], 4)
+    # ids past int64 raised OverflowError, which the HTTP layer mapped to 500.
+    with pytest.raises(ValueError, match="prompt ids"):
+        engine.validate([2**63], 4)
+    with pytest.raises(ValueError, match="prompt ids"):
+        engine.validate([2**31], 4)  # would overflow a direct int32 asarray
+    ok = engine.validate([0, cfg.vocab_size - 1], 4)
+    assert ok.dtype == np.int32
+
+
 def test_engine_seed_validation_and_greedy_variant(tiny):
     params, cfg = tiny
     engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float64)
@@ -443,6 +470,11 @@ def test_warmup_compiles_all_window_buckets(tiny):
         sampling_sizes = engine._decode._cache_size()
         assert greedy_sizes >= 3, greedy_sizes
         assert sampling_sizes >= 3, sampling_sizes
+        # ADVICE round 1: the fused prefill program must also be compiled
+        # at every power-of-two prompt bucket (16, 32, 64 at capacity 64),
+        # or the first long prompt on a cold node stalls the scheduler.
+        prefill_sizes = engine._prefill_insert._cache_size()
+        assert prefill_sizes >= 3, prefill_sizes
     finally:
         engine.shutdown()
 
