@@ -49,6 +49,17 @@ class Conflict(ApiError):
         super().__init__(409, message)
 
 
+class WatchExpired(ApiError):
+    """410 Gone — the watch's resourceVersion fell out of etcd's history.
+
+    The standard Kubernetes informer contract: the watcher must re-list to
+    get a fresh resourceVersion and resume from there.
+    """
+
+    def __init__(self, message: str = "watch expired"):
+        super().__init__(410, message)
+
+
 class RegistryError(Exception):
     """MLflow registry unreachable or returned an unexpected error."""
 
@@ -95,6 +106,19 @@ class ModelMetrics:
             "request_count": self.request_count,
             "feedback_request_count": self.feedback_request_count,
         }
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """One event off a Kubernetes watch stream.
+
+    ``type`` is the API server's event type: ``ADDED`` / ``MODIFIED`` /
+    ``DELETED``, plus ``BOOKMARK`` when ``allowWatchBookmarks`` is on
+    (a resourceVersion checkpoint carrying no object change).
+    """
+
+    type: str
+    object: Mapping[str, Any]
 
 
 @dataclass(frozen=True)

@@ -16,7 +16,7 @@ test strategy is built on:
 from __future__ import annotations
 
 import copy
-import itertools
+import queue as _queue
 import threading
 from typing import Any, Callable, Mapping
 
@@ -29,6 +29,7 @@ from .base import (
     NotFound,
     ObjectRef,
     RegistryError,
+    WatchEvent,
 )
 
 
@@ -82,10 +83,21 @@ class FakeKube:
 
     def __init__(self):
         self._objects: dict[tuple[str, str, str, str], dict] = {}
-        self._rv = itertools.count(1)
+        self._rv_counter = 0
         self._lock = threading.RLock()
         self.events: list[tuple[str, Event]] = []  # (object name, event)
         self.apply_log: list[dict] = []  # every create/replace body, in order
+        # Live watch subscriptions: each is a queue fed by every mutation.
+        self._watchers: list[_queue.Queue] = []
+
+    def _next_rv(self) -> str:
+        self._rv_counter += 1
+        return str(self._rv_counter)
+
+    def _broadcast(self, ref: ObjectRef, type_: str, obj: dict) -> None:
+        ev = WatchEvent(type=type_, object=copy.deepcopy(obj))
+        for q in list(self._watchers):
+            q.put((ref.group, ref.plural, ev))
 
     @staticmethod
     def _key(ref: ObjectRef) -> tuple[str, str, str, str]:
@@ -117,10 +129,14 @@ class FakeKube:
             obj.setdefault("metadata", {})
             obj["metadata"]["name"] = ref.name
             obj["metadata"]["namespace"] = ref.namespace
-            obj["metadata"]["resourceVersion"] = str(next(self._rv))
+            obj["metadata"]["resourceVersion"] = self._next_rv()
             obj["metadata"].setdefault("uid", f"uid-{ref.name}")
+            # Real API-server semantics: generation starts at 1 and bumps
+            # only on spec changes (status patches leave it alone).
+            obj["metadata"]["generation"] = 1
             self._objects[key] = obj
             self.apply_log.append(copy.deepcopy(obj))
+            self._broadcast(ref, "ADDED", obj)
             return copy.deepcopy(obj)
 
     def replace(self, ref: ObjectRef, body: Mapping[str, Any]) -> dict:
@@ -138,13 +154,17 @@ class FakeKube:
             obj.setdefault("metadata", {})
             obj["metadata"]["name"] = ref.name
             obj["metadata"]["namespace"] = ref.namespace
-            obj["metadata"]["resourceVersion"] = str(next(self._rv))
+            obj["metadata"]["resourceVersion"] = self._next_rv()
             obj["metadata"].setdefault("uid", self._objects[key]["metadata"].get("uid"))
+            old_gen = self._objects[key]["metadata"].get("generation", 1)
+            spec_changed = obj.get("spec") != self._objects[key].get("spec")
+            obj["metadata"]["generation"] = old_gen + 1 if spec_changed else old_gen
             # status is a subresource: plain replace does not change it
             if "status" in self._objects[key]:
                 obj["status"] = copy.deepcopy(self._objects[key]["status"])
             self._objects[key] = obj
             self.apply_log.append(copy.deepcopy(obj))
+            self._broadcast(ref, "MODIFIED", obj)
             return copy.deepcopy(obj)
 
     def patch_status(self, ref: ObjectRef, status: Mapping[str, Any]) -> dict:
@@ -156,7 +176,8 @@ class FakeKube:
             merged = dict(obj.get("status") or {})
             merged.update(copy.deepcopy(dict(status)))
             obj["status"] = merged
-            obj["metadata"]["resourceVersion"] = str(next(self._rv))
+            obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._broadcast(ref, "MODIFIED", obj)
             return copy.deepcopy(obj)
 
     def delete(self, ref: ObjectRef) -> None:
@@ -164,11 +185,53 @@ class FakeKube:
             key = self._key(ref)
             if key not in self._objects:
                 raise NotFound(f"{ref.plural}/{ref.name}")
-            del self._objects[key]
+            gone = self._objects.pop(key)
+            self._broadcast(ref, "DELETED", gone)
 
     def emit_event(self, ref: ObjectRef, event: Event) -> None:
         with self._lock:
             self.events.append((ref.name, event))
+
+    def list_with_version(self, ref: ObjectRef) -> tuple[list[dict], str]:
+        with self._lock:
+            return self.list(ref), str(self._rv_counter)
+
+    def watch(
+        self,
+        ref: ObjectRef,
+        resource_version: str | None = None,
+        timeout_s: int = 300,
+        stop=None,
+    ):
+        """Generator of WatchEvents for mutations after subscription.
+
+        Delivers only post-subscription events (the fake keeps no history,
+        so ``resource_version`` is accepted but unused -- callers list
+        first, exactly like against the real API server).  Ends when
+        ``stop`` is set, mimicking the server closing an idle watch.
+        """
+        q: _queue.Queue = _queue.Queue()
+        with self._lock:
+            self._watchers.append(q)
+        try:
+            while stop is None or not stop.is_set():
+                try:
+                    group, plural, ev = q.get(timeout=0.05)
+                except _queue.Empty:
+                    continue
+                if group != ref.group or plural != ref.plural:
+                    continue
+                meta = ev.object.get("metadata") or {}
+                if (
+                    ref.namespace
+                    and meta.get("namespace")
+                    and meta["namespace"] != ref.namespace
+                ):
+                    continue
+                yield ev
+        finally:
+            with self._lock:
+                self._watchers.remove(q)
 
     # -- test helpers -------------------------------------------------------
     def event_reasons(self) -> list[str]:

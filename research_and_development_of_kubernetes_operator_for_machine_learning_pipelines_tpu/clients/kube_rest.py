@@ -17,7 +17,7 @@ from typing import Any, Mapping
 
 import httpx
 
-from .base import ApiError, Conflict, Event, NotFound, ObjectRef
+from .base import ApiError, Conflict, Event, NotFound, ObjectRef, WatchEvent, WatchExpired
 
 _log = logging.getLogger(__name__)
 
@@ -92,8 +92,82 @@ class KubeRestClient:
         return self._check(self._request("GET", self._path(ref)))
 
     def list(self, ref: ObjectRef) -> list[dict]:
+        return self.list_with_version(ref)[0]
+
+    def list_with_version(self, ref: ObjectRef) -> tuple[list[dict], str]:
+        """List plus the collection's resourceVersion — the watch cursor.
+
+        Starting a watch from the list's resourceVersion (not per-item RVs)
+        is the informer contract: every change after this snapshot is
+        guaranteed to appear on the stream.
+        """
         body = self._check(self._request("GET", self._path(ref, name=False)))
-        return body.get("items", [])
+        rv = (body.get("metadata") or {}).get("resourceVersion", "")
+        return body.get("items", []), rv
+
+    def watch(
+        self,
+        ref: ObjectRef,
+        resource_version: str | None = None,
+        timeout_s: int = 300,
+        stop=None,
+    ):
+        """Stream watch events for a collection (kopf's push model,
+        reference ``mlflow_operator.py:26-27``, without kopf).
+
+        Yields :class:`WatchEvent`.  Raises :class:`WatchExpired` on 410
+        (either HTTP status or an ERROR event carrying code 410) — the
+        caller must re-list and restart the watch from the fresh
+        resourceVersion.  ``timeout_s`` is the server-side watch timeout;
+        the generator simply ends when the server closes the stream, and
+        the caller reconnects with its latest bookmark.
+        """
+        params: dict[str, str] = {
+            "watch": "1",
+            "allowWatchBookmarks": "true",
+            "timeoutSeconds": str(int(timeout_s)),
+        }
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        # Read timeout bounds how long a blocking read can ignore ``stop``:
+        # an idle stream raises ReadTimeout after 15s, the generator ends
+        # cleanly, and the caller reconnects from its cursor (no re-list).
+        # Without it, stop() could wait out the full server-side timeout.
+        with self._http.stream(
+            "GET",
+            self._path(ref, name=False),
+            params=params,
+            timeout=httpx.Timeout(30.0, read=15.0),
+        ) as resp:
+            if resp.status_code == 410:
+                raise WatchExpired("watch list version expired")
+            if resp.status_code >= 400:
+                resp.read()
+                raise ApiError(resp.status_code, resp.text[:500])
+            try:
+                lines = resp.iter_lines()
+            except httpx.ReadTimeout:
+                return
+            while True:
+                if stop is not None and stop.is_set():
+                    return
+                try:
+                    line = next(lines)
+                except (StopIteration, httpx.ReadTimeout):
+                    return
+                if not line.strip():
+                    continue
+                try:
+                    raw = json.loads(line)
+                except json.JSONDecodeError:
+                    _log.warning("undecodable watch line: %r", line[:200])
+                    continue
+                if raw.get("type") == "ERROR":
+                    code = (raw.get("object") or {}).get("code")
+                    if code == 410:
+                        raise WatchExpired(str(raw.get("object"))[:200])
+                    raise ApiError(int(code or 500), str(raw.get("object"))[:300])
+                yield WatchEvent(type=raw.get("type", ""), object=raw.get("object") or {})
 
     def create(self, ref: ObjectRef, body: Mapping[str, Any]) -> dict:
         return self._check(

@@ -17,7 +17,19 @@ import logging
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser("tpumlops-operator")
     ap.add_argument("--namespace", default="", help="watch one namespace (default all)")
-    ap.add_argument("--sync-interval", type=float, default=5.0)
+    ap.add_argument(
+        "--sync-interval",
+        type=float,
+        default=None,
+        help="fallback resync poll (default 30s with the watch active — it "
+        "only bounds staleness after a dropped watch event — or 5s under "
+        "--no-watch, where the poll is the only reaction path)",
+    )
+    ap.add_argument(
+        "--no-watch",
+        action="store_true",
+        help="disable the event-driven watch and rely on polling alone",
+    )
     ap.add_argument("--kube-url", default=None, help="API server URL (default in-cluster)")
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(
@@ -37,8 +49,11 @@ def main(argv: list[str] | None = None) -> None:
     from ..clients.kube_rest import KubeRestClient
     from ..clients.mlflow_rest import MlflowRestClient
     from ..clients.prom_http import PrometheusSource
-    from .runtime import OperatorRuntime
+    from .runtime import CrWatcher, OperatorRuntime
     from .telemetry import OperatorTelemetry
+
+    if args.sync_interval is None:
+        args.sync_interval = 5.0 if args.no_watch else 30.0
 
     kube = KubeRestClient(base_url=args.kube_url)
     registry = MlflowRestClient()
@@ -62,7 +77,12 @@ def main(argv: list[str] | None = None) -> None:
         sync_interval_s=args.sync_interval,
         telemetry=telemetry,
     )
-    runtime.serve()
+    watcher = None if args.no_watch else CrWatcher(runtime).start()
+    try:
+        runtime.serve()
+    finally:
+        if watcher is not None:
+            watcher.stop()
 
 
 if __name__ == "__main__":

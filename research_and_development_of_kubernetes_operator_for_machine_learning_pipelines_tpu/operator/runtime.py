@@ -16,8 +16,14 @@ Replaces kopf's role in the reference (``@kopf.on.create``/``on.update``,
 
 Deterministic by construction: with a ``FakeClock`` the test advances time
 and calls ``run_until_idle``; with the ``SystemClock`` ``serve`` runs a real
-loop.  If kopf *is* installed, ``kopf_adapter`` (separate module) bridges
-events into this same runtime.
+loop.
+
+Event-driven reaction (the reference's kopf watch registration,
+``mlflow_operator.py:26-27``): :class:`CrWatcher` consumes the API server's
+watch stream (``KubeClient.watch``) and pokes the runtime — a CR add, edit,
+or delete reconciles immediately instead of waiting out the resync poll.
+The poll in ``sync()`` stays as the level-triggered fallback, so a dropped
+watch event can delay a reconcile but never lose it.
 """
 
 from __future__ import annotations
@@ -49,6 +55,11 @@ class _Entry:
     reconciler: Reconciler
     due_at: float
     failures: int = 0
+    # metadata.generation of the object at its last reconcile.  The API
+    # server bumps generation on spec changes only — never on status
+    # patches — which is what lets notify() tell a user edit (reconcile
+    # now) from the reconciler's own status writes (don't touch pacing).
+    generation: int | None = None
 
 
 class OperatorRuntime:
@@ -81,6 +92,8 @@ class OperatorRuntime:
         self._entries: dict[tuple[str, str], _Entry] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        # Set by notify() (watch events) to cut a serve() sleep short.
+        self._wake = threading.Event()
 
     # -- discovery -----------------------------------------------------------
 
@@ -123,6 +136,41 @@ class OperatorRuntime:
                     if self.telemetry is not None:
                         self.telemetry.forget(ns, name)
 
+    def notify(
+        self,
+        namespace: str,
+        name: str,
+        obj: dict | None = None,
+        event_type: str = "MODIFIED",
+    ) -> None:
+        """React to a watch event: maybe mark the CR due now, wake serve.
+
+        The canary's pacing (step intervals, gate retry delays) lives in
+        ``requeue_after`` — so a MODIFIED event may only pull the due time
+        forward when the *spec* changed.  The API server bumps
+        ``metadata.generation`` on spec changes and never on status
+        patches; without this check the reconciler's own status writes
+        would echo back through the watch and each canary step would
+        immediately schedule the next, promoting 0→100% in milliseconds
+        with every gate interval skipped.
+
+        Unknown keys (a just-created CR, or a deletion) need no per-entry
+        action: ``step()`` always runs ``sync()`` first, which picks up
+        adds and tears down deletes — waking is enough.
+        """
+        with self._lock:
+            entry = self._entries.get((namespace, name))
+            if entry is not None:
+                # ADDED must take the same path: a reconnecting watch with
+                # no cursor replays synthetic ADDED for every live object,
+                # and those must not reset pacing either.
+                if event_type in ("ADDED", "MODIFIED") and obj is not None:
+                    gen = (obj.get("metadata") or {}).get("generation")
+                    if gen is not None and gen == entry.generation:
+                        return  # status echo / watch replay; pacing stands
+                entry.due_at = self.clock.now()
+        self._wake.set()
+
     # -- stepping ------------------------------------------------------------
 
     def step(self) -> float | None:
@@ -147,6 +195,7 @@ class OperatorRuntime:
                 obj = self.kube.get(
                     ObjectRef(namespace=ns, name=name, **MLFLOWMODEL)
                 )
+                entry.generation = (obj.get("metadata") or {}).get("generation")
                 outcome = entry.reconciler.reconcile(dict(obj))
                 entry.failures = 0
                 entry.due_at = self.clock.now() + max(0.0, outcome.requeue_after)
@@ -225,7 +274,105 @@ class OperatorRuntime:
                 _log.exception("runtime step failed")
                 delay = self.sync_interval_s
             sleep_for = self.sync_interval_s if delay is None else min(delay, self.sync_interval_s)
-            self._stop.wait(max(0.05, sleep_for))
+            # Sleep until the next due time OR a watch notification —
+            # whichever comes first.  stop() also sets _wake so shutdown
+            # never waits out a sleep.
+            if self._wake.wait(max(0.05, sleep_for)):
+                self._wake.clear()
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
+
+
+class CrWatcher:
+    """Event-driven bridge: API-server watch stream → ``runtime.notify``.
+
+    The push half of the informer pattern (the reference gets this from
+    kopf's watch registration, ``mlflow_operator.py:26-27``).  Lifecycle:
+
+    - list once for a resourceVersion cursor, then stream events from it;
+    - track the cursor through object and BOOKMARK events so a reconnect
+      resumes where it left off instead of replaying history;
+    - on 410 Gone (cursor fell out of etcd history) re-list for a fresh
+      cursor — the standard re-list contract;
+    - on transport errors reconnect with capped exponential backoff;
+    - every delivered event just pokes the runtime: reconcile state lives
+      in ``OperatorRuntime``/``Reconciler``; the watcher carries no state
+      worth preserving, so a crashed watcher degrades to poll-only, it
+      never wedges the operator.
+    """
+
+    def __init__(
+        self,
+        runtime: OperatorRuntime,
+        timeout_s: int = 300,
+        max_backoff_s: float = 30.0,
+    ):
+        kube = runtime.kube
+        if not hasattr(kube, "watch"):
+            raise TypeError(
+                f"{type(kube).__name__} has no watch(); CrWatcher needs a "
+                "watch-capable KubeClient (KubeRestClient or FakeKube)"
+            )
+        self.runtime = runtime
+        self.timeout_s = timeout_s
+        self.max_backoff_s = max_backoff_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "CrWatcher":
+        self._thread = threading.Thread(
+            target=self.run, daemon=True, name="cr-watcher"
+        )
+        self._thread.start()
+        return self
+
+    def run(self) -> None:
+        from ..clients.base import WatchExpired
+
+        ref = self.runtime._list_ref()
+        rv: str | None = None
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                if rv is None:
+                    if hasattr(self.runtime.kube, "list_with_version"):
+                        _, rv = self.runtime.kube.list_with_version(ref)
+                    else:
+                        rv = ""
+                    # The snapshot may differ from the runtime's view
+                    # (adds/deletes during the gap): force a resync pass.
+                    self.runtime._wake.set()
+                for ev in self.runtime.kube.watch(
+                    ref, resource_version=rv or None,
+                    timeout_s=self.timeout_s, stop=self._stop,
+                ):
+                    failures = 0
+                    meta = ev.object.get("metadata") or {}
+                    if meta.get("resourceVersion"):
+                        rv = meta["resourceVersion"]
+                    if ev.type == "BOOKMARK":
+                        continue
+                    self.runtime.notify(
+                        meta.get("namespace", "default"),
+                        meta.get("name", ""),
+                        obj=dict(ev.object),
+                        event_type=ev.type,
+                    )
+                # Server closed the stream (watch timeout): reconnect from
+                # the current cursor without re-listing.
+            except WatchExpired:
+                _log.info("watch cursor expired; re-listing")
+                rv = None
+            except Exception:
+                failures += 1
+                backoff = min(self.max_backoff_s, 2.0 ** min(failures, 16))
+                _log.exception("watch failed; reconnecting in %.0fs", backoff)
+                rv = None
+                self._stop.wait(backoff)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
