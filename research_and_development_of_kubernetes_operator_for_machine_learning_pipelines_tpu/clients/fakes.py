@@ -210,9 +210,16 @@ class FakeKube:
         first, exactly like against the real API server).  Ends when
         ``stop`` is set, mimicking the server closing an idle watch.
         """
+        # Register eagerly (watch() is NOT a generator): the queue must be
+        # live the moment watch() returns, or mutations between a caller's
+        # list() and its first next() would be dropped — the fake keeps no
+        # history to replay them from.
         q: _queue.Queue = _queue.Queue()
         with self._lock:
             self._watchers.append(q)
+        return self._drain_watch(q, ref, stop)
+
+    def _drain_watch(self, q: _queue.Queue, ref: ObjectRef, stop):
         try:
             while stop is None or not stop.is_set():
                 try:

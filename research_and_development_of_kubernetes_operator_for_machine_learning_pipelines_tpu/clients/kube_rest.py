@@ -144,10 +144,7 @@ class KubeRestClient:
             if resp.status_code >= 400:
                 resp.read()
                 raise ApiError(resp.status_code, resp.text[:500])
-            try:
-                lines = resp.iter_lines()
-            except httpx.ReadTimeout:
-                return
+            lines = resp.iter_lines()
             while True:
                 if stop is not None and stop.is_set():
                     return

@@ -35,6 +35,11 @@ _log = logging.getLogger(__name__)
 class GateDecision:
     promote: bool
     reasons: tuple[str, ...] = ()
+    # Which models had gating metrics missing (no traffic in the window):
+    # any subset of {"new", "old"}.  Typed so consumers (warm-up targeting
+    # in the reconciler) never parse the human-readable reason strings —
+    # rewording a message must not change behavior.
+    missing_on: frozenset[str] = frozenset()
 
     def __bool__(self) -> bool:
         return self.promote
@@ -54,6 +59,7 @@ def should_promote(
     # Availability check (reference :430-434): all three gating metrics must
     # be present on both models.  The reason names which model is missing
     # traffic so the reconciler can aim warm-up requests at that predictor.
+    missing_on: set[str] = set()
     for who, m in (("new", new), ("old", old)):
         missing = [
             label
@@ -65,6 +71,7 @@ def should_promote(
             if val is None
         ]
         if missing:
+            missing_on.add(who)
             reasons.append(
                 f"metrics {', '.join(missing)} unavailable on {who} model "
                 "(no traffic in window)"
@@ -72,7 +79,7 @@ def should_promote(
     if reasons:
         for r in reasons:
             log.warning(r)
-        return GateDecision(False, tuple(reasons))
+        return GateDecision(False, tuple(reasons), frozenset(missing_on))
 
     # Hardening: minimum sample count before the gate may pass.
     if t.min_sample_count > 0:

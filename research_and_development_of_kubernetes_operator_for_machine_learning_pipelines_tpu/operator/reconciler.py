@@ -80,6 +80,14 @@ class Reconciler:
         self.log = logger or model_logger(name, namespace)
         if metrics is None and metrics_factory is None:
             raise ValueError("either metrics or metrics_factory is required")
+        # (model, version) -> registry source URI.  An MLflow version's
+        # source is immutable once registered, so resolve each version once
+        # — the reference does the same (resolves at version-change time,
+        # ``mlflow_operator.py:125-135``); without this every canary step
+        # pays up to two registry round-trips re-resolving both versions.
+        # The cache holds the raw source, NOT the final artifact URI:
+        # spec.artifactRoot is mutable, so rooting must happen per call.
+        self._source_cache: dict[tuple[str, str], str] = {}
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -281,13 +289,9 @@ class Reconciler:
             # judge reported as missing traffic (usually the 10% canary, but a
             # drained stable predictor deadlocks the gate just the same).
             targets = []
-            if any(
-                "unavailable" in r and "new model" in r for r in decision.reasons
-            ):
+            if "new" in decision.missing_on:
                 targets.append(f"v{state.current_version}")
-            if any(
-                "unavailable" in r and "old model" in r for r in decision.reasons
-            ):
+            if "old" in decision.missing_on:
                 targets.append(f"v{state.previous_version}")
             for predictor in targets:
                 try:
@@ -350,8 +354,12 @@ class Reconciler:
     # -- deployment application ---------------------------------------------
 
     def _resolve_uri(self, config: OperatorConfig, version: str) -> str:
-        mv = self.registry.get_version(config.model_name, version)
-        return artifact_uri(mv.source, config.artifact_root)
+        key = (config.model_name, version)
+        source = self._source_cache.get(key)
+        if source is None:
+            source = self.registry.get_version(config.model_name, version).source
+            self._source_cache[key] = source
+        return artifact_uri(source, config.artifact_root)
 
     def _manifest_for_state(
         self,
@@ -362,6 +370,9 @@ class Reconciler:
     ) -> dict:
         if source_of_current is not None and source_of_current.version == state.current_version:
             new_uri = artifact_uri(source_of_current.source, config.artifact_root)
+            self._source_cache[
+                (config.model_name, state.current_version)
+            ] = source_of_current.source
         else:
             new_uri = self._resolve_uri(config, state.current_version)
         old_uri = None

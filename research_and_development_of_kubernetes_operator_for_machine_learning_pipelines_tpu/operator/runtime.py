@@ -375,4 +375,6 @@ class CrWatcher:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            # A real watch blocked in a read only observes stop after the
+            # client's 15s read timeout — join must outlast it.
+            self._thread.join(timeout=20)

@@ -62,3 +62,72 @@ def test_min_sample_count_gating():
     t = GateThresholds(min_sample_count=50)
     assert not should_promote(m(count=10), m(count=1000), t)
     assert should_promote(m(count=60), m(count=1000), t).promote
+
+
+def test_missing_on_is_typed_not_string_matched():
+    """Warm-up targeting reads GateDecision.missing_on, never the
+    human-readable reasons (VERDICT round 1, weak #2)."""
+    # new missing only
+    d = should_promote(ModelMetrics(), m())
+    assert not d.promote and d.missing_on == frozenset({"new"})
+    # old missing only
+    d = should_promote(m(), ModelMetrics())
+    assert d.missing_on == frozenset({"old"})
+    # both missing
+    d = should_promote(ModelMetrics(), ModelMetrics())
+    assert d.missing_on == frozenset({"new", "old"})
+    # nothing missing: threshold refusals carry no missing_on
+    d = should_promote(m(p95=9.9), m())
+    assert not d.promote and d.missing_on == frozenset()
+    # pass case
+    assert should_promote(m(), m()).missing_on == frozenset()
+
+
+def test_warmup_targeting_survives_reason_rewording(monkeypatch):
+    """Reword every reason string to gibberish; warm-up must still aim at
+    the right predictors because targeting is typed, not parsed."""
+    from tpumlops.clients.base import MLFLOWMODEL, ObjectRef
+    from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+    from tpumlops.operator import reconciler as rec_mod
+    from tpumlops.operator.judge import GateDecision
+    from tpumlops.operator.reconciler import Reconciler
+    from tpumlops.utils.clock import FakeClock
+
+    real = should_promote
+
+    def reworded(new, old, thresholds=None, logger=None):
+        d = real(new, old, thresholds, logger)
+        return GateDecision(
+            d.promote,
+            tuple(f"reason #{i}" for i in range(len(d.reasons))),
+            d.missing_on,
+        )
+
+    monkeypatch.setattr(rec_mod, "should_promote", reworded)
+
+    kube, registry, metrics = FakeKube(), FakeRegistry(), FakeMetrics()
+    ref = ObjectRef(namespace="models", name="iris", **MLFLOWMODEL)
+    kube.create(
+        ref,
+        {
+            "metadata": {"name": "iris", "namespace": "models"},
+            "spec": {
+                "modelName": "iris",
+                "modelAlias": "champion",
+                "canary": {"warmupRequests": 3},
+            },
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    calls = []
+    rec = Reconciler(
+        "iris", "models", kube, registry, metrics, FakeClock(),
+        warmup=lambda d, p, ns, n, model=None: calls.append(p),
+    )
+    rec.reconcile(kube.get(ref))
+    registry.register("iris", "2", "mlflow-artifacts:/1/b/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    rec.reconcile(kube.get(ref))
+    rec.reconcile(kube.get(ref))  # gate attempt: both predictors traffic-less
+    assert calls == ["v2", "v1"]
