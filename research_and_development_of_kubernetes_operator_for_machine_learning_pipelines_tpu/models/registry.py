@@ -106,7 +106,10 @@ def _build_xgboost(model: Any, **_kw) -> Predictor:
 
     Fully TPU-native (baseline config 1): trees run as the same flattened
     gather program as sklearn forests; the objective picks the output
-    transform (sigmoid for ``binary:*``, identity for regression).
+    transform (sigmoid for ``binary:*``, softmax/argmax over per-class
+    margins for ``multi:*``, identity for regression).  Matches xgboost's
+    ``predict`` output shapes: probabilities [B, K] for softprob, class
+    ids [B] for softmax.
     """
     from . import tabular
 
@@ -120,6 +123,18 @@ def _build_xgboost(model: Any, **_kw) -> Predictor:
             import jax
 
             return jax.nn.sigmoid(tabular.eval_forest(trees, x))
+    elif objective == "multi:softprob":
+        def predict(x):
+            import jax
+
+            return jax.nn.softmax(tabular.eval_forest(trees, x), axis=-1)
+    elif objective == "multi:softmax":
+        def predict(x):
+            import jax.numpy as jnp
+
+            return jnp.argmax(
+                tabular.eval_forest(trees, x), axis=-1
+            ).astype(jnp.float32)
     else:
         def predict(x):
             return tabular.eval_forest(trees, x)
@@ -134,6 +149,7 @@ def _build_xgboost(model: Any, **_kw) -> Predictor:
             "n_trees": int(trees.feature.shape[0]),
             "n_features": n_feat,
             "objective": objective,
+            "n_classes": trees.n_groups,
         },
     )
 

@@ -39,14 +39,22 @@ class TreeArrays:
     max_depth: int
     base_score: float = 0.0
     n_features: int = 0  # 0 = unknown (warmup shapes then derive from splits)
+    # Multi-class boosters (xgboost multi:*) train one tree group per class:
+    # tree_group[t] is the class whose margin tree t contributes to.
+    # n_groups == 1 keeps the scalar-output path ([B] not [B, 1]).
+    tree_group: jax.Array | None = None  # int32 [T] or None
+    n_groups: int = 1
 
 
 def eval_forest(trees: TreeArrays, x: jax.Array) -> jax.Array:
-    """Evaluate the forest: x [B, F] -> [B] summed leaf values.
+    """Evaluate the forest: x [B, F] -> [B] summed leaf values, or
+    [B, n_groups] per-class margins when the forest is multi-class.
 
     Each of ``max_depth`` rounds gathers (feature, threshold, children) for
     the current node of every (tree, row) pair — pure gathers/selects, TPU
-    VPU-friendly, no data-dependent control flow.
+    VPU-friendly, no data-dependent control flow.  The multi-class
+    reduction is a [T,B]x[T,K] matmul against a one-hot group matrix
+    (a vectorized per-class segment sum, MXU-friendly for big forests).
     """
     n_trees = trees.feature.shape[0]
     b = x.shape[0]
@@ -66,6 +74,11 @@ def eval_forest(trees: TreeArrays, x: jax.Array) -> jax.Array:
     for _ in range(trees.max_depth):
         node = step(node)
     leaf_vals = jnp.take_along_axis(trees.value, node, axis=1)  # [T, B]
+    if trees.n_groups > 1:
+        onehot = jax.nn.one_hot(
+            trees.tree_group, trees.n_groups, dtype=leaf_vals.dtype
+        )  # [T, K]
+        return leaf_vals.T @ onehot + trees.base_score  # [B, K]
     return leaf_vals.sum(axis=0) + trees.base_score
 
 
@@ -156,11 +169,6 @@ def from_xgboost_json(model: Any) -> tuple[TreeArrays, str]:
     lmp = learner.get("learner_model_param", {})
     num_class = int(lmp.get("num_class", "0") or 0)
     objective = (learner.get("objective") or {}).get("name", "reg:squarederror")
-    if num_class > 1 or objective.startswith("multi:"):
-        raise NotImplementedError(
-            f"multi-class xgboost (num_class={num_class}, {objective}) has "
-            "one tree group per class; not supported yet — use pyfunc tier"
-        )
     base = float(lmp.get("base_score", "0.5"))
     if objective.startswith("binary:"):
         # ProbToMargin: stored base_score is a probability.
@@ -175,6 +183,34 @@ def from_xgboost_json(model: Any) -> tuple[TreeArrays, str]:
     trees_json = (booster.get("model") or {}).get("trees", [])
     if not trees_json:
         raise ValueError("xgboost model contains no trees")
+    # Multi-class (multi:softprob/softmax): one tree group per class,
+    # recorded per tree in tree_info; margins reduce per class in
+    # eval_forest.  base_score stays a raw margin here — softmax has no
+    # ProbToMargin transform (unlike binary:*'s logit above).
+    if objective.startswith("multi:") and num_class < 2:
+        # Without a trustworthy num_class the [B] margin vector would be
+        # softmaxed ACROSS THE BATCH downstream — reject at load time.
+        raise ValueError(
+            f"objective {objective!r} requires num_class >= 2 in "
+            f"learner_model_param, got {num_class}"
+        )
+    n_groups = num_class if num_class > 1 else 1
+    tree_group = None
+    if n_groups > 1:
+        tree_info = (booster.get("model") or {}).get("tree_info", [])
+        if len(tree_info) != len(trees_json):
+            raise ValueError(
+                f"multi-class model: tree_info has {len(tree_info)} entries "
+                f"for {len(trees_json)} trees"
+            )
+        tree_group = np.asarray(tree_info, np.int32)
+        if tree_group.size and (
+            tree_group.min() < 0 or tree_group.max() >= n_groups
+        ):
+            raise ValueError(
+                f"tree_info class ids outside [0, {n_groups}): "
+                f"[{tree_group.min()}, {tree_group.max()}]"
+            )
 
     T = len(trees_json)
     max_nodes = max(len(t["left_children"]) for t in trees_json)
@@ -222,6 +258,8 @@ def from_xgboost_json(model: Any) -> tuple[TreeArrays, str]:
             base_score=base,
             n_features=int(lmp.get("num_feature", "0") or 0)
             or int(feature.max()) + 1,
+            tree_group=None if tree_group is None else jnp.asarray(tree_group),
+            n_groups=n_groups,
         ),
         objective,
     )

@@ -87,6 +87,9 @@ class Reconciler:
         # pays up to two registry round-trips re-resolving both versions.
         # The cache holds the raw source, NOT the final artifact URI:
         # spec.artifactRoot is mutable, so rooting must happen per call.
+        # Freshness: alias resolutions overwrite the current version's
+        # entry, and AliasNotFound clears the cache (a deleted/re-created
+        # registered model restarts version numbering with new sources).
         self._source_cache: dict[tuple[str, str], str] = {}
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
@@ -122,6 +125,11 @@ class Reconciler:
                 config.model_name, config.model_alias
             )
         except AliasNotFound:
+            # A vanished alias often means the registered model was deleted;
+            # if it is re-created, version numbers restart at 1 with new
+            # sources — cached sources for the old incarnation would serve
+            # stale artifacts, so drop them.
+            self._source_cache.clear()
             return self._on_alias_missing(obj, config, state, events)
         except RegistryError as e:
             # Transport error: unlike the reference (which tears the

