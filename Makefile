@@ -1,0 +1,49 @@
+# Build + deploy entry points.  The reference ships one prebuilt image
+# (nizepart/mlflow-operator:latest, README.md:32); this framework builds
+# its three first-party images from source.
+
+PKG      := research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu
+REGISTRY ?= tpumlops
+TAG      ?= latest
+DOCKER   ?= docker
+
+.PHONY: images operator-image server-image router-image router-bin \
+        install uninstall test bench
+
+images: operator-image server-image router-image
+
+operator-image:
+	$(DOCKER) build -f $(PKG)/deploy/docker/Dockerfile.operator \
+	  -t $(REGISTRY)/operator:$(TAG) .
+
+server-image:
+	$(DOCKER) build -f $(PKG)/deploy/docker/Dockerfile.server \
+	  -t $(REGISTRY)/jax-server:$(TAG) .
+
+router-image:
+	$(DOCKER) build -f $(PKG)/deploy/docker/Dockerfile.router \
+	  -t $(REGISTRY)/router:$(TAG) .
+
+# Local (no docker): compile the native router with the system toolchain.
+router-bin:
+	mkdir -p build
+	g++ -O2 -std=c++17 -Wall -o build/router $(PKG)/native/router.cc
+
+# Cluster install, mirroring the reference's README steps (:25-64):
+# CRD -> RBAC -> operator Deployment.  Assumes the mlflow-creds secret
+# exists in tpumlops-system (MLFLOW_TRACKING_URI + credentials).
+install:
+	kubectl apply -f $(PKG)/deploy/crd.yaml
+	kubectl apply -f $(PKG)/deploy/rbac.yaml
+	kubectl apply -f $(PKG)/deploy/operator-deployment.yaml
+
+uninstall:
+	kubectl delete -f $(PKG)/deploy/operator-deployment.yaml --ignore-not-found
+	kubectl delete -f $(PKG)/deploy/rbac.yaml --ignore-not-found
+	kubectl delete -f $(PKG)/deploy/crd.yaml --ignore-not-found
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py

@@ -221,6 +221,18 @@ def from_xgboost_json(model: Any) -> tuple[TreeArrays, str]:
     value = np.zeros((T, max_nodes), np.float32)
     max_depth = 1
     for ti, t in enumerate(trees_json):
+        leaf_vec = int((t.get("tree_param") or {}).get("size_leaf_vector", "1") or 1)
+        if leaf_vec > 1:
+            # xgboost >= 2.0 multi_strategy="multi_output_tree": one tree
+            # emits a vector of per-class leaf values.  The flattened
+            # scalar-leaf evaluator would silently sum every margin into
+            # class 0 — reject instead of serving wrong probabilities.
+            raise NotImplementedError(
+                f"vector-leaf tree (size_leaf_vector={leaf_vec}, "
+                "multi_output_tree strategy) has no TPU-native lowering; "
+                "train with one-tree-per-class (default) or use the "
+                "pyfunc tier"
+            )
         lc = np.asarray(t["left_children"], np.int32)
         rc = np.asarray(t["right_children"], np.int32)
         cond = np.asarray(t["split_conditions"], np.float32)

@@ -137,6 +137,12 @@ class Reconciler:
             # last-known-good data plane and retry.
             self.log.warning(f"registry unreachable, keeping current state: {e}")
             return ReconcileOutcome(state, config.monitoring_interval_s, events)
+        # Upsert the freshly resolved source unconditionally: if the
+        # registered model was deleted and re-created between reconciles
+        # (version numbers restart with new sources), the alias resolution
+        # in hand is the truth and any cached entry for this version is
+        # stale.
+        self._source_cache[(config.model_name, mv.version)] = mv.source
 
         # 2. Blocked version (post-rollback hold): don't redeploy a version
         #    that just failed its SLOs until the alias moves on.

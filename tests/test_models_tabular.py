@@ -328,3 +328,13 @@ def test_xgboost_multi_objective_requires_num_class():
     model["learner"]["learner_model_param"]["num_class"] = "0"
     with pytest.raises(ValueError, match="num_class"):
         tabular.from_xgboost_json(model)
+
+
+def test_xgboost_rejects_vector_leaf_trees():
+    import pytest
+
+    model, _, _ = _multiclass_model()
+    trees = model["learner"]["gradient_booster"]["model"]["trees"]
+    trees[0]["tree_param"]["size_leaf_vector"] = "3"
+    with pytest.raises(NotImplementedError, match="vector-leaf"):
+        tabular.from_xgboost_json(model)
