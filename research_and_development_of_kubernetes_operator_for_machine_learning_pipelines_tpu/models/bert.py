@@ -22,6 +22,16 @@ import jax.numpy as jnp
 from .common import dense, gelu, init_dense, layer_norm, take_embedding
 
 
+def _dense(x, p):
+    """Dense dispatch: f32/bf16 weights -> MXU bf16 matmul; int8 leaves
+    (quantization.quantize_bert) -> true int8 MXU matmul (dense_q8)."""
+    from .quantization import dense_q8, is_quantized
+
+    if is_quantized(p["w"]):
+        return dense_q8(x, p["w"], p.get("b"))
+    return dense(x, p["w"], p["b"])
+
+
 @dataclass(frozen=True)
 class BertConfig:
     vocab_size: int = 30522
@@ -114,9 +124,9 @@ def _self_attention(p: dict, x: jax.Array, mask_bias: jax.Array, cfg: BertConfig
     b, s, h = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
 
-    q = dense(x, p["q"]["w"], p["q"]["b"]).reshape(b, s, nh, hd)
-    k = dense(x, p["k"]["w"], p["k"]["b"]).reshape(b, s, nh, hd)
-    v = dense(x, p["v"]["w"], p["v"]["b"]).reshape(b, s, nh, hd)
+    q = _dense(x, p["q"]).reshape(b, s, nh, hd)
+    k = _dense(x, p["k"]).reshape(b, s, nh, hd)
+    v = _dense(x, p["v"]).reshape(b, s, nh, hd)
 
     scores = jnp.einsum(
         "bqnd,bknd->bnqk", q, k, preferred_element_type=jnp.float32
@@ -124,7 +134,7 @@ def _self_attention(p: dict, x: jax.Array, mask_bias: jax.Array, cfg: BertConfig
     scores = scores + mask_bias  # (b, 1, 1, s) additive bias
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(b, s, h)
-    return dense(ctx, p["o"]["w"], p["o"]["b"])
+    return _dense(ctx, p["o"])
 
 
 def encode(
@@ -162,9 +172,9 @@ def encode(
             layer["attn"]["ln"]["bias"],
             cfg.layer_norm_eps,
         )
-        m = dense(x, layer["mlp"]["up"]["w"], layer["mlp"]["up"]["b"])
+        m = _dense(x, layer["mlp"]["up"])
         m = gelu(m)
-        m = dense(m, layer["mlp"]["down"]["w"], layer["mlp"]["down"]["b"])
+        m = _dense(m, layer["mlp"]["down"])
         x = layer_norm(
             x + m,
             layer["mlp"]["ln"]["scale"],

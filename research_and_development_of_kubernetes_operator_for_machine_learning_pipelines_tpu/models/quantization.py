@@ -88,6 +88,67 @@ def quantize_llama(params: dict) -> dict:
     return _q(params)
 
 
+def dense_q8(x: jax.Array, qw: dict, b: jax.Array | None = None) -> jax.Array:
+    """Dynamic-activation int8 matmul: ``x [..., in] @ q8 [in, out]``.
+
+    Unlike the weight-only scheme above (a bandwidth lever for decode),
+    this feeds the MXU actual int8 operands — on v5e the int8 systolic
+    path has 2x the bf16 throughput, which is the only remaining lever for
+    a COMPUTE-bound workload like BERT prefill (bench.py measures bf16
+    classify at ~55% MXU).  Activations quantize per row (per token):
+    symmetric, scale = max|x| / 127 over the contraction axis, computed on
+    the fly — cheap elementwise work against an 8x-H^2 matmul.  The int32
+    accumulator rescales by (a_scale x w_scale) in f32, so the only
+    approximation is the two roundings to int8.
+    """
+    qa = quantize_tensor(x, axis=-1)  # per-row (per-token) scales
+    x8, a_scale = qa["q8"], qa["scale"]
+    y = jax.lax.dot_general(
+        x8,
+        qw["q8"],
+        (((x8.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    # w scale was reduced over axis=-2 with keepdims -> shape [1, out].
+    y32 = y.astype(jnp.float32) * a_scale * qw["scale"].reshape(-1)
+    if b is not None:
+        y32 = y32 + b.astype(jnp.float32)
+    return y32.astype(x.dtype)
+
+
+# BERT dense layers worth int8-ing: the six big matmuls per encoder layer.
+# ~97% of classify FLOPs at b32/s128 live here (12*S*H^2 vs 2*S^2*H for the
+# attention einsums); pooler/classifier/embeddings are noise-sensitive and
+# a rounding error away from flipping a logit, for no measurable FLOPs.
+_BERT_LAYER_MATS = (("attn", "q"), ("attn", "k"), ("attn", "v"), ("attn", "o"),
+                    ("mlp", "up"), ("mlp", "down"))
+
+
+def quantize_bert(params: dict) -> dict:
+    """Params tree with each encoder layer's dense weights as int8 leaves.
+
+    The per-dense dicts keep their ``b`` (bias) and gain ``{"q8","scale"}``
+    in place of ``w``; ``models/bert.py``'s dense dispatch routes such
+    layers through :func:`dense_q8`.
+    """
+
+    @jax.jit
+    def _q(params):
+        out = dict(params)
+        layers = []
+        for layer in params["layers"]:
+            new_layer = {k: dict(v) for k, v in layer.items()}
+            for group, name in _BERT_LAYER_MATS:
+                d = dict(new_layer[group][name])
+                d["w"] = quantize_tensor(d["w"])
+                new_layer[group][name] = d
+            layers.append(new_layer)
+        out["layers"] = layers
+        return out
+
+    return _q(params)
+
+
 def quantized_bytes(params: Any) -> int:
     """Total parameter bytes as stored (int8 leaves count 1 byte/elem)."""
     total = 0

@@ -230,17 +230,31 @@ def _finish_native(
     if quantize and quantize != "none":
         # After sharding: the jitted quantizer preserves input shardings
         # and computes per-channel scales with an on-mesh reduction.
-        if flavor != "llama-generate":
-            raise ModelLoadError(
-                f"quantize={quantize!r} is only supported for the "
-                f"llama-generate flavor (decode is HBM-bound); "
-                f"{flavor!r} serves prefill-style batches"
-            )
         if quantize not in ("int8", "int8kv"):
             raise ModelLoadError(f"unknown quantize mode {quantize!r}")
-        from ..models.quantization import quantize_llama
+        if flavor == "llama-generate":
+            # Decode is HBM-bound: weight-only int8 halves the bytes
+            # streamed per token (int8kv additionally quantizes the cache).
+            from ..models.quantization import quantize_llama
 
-        params = quantize_llama(params)
+            params = quantize_llama(params)
+        elif flavor == "bert-classifier":
+            # Prefill-style classify is MXU-bound: encoder matmuls run as
+            # true int8 x int8 -> int32 on the MXU with dynamic per-token
+            # activation scales (models/quantization.dense_q8).
+            if quantize == "int8kv":
+                raise ModelLoadError(
+                    "int8kv quantizes a KV cache; bert-classifier has "
+                    "none — use quantize: int8"
+                )
+            from ..models.quantization import quantize_bert
+
+            params = quantize_bert(params)
+        else:
+            raise ModelLoadError(
+                f"quantize={quantize!r} is not supported for flavor "
+                f"{flavor!r} (supported: llama-generate, bert-classifier)"
+            )
         _log.info("quantized %s weights to int8 (mode=%s)", flavor, quantize)
     kwargs = dict(builder_kwargs)
     if cfg is not None:
@@ -407,12 +421,12 @@ def load_predictor(
         )
 
     if quantize and quantize != "none":
-        # Only the native llama path got here without raising; every other
-        # artifact kind serves prefill-style batches where weight-only int8
-        # buys nothing (compute-bound) — reject loudly instead of ignoring.
+        # The JAX-native paths (llama, bert) handled quantize above; what
+        # remains are sklearn/xgboost/pyfunc artifacts with no quantizable
+        # weight matmuls — reject loudly instead of ignoring.
         raise ModelLoadError(
-            f"quantize={quantize!r} is only supported for the "
-            "llama-generate flavor (decode is HBM-bound)"
+            f"quantize={quantize!r} is only supported for JAX-native "
+            "flavors (llama-generate, bert-classifier)"
         )
 
     xgb_file = _find_xgboost_file(path)
