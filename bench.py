@@ -1,12 +1,31 @@
 """Benchmark of record (driver contract: prints ONE JSON line).
 
 Headline metric — BERT-base batched-inference p99 latency per chip
-(BASELINE.md north star; acceptance config 3).  ``vs_baseline`` compares
-against the reference's data plane: the reference serves models through
-Seldon's CPU ``MLFLOW_SERVER`` pods (its manifests request no GPU —
-``mlflow_operator.py:193-222``), so the baseline is the same BERT-base
-batch on torch/CPU, measured live in this process.  Values > 1 mean the
-TPU path is faster.
+(BASELINE.md north star; acceptance config 3), served int8 on the MXU's
+native s8 path (models/quantization.dense_q8; bf16 comparison included).
+``vs_baseline`` compares against the reference's data plane: the reference
+serves models through Seldon's CPU ``MLFLOW_SERVER`` pods (its manifests
+request no GPU — ``mlflow_operator.py:193-222``), so the baseline is the
+same BERT-base batch on torch/CPU, measured live in this process.  Values
+> 1 mean the TPU path is faster.
+
+``secondary`` covers the rest of BASELINE.json's configs and the second
+north star:
+
+- ``serve_path_http``  — p50/p99 per REQUEST through the real aiohttp
+  server + dynamic batcher (and through the native router in front), not
+  raw jit calls: the number the promotion gate actually judges.
+- ``time_to_100pct_traffic`` — wall time for a full canary 10%→100% on
+  the REAL local data plane (two live servers, C++ router split, gate fed
+  by the router's actual histograms) at an accelerated step interval,
+  with the policy-sleep floor separated out so the operator overhead is
+  visible.  The reference's floor for its default policy is 480 s
+  (``mlflow_operator.py:291-296``); ours is policy-bound the same way —
+  the overhead line is what the rebuild adds on top (≈0 means parity).
+- ``iris_sklearn_linear`` / ``xgboost_forest`` — µs-scale tabular configs.
+- ``resnet50_b8`` — image batch latency.
+- ``llama_1p35b_decode`` — continuous-batching decode throughput, int8
+  weights + windowed attention (models/llama.py, server/generation.py).
 
 Run on the real TPU chip: ``python bench.py``.
 """
@@ -33,54 +52,69 @@ PIPELINE = 64  # batches in flight per timed run (amortizes host<->device RTT)
 RUNS = 8
 
 
-def bench_tpu() -> dict[int, float]:
-    """Per-batch latency with PIPELINE batches in flight.
+def _timed(f, *args, runs: int = 6, inner: int = 100) -> dict[int, float]:
+    """Compile, then time ``inner`` pipelined dispatches per sample —
+    the shared methodology for every jit-level number here (single-call
+    block_until_ready would measure the host<->device tunnel RTT)."""
+    f(*args).block_until_ready()
+    samples = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(inner):
+            out = f(*args)
+        out.block_until_ready()
+        samples.append((time.perf_counter() - t0) / inner)
+    return _percentiles(samples)
 
-    Single-call block_until_ready timing would measure the host<->device
-    round trip (65+ ms through a tunnel in dev environments), not the chip.
-    A serving process keeps the dispatch queue full, so per-batch latency
-    under pipelining is the number that governs throughput and the
-    Prometheus histograms the gate reads.  Depth matters: measured on chip,
-    per-batch latency converges (10 -> 12.6 ms, 64 -> 6.95 ms, 128 ->
-    6.47 ms) toward the ~6.1 ms pure device time measured with a
-    CSE-proof on-device loop; 64 is a realistic loaded-server queue depth.
 
-    Variants measured on chip and REJECTED (b32/s128, p50 per batch):
-    XLA einsum attention 7.47 ms beats both a prefolded fused-QKV matmul
-    (7.89 ms — XLA already merges the three projections) and the Pallas
-    flash kernel (9.56 ms — at s=128 the whole KV fits one block, so
-    flash's streaming machinery is pure overhead; it wins at 8k, see
-    ops/flash_attention.py).  bf16 classify here is compute-bound at
-    ~55% MXU, so remaining headroom is numerics (int8), not scheduling.
-    """
+def _setup_jax():
     import jax
-    import jax.numpy as jnp
-
-    from tpumlops.models import bert
 
     try:  # persistent compile cache across rounds
         jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
     except Exception:
         pass
+    return jax
+
+
+def bench_bert() -> dict:
+    """Per-batch latency with PIPELINE batches in flight, int8 and bf16.
+
+    Single-call block_until_ready timing would measure the host<->device
+    round trip (65+ ms through a tunnel in dev environments), not the chip.
+    A serving process keeps the dispatch queue full, so per-batch latency
+    under pipelining is the number that governs throughput and the
+    Prometheus histograms the gate reads.
+
+    Numerics: int8 is the headline (dense_q8 feeds the MXU true s8
+    operands — compiled HLO shows the packed (4,1) s8 convolution; ~8%
+    over bf16 end-to-end, bounded by Amdahl: attention einsums, norms and
+    the activation-quant overhead stay bf16/VPU).  Variants measured on
+    chip and REJECTED for the bf16 path (b32/s128, p50 per batch): XLA
+    einsum attention 7.47 ms beats both a prefolded fused-QKV matmul
+    (7.89 ms — XLA already merges the three projections) and the Pallas
+    flash kernel (9.56 ms — at s=128 the whole KV fits one block; flash
+    wins at 8k, see ops/flash_attention.py).
+    """
+    jax = _setup_jax()
+    import jax.numpy as jnp
+
+    from tpumlops.models import bert
+    from tpumlops.models.quantization import quantize_bert
 
     cfg = bert.BertConfig.base()
     params = bert.init(jax.random.key(0), cfg)
+    qparams = quantize_bert(params)
     ids = jax.random.randint(jax.random.key(1), (BATCH, SEQ), 0, cfg.vocab_size)
     mask = jnp.ones((BATCH, SEQ), jnp.int32)
 
     f = jax.jit(
         lambda p, i, m: bert.classify(p, i, m, cfg=cfg, dtype=jnp.bfloat16)
     )
-    f(params, ids, mask).block_until_ready()  # compile
-    samples = []
-    for _ in range(RUNS):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(PIPELINE):
-            out = f(params, ids, mask)
-        out.block_until_ready()
-        samples.append((time.perf_counter() - t0) / PIPELINE)
-    return _percentiles(samples)
+    q8 = _timed(f, qparams, ids, mask, runs=RUNS, inner=PIPELINE)
+    bf16 = _timed(f, params, ids, mask, runs=RUNS, inner=PIPELINE)
+    return {"int8": q8, "bf16": bf16}
 
 
 def bench_torch_cpu(iters: int = 3) -> dict[int, float]:
@@ -101,8 +135,478 @@ def bench_torch_cpu(iters: int = 3) -> dict[int, float]:
     return _percentiles(samples)
 
 
+# ---------------------------------------------------------------------------
+# Serve path: HTTP through the real server (+ router), per-request latency
+# ---------------------------------------------------------------------------
+
+
+def bench_serve_path() -> dict:
+    """p50/p99 per single-sequence REQUEST through aiohttp + the dynamic
+    batcher (BERT-base int8), then the same through the native router —
+    the full Seldon-executor-analogue path the gate's PromQL measures."""
+    import concurrent.futures
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from tpumlops.clients.localplane import free_port, start_model_server
+    from tpumlops.models import bert
+    from tpumlops.server.loader import save_native_model
+    from tpumlops.utils.config import TpuSpec
+
+    jax = _setup_jax()
+
+    cfg = bert.BertConfig.base()
+    params = bert.init(jax.random.key(0), cfg)
+    art = tempfile.mkdtemp() + "/bert"
+    save_native_model(
+        art,
+        "bert-classifier",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "num_labels": cfg.num_labels,
+        },
+    )
+    port = free_port()
+    handle = start_model_server(
+        art,
+        "v1",
+        port,
+        model_name="bert",
+        namespace="bench",
+        tpu=TpuSpec.from_spec(
+            {
+                "meshShape": {"tp": 1},
+                "maxBatchSize": BATCH,
+                "maxBatchDelayMs": 2,
+                "quantize": "int8",
+            }
+        ),
+    )
+
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, SEQ))
+    # Both inputs, matching the engine's warmup examples: the batcher
+    # groups by the full input-name/shape key, so an input_ids-only
+    # request would form a new group and pay a live XLA compile.
+    body = json.dumps(
+        {
+            "inputs": [
+                {
+                    "name": "input_ids",
+                    "shape": [1, SEQ],
+                    "datatype": "INT32",
+                    "data": ids.ravel().tolist(),
+                },
+                {
+                    "name": "attention_mask",
+                    "shape": [1, SEQ],
+                    "datatype": "INT32",
+                    "data": [1] * SEQ,
+                },
+            ]
+        }
+    ).encode()
+
+    def fire(url: str, n: int, timeout: float = 30.0) -> list[float]:
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            urllib.request.urlopen(req, timeout=timeout).read()
+            lat.append(time.perf_counter() - t0)
+        return lat
+
+    def measure(url: str, clients: int = 8, per_client: int = 12) -> dict:
+        # generous first-request timeout: a cold compile cache may still
+        # be building an executable
+        fire(url, 5, timeout=300.0)
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            futs = [ex.submit(fire, url, per_client) for _ in range(clients)]
+            lats = [t for f in futs for t in f.result()]
+        p = _percentiles(lats)
+        return {
+            "p50_ms": round(p[50] * 1000, 2),
+            "p99_ms": round(p[99] * 1000, 2),
+            "requests": len(lats),
+        }
+
+    router = None
+    try:
+        direct = measure(f"http://127.0.0.1:{port}/v2/models/bert/infer")
+
+        # Same requests through the native router (the Istio-split stand-in).
+        from tpumlops.clients.router import RouterProcess
+
+        router = RouterProcess(
+            port=free_port(),
+            backends={"v1": ("127.0.0.1", port, 100)},
+            namespace="bench",
+        ).start()
+        routed = measure(
+            f"http://127.0.0.1:{router.port}/v2/models/bert/infer"
+        )
+    finally:
+        if router is not None:
+            router.stop()
+        handle.stop()
+    return {
+        "direct": direct,
+        "via_router": routed,
+        "router_overhead_p50_ms": round(
+            routed["p50_ms"] - direct["p50_ms"], 2
+        ),
+        "clients": 8,
+        "batch_per_request": 1,
+        "numerics": "int8",
+        "note": (
+            "this dev environment reaches the chip through a device "
+            "tunnel (~65 ms RTT per dispatch) which dominates these "
+            "absolutes; on a TPU host the compute floor is the headline "
+            "per-batch latency. router_overhead is the env-independent "
+            "signal here."
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Time-to-100%-traffic on the real local plane
+# ---------------------------------------------------------------------------
+
+
+def bench_time_to_100() -> dict:
+    """Full unscripted canary on the local plane: two live iris servers,
+    C++ router split, gate reading the router's real histograms.  The
+    step interval is accelerated (0.5 s vs the reference's 60 s); the
+    policy floor scales with it, so the reported overhead — measured
+    minus floor — is interval-independent."""
+    import tempfile
+    import threading
+
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+
+    from tpumlops.clients.base import ObjectRef
+    from tpumlops.clients.fakes import FakeRegistry
+    from tpumlops.clients.localplane import (
+        SyncingKube,
+        TrafficGenerator,
+        free_port,
+        start_model_server,
+    )
+    from tpumlops.clients.router import (
+        RouterMetricsSource,
+        RouterProcess,
+        RouterSync,
+    )
+    from tpumlops.operator.runtime import OperatorRuntime
+    from tpumlops.server.loader import save_sklearn_model
+    from tpumlops.utils.clock import SystemClock
+
+    STEP_INTERVAL = 0.5
+    root = tempfile.mkdtemp()
+    X, y = load_iris(return_X_y=True)
+    handles = []
+    ports = {}
+    router = None
+    rt = None
+    gens = []
+    try:
+        for tag, model in {
+            "1": LogisticRegression(max_iter=200).fit(X, y),
+            "2": LogisticRegression(max_iter=500, C=0.5).fit(X, y),
+        }.items():
+            uri = f"{root}/v{tag}"
+            save_sklearn_model(uri, model, "sklearn-linear")
+            port = free_port()
+            handles.append(
+                start_model_server(uri, f"v{tag}", port, namespace="bench")
+            )
+            ports[f"v{tag}"] = port
+
+        router = RouterProcess(
+            port=free_port(), backends={}, namespace="bench"
+        ).start()
+        sync = RouterSync(router.admin, lambda pred: ("127.0.0.1", ports[pred]))
+        kube = SyncingKube(sync)
+        registry = FakeRegistry()
+        registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+        registry.set_alias("iris", "prod", "1")
+        rt = OperatorRuntime(
+            kube,
+            registry,
+            metrics=RouterMetricsSource(router.admin),
+            clock=SystemClock(),
+            sync_interval_s=0.05,
+        )
+        CRREF = ObjectRef(
+            namespace="bench",
+            name="iris",
+            group="mlflow.nizepart.com",
+            version="v1alpha1",
+            plural="mlflowmodels",
+        )
+        kube.create(
+            CRREF,
+            {
+                "metadata": {"name": "iris", "namespace": "bench"},
+                "spec": {
+                    "modelName": "iris",
+                    "modelAlias": "prod",
+                    "monitoringInterval": 0.2,
+                    # Generous tolerances: identical models on a loaded
+                    # box; the gate judges real jitter.  Reference POLICY
+                    # shape: 10% steps from a 90/10 start.
+                    "thresholds": {
+                        "latencyP95": 5.0,
+                        "latencyAvg": 5.0,
+                        "errorRate": 1.0,
+                        "errorRateFloor": 0.5,
+                        "minSampleCount": 3,
+                    },
+                    "canary": {
+                        "step": 10,
+                        "stepInterval": STEP_INTERVAL,
+                        "attemptDelay": 0.15,
+                        "maxAttempts": 200,
+                        "initialTraffic": 10,
+                        "metricsWindow": 2,
+                    },
+                },
+            },
+        )
+
+        threading.Thread(target=rt.serve, daemon=True).start()
+        for _ in range(4):
+            gen = TrafficGenerator(router.port)
+            gen.__enter__()
+            gens.append(gen)
+
+        def status():
+            return kube.get(CRREF).get("status") or {}
+
+        deadline = time.monotonic() + 60
+        while status().get("phase") != "Stable" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert status().get("phase") == "Stable", status()
+
+        # Canary: flip the alias, time to Stable at 100%.
+        registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+        registry.set_alias("iris", "prod", "2")
+        t0 = time.monotonic()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            s = status()
+            if s.get("phase") == "Stable" and s.get("currentModelVersion") == "2":
+                break
+            time.sleep(0.05)
+        measured = time.monotonic() - t0
+        s = status()
+        assert s.get("phase") == "Stable" and s.get("currentModelVersion") == "2", s
+    finally:
+        for gen in gens:
+            gen.__exit__()
+        if rt is not None:
+            rt.stop()
+        if router is not None:
+            router.stop()
+        for h in handles:
+            h.stop()
+
+    # 9 gate passes take the split 10->100; the first fires immediately,
+    # the rest wait out STEP_INTERVAL: floor = 8 * STEP_INTERVAL (+ one
+    # monitoringInterval for the alias poll to notice the flip).
+    floor = 8 * STEP_INTERVAL + 0.2
+    return {
+        "measured_s": round(measured, 2),
+        "policy_floor_s": round(floor, 2),
+        "operator_overhead_s": round(measured - floor, 2),
+        "step_interval_s": STEP_INTERVAL,
+        "ref_floor_same_policy_s": 480,
+        "traffic_split": "native router (smooth WRR), gate on its live histograms",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Remaining baseline configs (secondary)
+# ---------------------------------------------------------------------------
+
+
+def bench_iris() -> dict:
+    jax = _setup_jax()
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+
+    from tpumlops.models import linear
+
+    X, y = load_iris(return_X_y=True)
+    sk = LogisticRegression(max_iter=500).fit(X, y)
+    params, cfg = linear.from_sklearn(sk)
+    x = jax.numpy.asarray(X[:32], jax.numpy.float32)
+    p = _timed(jax.jit(lambda x: linear.predict(params, x, cfg)), x, inner=200)
+    return {"p50_us": round(p[50] * 1e6, 1), "batch": 32}
+
+
+def bench_xgboost() -> dict:
+    """Synthetic 200-tree depth-6 regression forest via the JSON path —
+    the TPU-native gather evaluator (models/tabular.py)."""
+    jax = _setup_jax()
+    import numpy as np
+
+    from tpumlops.models import tabular
+
+    rng = np.random.default_rng(0)
+    n_feat, depth, n_trees = 16, 6, 200
+    n_nodes = 2 ** (depth + 1) - 1
+    n_internal = 2**depth - 1
+    trees = []
+    for _ in range(n_trees):
+        left = [2 * i + 1 if i < n_internal else -1 for i in range(n_nodes)]
+        right = [2 * i + 2 if i < n_internal else -1 for i in range(n_nodes)]
+        trees.append(
+            {
+                "left_children": left,
+                "right_children": right,
+                "split_indices": rng.integers(0, n_feat, n_nodes).tolist(),
+                "split_conditions": rng.normal(size=n_nodes).astype(float).tolist(),
+                "default_left": [1] * n_nodes,
+                "tree_param": {
+                    "num_nodes": str(n_nodes),
+                    "size_leaf_vector": "1",
+                },
+            }
+        )
+    model = {
+        "learner": {
+            "gradient_booster": {
+                "model": {"trees": trees, "tree_info": [0] * n_trees},
+                "name": "gbtree",
+            },
+            "learner_model_param": {
+                "base_score": "0.0",
+                "num_class": "0",
+                "num_feature": str(n_feat),
+            },
+            "objective": {"name": "reg:squarederror"},
+        }
+    }
+    arrs, _obj = tabular.from_xgboost_json(model)
+    x = jax.numpy.asarray(rng.normal(size=(256, n_feat)), jax.numpy.float32)
+    p = _timed(jax.jit(lambda x: tabular.eval_forest(arrs, x)), x)
+    return {"p50_us": round(p[50] * 1e6, 1), "trees": n_trees, "batch": 256}
+
+
+def bench_resnet() -> dict:
+    jax = _setup_jax()
+    import jax.numpy as jnp
+
+    from tpumlops.models import resnet
+
+    cfg = resnet.ResNetConfig.resnet50()
+    params = resnet.init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (8, 224, 224, 3), jnp.bfloat16)
+    p = _timed(jax.jit(lambda p, x: resnet.forward(p, x, cfg)), params, x, inner=32)
+    return {
+        "p50_ms": round(p[50] * 1000, 3),
+        "img_per_s": round(8 / p[50], 1),
+        "batch": 8,
+    }
+
+
+def bench_llama_decode() -> dict:
+    """Continuous-batching decode tok/s at a 1.35B shape: int8 weights +
+    windowed attention (the round-1 on-chip recipe), 8 active slots at
+    position ~256, capacity 1024."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        num_layers=24,
+        num_heads=16,
+        num_kv_heads=16,
+        intermediate_size=5632,
+        max_seq=1024,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    from tpumlops.models.quantization import quantize_llama
+
+    params = quantize_llama(params)
+
+    step_samples: list[tuple[int, float]] = []
+    engine = GenerationEngine(
+        params,
+        cfg,
+        max_slots=8,
+        dtype=jnp.bfloat16,
+        on_step=lambda active, dt: step_samples.append((active, dt)),
+    )
+    engine.start(warmup=True)
+    try:
+        prompt = np.ones((256,), np.int32).tolist()
+        futs = [engine.submit(prompt, 60) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=600)
+    finally:
+        engine.shutdown()
+    full = [(a, dt) for a, dt in step_samples if a == 8]
+    toks = sum(a for a, _ in full)
+    secs = sum(dt for _, dt in full)
+    engine_tok_s = round(toks / secs, 1) if secs else None
+
+    # Device decode throughput: chained decode steps with NO host sync
+    # between ticks.  The engine number above includes a host round trip
+    # per tick (it must read the token to schedule) — through this dev
+    # environment's device tunnel that RTT is ~60 ms and dominates; on a
+    # real TPU host it is microseconds, so the device-loop number is the
+    # production-relevant one and matches round 1's methodology.
+    cache = llama.RaggedKVCache.create(cfg, 8, jnp.bfloat16)
+    cache = cache._replace(lengths=jnp.full((8,), 256, jnp.int32))
+    toks0 = jnp.ones((8, 1), jnp.int32)
+
+    @jax.jit
+    def step(params, toks, cache):
+        logits, cache = llama.decode_ragged(
+            params, toks, cache, cfg, window=512
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        return nxt, cache
+
+    t, c = step(params, toks0, cache)  # compile
+    t.block_until_ready()
+    N = 100
+    t0 = time.perf_counter()
+    for _ in range(N):
+        t, c = step(params, t, c)
+    t.block_until_ready()
+    dev_secs = (time.perf_counter() - t0) / N
+    return {
+        "device_tok_per_s": round(8 / dev_secs, 1),
+        "ms_per_step": round(dev_secs * 1000, 2),
+        "engine_tok_per_s_tunnel_rtt_bound": engine_tok_s,
+        "slots": 8,
+        "params_b": 1.35,
+        "numerics": "int8 weights + windowed decode (window=512)",
+        "full_batch_steps": len(full),
+    }
+
+
 def main() -> None:
-    tpu = bench_tpu()
+    b = bench_bert()
+    tpu = b["int8"]
     try:
         ref = bench_torch_cpu()
         vs_baseline = ref[99] / tpu[99]
@@ -111,15 +615,34 @@ def main() -> None:
         print(f"baseline measurement failed: {e}", file=sys.stderr)
         vs_baseline = None
         baseline_ms = None
+
+    secondary = {}
+    for name, fn in (
+        ("serve_path_http", bench_serve_path),
+        ("time_to_100pct_traffic", bench_time_to_100),
+        ("iris_sklearn_linear", bench_iris),
+        ("xgboost_forest", bench_xgboost),
+        ("resnet50_b8", bench_resnet),
+        ("llama_1p35b_decode", bench_llama_decode),
+    ):
+        try:
+            secondary[name] = fn()
+        except Exception as e:
+            secondary[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"secondary bench {name} failed: {e}", file=sys.stderr)
+
     line = {
         "metric": "bert_base_b32_s128_p99_batch_latency_per_chip",
         "value": round(tpu[99] * 1000, 3),
         "unit": "ms",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
         "p50_ms": round(tpu[50] * 1000, 3),
+        "numerics": "int8 (MXU s8 path; bf16 comparison in bf16_p99_ms)",
+        "bf16_p99_ms": round(b["bf16"][99] * 1000, 3),
         "throughput_seq_per_s": round(BATCH / tpu[50], 1),
         "baseline_cpu_p99_ms": round(baseline_ms, 1) if baseline_ms else None,
         "hardware": "TPU v5e (1 chip)",
+        "secondary": secondary,
     }
     print(json.dumps(line))
 

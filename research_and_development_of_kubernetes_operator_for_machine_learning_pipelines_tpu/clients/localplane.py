@@ -1,0 +1,195 @@
+"""Local data-plane harness: real servers + native router, no cluster.
+
+One implementation shared by the e2e tests (tests/test_e2e_localplane.py)
+and the benchmark of record (bench.py) — both drive a full unscripted
+canary where the predictors are live aiohttp/JAX servers, traffic flows
+through the compiled ``native/router.cc`` split, and the gate reads the
+router's real histograms.  The pieces map to the reference's production
+loop (``mlflow_operator.py:56-361``):
+
+    reference            here
+    ------------------   ------------------------------------------
+    Seldon MLFLOW_SERVER server.app (JAX data plane)
+    Istio traffic split  native/router.cc smooth-WRR split
+    Seldon executor      router's seldon_api_executor_* histograms
+    kopf + API server    OperatorRuntime + FakeKube
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+from .base import SELDONDEPLOYMENT
+from .fakes import FakeKube
+from .router import RouterSync
+
+__all__ = [
+    "free_port",
+    "ModelServerHandle",
+    "start_model_server",
+    "SyncingKube",
+    "TrafficGenerator",
+]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ModelServerHandle:
+    """A live inference server on a daemon thread, stoppable."""
+
+    def __init__(self, server, loop, port: int, runner=None):
+        self.server = server
+        self.loop = loop
+        self.port = port
+        self.runner = runner
+
+    def stop(self) -> None:
+        # Run the aiohttp cleanup (closes the listening socket) before
+        # stopping the loop — a bare loop.stop() leaves the port bound,
+        # and a later client probing it would hang instead of failing.
+        async def _cleanup():
+            if self.runner is not None:
+                await self.runner.cleanup()
+            self.loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_cleanup(), self.loop)
+        self.server.shutdown()
+
+
+def start_model_server(
+    model_uri: str,
+    predictor: str,
+    port: int,
+    model_name: str = "iris",
+    deployment_name: str | None = None,
+    namespace: str = "models",
+    tpu=None,
+    ready_timeout_s: float = 180.0,
+) -> ModelServerHandle:
+    """Run a real inference server (aiohttp) on a daemon thread; raises
+    TimeoutError if it never becomes ready."""
+    from ..server.app import build_server
+    from ..utils.config import ServerConfig
+
+    cfg_kwargs = dict(
+        model_name=model_name,
+        model_uri=model_uri,
+        deployment_name=deployment_name or model_name,
+        predictor_name=predictor,
+        namespace=namespace,
+        port=port,
+    )
+    if tpu is not None:
+        cfg_kwargs["tpu"] = tpu
+    server = build_server(ServerConfig(**cfg_kwargs))
+    loop = asyncio.new_event_loop()
+    handle = ModelServerHandle(server, loop, port)
+
+    def run():
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(server.build_app())
+        handle.runner = runner
+        loop.run_until_complete(runner.setup())
+        loop.run_until_complete(web.TCPSite(runner, "127.0.0.1", port).start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    deadline = time.monotonic() + ready_timeout_s
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v2/health/ready", timeout=1
+            )
+            return handle
+        except Exception:
+            time.sleep(0.05)
+    raise TimeoutError(f"model server on :{port} never became ready")
+
+
+class SyncingKube(FakeKube):
+    """FakeKube that plays the Seldon-controller/Istio role: every applied
+    SeldonDeployment is pushed into its router as backends + weights.
+
+    ``syncs`` maps deployment name -> RouterSync; a single RouterSync may
+    be passed for the one-deployment case.
+    """
+
+    def __init__(self, syncs: "RouterSync | dict[str, RouterSync]"):
+        super().__init__()
+        self._syncs = syncs
+
+    def _sync_for(self, name: str) -> RouterSync | None:
+        if isinstance(self._syncs, dict):
+            return self._syncs.get(name)
+        return self._syncs
+
+    def _push(self, ref, obj) -> None:
+        if ref.plural == SELDONDEPLOYMENT["plural"]:
+            sync = self._sync_for(ref.name)
+            if sync is not None:
+                sync.sync_manifest(obj)
+
+    def create(self, ref, body):
+        obj = super().create(ref, body)
+        self._push(ref, obj)
+        return obj
+
+    def replace(self, ref, body):
+        obj = super().replace(ref, body)
+        self._push(ref, obj)
+        return obj
+
+
+class TrafficGenerator:
+    """Continuous client traffic through the router (the gate needs live
+    samples on both predictors; in production this is user traffic)."""
+
+    def __init__(self, router_port: int, model_name: str = "iris", body: bytes | None = None):
+        self.url = f"http://127.0.0.1:{router_port}/v2/models/{model_name}/infer"
+        self.body = body or json.dumps(
+            {
+                "inputs": [
+                    {
+                        "name": "x",
+                        "shape": [2, 4],
+                        "datatype": "FP32",
+                        "data": [5.1, 3.5, 1.4, 0.2, 6.7, 3.0, 5.2, 2.3],
+                    }
+                ]
+            }
+        ).encode()
+        self._stop = threading.Event()
+        self.sent = 0
+        self.errors = 0
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    self.url,
+                    data=self.body,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=2).read()
+            except Exception:
+                self.errors += 1  # 502s while a canary backend is dead, etc.
+            self.sent += 1
+            time.sleep(0.002)
+
+    def __enter__(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()

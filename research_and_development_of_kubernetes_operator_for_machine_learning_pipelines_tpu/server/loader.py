@@ -381,6 +381,53 @@ def _load_transformers(hf_dir: Path):
     return flavor, params, cfg, builder_kwargs
 
 
+# The llama leaves worth int8-ing at load time (mirrors
+# quantization._LLAMA_LAYER_MATS + lm_head, in the npz's flat key space).
+_LLAMA_STREAM_QUANT = tuple(
+    f"layers{_SEP}{m}" for m in ("q", "k", "v", "o", "gate", "up", "down")
+) + ("lm_head",)
+
+
+def _stream_native_params(npz_path: Path, quantize_leaves: tuple = ()) -> Any:
+    """Load ``params.npz`` leaf-by-leaf onto the device.
+
+    Leaves named in ``quantize_leaves`` are int8-quantized ON ARRIVAL and
+    their full-precision device copy freed before the next transfer.
+    That bounds peak HBM at (int8 tree + one full-precision leaf) —
+    without it a Llama-2-7B load with ``quantize: int8`` would need the
+    whole bf16 tree (~13.5 GiB) **plus** its int8 copy simultaneously,
+    which does not fit a 16 GiB v5e chip.
+
+    npz stores bfloat16 as raw void ``V2`` (numpy has no native bf16);
+    such arrays are viewed back through ml_dtypes before transfer.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.quantization import quantize_tensor
+
+    quant_jit = jax.jit(quantize_tensor)
+    leaves: dict[str, Any] = {}
+    with np.load(npz_path) as z:
+        for k in z.files:
+            arr = z[k]
+            if arr.dtype.kind == "V" and arr.dtype.itemsize == 2:
+                import ml_dtypes
+
+                arr = arr.view(ml_dtypes.bfloat16)
+            dev = jnp.asarray(arr)
+            del arr
+            if k in quantize_leaves:
+                q = quant_jit(dev)
+                q["q8"].block_until_ready()
+                dev.delete()  # free the full-precision copy NOW
+                leaves[f"{k}{_SEP}q8"] = q["q8"]
+                leaves[f"{k}{_SEP}scale"] = q["scale"]
+            else:
+                leaves[k] = dev
+    return _unflatten(leaves)
+
+
 def load_predictor(
     model_uri: str,
     flavor: str | None = None,
@@ -395,21 +442,32 @@ def load_predictor(
     if (path / "params.npz").exists():
         if not flavor:
             raise ModelLoadError(f"{path} has params.npz but no flavor recorded")
-        with np.load(path / "params.npz") as z:
-            params = _unflatten({k: z[k] for k in z.files})
-        import jax.numpy as jnp
-        import jax
-
-        params = jax.tree.map(jnp.asarray, params)
+        n_devices = 1
+        for v in (mesh_shape or {}).values():
+            n_devices *= int(v)
+        stream_quant = (
+            quantize in ("int8", "int8kv")
+            and flavor == "llama-generate"
+            and n_devices <= 1
+        )
+        params = _stream_native_params(
+            path / "params.npz",
+            quantize_leaves=_LLAMA_STREAM_QUANT if stream_quant else (),
+        )
         cfg = _build_config(flavor, meta.get("config", {}))
-        _log.info("loaded native %s model from %s", flavor, path)
+        _log.info(
+            "loaded native %s model from %s%s",
+            flavor,
+            path,
+            " (int8 quantized on arrival)" if stream_quant else "",
+        )
         return _finish_native(
             flavor,
             params,
             cfg,
             dict(meta.get("builder_kwargs", {})),
             mesh_shape,
-            quantize,
+            "none" if stream_quant else quantize,
         )
 
     hf_dir = _find_hf_checkpoint(path)

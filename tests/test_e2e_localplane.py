@@ -20,12 +20,8 @@ every external system replaced by the rebuild's first-party equivalent:
 
 from __future__ import annotations
 
-import asyncio
-import json
-import socket
 import threading
 import time
-import urllib.request
 
 import pytest
 
@@ -33,7 +29,6 @@ from research_and_development_of_kubernetes_operator_for_machine_learning_pipeli
     SELDONDEPLOYMENT,
 )
 from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.fakes import (
-    FakeKube,
     FakeRegistry,
 )
 from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
@@ -47,120 +42,18 @@ from research_and_development_of_kubernetes_operator_for_machine_learning_pipeli
 from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.clock import (
     SystemClock,
 )
-from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.config import (
-    ServerConfig,
-)
 
 CR = dict(
     group="mlflow.nizepart.com", version="v1alpha1", plural="mlflowmodels"
 )
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def start_model_server(model_uri: str, predictor: str, port: int) -> None:
-    """Run a real inference server (aiohttp) on a daemon thread."""
-    from tpumlops.server.app import build_server
-
-    cfg = ServerConfig(
-        model_name="iris",
-        model_uri=model_uri,
-        deployment_name="iris",
-        predictor_name=predictor,
-        namespace="models",
-        port=port,
-    )
-    server = build_server(cfg)
-
-    def run():
-        loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(loop)
-        from aiohttp import web
-
-        runner = web.AppRunner(server.build_app())
-        loop.run_until_complete(runner.setup())
-        loop.run_until_complete(web.TCPSite(runner, "127.0.0.1", port).start())
-        loop.run_forever()
-
-    threading.Thread(target=run, daemon=True).start()
-    deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
-        try:
-            urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/v2/health/ready", timeout=1
-            )
-            return
-        except Exception:
-            time.sleep(0.05)
-    raise TimeoutError(f"model server on :{port} never became ready")
-
-
-class SyncingKube(FakeKube):
-    """FakeKube that plays the Seldon-controller/Istio role: every applied
-    SeldonDeployment is pushed into the router as backends + weights."""
-
-    def __init__(self, sync: RouterSync):
-        super().__init__()
-        self._sync = sync
-
-    def create(self, ref, body):
-        obj = super().create(ref, body)
-        if ref.plural == SELDONDEPLOYMENT["plural"]:
-            self._sync.sync_manifest(obj)
-        return obj
-
-    def replace(self, ref, body):
-        obj = super().replace(ref, body)
-        if ref.plural == SELDONDEPLOYMENT["plural"]:
-            self._sync.sync_manifest(obj)
-        return obj
-
-
-class TrafficGenerator:
-    """Continuous client traffic through the router (the gate needs live
-    samples on both predictors; in production this is user traffic)."""
-
-    def __init__(self, router_port: int):
-        self.url = f"http://127.0.0.1:{router_port}/v2/models/iris/infer"
-        self.body = json.dumps(
-            {
-                "inputs": [
-                    {
-                        "name": "x",
-                        "shape": [2, 4],
-                        "datatype": "FP32",
-                        "data": [5.1, 3.5, 1.4, 0.2, 6.7, 3.0, 5.2, 2.3],
-                    }
-                ]
-            }
-        ).encode()
-        self._stop = threading.Event()
-        self.sent = 0
-        self.errors = 0
-
-    def _loop(self):
-        while not self._stop.is_set():
-            try:
-                req = urllib.request.Request(
-                    self.url, data=self.body,
-                    headers={"Content-Type": "application/json"},
-                )
-                urllib.request.urlopen(req, timeout=2).read()
-            except Exception:
-                self.errors += 1  # 502s while a canary backend is dead, etc.
-            self.sent += 1
-            time.sleep(0.002)
-
-    def __enter__(self):
-        threading.Thread(target=self._loop, daemon=True).start()
-        return self
-
-    def __exit__(self, *exc):
-        self._stop.set()
+from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.localplane import (
+    SyncingKube,
+    TrafficGenerator,
+    free_port,
+    start_model_server,
+)
 
 
 @pytest.fixture(scope="module")
@@ -363,3 +256,265 @@ def test_rollback_on_slo_breach_with_live_metrics(servers):
     finally:
         rt.stop()
         router.stop()
+
+
+def test_operator_restart_mid_rollout_resumes_from_status(servers):
+    """Kill the operator halfway through a canary and start a FRESH
+    runtime (new Reconciler objects, no in-memory state) over the same
+    cluster: promotion must resume from CR status at the same split and
+    complete -- the §3.5(2) fix proven against the real data plane, not
+    FakeMetrics."""
+    router, kube, registry, rt = make_world(servers)
+    rt2 = None
+    try:
+        kube.create(cr_ref(), {"spec": base_spec()})
+        threading.Thread(target=rt.serve, daemon=True).start()
+        wait_for(
+            lambda: get_status(kube).get("phase") == "Stable",
+            what="initial Stable phase",
+        )
+
+        with TrafficGenerator(router.port) as gen:
+            wait_for(lambda: gen.sent > 50, what="baseline traffic")
+            registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+            registry.set_alias("iris", "prod", "2")
+
+            # Let the canary reach a mid split (>= 50%), then kill the
+            # operator dead.
+            wait_for(
+                lambda: get_status(kube).get("phase") == "Canary"
+                and int(get_status(kube).get("trafficCurrent") or 0) >= 50,
+                timeout=120.0,
+                what="mid-rollout split",
+            )
+            rt.stop()
+            frozen = get_status(kube)
+            split_at_restart = int(frozen["trafficCurrent"])
+
+            # Fresh runtime: everything it knows must come from CR status.
+            from tpumlops.clients.router import RouterMetricsSource
+
+            rt2 = OperatorRuntime(
+                kube,
+                registry,
+                metrics=RouterMetricsSource(router.admin),
+                clock=SystemClock(),
+                sync_interval_s=0.05,
+            )
+            # Continuously sample the split: a runtime that restarts the
+            # canary from initialTraffic instead of resuming from status
+            # would be caught mid-flight here.
+            samples: list[int] = []
+            sampling = threading.Event()
+
+            def sample():
+                while not sampling.is_set():
+                    s = get_status(kube)
+                    if s.get("phase") in ("Canary", "Stable"):
+                        samples.append(int(s.get("trafficCurrent") or 0))
+                    time.sleep(0.01)
+
+            threading.Thread(target=sample, daemon=True).start()
+            threading.Thread(target=rt2.serve, daemon=True).start()
+
+            wait_for(
+                lambda: get_status(kube).get("phase") == "Stable"
+                and get_status(kube).get("currentModelVersion") == "2",
+                timeout=120.0,
+                what="promotion completion after operator restart",
+            )
+            sampling.set()
+
+        # Resumed, not restarted: no sampled split ever dropped below the
+        # pre-restart split.
+        assert samples, "sampler never observed the rollout"
+        assert min(samples) >= split_at_restart, (min(samples), split_at_restart)
+        assert int(get_status(kube)["trafficCurrent"]) == 100
+        assert router.admin.get_weights() == {"v2": 100}
+    finally:
+        rt.stop()
+        if rt2 is not None:
+            rt2.stop()
+        router.stop()
+
+
+def test_router_crash_and_declarative_restore_mid_rollout(servers):
+    """Crash the router mid-canary. Its in-memory split dies with it; the
+    controller stand-in (SyncingKube/RouterSync -- Seldon's controller +
+    Istio in-cluster) restores the split from the last applied manifest
+    when the router comes back, and the promotion resumes on fresh
+    metrics and completes."""
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.base import (
+        ObjectRef,
+    )
+
+    router, kube, registry, rt = make_world(servers)
+    try:
+        kube.create(cr_ref(), {"spec": base_spec()})
+        threading.Thread(target=rt.serve, daemon=True).start()
+        wait_for(
+            lambda: get_status(kube).get("phase") == "Stable",
+            what="initial Stable phase",
+        )
+
+        with TrafficGenerator(router.port) as gen:
+            wait_for(lambda: gen.sent > 50, what="baseline traffic")
+            registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+            registry.set_alias("iris", "prod", "2")
+            wait_for(
+                lambda: get_status(kube).get("phase") == "Canary"
+                and int(get_status(kube).get("trafficCurrent") or 0) >= 50,
+                timeout=120.0,
+                what="mid-rollout split",
+            )
+
+            # Hard-kill the router process (pod crash).
+            assert router.proc is not None
+            router.proc.kill()
+            router.proc.wait()
+            time.sleep(0.3)  # requests 502 into the void; metrics blackout
+
+            # Pod restarts on the same service address; the controller
+            # re-pushes the declarative split from the applied manifest.
+            router.proc = None
+            router.start()
+            sd = kube.get(
+                ObjectRef(
+                    namespace="models", name="iris", **SELDONDEPLOYMENT
+                )
+            )
+            from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
+                RouterSync,
+            )
+
+            # same resolve mapping the world was built with
+            RouterSync(router.admin, kube._syncs.resolve).sync_manifest(sd)
+            restored = router.admin.get_weights()
+            assert restored == {
+                p["name"]: p["traffic"] for p in sd["spec"]["predictors"]
+            }, restored
+
+            wait_for(
+                lambda: get_status(kube).get("phase") == "Stable"
+                and get_status(kube).get("currentModelVersion") == "2",
+                timeout=120.0,
+                what="promotion completion after router restart",
+            )
+        assert router.admin.get_weights() == {"v2": 100}
+        reasons = kube.event_reasons()
+        assert "PromotionComplete" in reasons
+    finally:
+        rt.stop()
+        router.stop()
+
+
+def test_two_concurrent_crs_share_the_real_plane(servers, iris_models):
+    """Two MlflowModels roll out concurrently, each through its own real
+    router + live metrics; one runtime interleaves both reconcilers and
+    both reach Stable at v2 without cross-talk."""
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.base import (
+        ObjectRef,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
+        RouterMetricsSource,
+        RouterSync,
+    )
+
+    # Second model: its own two servers serving model name "irisb".
+    ports_b = {}
+    handles_b = []
+    for version, uri in iris_models.items():
+        port = free_port()
+        handles_b.append(
+            start_model_server(
+                uri, f"v{version}", port, model_name="irisb", deployment_name="irisb"
+            )
+        )
+        ports_b[f"v{version}"] = port
+
+    routers = {
+        "iris": RouterProcess(
+            port=free_port(), backends={}, namespace="models", deployment="iris"
+        ).start(),
+        "irisb": RouterProcess(
+            port=free_port(), backends={}, namespace="models", deployment="irisb"
+        ).start(),
+    }
+    port_map = {"iris": dict(servers), "irisb": ports_b}
+    syncs = {
+        name: RouterSync(
+            routers[name].admin,
+            lambda pred, name=name: ("127.0.0.1", port_map[name][pred]),
+        )
+        for name in routers
+    }
+
+    class MultiRouterMetrics:
+        def __init__(self):
+            self._sources = {
+                name: RouterMetricsSource(routers[name].admin) for name in routers
+            }
+
+        def model_metrics(self, deployment_name, predictor_name, namespace, window_s=60):
+            return self._sources[deployment_name].model_metrics(
+                deployment_name, predictor_name, namespace, window_s
+            )
+
+    kube = SyncingKube(syncs)
+    registry = FakeRegistry()
+    for model in ("iris", "irisb"):
+        registry.register(model, "1", f"mlflow-artifacts:/1/{model}a/artifacts/model")
+        registry.set_alias(model, "prod", "1")
+    rt = OperatorRuntime(
+        kube,
+        registry,
+        metrics=MultiRouterMetrics(),
+        clock=SystemClock(),
+        sync_interval_s=0.05,
+    )
+
+    def ref_for(name):
+        return ObjectRef(namespace="models", name=name, **CR)
+
+    def status_of(name):
+        return kube.get(ref_for(name)).get("status") or {}
+
+    gens = []
+    try:
+        for model in ("iris", "irisb"):
+            spec = base_spec(modelName=model)
+            kube.create(ref_for(model), {"spec": spec})
+        threading.Thread(target=rt.serve, daemon=True).start()
+        for model in ("iris", "irisb"):
+            wait_for(
+                lambda m=model: status_of(m).get("phase") == "Stable",
+                what=f"initial Stable for {model}",
+            )
+
+        for model in ("iris", "irisb"):
+            gen = TrafficGenerator(routers[model].port, model_name=model)
+            gen.__enter__()
+            gens.append(gen)
+        wait_for(lambda: all(g.sent > 50 for g in gens), what="traffic on both")
+
+        for model in ("iris", "irisb"):
+            registry.register(model, "2", f"mlflow-artifacts:/1/{model}b/artifacts/model")
+            registry.set_alias(model, "prod", "2")
+
+        for model in ("iris", "irisb"):
+            wait_for(
+                lambda m=model: status_of(m).get("phase") == "Stable"
+                and status_of(m).get("currentModelVersion") == "2",
+                timeout=180.0,
+                what=f"promotion of {model}",
+            )
+        assert routers["iris"].admin.get_weights() == {"v2": 100}
+        assert routers["irisb"].admin.get_weights() == {"v2": 100}
+    finally:
+        for g in gens:
+            g.__exit__()
+        rt.stop()
+        for r in routers.values():
+            r.stop()
+        for h in handles_b:
+            h.stop()

@@ -88,6 +88,11 @@ def test_loader_quantize_plumbing(tmp_path):
     )
     pred = load_predictor(str(art), quantize="int8")
     assert is_quantized(pred.causal_lm["params"]["lm_head"])
+    # Every layer matmul too (regression: the streaming loader's leaf
+    # name list must use the npz flat-key separator, or layers silently
+    # stay full-precision while lm_head matches by accident).
+    for name in ("q", "k", "v", "o", "gate", "up", "down"):
+        assert is_quantized(pred.causal_lm["params"]["layers"][name]), name
     out = pred.predict(np.ones((1, 4), np.int32))
     assert np.asarray(out).shape[0] == 1
 
