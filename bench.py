@@ -291,16 +291,15 @@ def bench_time_to_100() -> dict:
     import tempfile
     import threading
 
-    from sklearn.datasets import load_iris
-    from sklearn.linear_model import LogisticRegression
-
     from tpumlops.clients.base import ObjectRef
     from tpumlops.clients.fakes import FakeRegistry
     from tpumlops.clients.localplane import (
         SyncingKube,
         TrafficGenerator,
         free_port,
+        relaxed_gate_spec,
         start_model_server,
+        train_iris_pair,
     )
     from tpumlops.clients.router import (
         RouterMetricsSource,
@@ -308,24 +307,17 @@ def bench_time_to_100() -> dict:
         RouterSync,
     )
     from tpumlops.operator.runtime import OperatorRuntime
-    from tpumlops.server.loader import save_sklearn_model
     from tpumlops.utils.clock import SystemClock
 
     STEP_INTERVAL = 0.5
     root = tempfile.mkdtemp()
-    X, y = load_iris(return_X_y=True)
     handles = []
     ports = {}
     router = None
     rt = None
     gens = []
     try:
-        for tag, model in {
-            "1": LogisticRegression(max_iter=200).fit(X, y),
-            "2": LogisticRegression(max_iter=500, C=0.5).fit(X, y),
-        }.items():
-            uri = f"{root}/v{tag}"
-            save_sklearn_model(uri, model, "sklearn-linear")
+        for tag, uri in train_iris_pair(root).items():
             port = free_port()
             handles.append(
                 start_model_server(uri, f"v{tag}", port, namespace="bench")
@@ -354,34 +346,16 @@ def bench_time_to_100() -> dict:
             version="v1alpha1",
             plural="mlflowmodels",
         )
+        # Reference POLICY shape: 10% steps from a 90/10 start.
+        spec = relaxed_gate_spec(
+            step=10,
+            stepInterval=STEP_INTERVAL,
+            maxAttempts=200,
+            initialTraffic=10,
+        )
         kube.create(
             CRREF,
-            {
-                "metadata": {"name": "iris", "namespace": "bench"},
-                "spec": {
-                    "modelName": "iris",
-                    "modelAlias": "prod",
-                    "monitoringInterval": 0.2,
-                    # Generous tolerances: identical models on a loaded
-                    # box; the gate judges real jitter.  Reference POLICY
-                    # shape: 10% steps from a 90/10 start.
-                    "thresholds": {
-                        "latencyP95": 5.0,
-                        "latencyAvg": 5.0,
-                        "errorRate": 1.0,
-                        "errorRateFloor": 0.5,
-                        "minSampleCount": 3,
-                    },
-                    "canary": {
-                        "step": 10,
-                        "stepInterval": STEP_INTERVAL,
-                        "attemptDelay": 0.15,
-                        "maxAttempts": 200,
-                        "initialTraffic": 10,
-                        "metricsWindow": 2,
-                    },
-                },
-            },
+            {"metadata": {"name": "iris", "namespace": "bench"}, "spec": spec},
         )
 
         threading.Thread(target=rt.serve, daemon=True).start()
@@ -456,8 +430,9 @@ def bench_iris() -> dict:
 
 
 def bench_xgboost() -> dict:
-    """Synthetic 200-tree depth-6 regression forest via the JSON path —
-    the TPU-native gather evaluator (models/tabular.py)."""
+    """Synthetic 200-tree depth-6 regression forest via the JSON path,
+    lowered by tabular.lower_forest — normally the GEMM (matmul) form,
+    ~11x the gather traversal on v5e; eval_form reports which ran."""
     jax = _setup_jax()
     import numpy as np
 
@@ -499,9 +474,15 @@ def bench_xgboost() -> dict:
         }
     }
     arrs, _obj = tabular.from_xgboost_json(model)
+    fn, form = tabular.lower_forest(arrs)
     x = jax.numpy.asarray(rng.normal(size=(256, n_feat)), jax.numpy.float32)
-    p = _timed(jax.jit(lambda x: tabular.eval_forest(arrs, x)), x)
-    return {"p50_us": round(p[50] * 1e6, 1), "trees": n_trees, "batch": 256}
+    p = _timed(jax.jit(fn), x)
+    return {
+        "p50_us": round(p[50] * 1e6, 1),
+        "trees": n_trees,
+        "batch": 256,
+        "eval_form": form,
+    }
 
 
 def bench_resnet() -> dict:

@@ -34,6 +34,8 @@ __all__ = [
     "start_model_server",
     "SyncingKube",
     "TrafficGenerator",
+    "train_iris_pair",
+    "relaxed_gate_spec",
 ]
 
 
@@ -93,20 +95,32 @@ def start_model_server(
     server = build_server(ServerConfig(**cfg_kwargs))
     loop = asyncio.new_event_loop()
     handle = ModelServerHandle(server, loop, port)
+    boot_error: list[BaseException] = []
 
     def run():
         asyncio.set_event_loop(loop)
         from aiohttp import web
 
-        runner = web.AppRunner(server.build_app())
-        handle.runner = runner
-        loop.run_until_complete(runner.setup())
-        loop.run_until_complete(web.TCPSite(runner, "127.0.0.1", port).start())
+        try:
+            runner = web.AppRunner(server.build_app())
+            handle.runner = runner
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start()
+            )
+        except BaseException as e:  # surface to the waiting caller
+            boot_error.append(e)
+            return
         loop.run_forever()
 
     threading.Thread(target=run, daemon=True).start()
     deadline = time.monotonic() + ready_timeout_s
     while time.monotonic() < deadline:
+        if boot_error:
+            handle.stop()
+            raise RuntimeError(
+                f"model server on :{port} failed to start"
+            ) from boot_error[0]
         try:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/v2/health/ready", timeout=1
@@ -114,6 +128,7 @@ def start_model_server(
             return handle
         except Exception:
             time.sleep(0.05)
+    handle.stop()
     raise TimeoutError(f"model server on :{port} never became ready")
 
 
@@ -193,3 +208,58 @@ class TrafficGenerator:
 
     def __exit__(self, *exc):
         self._stop.set()
+
+
+def train_iris_pair(root) -> dict[str, str]:
+    """Two distinguishable sklearn iris models saved as v1/v2 artifacts —
+    the canary pair used by both the e2e tests and the benchmark."""
+    from pathlib import Path
+
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+
+    from ..server.loader import save_sklearn_model
+
+    root = Path(root)
+    X, y = load_iris(return_X_y=True)
+    uris = {}
+    for tag, model in {
+        "1": LogisticRegression(max_iter=200).fit(X, y),
+        "2": LogisticRegression(max_iter=500, C=0.5).fit(X, y),
+    }.items():
+        path = str(root / f"v{tag}")
+        save_sklearn_model(path, model, "sklearn-linear")
+        uris[tag] = path
+    return uris
+
+
+def relaxed_gate_spec(**canary_overrides) -> dict:
+    """CR spec skeleton for local-plane canaries on live metrics.
+
+    Generous latency tolerances: both versions are identical sklearn
+    models on a loaded box — the gate must judge real jittery numbers
+    without flaking; the error floor absorbs transient 502s at
+    weight-switch instants.  Canary pacing fields come from the caller.
+    """
+    spec = {
+        "modelName": "iris",
+        "modelAlias": "prod",
+        "monitoringInterval": 0.2,
+        "thresholds": {
+            "latencyP95": 5.0,
+            "latencyAvg": 5.0,
+            "errorRate": 1.0,
+            "errorRateFloor": 0.5,
+            "minSampleCount": 3,
+        },
+        "canary": {
+            "step": 25,
+            "stepInterval": 0.2,
+            "attemptDelay": 0.15,
+            "maxAttempts": 60,
+            "initialTraffic": 25,
+            "metricsWindow": 2,
+        },
+    }
+    spec["canary"].update(canary_overrides)
+    return spec

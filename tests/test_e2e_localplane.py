@@ -58,22 +58,11 @@ from research_and_development_of_kubernetes_operator_for_machine_learning_pipeli
 
 @pytest.fixture(scope="module")
 def iris_models(tmp_path_factory):
-    from sklearn.datasets import load_iris
-    from sklearn.linear_model import LogisticRegression
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.localplane import (
+        train_iris_pair,
+    )
 
-    from tpumlops.server.loader import save_sklearn_model
-
-    root = tmp_path_factory.mktemp("iris")
-    X, y = load_iris(return_X_y=True)
-    uris = {}
-    for tag, model in {
-        "1": LogisticRegression(max_iter=200).fit(X, y),
-        "2": LogisticRegression(max_iter=500, C=0.5).fit(X, y),
-    }.items():
-        path = str(root / f"v{tag}")
-        save_sklearn_model(path, model, "sklearn-linear")
-        uris[tag] = path
-    return uris
+    return train_iris_pair(tmp_path_factory.mktemp("iris"))
 
 
 @pytest.fixture(scope="module")
@@ -114,30 +103,11 @@ def make_world(servers, extra_ports=None):
 
 
 def base_spec(**overrides):
-    spec = {
-        "modelName": "iris",
-        "modelAlias": "prod",
-        "monitoringInterval": 0.2,
-        # Generous latency tolerances: both versions are identical sklearn
-        # models on a loaded CI box — the gate must judge real jittery
-        # numbers without flaking.  error floor absorbs transient 502s at
-        # weight-switch instants.
-        "thresholds": {
-            "latencyP95": 5.0,
-            "latencyAvg": 5.0,
-            "errorRate": 1.0,
-            "errorRateFloor": 0.5,
-            "minSampleCount": 3,
-        },
-        "canary": {
-            "step": 25,
-            "stepInterval": 0.2,
-            "attemptDelay": 0.15,
-            "maxAttempts": 60,
-            "initialTraffic": 25,
-            "metricsWindow": 2,
-        },
-    }
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.localplane import (
+        relaxed_gate_spec,
+    )
+
+    spec = relaxed_gate_spec()
     spec.update(overrides)
     return spec
 

@@ -338,3 +338,64 @@ def test_xgboost_rejects_vector_leaf_trees():
     trees[0]["tree_param"]["size_leaf_vector"] = "3"
     with pytest.raises(NotImplementedError, match="vector-leaf"):
         tabular.from_xgboost_json(model)
+
+
+# ---------------------------------------------------------------------------
+# GEMM lowering (matmul-form forest; the TPU fast path)
+# ---------------------------------------------------------------------------
+
+
+def test_gemm_forest_exact_parity_with_gather():
+    """The matmul form must reproduce the gather traversal bit-for-bit
+    semantics: strict-< boundaries (nextafter thresholds), NaN routing
+    (NaN <= thr is False -> right branch), base_score."""
+    model, trees_json = _two_tree_model(base_score="0.75")
+    trees, _ = tabular.from_xgboost_json(model)
+    gf = tabular.to_gemm(trees)
+    assert gf is not None
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(64, 3)).astype(np.float32) * 2
+    X[0] = [1.5, 0.25, -0.5]          # exact split values -> strict < goes right
+    X[1, 0] = np.nan                   # NaN -> right branch everywhere
+    ref = np.asarray(jax.jit(lambda x: tabular.eval_forest(trees, x))(jnp.asarray(X)))
+    got = np.asarray(jax.jit(lambda x: tabular.eval_forest_gemm(gf, x))(jnp.asarray(X)))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_gemm_forest_multiclass_parity():
+    model, _, _ = _multiclass_model(n_class=3, rounds=5)
+    trees, objective = tabular.from_xgboost_json(model)
+    gf = tabular.to_gemm(trees)
+    assert gf is not None and gf.n_groups == 3
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(16, 4)).astype(np.float32)
+    ref = np.asarray(jax.jit(lambda x: tabular.eval_forest(trees, x))(jnp.asarray(X)))
+    got = np.asarray(jax.jit(lambda x: tabular.eval_forest_gemm(gf, x))(jnp.asarray(X)))
+    assert got.shape == (16, 3)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_budget_falls_back_to_gather(monkeypatch):
+    model, trees_json = _two_tree_model()
+    trees, _ = tabular.from_xgboost_json(model)
+    monkeypatch.setattr(tabular, "_GEMM_BUDGET_ELEMS", 1)
+    assert tabular.to_gemm(trees) is None
+    fn, form = tabular.lower_forest(trees)
+    assert form == "gather"
+    X = np.zeros((2, 3), np.float32)
+    ref = np.asarray(tabular.eval_forest(trees, jnp.asarray(X)))
+    np.testing.assert_allclose(np.asarray(fn(jnp.asarray(X))), ref)
+
+
+def test_sklearn_forest_uses_gemm_form():
+    from sklearn.datasets import load_iris
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    X, y = load_iris(return_X_y=True)
+    model = GradientBoostingRegressor(n_estimators=20, max_depth=3).fit(
+        X, y.astype(float)
+    )
+    pred = registry.get_builder("sklearn-forest")(model)
+    assert pred.metadata["eval_form"] == "gemm"
+    got = np.asarray(jax.jit(pred.predict)(jnp.asarray(X[:8], jnp.float32)))
+    np.testing.assert_allclose(got, model.predict(X[:8]), rtol=1e-4, atol=1e-4)
