@@ -110,6 +110,8 @@ def start_model_server(
             )
         except BaseException as e:  # surface to the waiting caller
             boot_error.append(e)
+            # The loop never serves; nothing can clean it up later.
+            loop.close()
             return
         loop.run_forever()
 
@@ -117,7 +119,7 @@ def start_model_server(
     deadline = time.monotonic() + ready_timeout_s
     while time.monotonic() < deadline:
         if boot_error:
-            handle.stop()
+            server.shutdown()  # loop is closed; only the engine needs stopping
             raise RuntimeError(
                 f"model server on :{port} failed to start"
             ) from boot_error[0]

@@ -103,10 +103,11 @@ def _build_xgboost(model: Any, **_kw) -> Predictor:
     """``model`` is a parsed xgboost JSON dict (or a live Booster).
 
     Fully TPU-native (baseline config 1): the forest is lowered to the
-    MXU matmul form when it fits the budget (tabular.GemmForest; ~11x the
-    gather traversal on v5e), else to the flattened gather program shared
-    with sklearn forests; the objective picks the output transform (sigmoid for ``binary:*``, softmax/argmax over per-class
-    margins for ``multi:*``, identity for regression).  Matches xgboost's
+    MXU matmul form when it fits the budget (tabular.GemmForest; ~11x
+    the gather traversal on v5e), else to the flattened gather program
+    shared with sklearn forests.  The objective picks the output
+    transform: sigmoid for ``binary:*``, softmax/argmax over per-class
+    margins for ``multi:*``, identity for regression.  Matches xgboost's
     ``predict`` output shapes: probabilities [B, K] for softprob, class
     ids [B] for softmax.
     """

@@ -49,7 +49,7 @@ def main(argv: list[str] | None = None) -> None:
     from ..clients.kube_rest import KubeRestClient
     from ..clients.mlflow_rest import MlflowRestClient
     from ..clients.prom_http import PrometheusSource
-    from .runtime import CrWatcher, OperatorRuntime
+    from .runtime import CrWatcher, DeploymentWatcher, OperatorRuntime
     from .telemetry import OperatorTelemetry
 
     if args.sync_interval is None:
@@ -77,12 +77,20 @@ def main(argv: list[str] | None = None) -> None:
         sync_interval_s=args.sync_interval,
         telemetry=telemetry,
     )
-    watcher = None if args.no_watch else CrWatcher(runtime).start()
+    watchers = (
+        []
+        if args.no_watch
+        else [CrWatcher(runtime).start(), DeploymentWatcher(runtime).start()]
+    )
     try:
         runtime.serve()
     finally:
-        if watcher is not None:
-            watcher.stop()
+        # Signal both before joining either: each stop() may wait out a
+        # 15s blocked watch read, and those waits must overlap.
+        for w in watchers:
+            w._stop.set()
+        for w in watchers:
+            w.stop()
 
 
 if __name__ == "__main__":

@@ -323,15 +323,28 @@ class CrWatcher:
 
     def start(self) -> "CrWatcher":
         self._thread = threading.Thread(
-            target=self.run, daemon=True, name="cr-watcher"
+            target=self.run, daemon=True, name=type(self).__name__
         )
         self._thread.start()
         return self
 
+    def _ref(self):
+        """The collection this watcher streams (MlflowModels)."""
+        return self.runtime._list_ref()
+
+    def _handle(self, ev) -> None:
+        meta = ev.object.get("metadata") or {}
+        self.runtime.notify(
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+            obj=dict(ev.object),
+            event_type=ev.type,
+        )
+
     def run(self) -> None:
         from ..clients.base import WatchExpired
 
-        ref = self.runtime._list_ref()
+        ref = self._ref()
         rv: str | None = None
         failures = 0
         while not self._stop.is_set():
@@ -354,12 +367,7 @@ class CrWatcher:
                         rv = meta["resourceVersion"]
                     if ev.type == "BOOKMARK":
                         continue
-                    self.runtime.notify(
-                        meta.get("namespace", "default"),
-                        meta.get("name", ""),
-                        obj=dict(ev.object),
-                        event_type=ev.type,
-                    )
+                    self._handle(ev)
                 # Server closed the stream (watch timeout): reconnect from
                 # the current cursor without re-listing.
             except WatchExpired:
@@ -378,3 +386,31 @@ class CrWatcher:
             # A real watch blocked in a read only observes stop after the
             # client's 15s read timeout — join must outlast it.
             self._thread.join(timeout=20)
+
+
+class DeploymentWatcher(CrWatcher):
+    """Watch SeldonDeployments and heal out-of-band deletions immediately.
+
+    Only DELETED events react: the operator's own applies echo back as
+    ADDED/MODIFIED and must not reset reconcile pacing, and any foreign
+    edit is overwritten by the next apply anyway.  A deleted deployment
+    whose (namespace, name) matches a tracked CR pulls that CR due NOW,
+    so ``Reconciler._ensure_deployment`` recreates it in milliseconds
+    instead of after the resync poll.
+    """
+
+    def _ref(self):
+        return ObjectRef(
+            namespace=self.runtime.namespace, name="", **SELDONDEPLOYMENT
+        )
+
+    def _handle(self, ev) -> None:
+        if ev.type != "DELETED":
+            return
+        meta = ev.object.get("metadata") or {}
+        self.runtime.notify(
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+            obj=None,
+            event_type="DELETED",
+        )

@@ -353,3 +353,69 @@ def test_watcher_recovers_from_expired_cursor():
         watcher.stop()
         rt.stop()
         thread.join(timeout=5)
+
+
+def test_deployment_watch_heals_out_of_band_deletion(live_runtime):
+    """An out-of-band SeldonDeployment deletion must be recreated in
+    milliseconds via the deployment watch, not after the resync poll."""
+    from tpumlops.operator.runtime import DeploymentWatcher
+
+    kube, registry, metrics, rt = live_runtime
+    dw = DeploymentWatcher(rt).start()
+    try:
+        registry.register("heal", "1", "mlflow-artifacts:/1/a/artifacts/model")
+        registry.set_alias("heal", "champion", "1")
+        _make_cr(kube, "heal")
+        sd_ref = ObjectRef(namespace="models", name="heal", **SELDONDEPLOYMENT)
+        _wait_for(lambda: _exists(kube, sd_ref), what="initial deploy")
+
+        t0 = time.monotonic()
+        kube.delete(sd_ref)
+        t_heal = _wait_for(
+            lambda: _exists(kube, sd_ref), timeout=5, what="self-heal"
+        )
+        assert t_heal - t0 < 5.0  # << sync_interval_s=60
+    finally:
+        dw.stop()
+
+
+def test_deployment_watch_ignores_own_applies(live_runtime):
+    """The operator's own SD creates/replaces echo as ADDED/MODIFIED on
+    the deployment watch; only DELETED may reschedule — canary pacing
+    must hold with the deployment watcher running."""
+    from tpumlops.operator.runtime import DeploymentWatcher
+
+    kube, registry, metrics, rt = live_runtime
+    dw = DeploymentWatcher(rt).start()
+    try:
+        registry.register("pace2", "1", "mlflow-artifacts:/1/a/artifacts/model")
+        registry.set_alias("pace2", "champion", "1")
+        _make_cr(kube, "pace2")
+        cr_ref = ObjectRef(namespace="models", name="pace2", **MLFLOWMODEL)
+        sd_ref = ObjectRef(namespace="models", name="pace2", **SELDONDEPLOYMENT)
+        _wait_for(lambda: _exists(kube, sd_ref), what="initial deploy")
+
+        registry.register("pace2", "2", "mlflow-artifacts:/1/b/artifacts/model")
+        registry.set_alias("pace2", "champion", "2")
+        metrics.set_metrics("pace2", "v1", "models", GOOD)
+        metrics.set_metrics("pace2", "v2", "models", GOOD)
+        obj = kube.get(cr_ref)
+        obj["spec"]["monitoringInterval"] = 61
+        kube.replace(cr_ref, obj)
+
+        def canary_started():
+            try:
+                return any(
+                    p["name"] == "v2"
+                    for p in kube.get(sd_ref)["spec"]["predictors"]
+                )
+            except NotFound:
+                return False
+
+        _wait_for(canary_started, what="canary start")
+        time.sleep(1.0)
+        status = kube.get(cr_ref).get("status") or {}
+        assert status.get("phase") == "Canary", status
+        assert int(status.get("trafficCurrent", 0)) <= 20, status
+    finally:
+        dw.stop()
