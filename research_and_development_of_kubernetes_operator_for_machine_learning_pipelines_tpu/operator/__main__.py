@@ -30,6 +30,18 @@ def main(argv: list[str] | None = None) -> None:
         action="store_true",
         help="disable the event-driven watch and rely on polling alone",
     )
+    ap.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="acquire a coordination.k8s.io Lease before reconciling, so "
+        "replicas > 1 run active/standby instead of double-reconciling "
+        "(the reference pins replicas: 1 and has no election)",
+    )
+    ap.add_argument(
+        "--leader-elect-namespace",
+        default="tpumlops-system",
+        help="namespace of the election Lease",
+    )
     ap.add_argument("--kube-url", default=None, help="API server URL (default in-cluster)")
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(
@@ -49,6 +61,7 @@ def main(argv: list[str] | None = None) -> None:
     from ..clients.kube_rest import KubeRestClient
     from ..clients.mlflow_rest import MlflowRestClient
     from ..clients.prom_http import PrometheusSource
+    from .leader import LeaderElector
     from .runtime import CrWatcher, DeploymentWatcher, OperatorRuntime
     from .telemetry import OperatorTelemetry
 
@@ -68,29 +81,92 @@ def main(argv: list[str] | None = None) -> None:
             sources[url] = PrometheusSource(url)
         return sources[url]
 
-    runtime = OperatorRuntime(
-        kube=kube,
-        registry=registry,
-        metrics_factory=metrics_factory,
-        warmup=DataPlaneWarmup(),
-        namespace=args.namespace,
-        sync_interval_s=args.sync_interval,
-        telemetry=telemetry,
-    )
-    watchers = (
-        []
-        if args.no_watch
-        else [CrWatcher(runtime).start(), DeploymentWatcher(runtime).start()]
-    )
-    try:
-        runtime.serve()
-    finally:
-        # Signal both before joining either: each stop() may wait out a
-        # 15s blocked watch read, and those waits must overlap.
-        for w in watchers:
-            w._stop.set()
-        for w in watchers:
-            w.stop()
+    import signal
+    import threading
+
+    class _Session:
+        """One reconciling session: a fresh runtime + watchers.
+
+        Fresh per leadership round on purpose: ``OperatorRuntime.stop``
+        is terminal (its stop event is never cleared), and all durable
+        state lives in CR status anyway — a regained leadership resumes
+        exactly like an operator restart would.
+        """
+
+        def __init__(self):
+            self.runtime = OperatorRuntime(
+                kube=kube,
+                registry=registry,
+                metrics_factory=metrics_factory,
+                warmup=DataPlaneWarmup(),
+                namespace=args.namespace,
+                sync_interval_s=args.sync_interval,
+                telemetry=telemetry,
+            )
+            # Watchers start HERE, synchronously, so teardown can never
+            # race a half-started serve thread into orphaning them.
+            self.watchers = (
+                []
+                if args.no_watch
+                else [
+                    CrWatcher(self.runtime).start(),
+                    DeploymentWatcher(self.runtime).start(),
+                ]
+            )
+            self.thread: threading.Thread | None = None
+
+        def serve_background(self):
+            self.thread = threading.Thread(
+                target=self.runtime.serve, daemon=True
+            )
+            self.thread.start()
+
+        def teardown(self):
+            self.runtime.stop()
+            # Signal both before joining either: each stop() may wait out
+            # a 15s blocked watch read, and those waits must overlap.
+            for w in self.watchers:
+                w._stop.set()
+            for w in self.watchers:
+                w.stop()
+            if self.thread is not None:
+                self.thread.join(timeout=30)
+
+    if args.leader_elect:
+        # Reconcile only while holding the Lease.  SIGTERM releases the
+        # lease so the successor takes over immediately instead of
+        # waiting out the lease duration (rolling-update gap).
+        elector = LeaderElector(kube, namespace=args.leader_elect_namespace)
+        session: list[_Session] = []
+
+        def on_started():
+            s = _Session()
+            session[:] = [s]
+            s.serve_background()
+
+        def on_stopped():
+            if session:
+                session.pop().teardown()
+
+        def _terminate(signum, frame):
+            logging.getLogger(__name__).info("SIGTERM: releasing lease")
+            elector.stop()
+
+        signal.signal(signal.SIGTERM, _terminate)
+        try:
+            elector.run(on_started, on_stopped)
+        finally:
+            elector.stop()
+            elector.release()
+    else:
+        s = _Session()
+        signal.signal(
+            signal.SIGTERM, lambda *_: s.runtime.stop()
+        )
+        try:
+            s.runtime.serve()
+        finally:
+            s.teardown()
 
 
 if __name__ == "__main__":

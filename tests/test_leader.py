@@ -1,0 +1,177 @@
+"""Lease-based leader election: replicas > 1 run active/standby.
+
+The reference pins the operator at one replica and would double-reconcile
+with two; the elector makes a second replica a hot standby that takes
+over when the leader's lease expires.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpumlops.clients.base import ObjectRef
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator.leader import LEASE, LeaderElector
+from tpumlops.utils.clock import FakeClock
+
+
+def elector(kube, clock, ident, **kw):
+    kw.setdefault("lease_duration_s", 15.0)
+    kw.setdefault("renew_interval_s", 5.0)
+    return LeaderElector(kube, identity=ident, clock=clock, **kw)
+
+
+def lease_holder(kube):
+    ref = ObjectRef(namespace="tpumlops-system", name="tpumlops-operator", **LEASE)
+    return kube.get(ref)["spec"]["holderIdentity"]
+
+
+def test_first_elector_acquires_second_blocks():
+    kube, clock = FakeKube(), FakeClock()
+    a = elector(kube, clock, "a")
+    b = elector(kube, clock, "b")
+    assert a.try_acquire_or_renew() is True
+    assert lease_holder(kube) == "a"
+    assert b.try_acquire_or_renew() is False
+    # renewal by the holder keeps working
+    clock.advance(5)
+    assert a.try_acquire_or_renew() is True
+
+
+def test_expired_lease_is_taken_over_with_transition_count():
+    kube, clock = FakeKube(), FakeClock()
+    a = elector(kube, clock, "a")
+    b = elector(kube, clock, "b")
+    assert a.try_acquire_or_renew()
+    clock.advance(16)  # past lease_duration: 'a' stopped renewing (crash)
+    assert b.try_acquire_or_renew() is True
+    ref = ObjectRef(namespace="tpumlops-system", name="tpumlops-operator", **LEASE)
+    spec = kube.get(ref)["spec"]
+    assert spec["holderIdentity"] == "b"
+    assert spec["leaseTransitions"] == 1
+
+
+def test_simultaneous_takeover_has_one_winner():
+    kube, clock = FakeKube(), FakeClock()
+    a = elector(kube, clock, "a")
+    assert a.try_acquire_or_renew()
+    clock.advance(20)
+
+    # Both standbys read the same expired lease, then race the replace:
+    # optimistic concurrency (resourceVersion) admits exactly one.
+    b = elector(kube, clock, "b")
+    c = elector(kube, clock, "c")
+    ref = ObjectRef(namespace="tpumlops-system", name="tpumlops-operator", **LEASE)
+    stale = kube.get(ref)
+    results = []
+    for e in (b, c):
+        body = e._lease_body(stale)  # both built from the SAME snapshot
+        try:
+            kube.replace(ref, body)
+            results.append(e.identity)
+        except Exception:
+            pass
+    assert len(results) == 1
+
+
+def test_renew_interval_must_undercut_lease_duration():
+    with pytest.raises(ValueError, match="renew_interval"):
+        LeaderElector(FakeKube(), lease_duration_s=5.0, renew_interval_s=5.0)
+
+
+def test_run_hands_off_leadership_on_expiry_realtime():
+    """Two electors on real (short) timers: A leads, A dies, B takes over
+    and only then starts reconciling."""
+    kube = FakeKube()
+    events: list[str] = []
+    a = LeaderElector(
+        kube, identity="a", lease_duration_s=0.6, renew_interval_s=0.2,
+        retry_interval_s=0.05,
+    )
+    b = LeaderElector(
+        kube, identity="b", lease_duration_s=0.6, renew_interval_s=0.2,
+        retry_interval_s=0.05,
+    )
+
+    ta = threading.Thread(
+        target=lambda: a.run(lambda: events.append("a+"), lambda: events.append("a-")),
+        daemon=True,
+    )
+    tb = threading.Thread(
+        target=lambda: b.run(lambda: events.append("b+"), lambda: events.append("b-")),
+        daemon=True,
+    )
+    ta.start()
+    deadline = time.monotonic() + 5
+    while "a+" not in events and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "a+" in events
+    tb.start()
+    time.sleep(0.3)
+    assert "b+" not in events  # standby stays passive while a renews
+
+    a.stop()  # 'a' crashes (stops renewing); lease expires
+    ta.join(timeout=3)
+    deadline = time.monotonic() + 5
+    while "b+" not in events and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "b+" in events
+    assert lease_holder(kube) == "b"
+    b.stop()
+    tb.join(timeout=3)
+    # a stepped down before b started (strict ordering in the event log)
+    assert events.index("a-") < events.index("b+")
+
+
+def test_transport_errors_are_failed_rounds_not_crashes():
+    kube, clock = FakeKube(), FakeClock()
+    a = elector(kube, clock, "a")
+    assert a.try_acquire_or_renew()
+
+    real_get = kube.get
+
+    def flaky_get(ref):
+        raise ConnectionError("API server unreachable")
+
+    kube.get = flaky_get
+    assert a.try_acquire_or_renew() is False  # not an exception
+    kube.get = real_get
+    assert a.try_acquire_or_renew() is True
+
+
+def test_release_lets_successor_take_over_immediately():
+    """SIGTERM path: the old leader releases, and the successor's very
+    next round acquires without waiting out the lease duration."""
+    kube, clock = FakeKube(), FakeClock()
+    a = elector(kube, clock, "a")
+    b = elector(kube, clock, "b")
+    assert a.try_acquire_or_renew()
+    assert b.try_acquire_or_renew() is False
+    a.release()
+    # NO clock advance: takeover must not need the expiry wait.
+    assert b.try_acquire_or_renew() is True
+    assert lease_holder(kube) == "b"
+
+
+def test_release_is_a_noop_for_non_holders():
+    kube, clock = FakeKube(), FakeClock()
+    a = elector(kube, clock, "a")
+    b = elector(kube, clock, "b")
+    assert a.try_acquire_or_renew()
+    b.release()  # must not clobber a's lease
+    assert lease_holder(kube) == "a"
+    clock.advance(5)
+    assert a.try_acquire_or_renew() is True
+
+
+def test_holder_steps_down_before_challenger_threshold():
+    """renew_deadline < lease_duration: the holder abandons strictly
+    before a challenger may act on the expired lease."""
+    a = LeaderElector(FakeKube(), identity="a")
+    assert a.renew_deadline_s < a.lease_duration_s
+    with pytest.raises(ValueError, match="renew_deadline"):
+        LeaderElector(
+            FakeKube(), lease_duration_s=10, renew_interval_s=2,
+            renew_deadline_s=10,
+        )
