@@ -31,6 +31,11 @@ class Predictor:
     # support autoregressive decoding: the server builds a continuous-
     # batching GenerationEngine from these and exposes /generate.
     causal_lm: dict | None = None
+    # Declarative sequence bucketing (server/batching.apply_seq_pad):
+    # collapses variable request lengths into power-of-two buckets so the
+    # batcher can merge them and XLA compiles log-many shapes.  Only for
+    # models whose padding is exact (masked attention, pooled outputs).
+    seq_pad: dict | None = None
 
 
 _BUILDERS: dict[str, Callable[..., Predictor]] = {}
@@ -169,11 +174,16 @@ def _build_bert(params: Any, cfg: Any = None, seq_len: int = 128, **_kw) -> Pred
 
     cfg = cfg or bert.BertConfig.base()
 
-    def predict(input_ids, attention_mask=None):
+    def predict(input_ids, attention_mask=None, token_type_ids=None):
         import jax.numpy as jnp
 
         return bert.classify(
-            params, input_ids, attention_mask, cfg=cfg, dtype=jnp.bfloat16
+            params,
+            input_ids,
+            attention_mask,
+            token_type_ids,
+            cfg=cfg,
+            dtype=jnp.bfloat16,
         )
 
     def example(b):
@@ -188,6 +198,22 @@ def _build_bert(params: Any, cfg: Any = None, seq_len: int = 128, **_kw) -> Pred
         jittable=True,
         example_input=example,
         metadata={"seq_len": seq_len, "num_labels": cfg.num_labels},
+        # Padding is exact for classification: the attention mask (0 on
+        # padded keys) removes them from every softmax, and the CLS
+        # pooling position is unaffected.  A request without a mask gets
+        # one synthesized BEFORE padding, or the padded ids would be
+        # attended.
+        seq_pad={
+            "axis": 1,
+            "pad_values": {
+                "input_ids": 0,
+                "attention_mask": 0,
+                "token_type_ids": 0,
+            },
+            "synthesize": {"attention_mask": 1},
+            "min_bucket": 16,
+            "max_len": cfg.max_position_embeddings,
+        },
     )
 
 

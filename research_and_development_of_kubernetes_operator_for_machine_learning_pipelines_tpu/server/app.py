@@ -111,6 +111,11 @@ class TpuInferenceServer:
         """Dispatch: batch-1 via the dynamic batcher, larger directly —
         but always through the warmed power-of-two buckets, never a raw
         client batch size (each distinct shape is an XLA compile)."""
+        seq_pad = getattr(self.engine.predictor, "seq_pad", None)
+        if seq_pad:
+            from .batching import apply_seq_pad
+
+            inputs = apply_seq_pad(inputs, seq_pad)
         batch = next(iter(inputs.values())).shape[0]
         if batch == 1:
             single = {k: v[0] for k, v in inputs.items()}

@@ -32,6 +32,65 @@ def _group_key(inputs: Mapping[str, np.ndarray]) -> tuple:
     return tuple(sorted((k, v.shape[1:], str(v.dtype)) for k, v in inputs.items()))
 
 
+def apply_seq_pad(
+    inputs: Mapping[str, np.ndarray], spec: Mapping[str, Any]
+) -> dict[str, np.ndarray]:
+    """Pad sequence-shaped inputs to a power-of-two length bucket.
+
+    Without this, every distinct request length is a distinct batch-group
+    shape — each one a fresh XLA compile and a batch nothing else can
+    join.  With it, lengths collapse into log-many buckets that merge in
+    the batcher and compile once each.
+
+    ``spec`` (Predictor.seq_pad) is declarative:
+
+    - ``axis``: the sequence axis (default 1);
+    - ``pad_values``: {input_name: fill} — ONLY these inputs are padded,
+      with model-correct fills (for BERT: ids 0, attention_mask 0 — the
+      mask makes padding mathematically exact for pooled/classification
+      outputs; token-level outputs would need slicing and are not
+      eligible);
+    - ``synthesize``: {input_name: fill} — inputs to create as a full
+      ``fill`` array when the request omits them, BEFORE padding.
+      Without this a request lacking attention_mask would have its
+      padded id positions attended (the model defaults a missing mask
+      to all-ones over the PADDED length);
+    - ``min_bucket`` (default 16) and ``max_len`` (cap): requests longer
+      than ``max_len`` raise ValueError — the HTTP layer turns that into
+      a 400.  Letting them through would silently clamp position
+      embeddings (garbage 200s) and hand hostile clients a fresh XLA
+      compile per distinct over-long length.
+    """
+    axis = int(spec.get("axis", 1))
+    pad_values = spec.get("pad_values") or {}
+    out = dict(inputs)
+    ref = next((out[k] for k in pad_values if k in out), None)
+    if ref is None:
+        return out
+    for name, fill in (spec.get("synthesize") or {}).items():
+        if name not in out:
+            out[name] = np.full_like(ref, fill)
+    length = max(out[k].shape[axis] for k in pad_values if k in out)
+    max_len = int(spec.get("max_len") or 0)
+    if max_len and length > max_len:
+        raise ValueError(
+            f"sequence length {length} exceeds the model maximum {max_len}"
+        )
+    bucket = max(int(spec.get("min_bucket", 16)), next_bucket(length, 1 << 30))
+    if max_len:
+        bucket = min(bucket, max_len)
+    if bucket <= length:
+        return out  # already exactly bucket-sized
+    for name in pad_values:
+        if name not in out:
+            continue
+        v = out[name]
+        widths = [(0, 0)] * v.ndim
+        widths[axis] = (0, bucket - v.shape[axis])
+        out[name] = np.pad(v, widths, constant_values=pad_values[name])
+    return out
+
+
 @dataclass
 class _Item:
     inputs: dict[str, np.ndarray]  # each [1, ...] (single example, batch dim 1)

@@ -116,13 +116,55 @@ class InferenceEngine:
             buckets = warmup_buckets(self.max_batch_size)
         predict = predict or self.predict
         t0 = time.perf_counter()
+        n_shapes = 0
         for b in buckets:
             ex = self.predictor.example_input(b)
             if not isinstance(ex, Mapping):
                 ex = {"x": ex}
             predict(ex)
+            n_shapes += 1
+        # Sequence-bucketed predictors: also warm the LENGTH buckets at
+        # the batch-grid edges (batch 1 and max).  The full batch x length
+        # grid would be |buckets|^2 cold compiles; the edges cover lone
+        # requests and saturated batches, and the persistent compile
+        # cache fills the interior once, fleet-wide.
+        seq_pad = getattr(self.predictor, "seq_pad", None)
+        if seq_pad:
+            axis = int(seq_pad.get("axis", 1))
+            max_len = int(seq_pad.get("max_len") or 0)
+            example = self.predictor.example_input(1)
+            pad_names = [
+                k
+                for k in (seq_pad.get("pad_values") or {})
+                if isinstance(example, Mapping) and k in example
+            ]
+            if pad_names and max_len:
+
+                def at_length(b: int, length: int) -> dict:
+                    ex = self.predictor.example_input(b)
+                    idx = np.zeros(length, np.intp)  # repeat position 0
+                    return {
+                        k: (np.take(v, idx, axis=axis) if k in pad_names else v)
+                        for k, v in ex.items()
+                    }
+
+                base_len = example[pad_names[0]].shape[axis]
+                lengths = []
+                length = max(int(seq_pad.get("min_bucket", 16)), 1)
+                while length < max_len:
+                    lengths.append(length)
+                    length *= 2
+                # apply_seq_pad clamps the top bucket to max_len itself,
+                # so a non-power-of-two max_len is a servable shape too.
+                lengths.append(max_len)
+                for length in lengths:
+                    if length == base_len:
+                        continue  # base length covered above
+                    for b in (1, self.max_batch_size):
+                        predict(at_length(b, length))
+                        n_shapes += 1
         dt = time.perf_counter() - t0
-        _log.info("warmup compiled %d buckets in %.1fs", len(buckets), dt)
+        _log.info("warmup compiled %d shapes in %.1fs", n_shapes, dt)
         return dt
 
 

@@ -80,3 +80,136 @@ def test_batcher_propagates_exceptions():
     fut2 = b.submit({"x": np.ones((2,), np.float32)})
     np.testing.assert_array_equal(fut2.result(timeout=5), np.ones((2,)))
     b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sequence bucketing (Predictor.seq_pad)
+# ---------------------------------------------------------------------------
+
+
+def test_apply_seq_pad_buckets_and_synthesizes_mask():
+    from tpumlops.server.batching import apply_seq_pad
+
+    spec = {
+        "axis": 1,
+        "pad_values": {"input_ids": 0, "attention_mask": 0},
+        "synthesize": {"attention_mask": 1},
+        "min_bucket": 16,
+        "max_len": 128,
+    }
+    # 57 tokens, no mask supplied.
+    ids = np.arange(57, dtype=np.int32).reshape(1, 57) + 1
+    out = apply_seq_pad({"input_ids": ids}, spec)
+    assert out["input_ids"].shape == (1, 64)
+    assert out["attention_mask"].shape == (1, 64)
+    # synthesized mask: 1 over the real tokens, 0 over padding
+    assert out["attention_mask"][0, :57].tolist() == [1] * 57
+    assert out["attention_mask"][0, 57:].tolist() == [0] * 7
+    assert out["input_ids"][0, 57:].tolist() == [0] * 7
+
+    # two different lengths land in the SAME batch group
+    from tpumlops.server.batching import _group_key
+
+    a = apply_seq_pad({"input_ids": np.ones((1, 57), np.int32)}, spec)
+    b = apply_seq_pad({"input_ids": np.ones((1, 60), np.int32)}, spec)
+    assert _group_key(a) == _group_key(b)
+
+    # cap: longer than max_len is rejected (HTTP layer makes it a 400)
+    import pytest
+
+    with pytest.raises(ValueError, match="exceeds the model maximum"):
+        apply_seq_pad({"input_ids": np.ones((1, 200), np.int32)}, spec)
+
+    # short: min_bucket floor
+    s = apply_seq_pad({"input_ids": np.ones((1, 3), np.int32)}, spec)
+    assert s["input_ids"].shape == (1, 16)
+
+
+def test_seq_padded_bert_classify_is_exact():
+    """Padding + synthesized mask must not change classification logits
+    (the attention mask removes padded keys from every softmax)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import bert, registry
+    from tpumlops.server.batching import apply_seq_pad
+
+    cfg = bert.BertConfig.tiny(num_labels=3)
+    params = bert.init(jax.random.key(0), cfg)
+    pred = registry.get_builder("bert-classifier")(params, cfg=cfg, seq_len=32)
+    assert pred.seq_pad is not None
+
+    ids = np.arange(1, 22, dtype=np.int32).reshape(1, 21)  # 21 tokens
+    ref = np.asarray(
+        pred.predict(jnp.asarray(ids), jnp.ones_like(jnp.asarray(ids)))
+    )
+    padded = apply_seq_pad({"input_ids": ids}, pred.seq_pad)
+    assert padded["input_ids"].shape == (1, 32)
+    got = np.asarray(
+        pred.predict(
+            jnp.asarray(padded["input_ids"]),
+            jnp.asarray(padded["attention_mask"]),
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_seq_pad_token_type_ids_forwarded_and_overlong_400(tmp_path):
+    """Sentence-pair requests (token_type_ids) serve through the padded
+    path, and over-long requests 400 at the HTTP layer."""
+    import jax
+
+    import httpx
+    from tpumlops.clients.localplane import free_port, start_model_server
+    from tpumlops.models import bert
+    from tpumlops.server.loader import save_native_model
+    from tpumlops.utils.config import TpuSpec
+
+    cfg = bert.BertConfig.tiny(num_labels=2, max_position_embeddings=32)
+    params = bert.init(jax.random.key(0), cfg)
+    art = tmp_path / "bpair"
+    save_native_model(
+        art,
+        "bert-classifier",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "num_labels": cfg.num_labels,
+        },
+        builder_kwargs={"seq_len": 16},
+    )
+    port = free_port()
+    h = start_model_server(
+        str(art), "v1", port, model_name="bpair", namespace="models",
+        tpu=TpuSpec.from_spec({"meshShape": {"tp": 1}, "maxBatchSize": 2}),
+    )
+    base = f"http://127.0.0.1:{port}/v2/models/bpair/infer"
+    try:
+        L = 10
+        body = {
+            "inputs": [
+                {"name": "input_ids", "shape": [1, L], "datatype": "INT32",
+                 "data": list(range(1, L + 1))},
+                {"name": "token_type_ids", "shape": [1, L], "datatype": "INT32",
+                 "data": [0] * 5 + [1] * 5},
+            ]
+        }
+        r = httpx.post(base, json=body, timeout=60)
+        assert r.status_code == 200, r.text
+
+        over = {
+            "inputs": [
+                {"name": "input_ids", "shape": [1, 40], "datatype": "INT32",
+                 "data": list(range(1, 41))}
+            ]
+        }
+        r = httpx.post(base, json=over, timeout=60)
+        assert r.status_code == 400, (r.status_code, r.text)
+        assert "exceeds the model maximum" in r.json()["error"]
+    finally:
+        h.stop()
