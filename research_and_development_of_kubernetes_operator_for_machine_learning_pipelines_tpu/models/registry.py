@@ -169,7 +169,13 @@ def _build_pyfunc(model: Any, **_kw) -> Predictor:
 
 
 @register("bert-classifier")
-def _build_bert(params: Any, cfg: Any = None, seq_len: int = 128, **_kw) -> Predictor:
+def _build_bert(
+    params: Any,
+    cfg: Any = None,
+    seq_len: int = 128,
+    seq_buckets: bool = True,
+    **_kw,
+) -> Predictor:
     from . import bert
 
     cfg = cfg or bert.BertConfig.base()
@@ -203,7 +209,12 @@ def _build_bert(params: Any, cfg: Any = None, seq_len: int = 128, **_kw) -> Pred
         # pooling position is unaffected.  A request without a mask gets
         # one synthesized BEFORE padding, or the padded ids would be
         # attended.
-        seq_pad={
+        # seq_buckets=False pins the model to fixed-length traffic (no
+        # length ladder warmed or served) — for controlled benches and
+        # pipelines that always send one length.
+        seq_pad=None
+        if not seq_buckets
+        else {
             "axis": 1,
             "pad_values": {
                 "input_ids": 0,

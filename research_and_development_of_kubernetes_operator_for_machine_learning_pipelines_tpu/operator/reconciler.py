@@ -112,6 +112,8 @@ class Reconciler:
 
     def reconcile(self, obj: dict) -> ReconcileOutcome:
         """One reconcile step for the given CR object (spec+status+metadata)."""
+        # Prior conditions feed lastTransitionTime stability (state.py).
+        self._prior_conditions = (obj.get("status") or {}).get("conditions")
         state = PromotionState.from_status(obj.get("status"))
         events: list[Event] = []
         try:
@@ -591,8 +593,19 @@ class Reconciler:
         self._gc_worker_units()
 
     def _patch_status(self, state: PromotionState) -> None:
+        import datetime
+
+        now_iso = datetime.datetime.fromtimestamp(
+            self.clock.now(), datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        status = state.to_status()
+        status["conditions"] = state.conditions(
+            getattr(self, "_prior_conditions", None), now_iso
+        )
+        # Later patches in the same reconcile see the fresh conditions.
+        self._prior_conditions = status["conditions"]
         try:
-            self.kube.patch_status(self.cr_ref, state.to_status())
+            self.kube.patch_status(self.cr_ref, status)
         except NotFound:
             # CR deleted mid-step; runtime will stop this reconciler.
             self.log.info("CR gone; skipping status patch.")

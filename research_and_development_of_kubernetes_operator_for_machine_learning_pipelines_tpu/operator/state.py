@@ -150,6 +150,88 @@ class PromotionState:
 
     # -- serialization ------------------------------------------------------
 
+    def conditions(
+        self,
+        prior: list[dict] | None = None,
+        now_iso: str = "",
+    ) -> list[dict[str, Any]]:
+        """Standard K8s status Conditions derived from the phase.
+
+        The reference exposes none; these make ``kubectl wait
+        --for=condition=Available`` and dashboard tooling work:
+
+        - ``Available``   — a version is serving traffic (Stable, mid-
+          Canary, rolled back onto the old version, or halted at a
+          frozen split — Failed still serves 100% of traffic);
+        - ``Progressing`` — a canary rollout is in flight;
+        - ``Degraded``    — promotion failed / spec or alias error /
+          serving the rolled-back version.
+
+        ``lastTransitionTime`` only moves when a condition's status
+        flips (K8s convention), which is why the caller passes the prior
+        conditions back in.
+        """
+        available = (
+            self.phase
+            in (Phase.STABLE, Phase.CANARY, Phase.ROLLED_BACK, Phase.FAILED)
+            and self.current_version is not None
+        )
+        degraded_reason = {
+            Phase.FAILED: ("PromotionFailed", "Canary halted at max attempts."),
+            Phase.ERROR: ("Error", self.error or "reconcile error"),
+            Phase.ROLLED_BACK: (
+                "RolledBack",
+                f"Serving previous version {self.current_version}; "
+                f"version {self.held_version} held.",
+            ),
+        }.get(self.phase)
+        desired = [
+            (
+                "Available",
+                available,
+                "Serving" if available else "NoServingVersion",
+                f"Version {self.current_version} at "
+                f"{self.traffic_current}% traffic."
+                if available
+                else "No model version is serving.",
+            ),
+            (
+                "Progressing",
+                self.phase == Phase.CANARY,
+                "CanaryRollout" if self.phase == Phase.CANARY else "Idle",
+                f"Canary at {self.traffic_current}% "
+                f"(attempt {self.attempt})."
+                if self.phase == Phase.CANARY
+                else "No rollout in flight.",
+            ),
+            (
+                "Degraded",
+                degraded_reason is not None,
+                degraded_reason[0] if degraded_reason else "Healthy",
+                degraded_reason[1] if degraded_reason else "",
+            ),
+        ]
+        prior_map = {c.get("type"): c for c in (prior or [])}
+        out = []
+        for ctype, truth, reason, message in desired:
+            status = "True" if truth else "False"
+            prev = prior_map.get(ctype)
+            ltt = (
+                prev.get("lastTransitionTime")
+                if prev is not None and prev.get("status") == status
+                else now_iso
+            )
+            out.append(
+                {
+                    "type": ctype,
+                    "status": status,
+                    "reason": reason,
+                    "message": message,
+                    "lastTransitionTime": ltt,
+                }
+            )
+        return out
+
     def to_status(self) -> dict[str, Any]:
         return {
             "phase": self.phase.value,

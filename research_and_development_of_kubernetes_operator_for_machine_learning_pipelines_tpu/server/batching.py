@@ -32,6 +32,25 @@ def _group_key(inputs: Mapping[str, np.ndarray]) -> tuple:
     return tuple(sorted((k, v.shape[1:], str(v.dtype)) for k, v in inputs.items()))
 
 
+def seq_buckets(spec: Mapping[str, Any]) -> list[int]:
+    """The servable length-bucket ladder for a ``seq_pad`` spec: powers of
+    two from ``min_bucket``, topped by ``max_len`` itself (which may not
+    be a power of two).  ONE definition — ``apply_seq_pad`` pads onto it
+    and the engine warmup compiles it; two copies would let warmed and
+    served shapes drift apart."""
+    max_len = int(spec.get("max_len") or 0)
+    ladder = []
+    length = max(int(spec.get("min_bucket", 16)), 1)
+    while not max_len or length < max_len:
+        ladder.append(length)
+        if not max_len and length >= 1 << 20:
+            break  # uncapped spec: don't ladder to infinity
+        length *= 2
+    if max_len:
+        ladder.append(max_len)
+    return ladder
+
+
 def apply_seq_pad(
     inputs: Mapping[str, np.ndarray], spec: Mapping[str, Any]
 ) -> dict[str, np.ndarray]:
@@ -70,15 +89,21 @@ def apply_seq_pad(
     for name, fill in (spec.get("synthesize") or {}).items():
         if name not in out:
             out[name] = np.full_like(ref, fill)
-    length = max(out[k].shape[axis] for k in pad_values if k in out)
+    lengths = {k: out[k].shape[axis] for k in pad_values if k in out}
+    if len(set(lengths.values())) > 1:
+        # Padding each input to the max would silently mask out real
+        # tokens (e.g. a short attention_mask zero-extended over live
+        # ids) — malformed requests must error, not get "repaired".
+        raise ValueError(
+            f"sequence inputs disagree on length along axis {axis}: {lengths}"
+        )
+    length = next(iter(lengths.values()))
     max_len = int(spec.get("max_len") or 0)
     if max_len and length > max_len:
         raise ValueError(
             f"sequence length {length} exceeds the model maximum {max_len}"
         )
-    bucket = max(int(spec.get("min_bucket", 16)), next_bucket(length, 1 << 30))
-    if max_len:
-        bucket = min(bucket, max_len)
+    bucket = next(b for b in seq_buckets(spec) if b >= length)
     if bucket <= length:
         return out  # already exactly bucket-sized
     for name in pad_values:

@@ -148,16 +148,10 @@ class InferenceEngine:
                         for k, v in ex.items()
                     }
 
+                from .batching import seq_buckets
+
                 base_len = example[pad_names[0]].shape[axis]
-                lengths = []
-                length = max(int(seq_pad.get("min_bucket", 16)), 1)
-                while length < max_len:
-                    lengths.append(length)
-                    length *= 2
-                # apply_seq_pad clamps the top bucket to max_len itself,
-                # so a non-power-of-two max_len is a servable shape too.
-                lengths.append(max_len)
-                for length in lengths:
+                for length in seq_buckets(seq_pad):
                     if length == base_len:
                         continue  # base length covered above
                     for b in (1, self.max_batch_size):

@@ -213,3 +213,32 @@ def test_seq_pad_token_type_ids_forwarded_and_overlong_400(tmp_path):
         assert "exceeds the model maximum" in r.json()["error"]
     finally:
         h.stop()
+
+
+def test_seq_pad_rejects_mismatched_input_lengths():
+    import pytest
+
+    from tpumlops.server.batching import apply_seq_pad
+
+    spec = {
+        "axis": 1,
+        "pad_values": {"input_ids": 0, "attention_mask": 0},
+        "min_bucket": 16,
+        "max_len": 64,
+    }
+    with pytest.raises(ValueError, match="disagree on length"):
+        apply_seq_pad(
+            {
+                "input_ids": np.ones((1, 60), np.int32),
+                "attention_mask": np.ones((1, 57), np.int32),
+            },
+            spec,
+        )
+
+
+def test_seq_buckets_ladder_is_shared_definition():
+    from tpumlops.server.batching import seq_buckets
+
+    assert seq_buckets({"min_bucket": 16, "max_len": 128}) == [16, 32, 64, 128]
+    # non-power-of-two cap is itself a servable bucket
+    assert seq_buckets({"min_bucket": 16, "max_len": 100}) == [16, 32, 64, 100]

@@ -147,3 +147,111 @@ def test_alias_alias_module_identity():
     )
     assert short_state is long_state
     assert short_state.Phase is long_state.Phase
+
+
+# ---------------------------------------------------------------------------
+# Status conditions (kubectl wait --for=condition=...)
+# ---------------------------------------------------------------------------
+
+
+def _cond(conds, type_):
+    return next(c for c in conds if c["type"] == type_)
+
+
+def test_conditions_by_phase():
+    from tpumlops.operator.state import Phase, PromotionState
+
+    stable = PromotionState(
+        phase=Phase.STABLE, current_version="2", traffic_current=100
+    )
+    c = stable.conditions(now_iso="T1")
+    assert _cond(c, "Available")["status"] == "True"
+    assert _cond(c, "Progressing")["status"] == "False"
+    assert _cond(c, "Degraded")["status"] == "False"
+
+    canary = PromotionState(
+        phase=Phase.CANARY, current_version="3", previous_version="2",
+        traffic_current=30, traffic_prev=70,
+    )
+    c = canary.conditions(now_iso="T1")
+    assert _cond(c, "Available")["status"] == "True"
+    assert _cond(c, "Progressing")["status"] == "True"
+    assert "30%" in _cond(c, "Progressing")["message"]
+
+    rolled = PromotionState(
+        phase=Phase.ROLLED_BACK, current_version="2", held_version="3",
+        traffic_current=100,
+    )
+    c = rolled.conditions(now_iso="T1")
+    assert _cond(c, "Available")["status"] == "True"  # old version serves
+    assert _cond(c, "Degraded")["status"] == "True"
+    assert _cond(c, "Degraded")["reason"] == "RolledBack"
+
+    idle = PromotionState()
+    c = idle.conditions(now_iso="T1")
+    assert _cond(c, "Available")["status"] == "False"
+
+
+def test_condition_transition_time_moves_only_on_flips():
+    from tpumlops.operator.state import Phase, PromotionState
+
+    stable = PromotionState(
+        phase=Phase.STABLE, current_version="1", traffic_current=100
+    )
+    first = stable.conditions(now_iso="T1")
+    # Same status re-derived later: timestamps must NOT churn.
+    again = stable.conditions(prior=first, now_iso="T2")
+    assert _cond(again, "Available")["lastTransitionTime"] == "T1"
+
+    canary = PromotionState(
+        phase=Phase.CANARY, current_version="2", previous_version="1",
+        traffic_current=10, traffic_prev=90,
+    )
+    flipped = canary.conditions(prior=again, now_iso="T3")
+    assert _cond(flipped, "Progressing")["lastTransitionTime"] == "T3"  # flip
+    assert _cond(flipped, "Available")["lastTransitionTime"] == "T1"  # stable
+
+
+def test_reconciler_writes_conditions_to_status():
+    from tpumlops.clients.base import MLFLOWMODEL, ObjectRef
+    from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+    from tpumlops.operator.reconciler import Reconciler
+    from tpumlops.utils.clock import FakeClock
+
+    kube, registry, metrics = FakeKube(), FakeRegistry(), FakeMetrics()
+    ref = ObjectRef(namespace="models", name="iris", **MLFLOWMODEL)
+    kube.create(
+        ref,
+        {
+            "metadata": {"name": "iris", "namespace": "models"},
+            "spec": {"modelName": "iris", "modelAlias": "champion"},
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec = Reconciler("iris", "models", kube, registry, metrics, FakeClock())
+    rec.reconcile(kube.get(ref))
+    conds = kube.get(ref)["status"]["conditions"]
+    assert _cond(conds, "Available")["status"] == "True"
+    ltt = _cond(conds, "Available")["lastTransitionTime"]
+
+    # A later reconcile with no change keeps the transition timestamp.
+    rec.reconcile(kube.get(ref))
+    conds2 = kube.get(ref)["status"].get("conditions") or conds
+    assert _cond(conds2, "Available")["lastTransitionTime"] == ltt
+
+
+def test_failed_frozen_split_is_still_available():
+    """Phase.FAILED freezes the split but KEEPS serving 100% of traffic
+    across both predictors — Available must stay True (Degraded flags
+    the problem)."""
+    from tpumlops.operator.state import Phase, PromotionState
+
+    failed = PromotionState(
+        phase=Phase.FAILED, current_version="3", previous_version="2",
+        traffic_current=30, traffic_prev=70, held_version="3",
+    )
+    c = failed.conditions(now_iso="T1")
+    assert _cond(c, "Available")["status"] == "True"
+    assert _cond(c, "Degraded")["status"] == "True"
+    assert _cond(c, "Degraded")["reason"] == "PromotionFailed"
