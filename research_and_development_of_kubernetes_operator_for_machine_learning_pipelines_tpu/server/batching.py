@@ -103,7 +103,14 @@ def apply_seq_pad(
         raise ValueError(
             f"sequence length {length} exceeds the model maximum {max_len}"
         )
-    bucket = next(b for b in seq_buckets(spec) if b >= length)
+    bucket = next((b for b in seq_buckets(spec) if b >= length), None)
+    if bucket is None:
+        # Uncapped spec past the ladder's safety stop (~1M tokens): a
+        # bare StopIteration here would surface as a 500.
+        raise ValueError(
+            f"sequence length {length} exceeds the bucket ladder "
+            f"(declare max_len in seq_pad to raise the cap explicitly)"
+        )
     if bucket <= length:
         return out  # already exactly bucket-sized
     for name in pad_values:

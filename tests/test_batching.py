@@ -242,3 +242,19 @@ def test_seq_buckets_ladder_is_shared_definition():
     assert seq_buckets({"min_bucket": 16, "max_len": 128}) == [16, 32, 64, 128]
     # non-power-of-two cap is itself a servable bucket
     assert seq_buckets({"min_bucket": 16, "max_len": 100}) == [16, 32, 64, 100]
+
+
+def test_seq_pad_uncapped_spec_overflow_is_a_value_error():
+    import pytest
+
+    from tpumlops.server.batching import apply_seq_pad
+
+    spec = {"axis": 1, "pad_values": {"input_ids": 0}, "min_bucket": 16}
+    # fits the uncapped ladder
+    out = apply_seq_pad({"input_ids": np.ones((1, 100), np.int32)}, spec)
+    assert out["input_ids"].shape == (1, 128)
+    # beyond the ladder's safety stop: 400-able ValueError, not StopIteration
+    with pytest.raises(ValueError, match="bucket ladder"):
+        apply_seq_pad(
+            {"input_ids": np.ones((1, (1 << 20) + 1), np.int8)}, spec
+        )
