@@ -33,6 +33,7 @@ Run on the real TPU chip: ``python bench.py``.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -610,11 +611,8 @@ def main() -> None:
     # warmed bucket is a real compile and the expensive benches can eat
     # tens of minutes cold.  Past the budget the remaining entries are
     # marked skipped — the headline line must always print.
-    import os
-    import time as _time
-
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "900"))
-    t_start = _time.monotonic()
+    t_start = time.monotonic()
     secondary = {}
     for name, fn in (
         ("time_to_100pct_traffic", bench_time_to_100),
@@ -624,7 +622,7 @@ def main() -> None:
         ("llama_1p35b_decode", bench_llama_decode),
         ("serve_path_http", bench_serve_path),
     ):
-        if _time.monotonic() - t_start > budget_s:
+        if time.monotonic() - t_start > budget_s:
             secondary[name] = {"skipped": f"wall budget {budget_s:.0f}s spent"}
             continue
         try:

@@ -38,6 +38,13 @@ def main(argv: list[str] | None = None) -> None:
         "(the reference pins replicas: 1 and has no election)",
     )
     ap.add_argument(
+        "--concurrent-reconciles",
+        type=int,
+        default=4,
+        help="distinct CRs reconciled in parallel (one CR is never "
+        "reconciled concurrently with itself); 1 = serial",
+    )
+    ap.add_argument(
         "--leader-elect-namespace",
         default="tpumlops-system",
         help="namespace of the election Lease",
@@ -102,6 +109,7 @@ def main(argv: list[str] | None = None) -> None:
                 namespace=args.namespace,
                 sync_interval_s=args.sync_interval,
                 telemetry=telemetry,
+                max_concurrent_reconciles=args.concurrent_reconciles,
             )
             # Watchers start HERE, synchronously, so teardown can never
             # race a half-started serve thread into orphaning them.

@@ -60,6 +60,9 @@ class _Entry:
     # patches — which is what lets notify() tell a user edit (reconcile
     # now) from the reconciler's own status writes (don't touch pacing).
     generation: int | None = None
+    # Bumped by notify(): a reconcile completing after a notify must not
+    # overwrite the notify's due-now with its computed requeue.
+    epoch: int = 0
 
 
 class OperatorRuntime:
@@ -74,6 +77,7 @@ class OperatorRuntime:
         metrics_factory=None,
         warmup=None,
         telemetry=None,
+        max_concurrent_reconciles: int = 1,
     ):
         if metrics is None and metrics_factory is None:
             raise ValueError(
@@ -94,6 +98,28 @@ class OperatorRuntime:
         self._stop = threading.Event()
         # Set by notify() (watch events) to cut a serve() sleep short.
         self._wake = threading.Event()
+        # Reconciles of DISTINCT CRs may run concurrently (kopf runs
+        # handlers concurrently; controller-runtime calls this knob
+        # MaxConcurrentReconciles): without it one CR with a slow metrics
+        # source stalls every other rollout.  Entries are never reconciled
+        # concurrently with themselves — step() partitions by entry.
+        self.max_concurrent_reconciles = max(1, int(max_concurrent_reconciles))
+        self._pool = None
+        # Keys currently being reconciled on the pool: step() neither
+        # re-submits them (a CR is never reconciled concurrently with
+        # itself) nor counts their stale due_at toward the next-due delay
+        # (which would spin the serve loop hot for the whole reconcile).
+        self._in_flight: set[tuple[str, str]] = set()
+        # Bumped by notify(): a reconcile that finishes AFTER a watch
+        # event must not clobber the event's due-now with its requeue.
+        self._epoch = 0
+        if self.max_concurrent_reconciles > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.max_concurrent_reconciles,
+                thread_name_prefix="reconcile",
+            )
 
     # -- discovery -----------------------------------------------------------
 
@@ -169,9 +195,52 @@ class OperatorRuntime:
                     if gen is not None and gen == entry.generation:
                         return  # status echo / watch replay; pacing stands
                 entry.due_at = self.clock.now()
+                entry.epoch += 1
         self._wake.set()
 
     # -- stepping ------------------------------------------------------------
+
+    def _set_due(self, entry: _Entry, epoch: int, due_at: float) -> None:
+        """Write the post-reconcile due time unless a notify() landed
+        mid-reconcile — its due-now wins over our computed requeue."""
+        with self._lock:
+            if entry.epoch == epoch:
+                entry.due_at = due_at
+
+    def _reconcile_one(self, key: tuple[str, str], entry: _Entry) -> None:
+        ns, name = key
+        t0 = time.perf_counter()
+        with self._lock:
+            epoch = entry.epoch
+        try:
+            obj = self.kube.get(
+                ObjectRef(namespace=ns, name=name, **MLFLOWMODEL)
+            )
+            entry.generation = (obj.get("metadata") or {}).get("generation")
+            outcome = entry.reconciler.reconcile(dict(obj))
+            entry.failures = 0
+            self._set_due(
+                entry, epoch, self.clock.now() + max(0.0, outcome.requeue_after)
+            )
+            if self.telemetry is not None:
+                self.telemetry.record_outcome(
+                    ns, name, outcome, time.perf_counter() - t0
+                )
+        except NotFound:
+            pass  # sync() on the next step removes it
+        except Exception:
+            entry.failures += 1
+            backoff = min(_MAX_BACKOFF_S, 2.0 ** entry.failures)
+            self._set_due(entry, epoch, self.clock.now() + backoff)
+            if self.telemetry is not None:
+                self.telemetry.record_failure(ns, name, time.perf_counter() - t0)
+            _log.exception(
+                "reconcile of %s/%s failed (attempt %d), backing off %.0fs",
+                ns,
+                name,
+                entry.failures,
+                backoff,
+            )
 
     def step(self) -> float | None:
         """Run every due reconciler once.
@@ -187,45 +256,48 @@ class OperatorRuntime:
             _log.exception("CR discovery failed; retrying next step")
         now = self.clock.now()
         with self._lock:
-            due = [(k, e) for k, e in self._entries.items() if e.due_at <= now]
-        for key, entry in due:
-            ns, name = key
-            t0 = time.perf_counter()
-            try:
-                obj = self.kube.get(
-                    ObjectRef(namespace=ns, name=name, **MLFLOWMODEL)
+            due = [
+                (k, e)
+                for k, e in self._entries.items()
+                if e.due_at <= now and k not in self._in_flight
+            ]
+        if self._pool is not None:
+            # Fire-and-continue, NO barrier: one slow CR must not gate
+            # anyone else's next round (controller-runtime semantics).
+            # Completion wakes serve() to recompute the next due time.
+            for key, entry in due:
+                with self._lock:
+                    self._in_flight.add(key)
+                try:
+                    fut = self._pool.submit(self._reconcile_one, key, entry)
+                except RuntimeError:  # pool shut down mid-step (stop())
+                    with self._lock:
+                        self._in_flight.discard(key)
+                    break
+                fut.add_done_callback(
+                    lambda _f, key=key: self._reconcile_done(key)
                 )
-                entry.generation = (obj.get("metadata") or {}).get("generation")
-                outcome = entry.reconciler.reconcile(dict(obj))
-                entry.failures = 0
-                entry.due_at = self.clock.now() + max(0.0, outcome.requeue_after)
-                if self.telemetry is not None:
-                    self.telemetry.record_outcome(
-                        ns, name, outcome, time.perf_counter() - t0
-                    )
-            except NotFound:
-                continue  # sync() on the next step removes it
-            except Exception:
-                entry.failures += 1
-                backoff = min(_MAX_BACKOFF_S, 2.0 ** entry.failures)
-                entry.due_at = self.clock.now() + backoff
-                if self.telemetry is not None:
-                    self.telemetry.record_failure(
-                        ns, name, time.perf_counter() - t0
-                    )
-                _log.exception(
-                    "reconcile of %s/%s failed (attempt %d), backing off %.0fs",
-                    ns,
-                    name,
-                    entry.failures,
-                    backoff,
-                )
+        else:
+            for key, entry in due:
+                self._reconcile_one(key, entry)
         with self._lock:
             if self.telemetry is not None:
                 self.telemetry.set_resource_count(len(self._entries))
-            if not self._entries:
+            # In-flight entries' due_at is stale (past); counting them
+            # would spin the serve loop for the whole reconcile.
+            pending = [
+                e.due_at
+                for k, e in self._entries.items()
+                if k not in self._in_flight
+            ]
+            if not pending:
                 return None
-            return max(0.0, min(e.due_at for e in self._entries.values()) - self.clock.now())
+            return max(0.0, min(pending) - self.clock.now())
+
+    def _reconcile_done(self, key: tuple[str, str]) -> None:
+        with self._lock:
+            self._in_flight.discard(key)
+        self._wake.set()
 
     # -- loops ---------------------------------------------------------------
 
@@ -283,6 +355,8 @@ class OperatorRuntime:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 class CrWatcher:

@@ -122,3 +122,64 @@ def test_runtime_survives_kube_outage():
     kube.list, kube.get = real_list, real_get
     rt.run_for(10)
     kube.get(ObjectRef(namespace="models", name="iris", **SELDONDEPLOYMENT))
+
+
+def test_concurrent_reconciles_overlap_slow_metrics():
+    """One CR with a slow backend must not stall the others (kopf runs
+    handlers concurrently; max_concurrent_reconciles restores that).
+    Deterministic proof: all four reconciles must be inside the registry
+    call at once before any may proceed."""
+    import threading
+
+    from tpumlops.utils.clock import SystemClock
+
+    kube, registry, metrics = FakeKube(), FakeRegistry(), FakeMetrics()
+    barrier = threading.Barrier(4, timeout=15)
+    real = registry.get_version_by_alias
+
+    def rendezvous(model, alias):
+        barrier.wait()  # serial execution would deadlock here (-> timeout)
+        return real(model, alias)
+
+    registry.get_version_by_alias = rendezvous
+    names = [f"m{i}" for i in range(4)]
+    for name in names:
+        make_cr(kube, name)
+        registry.register(name, "1", f"mlflow-artifacts:/1/{name}/artifacts/model")
+        registry.set_alias(name, "champion", "1")
+
+    rt = OperatorRuntime(
+        kube, registry, metrics, SystemClock(), max_concurrent_reconciles=4
+    )
+    rt.step()  # submits all four; completion is async
+
+    def all_deployed():
+        try:
+            return all(
+                kube.get(
+                    ObjectRef(namespace="models", name=n, **SELDONDEPLOYMENT)
+                )["spec"]["predictors"][0]["traffic"] == 100
+                for n in names
+            )
+        except NotFound:
+            return False
+
+    import time as _t
+
+    deadline = _t.monotonic() + 15
+    while not all_deployed() and _t.monotonic() < deadline:
+        _t.sleep(0.02)
+    assert all_deployed()
+    assert not barrier.broken  # genuine 4-way overlap, not a timeout
+    rt.stop()
+
+
+def test_serial_default_unchanged():
+    kube, registry, metrics, clock = FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock()
+    make_cr(kube, "iris")
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rt = OperatorRuntime(kube, registry, metrics, clock)
+    assert rt._pool is None  # default stays deterministic for FakeClock tests
+    rt.step()
+    assert kube.get(ObjectRef(namespace="models", name="iris", **SELDONDEPLOYMENT))
