@@ -129,8 +129,11 @@ def main(argv: list[str] | None = None) -> None:
             )
             self.thread.start()
 
-        def teardown(self):
-            self.runtime.stop()
+        def teardown(self, drain_s: float = 0.0):
+            # Leadership loss passes the election's takeover grace (one
+            # renew interval): an in-flight reconcile finishing its patch
+            # is fine inside the grace, a dual writer past it is not.
+            self.runtime.stop(drain_s=drain_s)
             # Signal both before joining either: each stop() may wait out
             # a 15s blocked watch read, and those waits must overlap.
             for w in self.watchers:
@@ -154,7 +157,7 @@ def main(argv: list[str] | None = None) -> None:
 
         def on_stopped():
             if session:
-                session.pop().teardown()
+                session.pop().teardown(drain_s=elector.renew_interval_s)
 
         def _terminate(signum, frame):
             logging.getLogger(__name__).info("SIGTERM: releasing lease")

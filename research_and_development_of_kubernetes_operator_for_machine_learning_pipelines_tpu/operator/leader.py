@@ -49,12 +49,24 @@ def _now_iso(clock: Clock) -> str:
 
 
 def _parse_iso(ts: str | None) -> float | None:
+    # A renewTime written by another client with no fractional seconds —
+    # or RFC3339Nano's nine digits — must NOT parse to None, or the
+    # challenger treats a live lease as takeable and two leaders run
+    # concurrently.  The fraction is normalized to microseconds by hand:
+    # fromisoformat only accepts arbitrary precision from 3.11 on, and
+    # this package supports 3.10.
     if not ts:
         return None
     try:
-        return datetime.datetime.strptime(
-            ts.rstrip("Z"), "%Y-%m-%dT%H:%M:%S.%f"
-        ).replace(tzinfo=datetime.timezone.utc).timestamp()
+        base = ts.rstrip("Z")
+        frac = "0"
+        if "." in base:
+            base, frac = base.split(".", 1)
+            frac = (frac + "000000")[:6]
+        dt = datetime.datetime.strptime(base, "%Y-%m-%dT%H:%M:%S")
+        return dt.replace(
+            microsecond=int(frac), tzinfo=datetime.timezone.utc
+        ).timestamp()
     except ValueError:
         return None
 

@@ -352,11 +352,40 @@ class OperatorRuntime:
             if self._wake.wait(max(0.05, sleep_for)):
                 self._wake.clear()
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 0.0) -> None:
+        """Stop the serve loop; optionally drain in-flight reconciles.
+
+        ``drain_s > 0`` bounds a wait for reconciles already running on
+        the pool.  On leadership loss this matters: shutdown(wait=False)
+        only cancels *pending* work, and a slow in-flight reconcile that
+        keeps patching status past the takeover window briefly
+        reintroduces the dual-writer the Lease exists to prevent.  The
+        wait is bounded (not ``shutdown(wait=True)``) so a hung metrics
+        source cannot wedge teardown past the successor's takeover.
+        """
         self._stop.set()
         self._wake.set()
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
+            if drain_s <= 0:
+                # No drain requested — and stop() may be running inside a
+                # signal handler on the serve thread itself, where taking
+                # self._lock (held by step()) would self-deadlock.
+                return
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._in_flight:
+                        return
+                time.sleep(0.05)
+            with self._lock:
+                leftover = set(self._in_flight)
+            if leftover:
+                _log.warning(
+                    "stop: %d reconcile(s) still running after %.1fs drain "
+                    "(%s) — a new leader may observe overlapping writes",
+                    len(leftover), drain_s, sorted(leftover),
+                )
 
 
 class CrWatcher:

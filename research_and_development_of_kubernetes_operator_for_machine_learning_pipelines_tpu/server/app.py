@@ -595,6 +595,7 @@ def build_server(
         predictor,
         max_batch_size=config.tpu.max_batch_size,
         on_compile=lambda: metrics.compilations.labels(**metrics.identity).inc(),
+        warmup_full_grid=config.tpu.warmup_full_grid,
     )
     channel = None
     if transport is not None:
@@ -744,7 +745,9 @@ def main(argv: list[str] | None = None) -> None:
                 quantize=config.tpu.quantize,
             )
             engine = InferenceEngine(
-                predictor, max_batch_size=config.tpu.max_batch_size
+                predictor,
+                max_batch_size=config.tpu.max_batch_size,
+                warmup_full_grid=config.tpu.warmup_full_grid,
             )
             gen_engine = None
             if predictor.causal_lm is not None:

@@ -49,9 +49,15 @@ class InferenceEngine:
         predictor: Predictor,
         max_batch_size: int = 32,
         on_compile: Callable[[], None] | None = None,
+        warmup_full_grid: bool = False,
     ):
         self.predictor = predictor
         self.max_batch_size = int(max_batch_size)
+        # Latency-sensitive deployments (CRD spec.tpu.warmupFullGrid) warm
+        # the full batch x length grid: with a cold persistent compile
+        # cache, an interior bucket (e.g. batch 4 at a non-base length)
+        # otherwise pays its XLA compile on first live traffic.
+        self.warmup_full_grid = bool(warmup_full_grid)
         self._on_compile = on_compile
         self._seen_signatures: set[tuple] = set()
         self._lock = threading.Lock()
@@ -127,7 +133,9 @@ class InferenceEngine:
         # the batch-grid edges (batch 1 and max).  The full batch x length
         # grid would be |buckets|^2 cold compiles; the edges cover lone
         # requests and saturated batches, and the persistent compile
-        # cache fills the interior once, fleet-wide.
+        # cache fills the interior once, fleet-wide.  warmup_full_grid
+        # opts into the whole grid for deployments that cannot afford a
+        # single cold-cache first-hit compile stall.
         seq_pad = getattr(self.predictor, "seq_pad", None)
         if seq_pad:
             axis = int(seq_pad.get("axis", 1))
@@ -151,10 +159,13 @@ class InferenceEngine:
                 from .batching import seq_buckets
 
                 base_len = example[pad_names[0]].shape[axis]
+                grid_batches = (
+                    buckets if self.warmup_full_grid else (1, self.max_batch_size)
+                )
                 for length in seq_buckets(seq_pad):
                     if length == base_len:
                         continue  # base length covered above
-                    for b in (1, self.max_batch_size):
+                    for b in grid_batches:
                         predict(at_length(b, length))
                         n_shapes += 1
         dt = time.perf_counter() - t0

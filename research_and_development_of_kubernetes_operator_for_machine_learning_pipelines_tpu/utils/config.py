@@ -193,6 +193,11 @@ class TpuSpec:
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
     quantize: str = "none"  # none | int8 (weights) | int8kv (weights+KV cache)
     prefill_chunk: int | None = None  # chunked prefill (decode interleaving)
+    # Warm the FULL batch x seq-length compile grid at startup instead of
+    # the edges (batch 1 / max per length).  Costs |batch buckets| x
+    # |length buckets| cold compiles; buys zero first-hit compile stalls
+    # even with a cold persistent cache.
+    warmup_full_grid: bool = False
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
@@ -208,6 +213,7 @@ class TpuSpec:
             compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
             quantize=_parse_quantize(spec.get("quantize", "none")),
             prefill_chunk=_parse_prefill_chunk(spec.get("prefillChunk")),
+            warmup_full_grid=bool(spec.get("warmupFullGrid", False)),
         )
 
     @property

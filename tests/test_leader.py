@@ -175,3 +175,33 @@ def test_holder_steps_down_before_challenger_threshold():
             FakeKube(), lease_duration_s=10, renew_interval_s=2,
             renew_deadline_s=10,
         )
+
+
+def test_renew_time_without_fractional_seconds_is_still_fresh():
+    """A renewTime written by another client (or hand-edited) without the
+    '.%f' part must parse: treating it as unparseable reads a LIVE lease
+    as immediately takeable — two active leaders (ADVICE r2)."""
+    kube, clock = FakeKube(), FakeClock()
+    a = elector(kube, clock, "a")
+    assert a.try_acquire_or_renew()
+    ref = ObjectRef(namespace="tpumlops-system", name="tpumlops-operator", **LEASE)
+    lease = kube.get(ref)
+    # FakeClock epoch 0 == 1970-01-01T00:00:00, written with no fraction.
+    lease["spec"]["renewTime"] = "1970-01-01T00:00:00Z"
+    kube.replace(ref, lease)
+    b = elector(kube, clock, "b")
+    assert b.try_acquire_or_renew() is False  # live lease: hands off
+    clock.advance(16)
+    assert b.try_acquire_or_renew() is True  # expiry semantics intact
+
+
+def test_parse_iso_accepts_varied_precision():
+    from tpumlops.operator.leader import _parse_iso
+
+    assert _parse_iso("2026-07-30T19:00:00Z") == _parse_iso(
+        "2026-07-30T19:00:00.000000Z"
+    )
+    assert _parse_iso("2026-07-30T19:00:00.5Z") is not None
+    assert _parse_iso("not-a-timestamp") is None
+    assert _parse_iso(None) is None
+    assert _parse_iso("") is None

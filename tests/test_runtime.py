@@ -183,3 +183,42 @@ def test_serial_default_unchanged():
     assert rt._pool is None  # default stays deterministic for FakeClock tests
     rt.step()
     assert kube.get(ObjectRef(namespace="models", name="iris", **SELDONDEPLOYMENT))
+
+
+def test_stop_drains_in_flight_reconciles():
+    """Leadership loss: ``stop(drain_s)`` waits (bounded) for reconciles
+    already RUNNING on the pool — shutdown(wait=False) only cancels
+    pending ones, and a still-writing reconcile past the takeover window
+    is the dual-writer the Lease exists to prevent (ADVICE r2)."""
+    import threading
+    import time as _t
+
+    from tpumlops.utils.clock import SystemClock
+
+    kube, registry, metrics = FakeKube(), FakeRegistry(), FakeMetrics()
+    entered, release = threading.Event(), threading.Event()
+    real = registry.get_version_by_alias
+
+    def slow(model, alias):
+        entered.set()
+        release.wait(10)
+        return real(model, alias)
+
+    registry.get_version_by_alias = slow
+    make_cr(kube, "m0")
+    registry.register("m0", "1", "mlflow-artifacts:/1/m0/artifacts/model")
+    registry.set_alias("m0", "champion", "1")
+    rt = OperatorRuntime(
+        kube, registry, metrics, SystemClock(), max_concurrent_reconciles=2
+    )
+    rt.step()
+    assert entered.wait(5)
+
+    t = threading.Thread(target=lambda: rt.stop(drain_s=8.0), daemon=True)
+    t.start()
+    _t.sleep(0.2)
+    assert t.is_alive()  # drain in progress while the reconcile runs
+    release.set()
+    t.join(timeout=5)
+    assert not t.is_alive()  # returned as soon as the reconcile finished
+    assert not rt._in_flight

@@ -83,3 +83,40 @@ def test_stop_fails_queued_futures():
     with pytest.raises((RuntimeError, Exception)):
         if f2.exception(timeout=1):
             raise f2.exception()
+
+
+def _grid_predictor(traced):
+    def predict(x):
+        traced.append(tuple(x.shape))  # recorded at trace time: one per shape
+        return x.sum(axis=-1)
+
+    return Predictor(
+        name="t",
+        predict=predict,
+        jittable=True,
+        example_input=lambda b: {"x": np.zeros((b, 16), np.float32)},
+        seq_pad={"axis": 1, "max_len": 64, "min_bucket": 16, "pad_values": {"x": 0}},
+    )
+
+
+def test_warmup_default_warms_length_ladder_edges_only():
+    traced = []
+    engine = InferenceEngine(_grid_predictor(traced), max_batch_size=4)
+    engine.warmup()
+    # base length: every batch bucket; other lengths: batch 1 and max only
+    assert (2, 16) in traced
+    assert (1, 32) in traced and (4, 32) in traced
+    assert (2, 32) not in traced and (2, 64) not in traced
+
+
+def test_warmup_full_grid_covers_interior_buckets():
+    """spec.tpu.warmupFullGrid: interior batch buckets at non-base lengths
+    must be compiled at startup, not on first live traffic (ADVICE r2)."""
+    traced = []
+    engine = InferenceEngine(
+        _grid_predictor(traced), max_batch_size=4, warmup_full_grid=True
+    )
+    engine.warmup()
+    for b in (1, 2, 4):
+        for s in (16, 32, 64):
+            assert (b, s) in traced, (b, s)
