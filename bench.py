@@ -49,24 +49,76 @@ def _percentiles(samples: list[float], ps=(50, 99)) -> dict[int, float]:
 
 BATCH = 32
 SEQ = 128
-PIPELINE = 64  # batches in flight per timed run (amortizes host<->device RTT)
 RUNS = 8
 
+# v5e single-chip peaks (public spec sheet): roofline denominators so every
+# entry reports how much of the hardware it actually uses (VERDICT r2 #5).
+V5E_BF16_TFLOPS = 197.0
+V5E_INT8_TOPS = 394.0
+V5E_HBM_GBPS = 819.0
 
-def _timed(f, *args, runs: int = 6, inner: int = 100) -> dict[int, float]:
-    """Compile, then time ``inner`` pipelined dispatches per sample —
-    the shared methodology for every jit-level number here (single-call
-    block_until_ready would measure the host<->device tunnel RTT)."""
-    f(*args).block_until_ready()
+# Published GPU anchors (BASELINE.md "GPU anchor points" — cited figures
+# carried in at build time; no GPU or network exists here).  vs_gpu > 1
+# means the v5e-1 path beats the anchor.
+GPU_ANCHORS = {
+    "bert_b32_s128_t4_int8_ms": 9.5,
+    "bert_b32_s128_a100_ms": 2.0,
+    "resnet50_t4_img_s": 5600.0,
+    "resnet50_a100_img_s": 36000.0,
+    "llama7b_a100_80g_tok_s": 1900.0,
+}
+
+
+def _scan_delta_timed(
+    make_step, carry, runs: int = 6, n1: int = 8, n2: int = 40
+) -> dict[int, float]:
+    """p50/p99 seconds per model iteration from two-length on-device scans.
+
+    THE timing methodology of record (round 3).  Round 1-2 pipelined N
+    independent dispatches and divided the wall by N; this round the
+    device tunnel started overlapping/eliding dispatches whose outputs
+    nothing consumes — ResNet-50 b8 "measured" 0.08 ms/fwd that way, an
+    impossible 410 TFLOP/s (true on-device number: ~4.9 ms).  So the
+    timed region is now ONE dispatch whose iterations are chained by a
+    data dependency the compiler cannot fold: ``lax.scan`` where each
+    step's carry is gated on the model output (``make_step(c) -> (c2,
+    probe)``).  Timing two scan lengths and differencing cancels the
+    constant dispatch + tunnel cost; noise enters at RTT-jitter/(n2-n1).
+    Cross-checked against chained-dispatch and component-sum ablations
+    (scripts/profile_bert_int8*.py): int8 BERT 4.71 ms scan-delta vs
+    4.97 ms chained-dispatch (the 0.26 ms is per-dispatch overhead the
+    scan correctly excludes)."""
+    import jax
+
+    def make(n):
+        @jax.jit
+        def f(carry):
+            return jax.lax.scan(
+                lambda c, _: make_step(c), carry, None, length=n
+            )[1]
+
+        return f
+
+    f1, f2 = make(n1), make(n2)
+    f1(carry).block_until_ready()
+    f2(carry).block_until_ready()
+
+    def wall(f):
+        t0 = time.perf_counter()
+        f(carry).block_until_ready()
+        return time.perf_counter() - t0
+
     samples = []
     for _ in range(runs):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(inner):
-            out = f(*args)
-        out.block_until_ready()
-        samples.append((time.perf_counter() - t0) / inner)
+        samples.append(max(0.0, (wall(f2) - wall(f1)) / (n2 - n1)))
     return _percentiles(samples)
+
+
+def _gate(c, logits):
+    """Multiply the carry by a runtime-dependent 1 so scan iterations form
+    a true data chain (XLA cannot hoist or elide the body).  The -1e30
+    threshold (not -inf) keeps the compare un-foldable."""
+    return c * (logits.sum() > -1e30).astype(c.dtype)
 
 
 def _setup_jax():
@@ -80,31 +132,41 @@ def _setup_jax():
 
 
 def bench_bert() -> dict:
-    """Per-batch latency with PIPELINE batches in flight, int8 and bf16.
+    """Per-batch latency via the scan-delta methodology, int8 and bf16.
 
     Single-call block_until_ready timing would measure the host<->device
-    round trip (65+ ms through a tunnel in dev environments), not the chip.
-    A serving process keeps the dispatch queue full, so per-batch latency
-    under pipelining is the number that governs throughput and the
-    Prometheus histograms the gate reads.
+    round trip (65+ ms through a tunnel in dev environments), not the
+    chip; pipelined independent dispatches get overlapped/elided by the
+    round-3 tunnel.  The on-device scan chain is what a saturated serving
+    process achieves, and its per-batch latency governs throughput and
+    the Prometheus histograms the gate reads.
 
-    Numerics: int8 is the headline (dense_q8 feeds the MXU true s8
-    operands — compiled HLO shows the packed (4,1) s8 convolution; ~8%
-    over bf16 end-to-end, bounded by Amdahl: attention einsums, norms and
-    the activation-quant overhead stay bf16/VPU).  Variants measured on
-    chip and REJECTED for the bf16 path (b32/s128, p50 per batch): XLA
-    einsum attention 7.47 ms beats both a prefolded fused-QKV matmul
-    (7.89 ms — XLA already merges the three projections) and the Pallas
-    flash kernel (9.56 ms — at s=128 the whole KV fits one block; flash
-    wins at 8k, see ops/flash_attention.py).
+    Numerics: int8 + tanh-GELU is the headline — what the int8 serving
+    path runs (loader._finish_native).  The round-3 ablation
+    (scripts/profile_bert_int8*.py) priced the int8 batch: 72 GEMMs with
+    dynamic act-quant 3.7 ms (188 TFLOP/s — act quant is FREE, fused
+    into the s8 matmuls), exact-erf GELU ~1.8 ms of UNFUSED VPU work,
+    attention core ~0.9 ms, LayerNorm ~0.24 ms, softmax ~0.11 ms.
+    Swapping erf for the tanh approximation (error ~1e-3, under int8
+    quant noise; argmax parity asserted below) fuses the activation into
+    the matmul epilogue: 6.8 -> ~5.0 ms p50, ~1.4x over bf16-erf.
+    Variants measured on chip and REJECTED: prefolded fused-QKV matmul
+    (XLA already merges the projections), Pallas flash at s=128 (whole
+    KV fits one block; flash wins at 8k, see ops/flash_attention.py),
+    merged-(b,n) attention batched GEMMs (7.25 ms — worse than XLA's
+    own einsum lowering), bf16 softmax (no change — already fused).
     """
     jax = _setup_jax()
+    import numpy as np
     import jax.numpy as jnp
 
     from tpumlops.models import bert
     from tpumlops.models.quantization import quantize_bert
 
-    cfg = bert.BertConfig.base()
+    cfg = bert.BertConfig.base()  # exact erf GELU: HF reference numerics
+    # What the int8 serving path actually runs (loader._finish_native):
+    # tanh-GELU — erf is ~1.8 ms of unfused VPU work per batch on v5e.
+    cfg_srv = bert.BertConfig.base(hidden_act="gelu_tanh")
     params = bert.init(jax.random.key(0), cfg)
     qparams = quantize_bert(params)
     ids = jax.random.randint(jax.random.key(1), (BATCH, SEQ), 0, cfg.vocab_size)
@@ -113,9 +175,48 @@ def bench_bert() -> dict:
     f = jax.jit(
         lambda p, i, m: bert.classify(p, i, m, cfg=cfg, dtype=jnp.bfloat16)
     )
-    q8 = _timed(f, qparams, ids, mask, runs=RUNS, inner=PIPELINE)
-    bf16 = _timed(f, params, ids, mask, runs=RUNS, inner=PIPELINE)
-    return {"int8": q8, "bf16": bf16}
+    f_srv = jax.jit(
+        lambda p, i, m: bert.classify(p, i, m, cfg=cfg_srv, dtype=jnp.bfloat16)
+    )
+
+    def step_srv(c):
+        logits = bert.classify(qparams, c, mask, cfg=cfg_srv, dtype=jnp.bfloat16)
+        return _gate(c, logits), logits[0, 0]
+
+    def step_ref(c):
+        logits = bert.classify(params, c, mask, cfg=cfg, dtype=jnp.bfloat16)
+        return _gate(c, logits), logits[0, 0]
+
+    q8 = _scan_delta_timed(step_srv, ids, runs=RUNS)
+    bf16 = _scan_delta_timed(step_ref, ids, runs=RUNS)
+
+    # Parity of the served numerics (int8 weights+acts, tanh GELU) against
+    # the bf16 erf reference on the bench batch: the approximation must
+    # not flip classifications.  HARD assertion — a numerics regression
+    # must fail the bench, not quietly ship a lower agreement number.
+    ref = np.asarray(f(params, ids, mask))
+    srv = np.asarray(f_srv(qparams, ids, mask))
+    agree = float(np.mean(ref.argmax(-1) == srv.argmax(-1)))
+    max_delta = float(np.max(np.abs(ref - srv)))
+    assert agree >= 0.97, (
+        f"int8+tanh flipped {100 * (1 - agree):.1f}% of argmaxes vs bf16-erf"
+    )
+
+    # Roofline: encoder GEMMs + attention einsum FLOPs per batch.
+    T, H, I = BATCH * SEQ, cfg.hidden_size, cfg.intermediate_size
+    flops = cfg.num_layers * (
+        2 * T * (4 * H * H + 2 * H * I)
+        + 2 * 2 * BATCH * cfg.num_heads * SEQ * SEQ * cfg.head_dim
+    )
+    return {
+        "int8": q8,
+        "bf16": bf16,
+        "parity": {"argmax_agreement": agree, "max_logit_delta": round(max_delta, 4)},
+        "tflops_int8": flops / q8[50] / 1e12,
+        "tflops_bf16": flops / bf16[50] / 1e12,
+        "mfu_int8": flops / q8[50] / 1e12 / V5E_INT8_TOPS,
+        "mfu_bf16": flops / bf16[50] / 1e12 / V5E_BF16_TFLOPS,
+    }
 
 
 def bench_torch_cpu(iters: int = 3) -> dict[int, float]:
@@ -248,9 +349,47 @@ def bench_serve_path() -> dict:
             "requests": len(lats),
         }
 
+    def scrape_means(base: str) -> dict[str, tuple[float, float]]:
+        """(sum, count) per relevant histogram from the server's own
+        /metrics — the series the promotion gate judges."""
+        import re
+
+        text = (
+            urllib.request.urlopen(f"{base}/metrics", timeout=10)
+            .read()
+            .decode()
+        )
+        out = {}
+        for name in (
+            "seldon_api_executor_client_requests_seconds",
+            "tpumlops_queue_seconds",
+            "tpumlops_batch_run_seconds",
+        ):
+            s = re.findall(rf"^{name}_sum{{[^}}]*}} ([0-9.e+-]+)", text, re.M)
+            c = re.findall(rf"^{name}_count{{[^}}]*}} ([0-9.e+-]+)", text, re.M)
+            out[name] = (sum(map(float, s)), sum(map(float, c)))
+        return out
+
     router = None
     try:
-        direct = measure(f"http://127.0.0.1:{port}/v2/models/bert/infer")
+        base = f"http://127.0.0.1:{port}"
+        before = scrape_means(base)
+        direct = measure(f"{base}/v2/models/bert/infer")
+        after = scrape_means(base)
+
+        def mean_ms(name: str) -> float:
+            ds = after[name][0] - before[name][0]
+            dc = after[name][1] - before[name][1]
+            return ds / dc * 1000 if dc else 0.0
+
+        # Per-request server-side decomposition, env-independent: what
+        # the server observed minus queue wait minus the device dispatch
+        # itself = JSON/HTTP/glue overhead (queue+run are per-batch
+        # means — a close per-request proxy at batch_per_request=1).
+        total_ms = mean_ms("seldon_api_executor_client_requests_seconds")
+        queue_ms = mean_ms("tpumlops_queue_seconds")
+        run_ms = mean_ms("tpumlops_batch_run_seconds")
+        server_overhead_ms = round(total_ms - queue_ms - run_ms, 2)
 
         # Same requests through the native router (the Istio-split stand-in).
         from tpumlops.clients.router import RouterProcess
@@ -273,6 +412,10 @@ def bench_serve_path() -> dict:
         "router_overhead_p50_ms": round(
             routed["p50_ms"] - direct["p50_ms"], 2
         ),
+        "server_observed_mean_ms": round(total_ms, 2),
+        "server_queue_mean_ms": round(queue_ms, 2),
+        "server_device_run_mean_ms": round(run_ms, 2),
+        "server_overhead_ms": server_overhead_ms,
         "clients": 8,
         "batch_per_request": 1,
         "numerics": "int8",
@@ -316,6 +459,7 @@ def bench_time_to_100() -> dict:
         RouterSync,
     )
     from tpumlops.operator.runtime import OperatorRuntime
+    from tpumlops.operator.telemetry import OperatorTelemetry
     from tpumlops.utils.clock import SystemClock
 
     STEP_INTERVAL = 0.5
@@ -341,12 +485,14 @@ def bench_time_to_100() -> dict:
         registry = FakeRegistry()
         registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
         registry.set_alias("iris", "prod", "1")
+        telemetry = OperatorTelemetry()
         rt = OperatorRuntime(
             kube,
             registry,
             metrics=RouterMetricsSource(router.admin),
             clock=SystemClock(),
             sync_interval_s=0.05,
+            telemetry=telemetry,
         )
         CRREF = ObjectRef(
             namespace="bench",
@@ -381,6 +527,26 @@ def bench_time_to_100() -> dict:
             time.sleep(0.05)
         assert status().get("phase") == "Stable", status()
 
+        def component_sums() -> dict[str, float]:
+            import re
+
+            text = telemetry.exposition().decode()
+            out: dict[str, float] = {}
+            for m in re.finditer(
+                r'tpumlops_operator_step_component_seconds_sum{[^}]*'
+                r'component="(\w+)"[^}]*} ([0-9.e+-]+)',
+                text,
+            ):
+                out[m.group(1)] = out.get(m.group(1), 0.0) + float(m.group(2))
+            m = re.search(
+                r"tpumlops_operator_reconcile_seconds_sum{[^}]*} ([0-9.e+-]+)",
+                text,
+            )
+            out["_step_total"] = float(m.group(1)) if m else 0.0
+            return out
+
+        comp0 = component_sums()
+
         # Canary: flip the alias, time to Stable at 100%.
         registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
         registry.set_alias("iris", "prod", "2")
@@ -394,6 +560,16 @@ def bench_time_to_100() -> dict:
         measured = time.monotonic() - t0
         s = status()
         assert s.get("phase") == "Stable" and s.get("currentModelVersion") == "2", s
+        comp1 = component_sums()
+        breakdown_ms = {
+            k: round((comp1.get(k, 0.0) - comp0.get(k, 0.0)) * 1000, 1)
+            for k in sorted(set(comp0) | set(comp1))
+            if k != "_step_total"
+        }
+        step_total_ms = round(
+            (comp1.get("_step_total", 0.0) - comp0.get("_step_total", 0.0)) * 1000,
+            1,
+        )
     finally:
         for gen in gens:
             gen.__exit__()
@@ -415,6 +591,16 @@ def bench_time_to_100() -> dict:
         "step_interval_s": STEP_INTERVAL,
         "ref_floor_same_policy_s": 480,
         "traffic_split": "native router (smooth WRR), gate on its live histograms",
+        # Where the reconcile-step time inside the canary went (operator
+        # telemetry component histograms; remainder = state machine +
+        # event emission + scheduler glue).  VERDICT r2 #10.
+        "overhead_breakdown_ms": {
+            **breakdown_ms,
+            "reconcile_steps_total": step_total_ms,
+            "other": round(
+                step_total_ms - sum(breakdown_ms.values()), 1
+            ),
+        },
     }
 
 
@@ -434,7 +620,13 @@ def bench_iris() -> dict:
     sk = LogisticRegression(max_iter=500).fit(X, y)
     params, cfg = linear.from_sklearn(sk)
     x = jax.numpy.asarray(X[:32], jax.numpy.float32)
-    p = _timed(jax.jit(lambda x: linear.predict(params, x, cfg)), x, inner=200)
+
+    def step(c):
+        out = linear.predict(params, c, cfg)
+        return _gate(c, out), out[0]
+
+    # µs-scale body: long scans so the delta rises above RTT jitter.
+    p = _scan_delta_timed(step, x, n1=512, n2=8192)
     return {"p50_us": round(p[50] * 1e6, 1), "batch": 32}
 
 
@@ -485,7 +677,12 @@ def bench_xgboost() -> dict:
     arrs, _obj = tabular.from_xgboost_json(model)
     fn, form = tabular.lower_forest(arrs)
     x = jax.numpy.asarray(rng.normal(size=(256, n_feat)), jax.numpy.float32)
-    p = _timed(jax.jit(fn), x)
+
+    def step(c):
+        out = fn(c)
+        return _gate(c, out), out.reshape(-1)[0]
+
+    p = _scan_delta_timed(step, x, n1=128, n2=1024)
     return {
         "p50_us": round(p[50] * 1e6, 1),
         "trees": n_trees,
@@ -495,6 +692,10 @@ def bench_xgboost() -> dict:
 
 
 def bench_resnet() -> dict:
+    """ResNet-50 batch ladder (VERDICT r2 #6): b8 is the latency point;
+    b32/b128 are the throughput points where conv im2col tiles fill the
+    MXU.  ``mfu`` uses ~4.1 GFLOP per 224x224 forward (fwd conv+fc MACs
+    x2) against the v5e bf16 peak."""
     jax = _setup_jax()
     import jax.numpy as jnp
 
@@ -502,25 +703,100 @@ def bench_resnet() -> dict:
 
     cfg = resnet.ResNetConfig.resnet50()
     params = resnet.init(jax.random.key(0), cfg)
-    x = jax.random.normal(jax.random.key(1), (8, 224, 224, 3), jnp.bfloat16)
-    p = _timed(jax.jit(lambda p, x: resnet.forward(p, x, cfg)), params, x, inner=32)
-    return {
-        "p50_ms": round(p[50] * 1000, 3),
-        "img_per_s": round(8 / p[50], 1),
-        "batch": 8,
+    FLOPS_PER_IMG = 4.1e9
+    out = {"ladder": {}}
+    best = None
+    for batch, (n1, n2) in ((8, (8, 48)), (32, (4, 24)), (128, (2, 10))):
+        x = jax.random.normal(
+            jax.random.key(1), (batch, 224, 224, 3), jnp.bfloat16
+        )
+
+        def step(c):
+            out = resnet.forward(params, c, cfg)
+            return _gate(c, out), out[0, 0]
+
+        p = _scan_delta_timed(step, x, n1=n1, n2=n2)
+        tflops = batch * FLOPS_PER_IMG / p[50] / 1e12
+        entry = {
+            "p50_ms": round(p[50] * 1000, 3),
+            "img_per_s": round(batch / p[50], 1),
+            "tflops": round(tflops, 1),
+            "mfu": round(tflops / V5E_BF16_TFLOPS, 3),
+        }
+        out["ladder"][str(batch)] = entry
+        if best is None or entry["img_per_s"] > best["img_per_s"]:
+            best = entry
+    out.update(best)
+    out["vs_gpu_baseline"] = {
+        "t4_int8_mlperf": round(best["img_per_s"] / GPU_ANCHORS["resnet50_t4_img_s"], 2),
+        "a100_int8_mlperf": round(
+            best["img_per_s"] / GPU_ANCHORS["resnet50_a100_img_s"], 2
+        ),
     }
+    return out
+
+
+def _decode_device_loop(jax, params, cfg, slots: int, *, kv_quant: bool,
+                        window: int, position: int, n1: int = 8,
+                        n2: int = 40) -> float:
+    """Seconds per decode step via the scan-delta methodology: the decode
+    chain (token + cache feedback) runs entirely on device, so the only
+    host contribution is the dispatch constant the two-length delta
+    cancels."""
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+
+    if kv_quant:
+        cache = llama.QuantRaggedKVCache.create(cfg, slots)
+    else:
+        cache = llama.RaggedKVCache.create(cfg, slots, jnp.bfloat16)
+    cache = cache._replace(lengths=jnp.full((slots,), position, jnp.int32))
+    toks0 = jnp.ones((slots, 1), jnp.int32)
+
+    from tpumlops.models import llama as _llama
+
+    def step(carry):
+        toks, cache = carry
+        logits, cache = _llama.decode_ragged(
+            params, toks, cache, cfg, window=window
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        return (nxt, cache), nxt[0, 0]
+
+    p = _scan_delta_timed(step, (toks0, cache), n1=n1, n2=n2)
+    return p[50]
+
+
+def _decode_hbm_bytes(params, cfg, slots: int, window: int, kv_quant: bool) -> int:
+    """HBM bytes one decode step must stream: all weights (as stored) +
+    the attended KV window (k+v, + f32 scales when quantized)."""
+    from tpumlops.models.quantization import quantized_bytes
+
+    kv_elem = slots * window * cfg.num_kv_heads * cfg.head_dim * cfg.num_layers
+    kv = 2 * kv_elem * (1 if kv_quant else 2)
+    if kv_quant:  # per-(pos, head) f32 scale, head_dim amortized
+        kv += 2 * kv_elem // cfg.head_dim * 4
+    return quantized_bytes(params) + kv
 
 
 def bench_llama_decode() -> dict:
-    """Continuous-batching decode tok/s at a 1.35B shape: int8 weights +
-    windowed attention (the round-1 on-chip recipe), 8 active slots at
-    position ~256, capacity 1024."""
+    """Continuous-batching decode at a 1.35B shape: int8 weights + int8 KV
+    cache + windowed attention, slots laddered 8..64 (VERDICT r2 #2).
+
+    Decode is HBM-bound — every step re-reads all weights, so tok/s rises
+    with slot count until the KV-cache traffic (which grows with slots)
+    dominates; the ladder locates that knee and ``bw_util`` reports each
+    point against the v5e ~819 GB/s roofline.  int8kv numerics are gated
+    by a teacher-forced logit-parity fixture vs the bf16 cache (VERDICT
+    r2 #4).
+    """
     jax = _setup_jax()
     import jax.numpy as jnp
     import numpy as np
 
     from tpumlops.models import llama
-    from tpumlops.server.generation import GenerationEngine
+    from tpumlops.models.quantization import quantize_llama
 
     cfg = llama.LlamaConfig(
         vocab_size=32000,
@@ -531,66 +807,163 @@ def bench_llama_decode() -> dict:
         intermediate_size=5632,
         max_seq=1024,
     )
-    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
-    from tpumlops.models.quantization import quantize_llama
+    params = quantize_llama(llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16))
 
-    params = quantize_llama(params)
-
-    step_samples: list[tuple[int, float]] = []
-    engine = GenerationEngine(
-        params,
-        cfg,
-        max_slots=8,
-        dtype=jnp.bfloat16,
-        on_step=lambda active, dt: step_samples.append((active, dt)),
+    # --- int8kv greedy-parity fixture (small capacity bounds compile) ---
+    cfg_p = llama.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads, intermediate_size=cfg.intermediate_size,
+        max_seq=64,
     )
-    engine.start(warmup=True)
-    try:
-        prompt = np.ones((256,), np.int32).tolist()
-        futs = [engine.submit(prompt, 60) for _ in range(8)]
-        for f in futs:
-            f.result(timeout=600)
-    finally:
-        engine.shutdown()
-    full = [(a, dt) for a, dt in step_samples if a == 8]
-    toks = sum(a for a, _ in full)
-    secs = sum(dt for _, dt in full)
-    engine_tok_s = round(toks / secs, 1) if secs else None
+    # Teacher-forced: BOTH cache types see the identical token stream, so
+    # the per-step logit error isolates KV rounding alone.  (Greedy
+    # continuations diverge chaotically under random-init weights — the
+    # logit gap between top tokens is ~bf16 noise — so token-match is not
+    # a falsifiable test here; per-step logit error is.)
+    fixture = np.asarray(
+        [[1, 42, 7, 99, 1234, 567, 31999, 2, 13, 17] + list(range(100, 116))],
+        np.int32,
+    )
 
-    # Device decode throughput: chained decode steps with NO host sync
-    # between ticks.  The engine number above includes a host round trip
-    # per tick (it must read the token to schedule) — through this dev
-    # environment's device tunnel that RTT is ~60 ms and dominates; on a
-    # real TPU host it is microseconds, so the device-loop number is the
-    # production-relevant one and matches round 1's methodology.
-    cache = llama.RaggedKVCache.create(cfg, 8, jnp.bfloat16)
-    cache = cache._replace(lengths=jnp.full((8,), 256, jnp.int32))
-    toks0 = jnp.ones((8, 1), jnp.int32)
+    def forced_logits(kv_quant: bool):
+        if kv_quant:
+            cache = llama.QuantRaggedKVCache.create(cfg_p, 1)
+        else:
+            cache = llama.RaggedKVCache.create(cfg_p, 1, jnp.bfloat16)
 
-    @jax.jit
-    def step(params, toks, cache):
-        logits, cache = llama.decode_ragged(
-            params, toks, cache, cfg, window=512
+        @jax.jit
+        def step(params, toks, cache):
+            logits, cache = llama.decode_ragged(params, toks, cache, cfg_p)
+            return logits[:, -1].astype(jnp.float32), cache
+
+        outs = []
+        for i in range(fixture.shape[1]):
+            logits, cache = step(params, fixture[:, i : i + 1], cache)
+            outs.append(np.asarray(logits))
+        return np.concatenate(outs, axis=0)  # [T, vocab]
+
+    logits_bf16 = forced_logits(kv_quant=False)
+    logits_q8 = forced_logits(kv_quant=True)
+    rel_err = float(
+        np.max(np.abs(logits_q8 - logits_bf16)) / (np.max(np.abs(logits_bf16)) + 1e-9)
+    )
+    argmax_agree = float(np.mean(logits_q8.argmax(-1) == logits_bf16.argmax(-1)))
+    kv_parity = {
+        "teacher_forced_steps": int(fixture.shape[1]),
+        "max_rel_logit_err": round(rel_err, 4),
+        "argmax_agreement": round(argmax_agree, 3),
+    }
+    assert rel_err < 0.05, (
+        f"int8 KV rel logit error {rel_err:.4f} vs bf16 KV exceeds 5%"
+    )
+
+    # --- slot ladder: device-loop tok/s at position ~256, window 512 ----
+    WINDOW, POS = 512, 256
+    ladder = {}
+    best = None
+    for slots in (8, 16, 32, 64):
+        dt = _decode_device_loop(
+            jax, params, cfg, slots, kv_quant=True, window=WINDOW, position=POS
         )
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
-        return nxt, cache
+        gbps = _decode_hbm_bytes(params, cfg, slots, WINDOW, True) / dt / 1e9
+        entry = {
+            "tok_per_s": round(slots / dt, 1),
+            "ms_per_step": round(dt * 1000, 2),
+            "hbm_gb_per_s": round(gbps, 1),
+            "bw_util": round(gbps / V5E_HBM_GBPS, 3),
+        }
+        ladder[str(slots)] = entry
+        if best is None or entry["tok_per_s"] > best[1]["tok_per_s"]:
+            best = (slots, entry)
 
-    t, c = step(params, toks0, cache)  # compile
-    t.block_until_ready()
-    N = 100
-    t0 = time.perf_counter()
-    for _ in range(N):
-        t, c = step(params, t, c)
-    t.block_until_ready()
-    dev_secs = (time.perf_counter() - t0) / N
     return {
-        "device_tok_per_s": round(8 / dev_secs, 1),
-        "ms_per_step": round(dev_secs * 1000, 2),
-        "engine_tok_per_s_tunnel_rtt_bound": engine_tok_s,
-        "slots": 8,
+        "device_tok_per_s": best[1]["tok_per_s"],
+        "ms_per_step": best[1]["ms_per_step"],
+        "slots": best[0],
+        "slot_ladder": ladder,
+        "bw_util_at_best": best[1]["bw_util"],
         "params_b": 1.35,
-        "numerics": "int8 weights + windowed decode (window=512)",
-        "full_batch_steps": len(full),
+        "numerics": "int8 weights + int8 kv + windowed decode (window=512)",
+        "int8kv_parity_vs_bf16kv": kv_parity,
+        "note": (
+            "engine-loop tok/s is not reported from this dev environment: "
+            "the per-tick host read rides a ~65 ms device tunnel "
+            "(BENCH_r02 measured 70.7 tok/s engine vs 787.6 device for "
+            "identical compute) — the device loop is the chip number."
+        ),
+    }
+
+
+def bench_llama_7b_decode() -> dict:
+    """BASELINE config[4], the real thing: Llama-2-7B geometry, int8
+    weights streamed from the 13 GiB checkpoint (docs/SCALE.md), int8 KV,
+    decode on the single v5e chip (VERDICT r2 #3)."""
+    jax = _setup_jax()
+    import os.path
+
+    ckpt = os.environ.get("BENCH_7B_CKPT", "/root/ckpt7b")
+    if not os.path.isdir(ckpt):
+        return {"skipped": f"7B checkpoint not found at {ckpt} "
+                           "(generate with scripts/gen_7b_checkpoint.py)"}
+
+    from tpumlops.server.loader import load_predictor
+
+    t0 = time.perf_counter()
+    pred = load_predictor(ckpt, quantize="int8")
+    load_s = time.perf_counter() - t0
+    params = pred.causal_lm["params"]
+    cfg = pred.causal_lm["cfg"]
+    # Bound the KV capacity so weights (6.4 GiB int8) + cache fit the
+    # 16 GiB chip across the ladder: 768 positions x 32 slots of int8
+    # k+v at 7B geometry is ~6.6 GiB.
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, max_seq=768)
+
+    from tpumlops.models.quantization import quantized_bytes
+
+    WINDOW, POS = 512, 256
+    ladder = {}
+    best = None
+    for slots in (8, 16, 32):
+        try:
+            dt = _decode_device_loop(
+                jax, params, cfg, slots, kv_quant=True, window=WINDOW,
+                position=POS, n1=4, n2=24,
+            )
+        except Exception as e:  # 32-slot point may exceed HBM; record it
+            ladder[str(slots)] = {"error": f"{type(e).__name__}"}
+            continue
+        gbps = _decode_hbm_bytes(params, cfg, slots, WINDOW, True) / dt / 1e9
+        entry = {
+            "tok_per_s": round(slots / dt, 1),
+            "ms_per_step": round(dt * 1000, 2),
+            "hbm_gb_per_s": round(gbps, 1),
+            "bw_util": round(gbps / V5E_HBM_GBPS, 3),
+        }
+        ladder[str(slots)] = entry
+        if best is None or entry["tok_per_s"] > best[1]["tok_per_s"]:
+            best = (slots, entry)
+    if best is None:
+        return {"error": "all ladder points failed", "slot_ladder": ladder,
+                "load_s": round(load_s, 1)}
+
+    return {
+        "device_tok_per_s": best[1]["tok_per_s"],
+        "ms_per_step": best[1]["ms_per_step"],
+        "slots": best[0],
+        "slot_ladder": ladder,
+        "bw_util_at_best": best[1]["bw_util"],
+        "params_b": 6.74,
+        "weight_bytes_gib": round(quantized_bytes(params) / 2**30, 2),
+        "load_s": round(load_s, 1),
+        "numerics": "int8 weights + int8 kv + windowed decode (window=512)",
+        "vs_gpu_baseline": {
+            "a100_80g_fp16_vllm": round(
+                best[1]["tok_per_s"] / GPU_ANCHORS["llama7b_a100_80g_tok_s"], 2
+            ),
+        },
     }
 
 
@@ -611,16 +984,17 @@ def main() -> None:
     # warmed bucket is a real compile and the expensive benches can eat
     # tens of minutes cold.  Past the budget the remaining entries are
     # marked skipped — the headline line must always print.
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "900"))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1800"))
     t_start = time.monotonic()
     secondary = {}
     for name, fn in (
         ("time_to_100pct_traffic", bench_time_to_100),
         ("iris_sklearn_linear", bench_iris),
         ("xgboost_forest", bench_xgboost),
-        ("resnet50_b8", bench_resnet),
+        ("resnet50", bench_resnet),
         ("llama_1p35b_decode", bench_llama_decode),
         ("serve_path_http", bench_serve_path),
+        ("llama_7b_decode", bench_llama_7b_decode),
     ):
         if time.monotonic() - t_start > budget_s:
             secondary[name] = {"skipped": f"wall budget {budget_s:.0f}s spent"}
@@ -637,10 +1011,27 @@ def main() -> None:
         "unit": "ms",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
         "p50_ms": round(tpu[50] * 1000, 3),
-        "numerics": "int8 (MXU s8 path; bf16 comparison in bf16_p99_ms)",
+        "numerics": (
+            "int8 acts+weights on the MXU s8 path, tanh-GELU (the int8 "
+            "serving default; bf16 erf comparison in bf16_p99_ms)"
+        ),
+        "parity_vs_bf16_erf": b["parity"],
         "bf16_p99_ms": round(b["bf16"][99] * 1000, 3),
         "throughput_seq_per_s": round(BATCH / tpu[50], 1),
+        "tflops": round(b["tflops_int8"], 1),
+        "mfu_vs_s8_peak": round(b["mfu_int8"], 3),
+        "bf16_tflops": round(b["tflops_bf16"], 1),
+        "bf16_mfu": round(b["mfu_bf16"], 3),
         "baseline_cpu_p99_ms": round(baseline_ms, 1) if baseline_ms else None,
+        # Published GPU anchors (BASELINE.md): >1 = faster than the anchor.
+        "vs_gpu_baseline": {
+            "t4_int8": round(
+                GPU_ANCHORS["bert_b32_s128_t4_int8_ms"] / (tpu[99] * 1000), 2
+            ),
+            "a100": round(
+                GPU_ANCHORS["bert_b32_s128_a100_ms"] / (tpu[99] * 1000), 2
+            ),
+        },
         "hardware": "TPU v5e (1 chip)",
         "secondary": secondary,
     }

@@ -58,6 +58,15 @@ def gelu(x: jax.Array) -> jax.Array:
     return jax.nn.gelu(x, approximate=False)
 
 
+def gelu_tanh(x: jax.Array) -> jax.Array:
+    """Tanh-approximate GELU (max abs error ~1e-3 vs erf, comparable to
+    bf16 rounding).  On v5e the erf polynomial is VPU work XLA does not
+    fuse into the matmul epilogue — measured ~1.8 ms of a 6.8 ms int8
+    BERT-base b32/s128 batch — while the tanh form fuses to ~zero cost;
+    the int8 serving path selects this via ``BertConfig.hidden_act``."""
+    return jax.nn.gelu(x, approximate=True)
+
+
 def take_embedding(table: jax.Array, ids: jax.Array, dtype=None) -> jax.Array:
     out = jnp.take(table, ids, axis=0)
     return out.astype(dtype) if dtype is not None else out

@@ -203,7 +203,11 @@ def _build_bert(
         predict=predict,
         jittable=True,
         example_input=example,
-        metadata={"seq_len": seq_len, "num_labels": cfg.num_labels},
+        metadata={
+            "seq_len": seq_len,
+            "num_labels": cfg.num_labels,
+            "hidden_act": cfg.hidden_act,
+        },
         # Padding is exact for classification: the attention mask (0 on
         # padded keys) removes them from every softmax, and the CLS
         # pooling position is unaffected.  A request without a mask gets

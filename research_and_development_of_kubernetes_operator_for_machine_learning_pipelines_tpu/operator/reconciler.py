@@ -47,6 +47,10 @@ class ReconcileOutcome:
     requeue_after: float  # seconds until the runtime should reconcile again
     events: list[Event] = field(default_factory=list)
     applied: bool = False  # whether a deployment manifest was written
+    # Seconds per operation class within this step (status_patch,
+    # manifest_apply, gate_read, registry) — the overhead breakdown the
+    # time-to-100% bench and operator telemetry report (VERDICT r2 #10).
+    timings: dict = field(default_factory=dict)
 
 
 class Reconciler:
@@ -91,6 +95,7 @@ class Reconciler:
         # entry, and AliasNotFound clears the cache (a deleted/re-created
         # registered model restarts version numbering with new sources).
         self._source_cache: dict[tuple[str, str], str] = {}
+        self._timings: dict[str, float] = {}
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -110,8 +115,32 @@ class Reconciler:
 
     # -- main entry ----------------------------------------------------------
 
+    def _op_timer(self, component: str):
+        """Accumulate wall time of one operation class into the step's
+        timing breakdown (read back through ReconcileOutcome.timings)."""
+        import contextlib
+        import time as _time
+
+        @contextlib.contextmanager
+        def cm():
+            t0 = _time.perf_counter()
+            try:
+                yield
+            finally:
+                self._timings[component] = self._timings.get(
+                    component, 0.0
+                ) + (_time.perf_counter() - t0)
+
+        return cm()
+
     def reconcile(self, obj: dict) -> ReconcileOutcome:
         """One reconcile step for the given CR object (spec+status+metadata)."""
+        self._timings = {}
+        outcome = self._reconcile_inner(obj)
+        outcome.timings = self._timings
+        return outcome
+
+    def _reconcile_inner(self, obj: dict) -> ReconcileOutcome:
         # Prior conditions feed lastTransitionTime stability (state.py).
         self._prior_conditions = (obj.get("status") or {}).get("conditions")
         state = PromotionState.from_status(obj.get("status"))
@@ -123,9 +152,10 @@ class Reconciler:
 
         # 1. Resolve alias -> version (reference :57-62).
         try:
-            mv = self.registry.get_version_by_alias(
-                config.model_name, config.model_alias
-            )
+            with self._op_timer("registry"):
+                mv = self.registry.get_version_by_alias(
+                    config.model_name, config.model_alias
+                )
         except AliasNotFound:
             # A vanished alias often means the registered model was deleted;
             # if it is re-created, version numbers restart at 1 with new
@@ -250,18 +280,19 @@ class Reconciler:
     ) -> ReconcileOutcome:
         canary = config.canary
         source = self._metrics_source(config)
-        new_m = source.model_metrics(
-            self.name,
-            f"v{state.current_version}",
-            self.namespace,
-            canary.metrics_window_s,
-        )
-        old_m = source.model_metrics(
-            self.name,
-            f"v{state.previous_version}",
-            self.namespace,
-            canary.metrics_window_s,
-        )
+        with self._op_timer("gate_read"):
+            new_m = source.model_metrics(
+                self.name,
+                f"v{state.current_version}",
+                self.namespace,
+                canary.metrics_window_s,
+            )
+            old_m = source.model_metrics(
+                self.name,
+                f"v{state.previous_version}",
+                self.namespace,
+                canary.metrics_window_s,
+            )
         self.log.info(
             f"Metrics for new model (version {state.current_version}): {new_m.as_dict()}"
         )
@@ -434,6 +465,12 @@ class Reconciler:
         409 from a concurrent writer kills the handler.  Here Conflict causes
         a re-get and retry.
         """
+        with self._op_timer("manifest_apply"):
+            self._apply_object_inner(ref, manifest, max_retries)
+
+    def _apply_object_inner(
+        self, ref: ObjectRef, manifest: dict, max_retries: int = 3
+    ) -> None:
         for attempt in range(max_retries):
             try:
                 existing = self.kube.get(ref)
@@ -605,7 +642,8 @@ class Reconciler:
         # Later patches in the same reconcile see the fresh conditions.
         self._prior_conditions = status["conditions"]
         try:
-            self.kube.patch_status(self.cr_ref, status)
+            with self._op_timer("status_patch"):
+                self.kube.patch_status(self.cr_ref, status)
         except NotFound:
             # CR deleted mid-step; runtime will stop this reconciler.
             self.log.info("CR gone; skipping status patch.")

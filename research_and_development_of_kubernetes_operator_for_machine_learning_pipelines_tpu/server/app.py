@@ -562,7 +562,10 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
     return GenerationEngine(
         predictor.causal_lm["params"],
         predictor.causal_lm["cfg"],
-        max_slots=min(config.tpu.max_batch_size, 8),
+        # Default stays latency-first; spec.tpu.maxSlots raises it for
+        # throughput (decode re-reads all weights per step — slots
+        # amortize that; see bench.py slot ladder).
+        max_slots=config.tpu.max_slots or min(config.tpu.max_batch_size, 8),
         eos_id=predictor.causal_lm.get("eos_id"),
         on_step=metrics.observe_decode_step if metrics else None,
         on_tokens=metrics.inc_generated_tokens if metrics else None,

@@ -143,7 +143,7 @@ class DynamicBatcher:
         run_batch: Callable[[dict[str, np.ndarray]], Any],
         max_batch_size: int = 32,
         max_batch_delay_ms: float = 5.0,
-        on_batch: Callable[[int, float], None] | None = None,
+        on_batch: Callable[[int, float, float], None] | None = None,
     ):
         self._run_batch = run_batch
         self.max_batch_size = int(max_batch_size)
@@ -233,9 +233,11 @@ class DynamicBatcher:
                 pad = {k: np.repeat(v[-1:], bucket - n, axis=0) for k, v in stacked.items()}
                 stacked = {k: np.concatenate([v, pad[k]], axis=0) for k, v in stacked.items()}
             queue_age = time.perf_counter() - items[0].enqueued_at
+            t_run = time.perf_counter()
             out = self._run_batch(stacked)
+            run_seconds = time.perf_counter() - t_run
             if self._on_batch:
-                self._on_batch(n, queue_age)
+                self._on_batch(n, queue_age, run_seconds)
             outputs = _split_outputs(out, n)
             for i, item in enumerate(items):
                 item.future.set_result(outputs[i])

@@ -220,8 +220,12 @@ def _finish_native(
     builder_kwargs: dict,
     mesh_shape: dict | None,
     quantize: str | None,
+    raw_config: dict | None = None,
 ) -> Predictor:
-    """Shared tail for JAX-native param trees: shard, quantize, build."""
+    """Shared tail for JAX-native param trees: shard, quantize, build.
+
+    ``raw_config`` is the artifact's config dict as written — used to
+    tell an explicit ``hidden_act`` pin apart from a dataclass default."""
     n_devices = 1
     for v in (mesh_shape or {}).values():
         n_devices *= int(v)
@@ -250,6 +254,15 @@ def _finish_native(
             from ..models.quantization import quantize_bert
 
             params = quantize_bert(params)
+            # quantize: int8 is an explicit speed-for-approximation
+            # opt-in, so the MLP activation also drops to tanh-GELU
+            # (error ~1e-3, far under int8 quant noise; erf is ~1.8 ms
+            # of unfused VPU work per b32/s128 batch on v5e).  An
+            # artifact that pins hidden_act keeps its pin.
+            if cfg is not None and "hidden_act" not in (raw_config or {}):
+                import dataclasses
+
+                cfg = dataclasses.replace(cfg, hidden_act="gelu_tanh")
         else:
             raise ModelLoadError(
                 f"quantize={quantize!r} is not supported for flavor "
@@ -325,6 +338,7 @@ def _load_transformers(hf_dir: Path):
                 "TPU-native llama (plain RoPE only)"
             )
         tm = LlamaForCausalLM.from_pretrained(hf_dir)
+        raw_config = {}
         cfg = llama.LlamaConfig(
             vocab_size=int(hf_cfg["vocab_size"]),
             hidden_size=int(hf_cfg["hidden_size"]),
@@ -351,6 +365,22 @@ def _load_transformers(hf_dir: Path):
         from ..models import bert
 
         tm = BertForSequenceClassification.from_pretrained(hf_dir)
+        # HF config.json always pins hidden_act explicitly; serving a
+        # different activation than the checkpoint was trained with
+        # would be silently wrong logits.  "gelu" in HF-land is exact
+        # erf; the *_tanh/_new spellings are the tanh approximation.
+        hf_act = str(hf_cfg.get("hidden_act", "gelu"))
+        act_map = {
+            "gelu": "gelu",
+            "gelu_python": "gelu",
+            "gelu_new": "gelu_tanh",
+            "gelu_pytorch_tanh": "gelu_tanh",
+        }
+        if hf_act not in act_map:
+            raise ModelLoadError(
+                f"unsupported BERT hidden_act {hf_act!r} "
+                f"(supported: {sorted(act_map)})"
+            )
         cfg = bert.BertConfig(
             vocab_size=int(hf_cfg["vocab_size"]),
             hidden_size=int(hf_cfg["hidden_size"]),
@@ -363,10 +393,12 @@ def _load_transformers(hf_dir: Path):
             type_vocab_size=int(hf_cfg.get("type_vocab_size", 2)),
             layer_norm_eps=float(hf_cfg.get("layer_norm_eps", 1e-12)),
             num_labels=int(getattr(tm.config, "num_labels", 2)),
+            hidden_act=act_map[hf_act],
         )
         params = bert.from_torch(tm, cfg)
         flavor = "bert-classifier"
         builder_kwargs = {}
+        raw_config = {"hidden_act": act_map[hf_act]}
     else:
         raise ModelLoadError(
             f"unsupported transformers model_type {model_type!r} "
@@ -378,7 +410,7 @@ def _load_transformers(hf_dir: Path):
         else x,
         params,
     )
-    return flavor, params, cfg, builder_kwargs
+    return flavor, params, cfg, builder_kwargs, raw_config
 
 
 # The llama leaves worth int8-ing at load time (mirrors
@@ -468,14 +500,18 @@ def load_predictor(
             dict(meta.get("builder_kwargs", {})),
             mesh_shape,
             "none" if stream_quant else quantize,
+            raw_config=meta.get("config", {}),
         )
 
     hf_dir = _find_hf_checkpoint(path)
     if hf_dir is not None:
-        flavor, params, cfg, builder_kwargs = _load_transformers(hf_dir)
+        flavor, params, cfg, builder_kwargs, raw_config = _load_transformers(
+            hf_dir
+        )
         _log.info("loaded transformers %s model from %s", flavor, hf_dir)
         return _finish_native(
-            flavor, params, cfg, builder_kwargs, mesh_shape, quantize
+            flavor, params, cfg, builder_kwargs, mesh_shape, quantize,
+            raw_config=raw_config,
         )
 
     if quantize and quantize != "none":

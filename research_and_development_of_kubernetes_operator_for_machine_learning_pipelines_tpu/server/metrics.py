@@ -78,6 +78,18 @@ class ServerMetrics:
             buckets=_LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # Device dispatch wall per batch: with queue_seconds and the
+        # request histogram this decomposes server-observed latency into
+        # queue wait + device run + server overhead (JSON, HTTP, glue) —
+        # the overhead term is environment-independent and benched
+        # (bench.py serve_path server_overhead_ms, VERDICT r2 #7).
+        self.batch_run_seconds = Histogram(
+            "tpumlops_batch_run_seconds",
+            "run_batch (device dispatch) wall time per executed batch",
+            ident_labels,
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
         self.compilations = Counter(
             "tpumlops_compilations_total",
             "XLA compilations triggered (by bucket signature)",
@@ -119,9 +131,12 @@ class ServerMetrics:
             **self.identity, code=str(code), service=service
         ).observe(seconds)
 
-    def observe_batch(self, size: int, queue_seconds: float):
+    def observe_batch(
+        self, size: int, queue_seconds: float, run_seconds: float = 0.0
+    ):
         self.batch_size.labels(**self.identity).observe(size)
         self.queue_seconds.labels(**self.identity).observe(queue_seconds)
+        self.batch_run_seconds.labels(**self.identity).observe(run_seconds)
 
     def observe_decode_step(self, active_slots: int, seconds: float):
         self.decode_batch.labels(**self.identity).observe(active_slots)

@@ -190,6 +190,12 @@ class TpuSpec:
     dtype: str = "bfloat16"
     max_batch_size: int = 32
     max_batch_delay_ms: float = 5.0
+    # Continuous-batching decode slots.  None = min(max_batch_size, 8), a
+    # conservative latency-first default; throughput deployments should
+    # raise it — decode streams the full weights per step, so tok/s rises
+    # near-linearly with slots until the KV cache dominates HBM traffic
+    # (measured curve in bench.py llama_decode.slot_ladder).
+    max_slots: int | None = None
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
     quantize: str = "none"  # none | int8 (weights) | int8kv (weights+KV cache)
     prefill_chunk: int | None = None  # chunked prefill (decode interleaving)
@@ -210,6 +216,9 @@ class TpuSpec:
             dtype=str(spec.get("dtype", "bfloat16")),
             max_batch_size=int(spec.get("maxBatchSize", 32)),
             max_batch_delay_ms=float(spec.get("maxBatchDelayMs", 5.0)),
+            max_slots=(
+                int(spec["maxSlots"]) if spec.get("maxSlots") is not None else None
+            ),
             compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
             quantize=_parse_quantize(spec.get("quantize", "none")),
             prefill_chunk=_parse_prefill_chunk(spec.get("prefillChunk")),

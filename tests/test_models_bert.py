@@ -96,3 +96,54 @@ def test_tp_sharded_encode_matches_unsharded(torch_twin):
     seq, pooled = jax.jit(lambda p, i: bert.encode(p, i, cfg=TINY))(sharded, ids)
     np.testing.assert_allclose(np.asarray(seq), np.asarray(ref_seq), atol=1e-4)
     np.testing.assert_allclose(np.asarray(pooled), np.asarray(ref_pooled), atol=1e-4)
+
+
+def test_gelu_tanh_hidden_act_close_to_exact():
+    """hidden_act="gelu_tanh" (the int8 serving default) stays within the
+    tanh-approximation bound of the exact-erf model — same weights, same
+    inputs, logits within ~1e-2 and identical argmax."""
+    cfg = bert.BertConfig.tiny()
+    cfg_tanh = bert.BertConfig.tiny(hidden_act="gelu_tanh")
+    params = bert.init(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    exact = np.asarray(bert.classify(params, ids, cfg=cfg))
+    approx = np.asarray(bert.classify(params, ids, cfg=cfg_tanh))
+    assert np.max(np.abs(exact - approx)) < 5e-2
+    assert (exact.argmax(-1) == approx.argmax(-1)).all()
+
+
+def test_int8_load_defaults_to_tanh_gelu_and_respects_pin(tmp_path):
+    """quantize: int8 flips hidden_act to gelu_tanh (speed opt-in implies
+    the cheaper activation), but an artifact that PINS hidden_act keeps
+    its pin."""
+    from tpumlops.server.loader import load_predictor, save_native_model
+
+    cfg = bert.BertConfig.tiny()
+    params = bert.init(jax.random.key(0), cfg)
+    base_cfg = {
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "num_layers": cfg.num_layers,
+        "num_heads": cfg.num_heads,
+        "intermediate_size": cfg.intermediate_size,
+        "max_position_embeddings": cfg.max_position_embeddings,
+    }
+    art = tmp_path / "b1"
+    save_native_model(art, "bert-classifier", params, config=base_cfg,
+                      builder_kwargs={"seq_len": 16})
+    pred = load_predictor(str(art), quantize="int8")
+    assert pred.metadata["hidden_act"] == "gelu_tanh"
+    # unquantized load keeps exact-erf reference numerics
+    assert load_predictor(str(art)).metadata["hidden_act"] == "gelu"
+
+    art2 = tmp_path / "b2"
+    save_native_model(art2, "bert-classifier", params,
+                      config={**base_cfg, "hidden_act": "gelu"},
+                      builder_kwargs={"seq_len": 16})
+    pred_pin = load_predictor(str(art2), quantize="int8")
+    assert pred_pin.metadata["hidden_act"] == "gelu"  # explicit pin wins
+    ids = np.zeros((1, 16), np.int32)
+    out_tanh = np.asarray(pred.predict(input_ids=ids))
+    out_pin = np.asarray(pred_pin.predict(input_ids=ids))
+    assert out_tanh.shape == out_pin.shape
+    assert np.max(np.abs(out_tanh - out_pin)) < 5e-2

@@ -142,3 +142,170 @@ def test_jax_process_transport_framing_across_two_processes(tmp_path):
         assert rc == 0, f"child failed:\n{err[-2000:]}"
     assert "LEADER_OK" in outs[0][1]
     assert "FOLLOWER_OK" in outs[1][1]
+
+
+# ---------------------------------------------------------------------------
+# Full op replay across two OS processes (VERDICT r2 #9): a real predict
+# and a real continuous-batching generation (admit + decode ticks) ride the
+# same two-round framing, and the follower's device state converges to the
+# leader's — proven at process granularity, not thread granularity.
+# ---------------------------------------------------------------------------
+
+CHILD_REPLAY = textwrap.dedent(
+    """
+    import socket, sys, time, threading
+    import numpy as np
+
+    rank = int(sys.argv[1])
+    port = int(sys.argv[2])
+
+    if rank == 0:
+        srv = socket.create_server(("127.0.0.1", port))
+        conn, _ = srv.accept()
+    else:
+        conn = None
+        for _ in range(400):
+            try:
+                conn = socket.create_connection(("127.0.0.1", port))
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert conn is not None, "could not reach leader"
+    conn.settimeout(120)
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    _send_lock = threading.Lock()
+
+    def socket_broadcast_one_to_all(x):
+        arr = np.ascontiguousarray(x)
+        if rank == 0:
+            with _send_lock:
+                conn.sendall(arr.tobytes())
+            return arr
+        buf = bytearray()
+        while len(buf) < arr.nbytes:
+            chunk = conn.recv(arr.nbytes - len(buf))
+            if not chunk:
+                raise RuntimeError("leader closed mid-broadcast")
+            buf.extend(chunk)
+        return np.frombuffer(bytes(buf), arr.dtype).reshape(arr.shape)
+
+    multihost_utils.broadcast_one_to_all = socket_broadcast_one_to_all
+    jax.process_index = lambda: rank
+
+    import jax.numpy as jnp
+    from tpumlops.models import llama
+    from tpumlops.models.registry import Predictor
+    from tpumlops.server.engine import InferenceEngine
+    from tpumlops.server.generation import GenerationEngine
+    from tpumlops.server.multihost import (
+        OP_SHUTDOWN,
+        JaxProcessTransport,
+        MultihostEngine,
+        UnitChannel,
+        encode_message,
+        follower_loop,
+    )
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.float32)
+
+    def mk_engine():
+        return InferenceEngine(
+            Predictor(
+                name="double",
+                predict=lambda x: x * 2.0,
+                jittable=True,
+                example_input=lambda b: np.zeros((b, 3), np.float32),
+            ),
+            max_batch_size=4,
+        )
+
+    def checksum(gen):
+        toks = np.asarray(gen._tokens).ravel().tolist()
+        lens = np.asarray(gen._lengths).ravel().tolist()
+        return f"{toks}|{lens}"
+
+    transport = JaxProcessTransport()
+    if rank == 0:
+        channel = UnitChannel(transport)
+        mh = MultihostEngine(mk_engine(), transport, channel)
+        gen = GenerationEngine(
+            params, cfg, max_slots=2, dtype=jnp.float32, channel=channel
+        )
+        gen.start(warmup=True)
+        try:
+            out = np.asarray(mh.predict({"x": np.arange(6, dtype=np.float32).reshape(2, 3)}))
+            assert np.allclose(out, np.arange(6, dtype=np.float32).reshape(2, 3) * 2.0)
+            toks = gen.generate([5, 9, 2], 6).tolist()
+            ref = np.asarray(
+                llama.generate_greedy(
+                    params, jnp.asarray([[5, 9, 2]], jnp.int32), 6, cfg,
+                    dtype=jnp.float32,
+                )
+            )[0].tolist()
+            assert toks == ref, (toks, ref)
+        finally:
+            gen.shutdown()
+            channel.close_with(encode_message(OP_SHUTDOWN))
+        print("STATE", checksum(gen), flush=True)
+        print("LEADER_OK", flush=True)
+    else:
+        fgen = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float32)
+        steps = follower_loop(mk_engine(), transport, gen_engine=fgen)
+        assert steps >= 3, f"expected predict+admit+steps, got {steps}"
+        print("STATE", checksum(fgen), flush=True)
+        print("FOLLOWER_OK", flush=True)
+    conn.close()
+    """
+)
+
+
+def test_predict_and_generation_replay_across_two_processes(tmp_path):
+    import socket
+
+    child = tmp_path / "child_replay.py"
+    child.write_text(CHILD_REPLAY)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(Path(__file__).resolve().parent.parent)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(child), str(rank), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("replay deadlock: processes did not finish")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed:\\n{err[-3000:]}"
+    assert "LEADER_OK" in outs[0][1]
+    assert "FOLLOWER_OK" in outs[1][1]
+
+    def state(out):
+        for line in out.splitlines():
+            if line.startswith("STATE "):
+                return line[len("STATE "):]
+        raise AssertionError(f"no STATE line in {out!r}")
+
+    # Device state converged across REAL process boundaries.
+    assert state(outs[0][1]) == state(outs[1][1])
