@@ -93,13 +93,16 @@ def dense_q8(x: jax.Array, qw: dict, b: jax.Array | None = None) -> jax.Array:
 
     Unlike the weight-only scheme above (a bandwidth lever for decode),
     this feeds the MXU actual int8 operands — on v5e the int8 systolic
-    path has 2x the bf16 throughput, which is the only remaining lever for
-    a COMPUTE-bound workload like BERT prefill (bench.py measures bf16
-    classify at ~55% MXU).  Activations quantize per row (per token):
-    symmetric, scale = max|x| / 127 over the contraction axis, computed on
-    the fly — cheap elementwise work against an 8x-H^2 matmul.  The int32
-    accumulator rescales by (a_scale x w_scale) in f32, so the only
-    approximation is the two roundings to int8.
+    path has 2x the bf16 throughput, the lever for a COMPUTE-bound
+    workload like BERT prefill.  Activations quantize per row (per
+    token): symmetric, scale = max|x| / 127 over the contraction axis,
+    computed on the fly — XLA fuses it into the matmul read (round-3
+    ablation: the dynamic-quant GEMM ladder runs at 188 TFLOP/s, ~0 cost
+    over pre-quantized operands; scripts/profile_bert_int8.py).  The
+    int32 accumulator rescales by (a_scale x w_scale) in f32, so the
+    only approximation is the two roundings to int8.  End to end the
+    int8 path pairs with tanh-GELU (loader default under quantize: int8
+    — see common.gelu_tanh) for ~1.4x over bf16-erf at b32/s128.
     """
     qa = quantize_tensor(x, axis=-1)  # per-row (per-token) scales
     x8, a_scale = qa["q8"], qa["scale"]

@@ -15,6 +15,7 @@ the runtime can interleave many resources on one thread.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 
 from ..clients.base import (
@@ -39,6 +40,25 @@ from .builder import build_deployment
 from .judge import should_promote
 from .state import Phase, PromotionState
 from .uri import artifact_uri
+
+
+class _OpTimer:
+    """Context manager accumulating wall seconds into ``sink[component]``."""
+
+    __slots__ = ("_sink", "_component", "_t0")
+
+    def __init__(self, sink: dict, component: str):
+        self._sink = sink
+        self._component = component
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+
+    def __exit__(self, *exc):
+        self._sink[self._component] = self._sink.get(self._component, 0.0) + (
+            time.perf_counter() - self._t0
+        )
+        return False
 
 
 @dataclass
@@ -118,20 +138,7 @@ class Reconciler:
     def _op_timer(self, component: str):
         """Accumulate wall time of one operation class into the step's
         timing breakdown (read back through ReconcileOutcome.timings)."""
-        import contextlib
-        import time as _time
-
-        @contextlib.contextmanager
-        def cm():
-            t0 = _time.perf_counter()
-            try:
-                yield
-            finally:
-                self._timings[component] = self._timings.get(
-                    component, 0.0
-                ) + (_time.perf_counter() - t0)
-
-        return cm()
+        return _OpTimer(self._timings, component)
 
     def reconcile(self, obj: dict) -> ReconcileOutcome:
         """One reconcile step for the given CR object (spec+status+metadata)."""
