@@ -74,20 +74,30 @@ GPU_ANCHORS = {
 
 
 def _scan_delta_timed(
-    make_step, carry, runs: int = 6, n1: int = 8, n2: int = 40
+    make_step, make_carry, runs: int = 6, n1: int = 8, n2: int = 40,
+    params=None,
 ) -> dict[int, float]:
     """p50/p99 seconds per model iteration from two-length on-device scans.
 
-    THE timing methodology of record (round 3).  Round 1-2 pipelined N
-    independent dispatches and divided the wall by N; this round the
-    device tunnel started overlapping/eliding dispatches whose outputs
-    nothing consumes — ResNet-50 b8 "measured" 0.08 ms/fwd that way, an
-    impossible 410 TFLOP/s (true on-device number: ~4.9 ms).  So the
-    timed region is now ONE dispatch whose iterations are chained by a
-    data dependency the compiler cannot fold: ``lax.scan`` where each
-    step's carry is gated on the model output (``make_step(c) -> (c2,
-    probe)``).  Timing two scan lengths and differencing cancels the
-    constant dispatch + tunnel cost; noise enters at RTT-jitter/(n2-n1).
+    THE timing methodology of record (round 3), built to survive this
+    environment's device tunnel, which (a) overlaps/elides pipelined
+    independent dispatches — ResNet-50 b8 "measured" 0.08 ms/fwd that
+    way, an impossible 410 TFLOP/s — and (b) replays cached results for
+    repeated calls with identical argument values (a 7B decode scan
+    "ran" in 0.0 ms on its second call).  Countermeasures, in order:
+
+    - the timed region is ONE dispatch whose iterations are chained by a
+      data dependency XLA cannot fold: ``lax.scan`` with the carry gated
+      on the model output (``make_step([params,] c) -> (c2, probe)``);
+    - ``make_carry(i)`` must return a carry with DISTINCT VALUES per
+      ``i`` so no replay cache across calls can hit;
+    - big ``params`` ride as explicit jit arguments, never closure
+      constants — closed-over weights are embedded in the serialized
+      remote-compile payload, and a 1.35 GiB one wedges the tunnel
+      (tcp_sendmsg on a full socket buffer);
+    - timing two scan lengths and differencing cancels the constant
+      dispatch + tunnel cost; noise enters at RTT-jitter/(n2-n1).
+
     Cross-checked against chained-dispatch and component-sum ablations
     (scripts/profile_bert_int8*.py): int8 BERT 4.71 ms scan-delta vs
     4.97 ms chained-dispatch (the 0.26 ms is per-dispatch overhead the
@@ -95,27 +105,52 @@ def _scan_delta_timed(
     import jax
 
     def make(n):
-        @jax.jit
-        def f(carry):
-            return jax.lax.scan(
-                lambda c, _: make_step(c), carry, None, length=n
-            )[1]
+        if params is None:
+
+            @jax.jit
+            def f(carry):
+                return jax.lax.scan(
+                    lambda c, _: make_step(c), carry, None, length=n
+                )[1]
+
+        else:
+
+            @jax.jit
+            def f(params, carry):
+                return jax.lax.scan(
+                    lambda c, _: make_step(params, c), carry, None, length=n
+                )[1]
 
         return f
 
-    f1, f2 = make(n1), make(n2)
-    f1(carry).block_until_ready()
-    f2(carry).block_until_ready()
+    def call(f, i):
+        carry = make_carry(i)
+        args = (carry,) if params is None else (params, carry)
+        out = f(*args)
+        out.block_until_ready()
+        return out
 
-    def wall(f):
+    f1, f2 = make(n1), make(n2)
+    call(f1, -1)
+    call(f2, -2)
+
+    def wall(f, i):
         t0 = time.perf_counter()
-        f(carry).block_until_ready()
+        call(f, i)
         return time.perf_counter() - t0
 
     samples = []
-    for _ in range(runs):
-        samples.append(max(0.0, (wall(f2) - wall(f1)) / (n2 - n1)))
-    return _percentiles(samples)
+    for r in range(runs):
+        w1 = wall(f1, 2 * r)
+        w2 = wall(f2, 2 * r + 1)
+        samples.append(max(0.0, (w2 - w1) / (n2 - n1)))
+    p = _percentiles(samples)
+    if p[50] <= 0.0:
+        raise RuntimeError(
+            "scan-delta collapsed to zero — the device tunnel elided the "
+            "timed computation despite varied carries"
+        )
+    return p
 
 
 def _gate(c, logits):
@@ -183,16 +218,19 @@ def bench_bert() -> dict:
         lambda p, i, m: bert.classify(p, i, m, cfg=cfg_srv, dtype=jnp.bfloat16)
     )
 
-    def step_srv(c):
-        logits = bert.classify(qparams, c, mask, cfg=cfg_srv, dtype=jnp.bfloat16)
+    def step_srv(p, c):
+        logits = bert.classify(p, c, mask, cfg=cfg_srv, dtype=jnp.bfloat16)
         return _gate(c, logits), logits[0, 0]
 
-    def step_ref(c):
-        logits = bert.classify(params, c, mask, cfg=cfg, dtype=jnp.bfloat16)
+    def step_ref(p, c):
+        logits = bert.classify(p, c, mask, cfg=cfg, dtype=jnp.bfloat16)
         return _gate(c, logits), logits[0, 0]
 
-    q8 = _scan_delta_timed(step_srv, ids, runs=RUNS)
-    bf16 = _scan_delta_timed(step_ref, ids, runs=RUNS)
+    def carry_at(i):
+        return (ids + jnp.int32(i)) % cfg.vocab_size
+
+    q8 = _scan_delta_timed(step_srv, carry_at, runs=RUNS, params=qparams)
+    bf16 = _scan_delta_timed(step_ref, carry_at, runs=RUNS, params=params)
 
     # Parity of the served numerics (int8 weights+acts, tanh GELU) against
     # the bf16 erf reference on the bench batch: the approximation must
@@ -625,12 +663,14 @@ def bench_iris() -> dict:
     params, cfg = linear.from_sklearn(sk)
     x = jax.numpy.asarray(X[:32], jax.numpy.float32)
 
-    def step(c):
-        out = linear.predict(params, c, cfg)
+    def step(p, c):
+        out = linear.predict(p, c, cfg)
         return _gate(c, out), out[0]
 
     # µs-scale body: long scans so the delta rises above RTT jitter.
-    p = _scan_delta_timed(step, x, n1=512, n2=8192)
+    p = _scan_delta_timed(
+        step, lambda i: x + 0.001 * i, n1=512, n2=8192, params=params
+    )
     return {"p50_us": round(p[50] * 1e6, 1), "batch": 32}
 
 
@@ -686,7 +726,7 @@ def bench_xgboost() -> dict:
         out = fn(c)
         return _gate(c, out), out.reshape(-1)[0]
 
-    p = _scan_delta_timed(step, x, n1=128, n2=1024)
+    p = _scan_delta_timed(step, lambda i: x + 0.001 * i, n1=128, n2=1024)
     return {
         "p50_us": round(p[50] * 1e6, 1),
         "trees": n_trees,
@@ -715,11 +755,14 @@ def bench_resnet() -> dict:
             jax.random.key(1), (batch, 224, 224, 3), jnp.bfloat16
         )
 
-        def step(c):
-            out = resnet.forward(params, c, cfg)
+        def step(p, c):
+            out = resnet.forward(p, c, cfg)
             return _gate(c, out), out[0, 0]
 
-        p = _scan_delta_timed(step, x, n1=n1, n2=n2)
+        p = _scan_delta_timed(
+            step, lambda i: x + jnp.bfloat16(0.01) * i, n1=n1, n2=n2,
+            params=params,
+        )
         tflops = batch * FLOPS_PER_IMG / p[50] / 1e12
         entry = {
             "p50_ms": round(p[50] * 1000, 3),
@@ -756,19 +799,22 @@ def _decode_device_loop(jax, params, cfg, slots: int, *, kv_quant: bool,
     else:
         cache = llama.RaggedKVCache.create(cfg, slots, jnp.bfloat16)
     cache = cache._replace(lengths=jnp.full((slots,), position, jnp.int32))
-    toks0 = jnp.ones((slots, 1), jnp.int32)
 
     from tpumlops.models import llama as _llama
 
-    def step(carry):
+    def step(p, carry):
         toks, cache = carry
         logits, cache = _llama.decode_ragged(
-            params, toks, cache, cfg, window=window
+            p, toks, cache, cfg, window=window
         )
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
         return (nxt, cache), nxt[0, 0]
 
-    p = _scan_delta_timed(step, (toks0, cache), n1=n1, n2=n2)
+    def carry_at(i):
+        toks = jnp.full((slots, 1), (7 + i) % 1000 + 1, jnp.int32)
+        return (toks, cache)
+
+    p = _scan_delta_timed(step, carry_at, n1=n1, n2=n2, params=params)
     return p[50]
 
 
@@ -926,16 +972,64 @@ def bench_llama_decode() -> dict:
 
 
 def bench_llama_7b_decode() -> dict:
-    """BASELINE config[4], the real thing: Llama-2-7B geometry, int8
-    weights streamed from the 13 GiB checkpoint (docs/SCALE.md), int8 KV,
-    decode on the single v5e chip (VERDICT r2 #3)."""
+    """BASELINE config[4] in a KILLABLE subprocess: the remote-compile
+    tunnel in this environment sometimes wedges indefinitely on very
+    large programs (zero CPU, blocked socket) — a timeout + fresh process
+    contains that, and per-point progress lines let the parent salvage a
+    partial ladder."""
+    import subprocess
+
+    timeout_s = float(os.environ.get("BENCH_7B_TIMEOUT_S", "900"))
+    code = "import bench; bench._llama_7b_inner()"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        stdout = proc.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        stdout = (
+            e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        )
+        partial = {}
+        for line in stdout.splitlines():
+            if line.startswith("7BPOINT "):
+                try:
+                    partial.update(json.loads(line[len("7BPOINT "):]))
+                except json.JSONDecodeError:
+                    pass
+        return {
+            "error": f"timeout after {timeout_s:.0f}s (wedged remote compile)",
+            "slot_ladder": partial or None,
+        }
+    for line in reversed(stdout.splitlines()):
+        if line.startswith("7BRESULT "):
+            return json.loads(line[len("7BRESULT "):])
+    return {
+        "error": "subprocess produced no result",
+        "rc": proc.returncode,
+        "tail": (proc.stderr or "")[-300:],
+    }
+
+
+def _llama_7b_inner() -> None:
+    """Subprocess body for :func:`bench_llama_7b_decode`: Llama-2-7B
+    geometry, int8 weights streamed from the 13 GiB checkpoint
+    (docs/SCALE.md), int8 KV, decode on the single v5e chip."""
     jax = _setup_jax()
     import os.path
 
+    def emit(result: dict) -> None:
+        print("7BRESULT " + json.dumps(result), flush=True)
+
     ckpt = os.environ.get("BENCH_7B_CKPT", "/root/ckpt7b")
     if not os.path.isdir(ckpt):
-        return {"skipped": f"7B checkpoint not found at {ckpt} "
-                           "(generate with scripts/gen_7b_checkpoint.py)"}
+        emit({"skipped": f"7B checkpoint not found at {ckpt} "
+                         "(generate with scripts/gen_7b_checkpoint.py)"})
+        return
 
     from tpumlops.server.loader import load_predictor
 
@@ -954,14 +1048,28 @@ def bench_llama_7b_decode() -> dict:
     from tpumlops.models.quantization import quantized_bytes
 
     WINDOW, POS = 512, 256
-    ladder, best = _run_slot_ladder(
-        jax, params, cfg, (8, 32), window=WINDOW, position=POS, n1=4, n2=24
-    )
+    # 32 slots needs input+loop cache copies (2 x 4.8 GiB) on top of the
+    # 6.4 GiB weights and may not compile on 16 GiB; its error is still
+    # recorded as the documented ceiling.
+    ladder = {}
+    best = None
+    for slots in (8, 16, 32):
+        point, point_best = _run_slot_ladder(
+            jax, params, cfg, (slots,), window=WINDOW, position=POS,
+            n1=4, n2=24,
+        )
+        ladder.update(point)
+        print("7BPOINT " + json.dumps(point), flush=True)
+        if point_best is not None and (
+            best is None or point_best[1]["tok_per_s"] > best[1]["tok_per_s"]
+        ):
+            best = point_best
     if best is None:
-        return {"error": "all ladder points failed", "slot_ladder": ladder,
-                "load_s": round(load_s, 1)}
+        emit({"error": "all ladder points failed", "slot_ladder": ladder,
+              "load_s": round(load_s, 1)})
+        return
 
-    return {
+    emit({
         "device_tok_per_s": best[1]["tok_per_s"],
         "ms_per_step": best[1]["ms_per_step"],
         "slots": best[0],
@@ -976,7 +1084,7 @@ def bench_llama_7b_decode() -> dict:
                 best[1]["tok_per_s"] / GPU_ANCHORS["llama7b_a100_80g_tok_s"], 2
             ),
         },
-    }
+    })
 
 
 def main() -> None:

@@ -197,13 +197,16 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, obj)
 
     def do_POST(self):
+        # Read the body FIRST, even on error paths: an undrained body
+        # desyncs the keep-alive connection — the next request on the
+        # pooled socket gets parsed out of leftover body bytes.
+        body = self._body() or {}
         if not self._auth_ok():
             return self._status(401, "Unauthorized", "bad token")
         parsed = self._parse()
         if not parsed:
             return self._status(404, "NotFound", "bad path")
         coll, ns, _name, _sub, _q = parsed
-        body = self._body() or {}
         st = self.state
         name = (body.get("metadata") or {}).get("generateName")
         with st.lock:
@@ -226,13 +229,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(201, obj)
 
     def do_PUT(self):
+        body = self._body() or {}  # drain first (see do_POST)
         if not self._auth_ok():
             return self._status(401, "Unauthorized", "bad token")
         parsed = self._parse()
         if not parsed or parsed[2] is None:
             return self._status(404, "NotFound", "bad path")
         coll, ns, name, _sub, _q = parsed
-        body = self._body() or {}
         st = self.state
         with st.lock:
             key = (coll, ns or "", name)
@@ -263,6 +266,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, obj)
 
     def do_PATCH(self):
+        patch = self._body() or {}  # drain first (see do_POST)
         if not self._auth_ok():
             return self._status(401, "Unauthorized", "bad token")
         parsed = self._parse()
@@ -271,7 +275,6 @@ class _Handler(BaseHTTPRequestHandler):
         coll, ns, name, sub, _q = parsed
         if "merge-patch" not in (self.headers.get("Content-Type") or ""):
             return self._status(415, "UnsupportedMediaType", "merge-patch only")
-        patch = self._body() or {}
         st = self.state
         with st.lock:
             key = (coll, ns or "", name)
@@ -332,7 +335,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return False  # client went away
 
         with st.lock:
-            if cursor and cursor <= st.log_floor.get(coll, 0):
+            # Strict: a cursor exactly at the floor misses nothing — the
+            # floor IS the rv a fresh post-compaction list returns, and
+            # 410ing it would spin CrWatcher in a list->watch->410 loop.
+            if cursor and cursor < st.log_floor.get(coll, 0):
                 write_line(
                     {
                         "type": "ERROR",

@@ -285,8 +285,8 @@ def _block(
     """One decoder layer over a fixed-capacity cache.
 
     x: [B,S,H]; cache_k/v: [B,max_seq,NKV,D]; start: scalar write offset
-    shared by the batch, or an int32 [B] of per-row offsets (continuous
-    batching: each slot is at its own sequence position).
+    shared by the batch (prefill / chunked prefill).  Per-row ragged
+    decode does NOT come through here — see _block_decode_deferred.
 
     ``window`` (static) restricts ATTENTION to cache positions
     ``[0, window)`` while writes still land in the full buffer — decode's
@@ -299,7 +299,6 @@ def _block(
     """
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    ragged = getattr(start, "ndim", 0) == 1
 
     xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
     q = jnp.matmul(xn, _mat(lp["q"], xn.dtype), preferred_element_type=jnp.float32)
@@ -319,22 +318,17 @@ def _block(
     quant_cache = isinstance(cache_k, tuple)
 
     def _write_all(buffers_and_vals):
+        # Scalar start only: ragged (per-row) decode writes do not come
+        # through here — decode_ragged defers them and commits all layers
+        # with one scatter after its scan (see _block_decode_deferred).
         out = []
-        if ragged:
-            def _write(row_cache, row_kv, row_start):
-                z = jnp.zeros((), row_start.dtype)
-                return lax.dynamic_update_slice(row_cache, row_kv, (row_start, z, z))
-
-            for buf, vals in buffers_and_vals:
-                out.append(jax.vmap(_write)(buf, vals.astype(buf.dtype), start))
-        else:
-            z = jnp.zeros((), start.dtype) if hasattr(start, "dtype") else 0
-            for buf, vals in buffers_and_vals:
-                out.append(
-                    lax.dynamic_update_slice(
-                        buf, vals.astype(buf.dtype), (z, start, z, z)
-                    )
+        z = jnp.zeros((), start.dtype) if hasattr(start, "dtype") else 0
+        for buf, vals in buffers_and_vals:
+            out.append(
+                lax.dynamic_update_slice(
+                    buf, vals.astype(buf.dtype), (z, start, z, z)
                 )
+            )
         return out
 
     if quant_cache:
@@ -407,6 +401,106 @@ def _block(
         act.astype(x.dtype), _mat(lp["down"], x.dtype), preferred_element_type=jnp.float32
     ).astype(x.dtype)
     return x + down, cache_k, cache_v
+
+
+def _block_decode_deferred(
+    x: jax.Array,
+    lp: dict,
+    cache_k,
+    cache_v,
+    cos: jax.Array,
+    sin: jax.Array,
+    mask_bias: jax.Array,
+    cfg: LlamaConfig,
+    window: int,
+):
+    """One decoder layer for single-token ragged decode with the cache
+    READ-ONLY: returns ``(y, k_new, v_new)`` instead of an updated cache.
+
+    Why: if the layer scan carried an updated cache, the update would ride
+    the scan's stacked outputs and XLA materializes that as a full cache
+    read + write every step — traffic linear in slots that capped 1.35B
+    decode at ~1000 tok/s (round-3 probe: the write path cost 11.7 ms of
+    a 17 ms step at 32 slots).  Deferring the write means the scan emits
+    only each layer's tiny ``[B,1,NKV,D]`` row and :func:`decode_ragged`
+    commits every layer with ONE scatter after the scan, leaving the big
+    buffers untouched through the jit body.
+
+    The current token is attended via an exact bf16 self-term concatenated
+    before the softmax — ``mask_bias`` must therefore be STRICT
+    (``key_pos < position``): the current position's cache row is
+    stale/unwritten by design.  On the quant-cache path this also skips a
+    quantize round-trip for the newest token (slightly better numerics).
+    """
+    b, s, h = x.shape  # s == 1 by contract
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.matmul(xn, _mat(lp["q"], xn.dtype), preferred_element_type=jnp.float32)
+    k = jnp.matmul(xn, _mat(lp["k"], xn.dtype), preferred_element_type=jnp.float32)
+    v = jnp.matmul(xn, _mat(lp["v"], xn.dtype), preferred_element_type=jnp.float32)
+    q = q.astype(x.dtype).reshape(b, s, nh, hd)
+    k = k.astype(x.dtype).reshape(b, s, nkv, hd)
+    v = v.astype(x.dtype).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    group = nh // nkv
+    qg = q.reshape(b, s, nkv, group, hd)
+    quant_cache = isinstance(cache_k, tuple)
+    if quant_cache:
+        k8, ks = cache_k
+        v8, vs = cache_v
+        k8, ks = k8[:, :window], ks[:, :window]
+        v8, vs = v8[:, :window], vs[:, :window]
+        scores = jnp.einsum(
+            "bqngd,bknd->bngqk",
+            qg,
+            k8.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(jnp.float32(hd))
+        kscale = jnp.moveaxis(ks[..., 0], 1, 2)[:, :, None, None, :]
+        scores = scores * kscale
+    else:
+        kk = cache_k[:, :window].astype(x.dtype)
+        scores = jnp.einsum(
+            "bqngd,bknd->bngqk", qg, kk, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.float32(hd))
+    scores = scores + mask_bias[:, None]
+
+    # Exact self-term for the current (not-yet-written) position.
+    score_self = (
+        jnp.einsum("bqngd,bqnd->bngq", qg, k, preferred_element_type=jnp.float32)
+        / jnp.sqrt(jnp.float32(hd))
+    )[..., None]
+    full = jnp.concatenate([scores, score_self], axis=-1)
+    probs = jax.nn.softmax(full, axis=-1)
+    probs_cache, prob_self = probs[..., :-1], probs[..., -1:]
+
+    if quant_cache:
+        vscale = jnp.moveaxis(vs[..., 0], 1, 2)[:, :, None, None, :]
+        probs_cache = (probs_cache * vscale).astype(x.dtype)
+        ctx = jnp.einsum("bngqk,bknd->bqngd", probs_cache, v8.astype(x.dtype))
+    else:
+        vv = cache_v[:, :window].astype(x.dtype)
+        ctx = jnp.einsum("bngqk,bknd->bqngd", probs_cache.astype(x.dtype), vv)
+    ctx = ctx + jnp.einsum(
+        "bngqk,bknd->bqngd", prob_self.astype(x.dtype), v
+    )
+    ctx = ctx.reshape(b, s, nh * hd)
+
+    attn_out = jnp.matmul(
+        ctx, _mat(lp["o"], ctx.dtype), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    x = x + attn_out
+    xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    gate = jnp.matmul(xn, _mat(lp["gate"], xn.dtype), preferred_element_type=jnp.float32)
+    up = jnp.matmul(xn, _mat(lp["up"], xn.dtype), preferred_element_type=jnp.float32)
+    act = jax.nn.silu(gate) * up
+    down = jnp.matmul(
+        act.astype(x.dtype), _mat(lp["down"], x.dtype), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    return x + down, k, v
 
 
 def forward(
@@ -518,10 +612,12 @@ def decode_ragged(
     their outputs are ignored by the scheduler.
 
     Slot-reuse safety: a reused slot's stale K/V beyond the new sequence's
-    current position is never attended — the mask admits ``key_pos <= p``
-    and every position ``<= p`` has been rewritten by the new occupant's
-    prefill insert or a prior decode write (each step writes position ``p``
-    before attending it).
+    current position is never attended — the cache mask is STRICT
+    (``key_pos < p``), every position ``< p`` has been rewritten by the
+    new occupant's prefill insert or a prior decode step's commit, and
+    position ``p`` itself is attended through the exact in-flight
+    self-term (never read from the cache this step; its row is written
+    by the post-scan scatter for the NEXT step to read).
 
     ``window`` (STATIC int) bounds the attended cache prefix: callers pass
     a power-of-two bucket ``> max(lengths of active rows)`` so each window
@@ -548,20 +644,24 @@ def decode_ragged(
         window = capacity
     window = min(int(window), capacity)
     key_pos = jnp.arange(window)
-    valid = key_pos[None, None, :] <= positions[:, :, None]  # [B, 1, W]
+    # STRICT mask: the current position is attended via the exact
+    # self-term inside _block_decode_deferred, not read back from the
+    # cache (which stays read-only through the layer scan — see that
+    # function's docstring for the traffic argument).
+    valid = key_pos[None, None, :] < positions[:, :, None]  # [B, 1, W]
     mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[:, None]  # [B,1,1,W]
 
     def scan_body(carry, layer_inputs):
         x = carry
         lp, ck, cv = layer_inputs
-        y, ck2, cv2 = _block(
-            x, lp, ck, cv, lengths, cos, sin, mask_bias, cfg, window=window
+        y, k_new, v_new = _block_decode_deferred(
+            x, lp, ck, cv, cos, sin, mask_bias, cfg, window=window
         )
-        return y, (ck2, cv2)
+        return y, (k_new, v_new)
 
     ck0 = (cache.k8, cache.k_scale) if quant else cache.k
     cv0 = (cache.v8, cache.v_scale) if quant else cache.v
-    x, (new_k, new_v) = lax.scan(scan_body, x, (params["layers"], ck0, cv0))
+    x, (k_news, v_news) = lax.scan(scan_body, x, (params["layers"], ck0, cv0))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = jnp.matmul(
         x, _mat(params["lm_head"], x.dtype), preferred_element_type=jnp.float32
@@ -569,11 +669,26 @@ def decode_ragged(
     advance = (
         jnp.ones((b,), jnp.int32) if active is None else active.astype(jnp.int32)
     )
+    # Commit every layer's new K/V row with ONE scatter per buffer: the
+    # only write the whole decode step performs against the cache.
+    rows = jnp.arange(b)
+    k_news = k_news[:, :, 0]  # [L, B, NKV, D]
+    v_news = v_news[:, :, 0]
     if quant:
+        kq, kqs = _quant_kv(k_news)
+        vq, vqs = _quant_kv(v_news)
         return logits, QuantRaggedKVCache(
-            new_k[0], new_k[1], new_v[0], new_v[1], lengths + advance
+            cache.k8.at[:, rows, lengths].set(kq),
+            cache.k_scale.at[:, rows, lengths].set(kqs),
+            cache.v8.at[:, rows, lengths].set(vq),
+            cache.v_scale.at[:, rows, lengths].set(vqs),
+            lengths + advance,
         )
-    return logits, RaggedKVCache(new_k, new_v, lengths + advance)
+    return logits, RaggedKVCache(
+        cache.k.at[:, rows, lengths].set(k_news.astype(cache.k.dtype)),
+        cache.v.at[:, rows, lengths].set(v_news.astype(cache.v.dtype)),
+        lengths + advance,
+    )
 
 
 def insert_sequence(

@@ -243,3 +243,37 @@ def test_full_operator_canary_over_the_wire():
             rt.stop()
             watcher.stop()
             thread.join(timeout=10)
+
+
+def test_keep_alive_survives_errored_bodied_requests():
+    """Error responses on bodied requests must drain the body, or the
+    pooled keep-alive connection desyncs and the NEXT request is parsed
+    out of leftover body bytes (round-3 review repro)."""
+    from tpumlops.clients.base import ApiError
+
+    with EnvtestServer(token="t") as srv:
+        bad = make_client(srv, token="wrong")
+        for _ in range(2):  # same pooled connection, twice
+            with pytest.raises(ApiError):
+                bad.create(CR, cr_body())
+        good = make_client(srv, token="t")
+        good.create(CR, cr_body())
+        with pytest.raises(NotFound):  # 404 PUT with a body, then reuse
+            good.replace(
+                ObjectRef(namespace="models", name="nope", **MLFLOWMODEL),
+                cr_body("nope"),
+            )
+        assert good.get(CR)["metadata"]["name"] == "iris"
+
+
+def test_watch_from_post_compaction_rv_is_not_410():
+    """The rv a fresh post-compaction list returns misses nothing; a 410
+    for it would spin CrWatcher in a list->watch->410 loop."""
+    with EnvtestServer() as srv:
+        kube = make_client(srv)
+        kube.create(CR, cr_body())
+        srv.compact("mlflow.nizepart.com/v1alpha1", "mlflowmodels")
+        _, rv = kube.list_with_version(CR)
+        # must NOT raise WatchExpired; idle stream ends at the timeout
+        events = list(kube.watch(CR, resource_version=rv, timeout_s=1))
+        assert events == []
