@@ -433,12 +433,8 @@ def _stream_native_params(npz_path: Path, quantize_leaves: tuple = ()) -> Any:
     npz stores bfloat16 as raw void ``V2`` (numpy has no native bf16);
     such arrays are viewed back through ml_dtypes before transfer.
     """
-    import jax
     import jax.numpy as jnp
 
-    from ..models.quantization import quantize_tensor
-
-    quant_jit = jax.jit(quantize_tensor)
     leaves: dict[str, Any] = {}
     with np.load(npz_path) as z:
         for k in z.files:
@@ -447,16 +443,27 @@ def _stream_native_params(npz_path: Path, quantize_leaves: tuple = ()) -> Any:
                 import ml_dtypes
 
                 arr = arr.view(ml_dtypes.bfloat16)
-            dev = jnp.asarray(arr)
-            del arr
             if k in quantize_leaves:
-                q = quant_jit(dev)
-                q["q8"].block_until_ready()
-                dev.delete()  # free the full-precision copy NOW
-                leaves[f"{k}{_SEP}q8"] = q["q8"]
-                leaves[f"{k}{_SEP}scale"] = q["scale"]
+                # Quantize on the HOST, transfer int8: half the wire
+                # bytes of shipping bf16 and quantizing on device, zero
+                # device-side quantize dispatches, and the HBM peak is
+                # just the int8 tree (no full-precision leaf ever lands
+                # on device).  Same scheme as quantization.quantize_tensor
+                # (symmetric, per-output-channel over axis=-2, epsilon,
+                # round-half-even) — parity asserted in
+                # tests/test_server.py streamed-vs-jit quantize test.
+                w32 = np.asarray(arr, dtype=np.float32)
+                del arr
+                amax = np.max(np.abs(w32), axis=-2, keepdims=True)
+                scale = np.maximum(amax, 1e-12) / 127.0
+                q8 = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+                del w32
+                leaves[f"{k}{_SEP}q8"] = jnp.asarray(q8)
+                leaves[f"{k}{_SEP}scale"] = jnp.asarray(scale)
+                del q8
             else:
-                leaves[k] = dev
+                leaves[k] = jnp.asarray(arr)
+                del arr
     return _unflatten(leaves)
 
 

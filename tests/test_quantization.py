@@ -324,3 +324,45 @@ def test_loader_bert_int8(tmp_path):
     assert np.abs(got - want).max() < 0.05 * max(np.abs(want).max(), 1.0)
     with pytest.raises(ModelLoadError, match="int8kv"):
         load_predictor(str(art), quantize="int8kv")
+
+
+def test_streamed_host_quantize_matches_device_quantize(tmp_path):
+    """The loader's host-side (numpy) quantize-on-arrival must implement
+    the same scheme as quantization.quantize_tensor (device): identical
+    scales and q8 within one rounding ulp.  Host-side is the round-3
+    default — it halves load transfer bytes and the HBM peak."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.models.quantization import quantize_llama
+
+    from tpumlops.server.loader import load_predictor, save_native_model
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(7), cfg, dtype=jnp.bfloat16)
+    art = tmp_path / "llq"
+    save_native_model(
+        art, "llama-generate", params,
+        config={
+            "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size, "max_seq": cfg.max_seq,
+        },
+    )
+    streamed = load_predictor(str(art), quantize="int8").causal_lm["params"]
+    ref = quantize_llama(
+        load_predictor(str(art)).causal_lm["params"]
+    )
+    for name in ("q", "k", "v", "o", "gate", "up", "down"):
+        s_leaf = streamed["layers"][name]
+        r_leaf = ref["layers"][name]
+        np.testing.assert_allclose(
+            np.asarray(s_leaf["scale"]), np.asarray(r_leaf["scale"]),
+            rtol=1e-6, err_msg=name,
+        )
+        diff = np.abs(
+            np.asarray(s_leaf["q8"], np.int32) - np.asarray(r_leaf["q8"], np.int32)
+        )
+        assert diff.max() <= 1, (name, diff.max())  # rounding-tie ulp only
