@@ -123,27 +123,50 @@ def _scan_delta_timed(
 
         return f
 
+    import numpy as np
+
     def call(f, i):
+        # np.asarray, not block_until_ready: synchronize through the DATA
+        # path.  The tunnel has been observed acking block_until_ready
+        # early; pulling the probe values (a few floats) to host cannot
+        # complete before the computation actually ran.
         carry = make_carry(i)
         args = (carry,) if params is None else (params, carry)
-        out = f(*args)
-        out.block_until_ready()
-        return out
+        return np.asarray(f(*args))
 
     f1, f2 = make(n1), make(n2)
     call(f1, -1)
     call(f2, -2)
 
-    def wall(f, i):
+    probes: list = [None, None]  # last probe values per scan length
+
+    def wall(f, i, slot):
         t0 = time.perf_counter()
-        call(f, i)
-        return time.perf_counter() - t0
+        out = call(f, i)
+        dt = time.perf_counter() - t0
+        # Replay detector: distinct carry VALUES should yield distinct
+        # probe values; bit-identical probes mean a cached result was
+        # probably served and this wall is not a measurement.  (Integer
+        # argmax probes CAN legitimately collide, so a tainted pair is
+        # discarded, not fatal — only an all-tainted run raises.)
+        replayed = probes[slot] is not None and np.array_equal(probes[slot], out)
+        probes[slot] = out
+        return dt, replayed
 
     samples = []
+    tainted = 0
     for r in range(runs):
-        w1 = wall(f1, 2 * r)
-        w2 = wall(f2, 2 * r + 1)
+        w1, r1 = wall(f1, 2 * r, 0)
+        w2, r2 = wall(f2, 2 * r + 1, 1)
+        if r1 or r2:
+            tainted += 1
+            continue
         samples.append(max(0.0, (w2 - w1) / (n2 - n1)))
+    if not samples:
+        raise RuntimeError(
+            f"all {tainted} scan-delta sample pairs were replayed cached "
+            "results — the device tunnel is not executing the computation"
+        )
     p = _percentiles(samples)
     if p[50] <= 0.0:
         raise RuntimeError(
