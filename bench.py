@@ -400,19 +400,44 @@ def bench_serve_path() -> dict:
             lat.append(time.perf_counter() - t0)
         return lat
 
-    def measure(url: str, clients: int = 8, per_client: int = 12) -> dict:
+    def fire_alternating(urls: tuple, n_pairs: int, timeout: float = 30.0):
+        """Alternate between URLs per request so environment drift (the
+        tunnel's minutes-scale mood swings) hits both sides equally —
+        sequential phases once produced a NEGATIVE router overhead."""
+        lats: tuple[list[float], ...] = tuple([] for _ in urls)
+        for _ in range(n_pairs):
+            for which, url in enumerate(urls):
+                t0 = time.perf_counter()
+                req = urllib.request.Request(
+                    url, data=body, headers={"Content-Type": "application/json"}
+                )
+                urllib.request.urlopen(req, timeout=timeout).read()
+                lats[which].append(time.perf_counter() - t0)
+        return lats
+
+    def measure_pair(urls: tuple, clients: int = 8, per_client: int = 12):
         # generous first-request timeout: a cold compile cache may still
         # be building an executable
-        fire(url, 5, timeout=300.0)
+        for url in urls:
+            fire(url, 5, timeout=300.0)
         with concurrent.futures.ThreadPoolExecutor(clients) as ex:
-            futs = [ex.submit(fire, url, per_client) for _ in range(clients)]
-            lats = [t for f in futs for t in f.result()]
-        p = _percentiles(lats)
-        return {
-            "p50_ms": round(p[50] * 1000, 2),
-            "p99_ms": round(p[99] * 1000, 2),
-            "requests": len(lats),
-        }
+            futs = [
+                ex.submit(fire_alternating, urls, per_client)
+                for _ in range(clients)
+            ]
+            results = [f.result() for f in futs]
+        out = []
+        for which in range(len(urls)):
+            lats = [t for r in results for t in r[which]]
+            p = _percentiles(lats)
+            out.append(
+                {
+                    "p50_ms": round(p[50] * 1000, 2),
+                    "p99_ms": round(p[99] * 1000, 2),
+                    "requests": len(lats),
+                }
+            )
+        return out
 
     def scrape_means(base: str) -> dict[str, tuple[float, float]]:
         """(sum, count) per relevant histogram from the server's own
@@ -438,8 +463,23 @@ def bench_serve_path() -> dict:
     router = None
     try:
         base = f"http://127.0.0.1:{port}"
+        # The native router (the Istio-split stand-in) fronts the same
+        # server; requests ALTERNATE direct/routed so both see the same
+        # environment.
+        from tpumlops.clients.router import RouterProcess
+
+        router = RouterProcess(
+            port=free_port(),
+            backends={"v1": ("127.0.0.1", port, 100)},
+            namespace="bench",
+        ).start()
         before = scrape_means(base)
-        direct = measure(f"{base}/v2/models/bert/infer")
+        direct, routed = measure_pair(
+            (
+                f"{base}/v2/models/bert/infer",
+                f"http://127.0.0.1:{router.port}/v2/models/bert/infer",
+            )
+        )
         after = scrape_means(base)
 
         def mean_ms(name: str) -> float:
@@ -455,18 +495,6 @@ def bench_serve_path() -> dict:
         queue_ms = mean_ms("tpumlops_queue_seconds")
         run_ms = mean_ms("tpumlops_batch_run_seconds")
         server_overhead_ms = round(total_ms - queue_ms - run_ms, 2)
-
-        # Same requests through the native router (the Istio-split stand-in).
-        from tpumlops.clients.router import RouterProcess
-
-        router = RouterProcess(
-            port=free_port(),
-            backends={"v1": ("127.0.0.1", port, 100)},
-            namespace="bench",
-        ).start()
-        routed = measure(
-            f"http://127.0.0.1:{router.port}/v2/models/bert/infer"
-        )
     finally:
         if router is not None:
             router.stop()

@@ -136,11 +136,12 @@ class QuantRaggedKVCache(NamedTuple):
     per-(layer, row, position, head) scale over the ``head_dim`` axis —
     written once when the position is produced and consumed WITHOUT a
     dequantized copy (scales factor out of the attention einsums; see
-    ``_block``).  Measured on a v5e chip (1.35B shape, 8 slots at position
-    256): with int8 weights and window=512, the int8 cache lifts decode
-    from 780 to 812 tok/s (1.30x over the bf16 baseline's 623), and still
-    wins at full capacity (1.21x).  Opt-in: ``spec.tpu.quantize: int8kv``
-    (KV rounding costs ~1e-2 relative logit error).
+    ``_block_decode_deferred``).  With the round-3 deferred-write decode
+    (v5e chip, 1.35B shape, int8 weights, window=512) the int8 cache is
+    part of the 1938 tok/s @ 8 slots / 2240 @ 16 ladder (docs/PERF.md);
+    numerics are gated by bench.py's teacher-forced logit-parity fixture
+    (~3% max rel err, argmax agreement 1.0).  Opt-in:
+    ``spec.tpu.quantize: int8kv``.
     """
 
     k8: jax.Array  # int8   [L, B, T, NKV, D]
