@@ -389,16 +389,16 @@ def bench_serve_path() -> dict:
         }
     ).encode()
 
+    def one_request(url: str, timeout: float) -> float:
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        urllib.request.urlopen(req, timeout=timeout).read()
+        return time.perf_counter() - t0
+
     def fire(url: str, n: int, timeout: float = 30.0) -> list[float]:
-        lat = []
-        for _ in range(n):
-            t0 = time.perf_counter()
-            req = urllib.request.Request(
-                url, data=body, headers={"Content-Type": "application/json"}
-            )
-            urllib.request.urlopen(req, timeout=timeout).read()
-            lat.append(time.perf_counter() - t0)
-        return lat
+        return [one_request(url, timeout) for _ in range(n)]
 
     def fire_alternating(urls: tuple, n_pairs: int, timeout: float = 30.0):
         """Alternate between URLs per request so environment drift (the
@@ -407,12 +407,7 @@ def bench_serve_path() -> dict:
         lats: tuple[list[float], ...] = tuple([] for _ in urls)
         for _ in range(n_pairs):
             for which, url in enumerate(urls):
-                t0 = time.perf_counter()
-                req = urllib.request.Request(
-                    url, data=body, headers={"Content-Type": "application/json"}
-                )
-                urllib.request.urlopen(req, timeout=timeout).read()
-                lats[which].append(time.perf_counter() - t0)
+                lats[which].append(one_request(url, timeout))
         return lats
 
     def measure_pair(urls: tuple, clients: int = 8, per_client: int = 12):

@@ -172,8 +172,17 @@ class TrafficGenerator:
     """Continuous client traffic through the router (the gate needs live
     samples on both predictors; in production this is user traffic)."""
 
-    def __init__(self, router_port: int, model_name: str = "iris", body: bytes | None = None):
-        self.url = f"http://127.0.0.1:{router_port}/v2/models/{model_name}/infer"
+    def __init__(
+        self,
+        router_port: int,
+        model_name: str = "iris",
+        body: bytes | None = None,
+        path: str = "infer",
+    ):
+        # ``path="generate"`` drives the continuous-batching causal-LM
+        # endpoint instead — the router proxies (and records gate
+        # histograms for) every model path the same way.
+        self.url = f"http://127.0.0.1:{router_port}/v2/models/{model_name}/{path}"
         self.body = body or json.dumps(
             {
                 "inputs": [

@@ -488,3 +488,145 @@ def test_two_concurrent_crs_share_the_real_plane(servers, iris_models):
             r.stop()
         for h in handles_b:
             h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Generation canary: an LLM (causal-LM) model family promoted under live
+# /generate traffic — proves the canary machinery is model-family agnostic
+# end to end, including continuous-batching servers behind the router.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def llm_models(tmp_path_factory):
+    import jax
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.models import (
+        llama,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.server.loader import (
+        save_native_model,
+    )
+
+    root = tmp_path_factory.mktemp("llm")
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    uris = {}
+    for tag, seed in (("1", 3), ("2", 4)):  # two distinguishable versions
+        art = root / f"v{tag}"
+        save_native_model(
+            art,
+            "llama-generate",
+            llama.init(jax.random.key(seed), cfg),
+            config={
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "num_layers": cfg.num_layers,
+                "num_heads": cfg.num_heads,
+                "num_kv_heads": cfg.num_kv_heads,
+                "intermediate_size": cfg.intermediate_size,
+                "max_seq": cfg.max_seq,
+            },
+        )
+        uris[tag] = str(art)
+    return uris
+
+
+def test_generation_canary_on_live_metrics(llm_models):
+    import json as _json
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.base import (
+        ObjectRef,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.config import (
+        TpuSpec,
+    )
+
+    ports = {}
+    handles = []
+    for tag, uri in llm_models.items():
+        port = free_port()
+        handles.append(
+            start_model_server(
+                uri,
+                f"v{tag}",
+                port,
+                model_name="llm",
+                namespace="models",
+                tpu=TpuSpec.from_spec(
+                    {"meshShape": {"tp": 1}, "maxBatchSize": 2, "maxSlots": 2}
+                ),
+            )
+        )
+        ports[f"v{tag}"] = port
+
+    router = RouterProcess(port=free_port(), backends={}, namespace="models").start()
+    sync = RouterSync(router.admin, lambda pred: ("127.0.0.1", ports[pred]))
+    kube = SyncingKube(sync)
+    registry = FakeRegistry()
+    registry.register("llm", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias("llm", "prod", "1")
+    rt = OperatorRuntime(
+        kube,
+        registry,
+        metrics=RouterMetricsSource(router.admin),
+        clock=SystemClock(),
+        sync_interval_s=0.05,
+    )
+    ref = ObjectRef(namespace="models", name="llm", **CR)
+    # Generation requests take tens of ms on CPU: latency tolerances and
+    # pacing must absorb that (the gate still judges REAL histograms).
+    spec = base_spec(
+        modelName="llm",
+        thresholds={
+            "latencyP95": 30.0,
+            "latencyAvg": 30.0,
+            "errorRate": 1.0,
+            "errorRateFloor": 0.5,
+            "minSampleCount": 2,
+        },
+        canary={
+            "step": 50,
+            "stepInterval": 0.3,
+            "attemptDelay": 0.3,
+            "maxAttempts": 60,
+            "initialTraffic": 50,
+            "metricsWindow": 5,
+        },
+    )
+    body = _json.dumps({"prompt_ids": [5, 9, 2], "max_new_tokens": 3}).encode()
+    gen = None
+    try:
+        kube.create(ref, {"spec": spec})
+        t = threading.Thread(target=rt.serve, daemon=True)
+        t.start()
+
+        def status():
+            return kube.get(ref).get("status") or {}
+
+        wait_for(lambda: status().get("phase") == "Stable", what="v1 Stable")
+        assert router.admin.get_weights() == {"v1": 100}
+
+        gen = TrafficGenerator(router.port, model_name="llm", body=body,
+                               path="generate")
+        gen.__enter__()
+        wait_for(lambda: gen.sent - gen.errors > 10, what="baseline gen traffic")
+
+        registry.register("llm", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+        registry.set_alias("llm", "prod", "2")
+        wait_for(
+            lambda: status().get("phase") == "Stable"
+            and status().get("currentModelVersion") == "2",
+            timeout=180.0,
+            what="LLM canary promoted to v2 on live /generate metrics",
+        )
+        assert router.admin.get_weights() == {"v2": 100}
+        assert "PromotionComplete" in kube.event_reasons()
+        # the gate judged REAL generation traffic recorded by the router
+        assert 'predictor_name="v2"' in router.admin.metrics_text()
+    finally:
+        if gen is not None:
+            gen.__exit__()
+        rt.stop()
+        router.stop()
+        for h in handles:
+            h.stop()
