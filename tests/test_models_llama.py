@@ -117,3 +117,41 @@ def test_cache_is_static_shape():
     _, cache2 = llama.forward(params, ids, cache, TINY)
     assert cache2.k.shape == cache.k.shape  # capacity never changes
     assert int(cache2.length) == 4
+
+
+def test_decode_self_attention_at_exact_window_boundary():
+    """A row whose position EQUALS the attention window must still attend
+    its own current token (via the deferred-decode self-term).  The old
+    write-then-attend design sliced the cache to [0, window) AFTER
+    writing the current token at index == window — dropping the query's
+    self-attention exactly at power-of-two bucket boundaries (the
+    engine's window policy produces window == position there).
+    Oracle: a window that comfortably covers everything."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(2), cfg, dtype=jnp.float32)
+    W = 16  # the boundary window
+
+    def run(window):
+        cache = llama.RaggedKVCache.create(cfg, 1, jnp.float32)
+        # teacher-force W tokens so positions 0..W-1 hold real content
+        logits = None
+        for i in range(W):
+            tok = jnp.asarray([[(7 * i) % cfg.vocab_size]], jnp.int32)
+            logits, cache = llama.decode_ragged(
+                params, tok, cache, cfg, dtype=jnp.float32, window=64
+            )
+        # the step at position == W, with the boundary window
+        tok = jnp.asarray([[5]], jnp.int32)
+        logits, _ = llama.decode_ragged(
+            params, tok, cache, cfg, dtype=jnp.float32, window=window
+        )
+        return np.asarray(logits[0, -1])
+
+    at_boundary = run(window=W)      # position W, window W
+    oracle = run(window=64)          # same state, window covers all
+    np.testing.assert_allclose(at_boundary, oracle, rtol=2e-5, atol=2e-5)
