@@ -221,6 +221,60 @@ class TrafficGenerator:
         self._stop.set()
 
 
+class DeploymentSyncWatcher:
+    """Watch SeldonDeployments on a (real) API server and push each
+    change's traffic split into the router — the role Seldon's controller
+    + Istio play in-cluster, reduced to its data-plane essence.
+
+    Unlike :class:`SyncingKube` (a FakeKube subclass that intercepts
+    writes in-process), this consumes the apiserver's WATCH STREAM, so an
+    operator talking to a real (or envtest) API server over HTTP gets its
+    weight changes applied the same way a production controller would:
+    asynchronously, from events.
+    """
+
+    def __init__(self, kube, sync: RouterSync, namespace: str = "models"):
+        from .base import SELDONDEPLOYMENT, ObjectRef, WatchExpired
+
+        self._kube = kube
+        self._sync = sync
+        self._ref = ObjectRef(namespace=namespace, name="", **SELDONDEPLOYMENT)
+        self._WatchExpired = WatchExpired
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> "DeploymentSyncWatcher":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        rv = None
+        while not self._stop.is_set():
+            try:
+                if rv is None:
+                    items, rv = self._kube.list_with_version(self._ref)
+                    for obj in items:
+                        self._sync.sync_manifest(obj)
+                for ev in self._kube.watch(
+                    self._ref, resource_version=rv, timeout_s=5,
+                    stop=self._stop,
+                ):
+                    rv = (ev.object.get("metadata") or {}).get(
+                        "resourceVersion", rv
+                    )
+                    if ev.type in ("ADDED", "MODIFIED"):
+                        self._sync.sync_manifest(ev.object)
+            except self._WatchExpired:
+                rv = None  # re-list
+            except Exception:
+                if not self._stop.is_set():
+                    time.sleep(0.1)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
 def train_iris_pair(root) -> dict[str, str]:
     """Two distinguishable sklearn iris models saved as v1/v2 artifacts —
     the canary pair used by both the e2e tests and the benchmark."""
