@@ -1156,6 +1156,110 @@ def _llama_7b_inner() -> None:
     })
 
 
+# ---------------------------------------------------------------------------
+# Driver-line compaction (VERDICT r3 #1)
+# ---------------------------------------------------------------------------
+
+# The driver captures only the last ~2 KB of stdout; round 3's final line
+# outgrew that (slot ladders + prose notes) and the official record lost
+# the round's headline (BENCH_r03.json "parsed": null).  The full record
+# now goes to BENCH_DETAIL.json and stderr; stdout carries one compact,
+# size-guarded headline line.
+COMPACT_BUDGET_BYTES = 1500
+
+# Per-secondary allowlist of the keys that belong on the headline line.
+# Everything else (ladders, parity fixtures, notes, breakdowns) lives in
+# BENCH_DETAIL.json.
+_COMPACT_KEYS = {
+    "time_to_100pct_traffic": (
+        "measured_s", "policy_floor_s", "operator_overhead_s"),
+    "iris_sklearn_linear": ("p50_us",),
+    "xgboost_forest": ("p50_us", "eval_form"),
+    "resnet50": ("img_per_s", "p50_ms", "mfu"),
+    "llama_1p35b_decode": (
+        "device_tok_per_s", "slots", "bw_util_at_best"),
+    "serve_path_http": (
+        "server_queue_mean_ms", "server_device_run_mean_ms",
+        "server_observed_mean_ms", "router_overhead_p50_ms",
+        "router_overhead_p99_ms", "batch_fill_mean"),
+    "llama_7b_decode": (
+        "device_tok_per_s", "slots", "bw_util_at_best", "load_s",
+        "warm_load_s", "vs_gpu_per_gbps"),
+}
+
+# Top-level keys dropped one by one (least headline-y first) if the
+# compact line still exceeds the budget after secondary compaction.
+_SHED_ORDER = (
+    "numerics", "hardware", "parity_vs_bf16_erf", "bf16_tflops",
+    "bf16_mfu", "baseline_cpu_p99_ms", "throughput_seq_per_s",
+    "bf16_p99_ms", "tflops", "vs_gpu_baseline", "device_p99_ms",
+    "secondary",
+)
+
+
+def compact_line(full: dict) -> dict:
+    """Shrink the full bench record to a driver-parseable headline.
+
+    Deterministic and total: any secondary entry (including error /
+    skipped shapes) compacts to a few scalars; the result is re-checked
+    against ``COMPACT_BUDGET_BYTES`` and sheds optional fields in
+    ``_SHED_ORDER`` until it fits.  The driver contract keys (metric /
+    value / unit / vs_baseline) are never shed.
+    """
+    line = {k: v for k, v in full.items() if k != "secondary"}
+    sec = {}
+    for name, entry in (full.get("secondary") or {}).items():
+        if not isinstance(entry, dict):
+            sec[name] = entry
+            continue
+        keep = {}
+        for k in _COMPACT_KEYS.get(name, ()):
+            if k in entry:
+                keep[k] = entry[k]
+        for k in ("error", "skipped"):
+            if k in entry and not keep:
+                # One-line reason, control chars stripped (the r03 tail
+                # carried raw ANSI escapes from a compile-helper 500).
+                msg = "".join(
+                    ch for ch in str(entry[k]) if ch.isprintable()
+                )[:80]
+                keep[k] = msg
+        if not keep:  # unknown shape: first few scalars, stable order
+            for k, v in entry.items():
+                if isinstance(v, (int, float)) and len(keep) < 3:
+                    keep[k] = v
+        sec[name] = keep
+    line["secondary"] = sec
+    line["detail"] = "BENCH_DETAIL.json"
+
+    for victim in _SHED_ORDER:
+        if len(json.dumps(line)) <= COMPACT_BUDGET_BYTES:
+            break
+        line.pop(victim, None)
+    return line
+
+
+def emit_record(full: dict) -> None:
+    """Persist the full record, then print the compact driver line.
+
+    stdout gets ONE line (the driver contract); the full record goes to
+    ``BENCH_DETAIL.json`` next to this file and to stderr for the log.
+    """
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+    )
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(full, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"could not write {detail_path}: {e}", file=sys.stderr)
+    print("FULL " + json.dumps(full), file=sys.stderr)
+    out = json.dumps(compact_line(full))
+    assert len(out) <= COMPACT_BUDGET_BYTES + 200, len(out)
+    print(out)
+
+
 def main() -> None:
     b = bench_bert()
     tpu = b["int8"]
@@ -1230,7 +1334,7 @@ def main() -> None:
         "hardware": "TPU v5e (1 chip)",
         "secondary": secondary,
     }
-    print(json.dumps(line))
+    emit_record(line)
 
 
 if __name__ == "__main__":

@@ -238,8 +238,8 @@ class RouterMetricsSource:
             ("namespace", namespace),
         }
 
-        def delta(name: str, le: bool = False):
-            """(current - base) per bucket/code over series matching identity.
+        def delta(name: str, key: str = "code"):
+            """(current - base) per label value over series matching identity.
 
             Clamped at 0 per series: a counter that went BACKWARD means the
             series was reset (predictor removed and re-added, router
@@ -251,11 +251,11 @@ class RouterMetricsSource:
                 if n != name or not ident <= labels:
                     continue
                 ld = dict(labels)
-                key = ld.get("le", "") if le else ld.get("code", "")
-                out[key] = out.get(key, 0.0) + max(0.0, v - base.get((n, labels), 0.0))
+                k = ld.get(key, "")
+                out[k] = out.get(k, 0.0) + max(0.0, v - base.get((n, labels), 0.0))
             return out
 
-        bucket_deltas = delta(self._CLIENT + "_bucket", le=True)
+        bucket_deltas = delta(self._CLIENT + "_bucket", key="le")
         buckets = sorted(
             ((float(le), c) for le, c in bucket_deltas.items()),
             key=lambda x: x[0],
@@ -266,6 +266,11 @@ class RouterMetricsSource:
         by_code = delta(self._SERVER + "_count")
         server_total = sum(by_code.values())
         errors = sum(v for code, v in by_code.items() if code != "200")
+        # service="feedback" series from the router's own histograms —
+        # the count the reference reads at mlflow_operator.py:410-415.
+        feedback = delta(self._SERVER + "_count", key="service").get(
+            "feedback", 0.0
+        )
 
         return ModelMetrics(
             latency_p95=_histogram_quantile(0.95, buckets),
@@ -273,7 +278,7 @@ class RouterMetricsSource:
             error_rate=(errors / server_total) if server_total > 0 else None,
             latency_avg=(total_sum / count) if count > 0 else None,
             request_count=count,
-            feedback_request_count=0.0,
+            feedback_request_count=feedback,
         )
 
 

@@ -115,9 +115,12 @@ struct Backend {
   sockaddr_in addr{};    // resolved at config time (getaddrinfo)
   uint32_t addr_epoch = 0;  // bumped on repoint; gates pool admission
 
-  Histogram client_latency;                    // client_requests_seconds
-  std::map<std::string, Histogram> by_code;    // server_requests_seconds{code=}
-  std::vector<int> idle_conns;                 // keep-alive pool (fds)
+  Histogram client_latency;  // client_requests_seconds (predictions only)
+  // server_requests_seconds{code=,service=} keyed (code, service): the
+  // gate counts errors across services (mlflow_operator.py:375) and
+  // feedback volume via service="feedback" (:410-415).
+  std::map<std::pair<std::string, std::string>, Histogram> by_code;
+  std::vector<int> idle_conns;  // keep-alive pool (fds)
 };
 
 // Resolve host:port once at config time (k8s service names and "localhost"
@@ -474,7 +477,8 @@ struct ClientConn {
   BackendPtr backend;  // chosen for current request
   double t_start = 0;  // request receipt time
   int retries = 0;     // stale pooled-connection retries this request
-  bool closing = false;  // close after out drains
+  bool closing = false;   // close after out drains
+  bool feedback = false;  // current request is /api/v1.0/feedback
 };
 
 struct FdEntry {
@@ -590,13 +594,14 @@ std::string metrics_text() {
   }
   out += "# TYPE seldon_api_executor_server_requests_seconds histogram\n";
   for (auto& b : g_state.backends) {
-    for (auto& [code, hist] : b->by_code) {
+    for (auto& [key, hist] : b->by_code) {
+      const auto& [code, service] = key;
       char labels[320];
       snprintf(labels, sizeof(labels),
                "deployment_name=\"%s\",predictor_name=\"%s\",namespace=\"%s\","
-               "code=\"%s\",service=\"predictions\"",
+               "code=\"%s\",service=\"%s\"",
                g_state.deployment.c_str(), b->name.c_str(), g_state.ns.c_str(),
-               code.c_str());
+               code.c_str(), service.c_str());
       emit_histogram(&out, "seldon_api_executor_server_requests_seconds", labels,
                      hist);
     }
@@ -787,9 +792,13 @@ void handle_admin(ClientConn* c) {
 // Proxying
 // ---------------------------------------------------------------------------
 
-void finish_request(const BackendPtr& b, int code, double seconds) {
-  b->client_latency.observe(seconds);
-  b->by_code[std::to_string(code)].observe(seconds);
+void finish_request(const BackendPtr& b, int code, double seconds,
+                    bool feedback) {
+  // Feedback posts count under their own service label but stay out of
+  // the latency histogram the gate's p95/mean queries read.
+  if (!feedback) b->client_latency.observe(seconds);
+  b->by_code[{std::to_string(code), feedback ? "feedback" : "predictions"}]
+      .observe(seconds);
   g_state.proxied_total++;
 }
 
@@ -797,7 +806,7 @@ void advance_client(ClientConn* c);  // defined below
 
 void fail_502(ClientConn* c, const char* why) {
   if (c->backend)
-    finish_request(c->backend, 502, now_s() - c->t_start);
+    finish_request(c->backend, 502, now_s() - c->t_start, c->feedback);
   client_send(c, http_response(502, "Bad Gateway", "text/plain",
                                std::string(why) + "\n"));
   if (c->upstream) {
@@ -937,6 +946,7 @@ void dispatch_request(ClientConn* c) {
     handle_admin(c);
     c->req.reset();
   } else {
+    c->feedback = c->req.path == "/api/v1.0/feedback";
     start_proxy(c);
   }
 }
@@ -1103,7 +1113,7 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
     if (!u->resp.headers_complete()) u->resp.try_parse_headers(/*is_request=*/false);
     if (u->resp.headers_complete() && u->resp.complete(/*is_request=*/false, eof)) {
       double dt = now_s() - c->t_start;
-      finish_request(u->backend, u->resp.status, dt);
+      finish_request(u->backend, u->resp.status, dt, c->feedback);
       // A close-delimited response (no Content-Length, not chunked, not a
       // no-body status) is forwarded verbatim — the CLIENT can then only
       // find the body's end by connection close, so close our side too.

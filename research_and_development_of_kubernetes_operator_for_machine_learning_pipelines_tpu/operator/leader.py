@@ -27,6 +27,7 @@ from __future__ import annotations
 import datetime
 import logging
 import os
+import re
 import socket
 import threading
 import uuid
@@ -49,24 +50,32 @@ def _now_iso(clock: Clock) -> str:
 
 
 def _parse_iso(ts: str | None) -> float | None:
-    # A renewTime written by another client with no fractional seconds —
-    # or RFC3339Nano's nine digits — must NOT parse to None, or the
-    # challenger treats a live lease as takeable and two leaders run
-    # concurrently.  The fraction is normalized to microseconds by hand:
-    # fromisoformat only accepts arbitrary precision from 3.11 on, and
-    # this package supports 3.10.
+    # A renewTime written by another client with no fractional seconds,
+    # RFC3339Nano's nine digits, or a numeric UTC offset instead of 'Z'
+    # (e.g. ``...+00:00``) must NOT parse to None, or the challenger
+    # treats a live lease as takeable and two leaders run concurrently.
+    # The fraction is normalized to microseconds by hand: fromisoformat
+    # only accepts arbitrary precision (and 'Z') from 3.11 on, and this
+    # package supports 3.10.
     if not ts:
         return None
     try:
-        base = ts.rstrip("Z")
-        frac = "0"
+        s = ts.strip().rstrip("Zz")
+        offset_s = 0
+        m = re.search(r"([+-])(\d{2}):?(\d{2})$", s)
+        if m:
+            offset_s = (int(m.group(2)) * 3600 + int(m.group(3)) * 60) * (
+                1 if m.group(1) == "+" else -1
+            )
+            s = s[: m.start()]
+        base, frac = s, "0"
         if "." in base:
             base, frac = base.split(".", 1)
             frac = (frac + "000000")[:6]
         dt = datetime.datetime.strptime(base, "%Y-%m-%dT%H:%M:%S")
         return dt.replace(
             microsecond=int(frac), tzinfo=datetime.timezone.utc
-        ).timestamp()
+        ).timestamp() - offset_s
     except ValueError:
         return None
 

@@ -19,6 +19,35 @@ _log = logging.getLogger(__name__)
 _initialized = False
 
 
+def configure_cpu_rehearsal(num_local_devices: int = 1) -> None:
+    """Rehearse the multi-host (DCN) path on CPU processes.
+
+    Selects the CPU backend and its cross-process collectives
+    implementation (Gloo) so ``maybe_initialize_distributed`` can form a
+    REAL ``jax.distributed`` group between OS processes on one machine:
+    after it, ``jax.device_count() > jax.local_device_count()`` and
+    ``psum``/``all_gather`` genuinely cross process boundaries — the same
+    code path a v5e multi-host slice takes over DCN, minus the TPU
+    transport.  Must run before the group forms; it drops any
+    already-created backends because environments that pre-import JAX
+    (or pytest's conftest) may have initialized a different platform.
+
+    Proven by ``tests/test_distributed_group.py``: two processes, one
+    coordinator, a cross-process ``psum`` with bitwise-checked results on
+    both ranks (SURVEY §2.3 distributed-comm-backend obligation).
+    """
+    import jax
+    from jax.extend import backend
+
+    # Clear BEFORE the device-count update: with a backend already live
+    # (pre-imported JAX), jax_num_cpu_devices raises "config should be
+    # updated before backends are initialized".
+    jax.config.update("jax_platforms", "cpu")
+    backend.clear_backends()
+    jax.config.update("jax_num_cpu_devices", num_local_devices)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
 def maybe_initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -208,6 +208,40 @@ class TpuInferenceServer:
         finally:
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
 
+    async def handle_feedback(self, request: web.Request) -> web.Response:
+        """Seldon feedback API (``/api/v1.0/feedback``).
+
+        The reference's metric collector counts these per predictor
+        (``mlflow_operator.py:410-415``, ``service="feedback"``) — in the
+        reference stack Seldon's executor serves the route; here the
+        first-party data plane does.  The body is the Seldon shape
+        ``{"request": .., "response": .., "reward": r, "truth": ..}``;
+        the count (and reward sum) is the product — feedback is reward
+        signal, not inference, so nothing is recomputed.
+        """
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("feedback body must be a JSON object")
+            reward = body.get("reward", 0.0)
+            if not isinstance(reward, (int, float)):
+                raise ValueError("reward must be a number")
+            self.metrics.observe_feedback_reward(float(reward))
+            return web.json_response({"meta": {}})
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            code = 400
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            _log.exception("feedback handling failed")
+            code = 500
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self.metrics.observe_request(
+                time.perf_counter() - t0, code=code, service="feedback"
+            )
+
     async def handle_generate(self, request: web.Request) -> web.Response:
         """Text generation with continuous batching (causal-LM flavors only).
 
@@ -485,6 +519,7 @@ class TpuInferenceServer:
         if self.gen_engine is not None:
             app.router.add_post(f"/v2/models/{name}/generate", self.handle_generate)
         app.router.add_post("/api/v1.0/predictions", self.handle_seldon_predict)
+        app.router.add_post("/api/v1.0/feedback", self.handle_feedback)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_post("/debug/profile", self.handle_profile)
 

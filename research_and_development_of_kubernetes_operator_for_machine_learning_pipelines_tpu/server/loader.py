@@ -263,6 +263,13 @@ def _finish_native(
                 import dataclasses
 
                 cfg = dataclasses.replace(cfg, hidden_act="gelu_tanh")
+                # Numerics change vs the same artifact served bf16 —
+                # surface it at load time, not just in a code comment.
+                _log.info(
+                    "int8 path substituting hidden_act=gelu_tanh for "
+                    "artifact without a hidden_act pin (set hidden_act "
+                    "in the saved config to keep exact-erf GELU)"
+                )
         else:
             raise ModelLoadError(
                 f"quantize={quantize!r} is not supported for flavor "
@@ -450,8 +457,9 @@ def _stream_native_params(npz_path: Path, quantize_leaves: tuple = ()) -> Any:
                 # just the int8 tree (no full-precision leaf ever lands
                 # on device).  Same scheme as quantization.quantize_tensor
                 # (symmetric, per-output-channel over axis=-2, epsilon,
-                # round-half-even) — parity asserted in
-                # tests/test_server.py streamed-vs-jit quantize test.
+                # round-half-even) — parity asserted in tests/
+                # test_quantization.py::test_streamed_host_quantize_
+                # matches_device_quantize.
                 w32 = np.asarray(arr, dtype=np.float32)
                 del arr
                 amax = np.max(np.abs(w32), axis=-2, keepdims=True)

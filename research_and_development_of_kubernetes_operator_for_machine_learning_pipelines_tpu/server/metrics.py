@@ -122,14 +122,35 @@ class ServerMetrics:
             ident_labels,
             registry=self.registry,
         )
+        # First-party reward telemetry for the Seldon feedback API
+        # (``/api/v1.0/feedback``); the gate-visible count lives in
+        # ``server_requests{service="feedback"}`` (``:410-415``).  A
+        # Gauge, not a Counter: rewards are arbitrary floats (negative =
+        # penalty signal) and the sum must not silently drop them.
+        self.feedback_reward = Gauge(
+            "tpumlops_feedback_reward_total",
+            "Running sum of rewards posted to the feedback endpoint "
+            "(may decrease: negative rewards are penalties)",
+            ident_labels,
+            registry=self.registry,
+        )
 
     # -- recording helpers ---------------------------------------------------
 
     def observe_request(self, seconds: float, code: int = 200, service: str = "predictions"):
-        self.client_requests.labels(**self.identity).observe(seconds)
+        # client_requests feeds the gate's latency percentiles
+        # (``:367-372``) — inference traffic only; feedback posts land in
+        # server_requests under their own ``service`` label so the
+        # feedback count query (``:410-415``) sees them without skewing
+        # the latency gate.
+        if service == "predictions":
+            self.client_requests.labels(**self.identity).observe(seconds)
         self.server_requests.labels(
             **self.identity, code=str(code), service=service
         ).observe(seconds)
+
+    def observe_feedback_reward(self, reward: float):
+        self.feedback_reward.labels(**self.identity).inc(reward)
 
     def observe_batch(
         self, size: int, queue_seconds: float, run_seconds: float = 0.0

@@ -1,0 +1,206 @@
+"""Driver-contract tests for bench.py's final stdout line.
+
+Round 3's official record was lost because the final JSON line outgrew
+the driver's ~2 KB stdout tail capture (BENCH_r03.json "parsed": null).
+These tests pin the contract: ``compact_line`` must keep the headline
+(BERT p99 / MFU / vs_baseline) and stay under the byte budget even when
+every secondary bench returns its fattest possible payload — ladders,
+prose notes, multi-line error strings with ANSI escapes.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def _fat_full_record() -> dict:
+    """A record modeled on the ACTUAL round-3 output that broke parsing:
+    full slot ladders, long notes, and the raw compile-helper 500 with
+    embedded ANSI escape sequences."""
+    ansi_error = (
+        "JaxRuntimeError: INTERNAL: http://127.0.0.1:8103/remote_compile: "
+        "HTTP 500: tpu_compile_helper subprocess exit code 1\n"
+        "[2m2026-07-31T04:27:22.482386Z[0m [33m W"
+        + "x" * 400
+    )
+    ladder_1p35 = {
+        str(s): {
+            "tok_per_s": 2240.5 - s,
+            "ms_per_step": 14.28,
+            "hbm_gb_per_s": 335.3,
+            "bw_util": 0.409,
+        }
+        for s in (8, 16, 32, 64)
+    }
+    return {
+        "metric": "bert_base_b32_s128_p99_batch_latency_per_chip",
+        "value": 4.31,
+        "unit": "ms",
+        "vs_baseline": 104.3,
+        "p50_ms": 3.55,
+        "numerics": "int8 acts+weights on the MXU s8 path, tanh-GELU "
+                    "(the int8 serving default; bf16 erf comparison in "
+                    "bf16_p99_ms)",
+        "parity_vs_bf16_erf": {"max_abs_logit_diff": 0.031},
+        "bf16_p99_ms": 7.31,
+        "throughput_seq_per_s": 9014.1,
+        "tflops": 41.3,
+        "mfu_vs_s8_peak": 0.105,
+        "bf16_tflops": 24.4,
+        "bf16_mfu": 0.124,
+        "baseline_cpu_p99_ms": 449.5,
+        "vs_gpu_baseline": {"t4_int8": 2.2, "a100": 0.46},
+        "hardware": "TPU v5e (1 chip)",
+        "secondary": {
+            "time_to_100pct_traffic": {
+                "measured_s": 5.43,
+                "policy_floor_s": 4.2,
+                "operator_overhead_s": 1.23,
+                "step_interval_s": 0.5,
+                "ref_floor_same_policy_s": 480,
+                "traffic_split": "native router (smooth WRR), gate on "
+                                 "its live histograms",
+                "overhead_breakdown_ms": {
+                    "alias_resolve": 101.9, "apply": 55.2, "gate": 40.1,
+                    "metrics": 230.8, "status": 60.0,
+                    "reconcile_steps_total": 600.1, "other": 112.1,
+                },
+            },
+            "iris_sklearn_linear": {"p50_us": 28.1, "batch": 32},
+            "xgboost_forest": {
+                "p50_us": 79.0, "trees": 200, "batch": 256,
+                "eval_form": "gemm",
+            },
+            "resnet50": {
+                "ladder": {
+                    "8": {"p50_ms": 5.0, "img_per_s": 1601.0,
+                          "tflops": 6.6, "mfu": 0.033},
+                    "32": {"p50_ms": 11.4, "img_per_s": 2801.2,
+                           "tflops": 11.5, "mfu": 0.058},
+                    "128": {"p50_ms": 38.6, "img_per_s": 3313.7,
+                            "tflops": 13.6, "mfu": 0.069},
+                },
+                "p50_ms": 38.6, "img_per_s": 3313.7, "tflops": 13.6,
+                "mfu": 0.069,
+                "vs_gpu_baseline": {"t4_int8_mlperf": 0.59,
+                                    "a100_int8_mlperf": 0.09},
+            },
+            "llama_1p35b_decode": {
+                "device_tok_per_s": 2240.5,
+                "ms_per_step": 14.28,
+                "slots": 32,
+                "slot_ladder": ladder_1p35,
+                "bw_util_at_best": 0.409,
+                "params_b": 1.35,
+                "numerics": "int8 weights + int8 kv + windowed decode "
+                            "(window=512)",
+                "int8kv_parity_vs_bf16kv": {
+                    "teacher_forced_steps": 26,
+                    "max_rel_logit_err": 0.0087,
+                    "argmax_agreement": 1.0,
+                },
+                "note": "engine-loop tok/s is not reported from this dev "
+                        "environment: the per-tick host read rides a "
+                        "~65 ms device tunnel (BENCH_r02 measured 70.7 "
+                        "tok/s engine vs 787.6 device for identical "
+                        "compute) — the device loop is the chip number.",
+            },
+            "serve_path_http": {
+                "direct": {"p50_ms": 201.4, "p99_ms": 249.1,
+                           "requests": 96},
+                "via_router": {"p50_ms": 201.8, "p99_ms": 273.0,
+                               "requests": 96},
+                "router_overhead_p50_ms": 0.37,
+                "server_observed_mean_ms": 208.73,
+                "server_queue_mean_ms": 87.28,
+                "server_device_run_mean_ms": 109.48,
+                "server_overhead_ms": 11.97,
+                "clients": 8,
+                "batch_per_request": 1,
+                "numerics": "int8",
+                "note": "this dev environment reaches the chip through a "
+                        "device tunnel (~65 ms RTT per dispatch) which "
+                        "dominates these absolutes; on a TPU host the "
+                        "compute floor is the headline per-batch latency. "
+                        "router_overhead is the env-independent signal "
+                        "here.",
+            },
+            "llama_7b_decode": {
+                "device_tok_per_s": 663.5,
+                "ms_per_step": 24.11,
+                "slots": 16,
+                "slot_ladder": {
+                    "8": {"tok_per_s": 488.5, "ms_per_step": 16.4,
+                          "hbm_gb_per_s": 488.5, "bw_util": 0.596},
+                    "16": {"tok_per_s": 663.5, "ms_per_step": 24.11,
+                           "hbm_gb_per_s": 377.0, "bw_util": 0.46},
+                    "32": {"error": ansi_error},
+                },
+                "bw_util_at_best": 0.46,
+                "params_b": 6.74,
+                "weight_bytes_gib": 6.4,
+                "load_s": 545.9,
+                "numerics": "int8 weights + int8 kv + windowed decode "
+                            "(window=512)",
+                "vs_gpu_baseline": {"a100_80g_fp16_vllm": 0.35},
+            },
+        },
+    }
+
+
+def test_compact_line_fits_driver_tail():
+    out = json.dumps(bench.compact_line(_fat_full_record()))
+    assert len(out) <= bench.COMPACT_BUDGET_BYTES, len(out)
+    parsed = json.loads(out)  # round-trips
+    # Driver contract keys survive compaction.
+    assert parsed["metric"] == "bert_base_b32_s128_p99_batch_latency_per_chip"
+    assert parsed["value"] == 4.31
+    assert parsed["unit"] == "ms"
+    assert parsed["vs_baseline"] == 104.3
+    # The round-3 loss: BERT p99 and MFU must be ON the parsed line.
+    assert parsed["mfu_vs_s8_peak"] == 0.105
+    assert parsed["p50_ms"] == 3.55
+
+
+def test_compact_line_keeps_secondary_headlines():
+    parsed = bench.compact_line(_fat_full_record())
+    sec = parsed["secondary"]
+    assert sec["llama_7b_decode"]["device_tok_per_s"] == 663.5
+    assert sec["llama_7b_decode"]["load_s"] == 545.9
+    assert sec["llama_1p35b_decode"]["device_tok_per_s"] == 2240.5
+    assert sec["time_to_100pct_traffic"]["measured_s"] == 5.43
+    assert sec["serve_path_http"]["server_queue_mean_ms"] == 87.28
+    # Ladders and notes are detail-file material, not headline material.
+    assert "slot_ladder" not in sec["llama_7b_decode"]
+    assert "note" not in sec["llama_1p35b_decode"]
+    assert parsed["detail"] == "BENCH_DETAIL.json"
+
+
+def test_compact_line_sanitizes_error_entries():
+    full = _fat_full_record()
+    full["secondary"]["llama_7b_decode"] = {
+        "error": "timeout after 900s (wedged remote compile)\n"
+                 "[2mtrace[0m " + "y" * 500,
+    }
+    full["secondary"]["resnet50"] = {"skipped": "wall budget 2400s spent"}
+    parsed = bench.compact_line(full)
+    err = parsed["secondary"]["llama_7b_decode"]["error"]
+    assert len(err) <= 80
+    assert "" not in err and "\n" not in err
+    assert parsed["secondary"]["resnet50"]["skipped"].startswith("wall budget")
+
+
+def test_compact_line_sheds_to_budget_without_losing_contract():
+    full = _fat_full_record()
+    # Adversarial: a secondary with a huge allowlisted value set.
+    full["secondary"]["llama_7b_decode"]["vs_gpu_per_gbps"] = 0.88
+    full["notes_blob"] = "z" * 5000  # unknown top-level key, not shed-able
+    # Unknown top-level keys ride along unless shedding must remove known
+    # optional ones; the contract keys must always survive.
+    parsed = bench.compact_line(full)
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in parsed

@@ -27,6 +27,10 @@ from tpumlops.clients.base import (
 from tpumlops.clients.envtest import EnvtestServer
 from tpumlops.clients.kube_rest import KubeRestClient
 
+# Real HTTP apiserver per test module: excluded from the fast core
+# (`make test-fast`, VERDICT r3 #10).
+pytestmark = pytest.mark.e2e
+
 
 CR = ObjectRef(namespace="models", name="iris", **MLFLOWMODEL)
 

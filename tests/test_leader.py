@@ -205,3 +205,21 @@ def test_parse_iso_accepts_varied_precision():
     assert _parse_iso("not-a-timestamp") is None
     assert _parse_iso(None) is None
     assert _parse_iso("") is None
+
+
+def test_parse_iso_accepts_numeric_utc_offsets():
+    """ADVICE r3: a renewTime with ``+00:00`` instead of ``Z`` parsed to
+    None, making the challenger treat a live lease as takeable — the
+    dual-leader hazard.  Offsets must parse AND shift to UTC."""
+    from tpumlops.operator.leader import _parse_iso
+
+    utc = _parse_iso("2026-07-31T10:00:00.123456Z")
+    assert _parse_iso("2026-07-31T10:00:00.123456+00:00") == utc
+    assert _parse_iso("2026-07-31T10:00:00.123456+0000") == utc
+    # +02:00 wall time is 2h ahead of UTC: 12:00+02:00 == 10:00Z.
+    assert _parse_iso("2026-07-31T12:00:00.123456+02:00") == utc
+    assert _parse_iso("2026-07-31T05:30:00-04:30") == _parse_iso(
+        "2026-07-31T10:00:00Z"
+    )
+    # A bare date must not have its month/day eaten as an offset.
+    assert _parse_iso("2026-07-31") is None

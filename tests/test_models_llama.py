@@ -119,6 +119,7 @@ def test_cache_is_static_shape():
     assert int(cache2.length) == 4
 
 
+@pytest.mark.slow
 def test_decode_self_attention_at_exact_window_boundary():
     """A row whose position EQUALS the attention window must still attend
     its own current token (via the deferred-decode self-term).  The old

@@ -411,6 +411,7 @@ def _gen_unit(n_hosts, cfg, params, dtype):
     return leader, followers, results, threads, channel
 
 
+@pytest.mark.slow
 def test_multihost_generation_lockstep_and_state_parity():
     import jax
     import jax.numpy as jnp
@@ -566,6 +567,7 @@ def test_multihost_gen_reset_broadcast_on_leader_failure():
     )
 
 
+@pytest.mark.slow
 def test_multihost_chunked_prefill_lockstep():
     import jax
     import jax.numpy as jnp

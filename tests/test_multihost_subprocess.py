@@ -22,6 +22,12 @@ import sys
 import textwrap
 from pathlib import Path
 
+import pytest
+
+# Spawns real OS processes (fresh JAX imports each): excluded from the
+# fast core (`make test-fast`, VERDICT r3 #10).
+pytestmark = pytest.mark.slow
+
 CHILD = textwrap.dedent(
     """
     import socket, sys, time

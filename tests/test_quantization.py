@@ -49,6 +49,7 @@ def test_quantized_llama_logits_close_and_greedy_stable():
     assert cos > 0.999, cos
 
 
+@pytest.mark.slow
 def test_quantized_params_flow_through_generation_engine():
     from tpumlops.server.generation import GenerationEngine
 
@@ -149,6 +150,7 @@ def test_dequantize_bf16_single_rounding():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_quant_kv_cache_decode_close_to_full_precision():
     from tpumlops.models.llama import QuantRaggedKVCache, RaggedKVCache
 
@@ -185,6 +187,7 @@ def test_quant_kv_cache_decode_close_to_full_precision():
     assert quant.lengths[0] == full.lengths[0]
 
 
+@pytest.mark.slow
 def test_engine_kv_quant_end_to_end():
     from tpumlops.server.generation import GenerationEngine
 
