@@ -96,6 +96,14 @@ class RouterAdmin:
     def get_config(self) -> dict:
         return json.loads(self._req("/router/config"))
 
+    def drain_latencies(self) -> list[float]:
+        """Exact router-internal per-request latencies (SECONDS) since
+        the last drain — read-and-clear.  Precise where the Prometheus
+        histogram's buckets are decades wide; used to attribute tail
+        latency to inside-the-proxy vs kernel/client scheduling."""
+        payload = json.loads(self._req("/router/latencies"))
+        return [us / 1e6 for us in payload.get("recent_us", [])]
+
     def set_config(
         self,
         backends: list[dict],

@@ -595,6 +595,14 @@ def generate_greedy(
 # ---------------------------------------------------------------------------
 
 
+# Layer-walk strategy for decode_ragged: "fori" (default — dynamic-slice
+# reads against the original cache buffers) or "scan" (cache packed as
+# scan xs).  Kept switchable so the two loop forms can be A/B'd inside
+# ONE process (scripts/ab_decode.py) — this environment's cross-process
+# timing variance (~±20%) swamps the difference otherwise.
+_DECODE_LAYER_LOOP = "fori"
+
+
 def decode_ragged(
     params: dict,
     token_ids: jax.Array,
@@ -652,17 +660,87 @@ def decode_ragged(
     valid = key_pos[None, None, :] < positions[:, :, None]  # [B, 1, W]
     mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[:, None]  # [B,1,1,W]
 
-    def scan_body(carry, layer_inputs):
-        x = carry
-        lp, ck, cv = layer_inputs
+    if _DECODE_LAYER_LOOP == "scan":
+        def scan_body(carry, layer_inputs):
+            xc = carry
+            lp, ck, cv = layer_inputs
+            y, k_new, v_new = _block_decode_deferred(
+                xc, lp, ck, cv, cos, sin, mask_bias, cfg, window=window
+            )
+            return y, (k_new, v_new)
+
+        ck0 = (cache.k8, cache.k_scale) if quant else cache.k
+        cv0 = (cache.v8, cache.v_scale) if quant else cache.v
+        x, (k_news, v_news) = lax.scan(
+            scan_body, x, (params["layers"], ck0, cv0)
+        )
+        k_news = k_news[:, :, 0]  # [L, B, NKV, D]
+        v_news = v_news[:, :, 0]
+        return _finish_decode(
+            params, x, k_news, v_news, cache, lengths, active, quant, cfg
+        )
+
+    # Default: fori_loop + dynamic_index_in_dim, NOT lax.scan with the
+    # cache as xs — packing multi-GiB buffers into a scan's xs tuple can
+    # make XLA copy them into loop state each step.  The fori body reads
+    # each layer's weights and cache slabs with dynamic slices against
+    # the ORIGINAL buffers (read-only, no loop-state packing) and
+    # accumulates the tiny per-layer K/V rows in place.  A/B on chip:
+    # scripts/ab_decode.py (the scan variant stays selectable above so
+    # both compile in ONE process — cross-process timings on this
+    # tunnel differ ±20% and cannot compare variants).
+    nlayers = cfg.num_layers
+    kv_dtype = x.dtype
+    acc_k = jnp.zeros((nlayers, b, cfg.num_kv_heads, cfg.head_dim), kv_dtype)
+    acc_v = jnp.zeros_like(acc_k)
+
+    def idx(tree, l):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, l, axis=0, keepdims=False),
+            tree,
+        )
+
+    def layer_body(l, carry):
+        x, acc_k, acc_v = carry
+        lp = idx(params["layers"], l)
+        if quant:
+            ck = (
+                lax.dynamic_index_in_dim(cache.k8, l, 0, keepdims=False),
+                lax.dynamic_index_in_dim(cache.k_scale, l, 0, keepdims=False),
+            )
+            cv = (
+                lax.dynamic_index_in_dim(cache.v8, l, 0, keepdims=False),
+                lax.dynamic_index_in_dim(cache.v_scale, l, 0, keepdims=False),
+            )
+        else:
+            ck = lax.dynamic_index_in_dim(cache.k, l, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cache.v, l, 0, keepdims=False)
         y, k_new, v_new = _block_decode_deferred(
             x, lp, ck, cv, cos, sin, mask_bias, cfg, window=window
         )
-        return y, (k_new, v_new)
+        acc_k = lax.dynamic_update_slice_in_dim(
+            acc_k, k_new[None, :, 0].astype(kv_dtype), l, axis=0
+        )
+        acc_v = lax.dynamic_update_slice_in_dim(
+            acc_v, v_new[None, :, 0].astype(kv_dtype), l, axis=0
+        )
+        return y, acc_k, acc_v
 
-    ck0 = (cache.k8, cache.k_scale) if quant else cache.k
-    cv0 = (cache.v8, cache.v_scale) if quant else cache.v
-    x, (k_news, v_news) = lax.scan(scan_body, x, (params["layers"], ck0, cv0))
+    x, k_news, v_news = lax.fori_loop(
+        0, nlayers, layer_body, (x, acc_k, acc_v)
+    )
+    return _finish_decode(
+        params, x, k_news, v_news, cache, lengths, active, quant, cfg
+    )
+
+
+def _finish_decode(params, x, k_news, v_news, cache, lengths, active, quant, cfg):
+    """Shared decode tail: final norm, lm_head, and the cache commit.
+
+    ``k_news``/``v_news`` are ``[L, B, NKV, D]`` — every layer's new
+    token row, committed with one write pass (see ``_commit_rows``).
+    """
+    b = x.shape[0]
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = jnp.matmul(
         x, _mat(params["lm_head"], x.dtype), preferred_element_type=jnp.float32
@@ -670,26 +748,49 @@ def decode_ragged(
     advance = (
         jnp.ones((b,), jnp.int32) if active is None else active.astype(jnp.int32)
     )
-    # Commit every layer's new K/V row with ONE scatter per buffer: the
-    # only write the whole decode step performs against the cache.
-    rows = jnp.arange(b)
-    k_news = k_news[:, :, 0]  # [L, B, NKV, D]
-    v_news = v_news[:, :, 0]
     if quant:
         kq, kqs = _quant_kv(k_news)
         vq, vqs = _quant_kv(v_news)
         return logits, QuantRaggedKVCache(
-            cache.k8.at[:, rows, lengths].set(kq),
-            cache.k_scale.at[:, rows, lengths].set(kqs),
-            cache.v8.at[:, rows, lengths].set(vq),
-            cache.v_scale.at[:, rows, lengths].set(vqs),
+            _commit_rows(cache.k8, kq, lengths),
+            _commit_rows(cache.k_scale, kqs, lengths),
+            _commit_rows(cache.v8, vq, lengths),
+            _commit_rows(cache.v_scale, vqs, lengths),
             lengths + advance,
         )
     return logits, RaggedKVCache(
-        cache.k.at[:, rows, lengths].set(k_news.astype(cache.k.dtype)),
-        cache.v.at[:, rows, lengths].set(v_news.astype(cache.v.dtype)),
+        _commit_rows(cache.k, k_news.astype(cache.k.dtype), lengths),
+        _commit_rows(cache.v, v_news.astype(cache.v.dtype), lengths),
         lengths + advance,
     )
+
+
+def _commit_rows(buf: jax.Array, vals: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Write row ``b``'s new K/V at ``(..., b, lengths[b], ...)`` in place.
+
+    ``buf`` is ``[L, B, T, ...]``, ``vals`` ``[L, B, ...]``.  A single
+    batched scatter (``buf.at[:, rows, lengths].set``) is the obvious
+    spelling, but measured on v5e it makes XLA materialize a full copy of
+    the cache buffer every decode step once the buffer is also consumed
+    as the layer scan's xs — 4.5 ms/step at 1.35B/32 slots, 14 ms at 64
+    (round-4 profile; the standalone scatter on a carried buffer is
+    0.2 ms, so it is the xs-read + scatter interplay that defeats copy
+    elimination).  A ``fori_loop`` of per-row ``dynamic_update_slice``
+    is the pattern XLA's in-place analysis handles: each iteration
+    updates the loop-carried buffer exactly once.
+    """
+    def body(b, acc):
+        # [L, 1, 1, ...] slab for row b at its own position.  All start
+        # indices share one dtype (x64 mode would otherwise mix the
+        # loop's int64 counter with int32 zeros).
+        slab = jax.lax.dynamic_slice_in_dim(vals, b, 1, axis=1)[:, :, None]
+        z = jnp.zeros((), jnp.int32)
+        start = (z, jnp.asarray(b, jnp.int32), jnp.asarray(lengths[b], jnp.int32)) + (
+            z,
+        ) * (buf.ndim - 3)
+        return jax.lax.dynamic_update_slice(acc, slab.astype(acc.dtype), start)
+
+    return jax.lax.fori_loop(0, buf.shape[1], body, buf)
 
 
 def insert_sequence(

@@ -68,6 +68,7 @@ class TpuInferenceServer:
         max_batch_size: int = 32,
         max_batch_delay_ms: float = 5.0,
         gen_engine=None,
+        max_inflight_batches: int = 2,
     ):
         self.engine = engine
         self.metrics = metrics
@@ -77,11 +78,16 @@ class TpuInferenceServer:
         import threading
 
         self._profile_lock = threading.Lock()
+        # Pipelined when the engine supports async dispatch (the jit
+        # tier): batch N+1 stacks/dispatches while N executes on device.
+        has_async = hasattr(engine, "predict_async")
         self.batcher = DynamicBatcher(
-            run_batch=engine.predict,
+            run_batch=engine.predict_async if has_async else engine.predict,
             max_batch_size=max_batch_size,
             max_batch_delay_ms=max_batch_delay_ms,
             on_batch=metrics.observe_batch,
+            materialize=engine.materialize if has_async else None,
+            max_inflight=max_inflight_batches,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -657,6 +663,7 @@ def build_server(
         max_batch_size=config.tpu.max_batch_size,
         max_batch_delay_ms=config.tpu.max_batch_delay_ms,
         gen_engine=gen_engine,
+        max_inflight_batches=config.tpu.max_inflight_batches,
     )
     server.startup(warmup=warmup)
     return server

@@ -136,6 +136,17 @@ class DynamicBatcher:
     ``run_batch(inputs: dict[str, np.ndarray]) -> np.ndarray | tuple`` is
     called with stacked+padded arrays; outputs are split along axis 0 and
     delivered to each request's Future.
+
+    **Pipelined mode** (pass ``materialize``): ``run_batch`` is treated
+    as an ASYNC dispatch (XLA returns device-array promises immediately)
+    and ``materialize(out)`` as the blocking wait.  The collector then
+    stacks, pads, and dispatches batch N+1 while batch N still executes
+    on device — double buffering, bounded by ``max_inflight`` dispatched-
+    but-unmaterialized batches (the put blocks as backpressure).  Under
+    concurrent load this removes the serial wait each request otherwise
+    pays for the in-flight batch ahead of it (VERDICT r3 #4: queue wait
+    was ~an entire device run at clients=8).  Without ``materialize``
+    the batcher runs exactly as before: one synchronous batch at a time.
     """
 
     def __init__(
@@ -144,13 +155,21 @@ class DynamicBatcher:
         max_batch_size: int = 32,
         max_batch_delay_ms: float = 5.0,
         on_batch: Callable[[int, float, float], None] | None = None,
+        materialize: Callable[[Any], Any] | None = None,
+        max_inflight: int = 2,
     ):
         self._run_batch = run_batch
+        self._materialize = materialize
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = float(max_batch_delay_ms) / 1000.0
+        self.max_inflight = max(1, int(max_inflight)) if materialize else 1
         self._on_batch = on_batch
         self._queue: queue.Queue[_Item | None] = queue.Queue()
+        self._inflight: queue.Queue = queue.Queue(maxsize=self.max_inflight)
         self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._completer = threading.Thread(
+            target=self._completion_worker, daemon=True
+        )
         self._started = False
         self._stop = False
 
@@ -158,15 +177,32 @@ class DynamicBatcher:
         if not self._started:
             self._started = True
             self._thread.start()
+            self._completer.start()
 
     def stop(self) -> None:
         self._stop = True
         self._queue.put(None)
         if self._started:
+            # A wedged materialize can leave the completer stuck and the
+            # in-flight queue full (with the collector blocked on its
+            # put) — drain BEFORE joining so the collector unsticks, and
+            # never block on the sentinel put: everything here must stay
+            # bounded even when the device hangs.
+            self._drain_inflight()
             self._thread.join(timeout=5)
+            try:
+                self._inflight.put_nowait(None)
+            except queue.Full:
+                self._drain_inflight()
+                try:
+                    self._inflight.put_nowait(None)
+                except queue.Full:
+                    pass  # completer is wedged; it's a daemon thread
+            self._completer.join(timeout=5)
         # Fail anything still queued (including different-shape items the
         # collector re-queued) so in-flight HTTP requests get an error
         # instead of hanging until the server's shutdown timeout.
+        self._drain_inflight()
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -174,6 +210,19 @@ class DynamicBatcher:
                 break
             if item is not None and not item.future.done():
                 item.future.set_exception(RuntimeError("server shutting down"))
+
+    def _drain_inflight(self) -> None:
+        while True:
+            try:
+                entry = self._inflight.get_nowait()
+            except queue.Empty:
+                return
+            if entry is not None:
+                for item in entry[0]:
+                    if not item.future.done():
+                        item.future.set_exception(
+                            RuntimeError("server shutting down")
+                        )
 
     # -- client side ---------------------------------------------------------
 
@@ -218,9 +267,15 @@ class DynamicBatcher:
             items = self._collect()
             if not items:
                 continue
-            self._execute(items)
+            self._dispatch(items)
 
-    def _execute(self, items: list[_Item]) -> None:
+    def _dispatch(self, items: list[_Item]) -> None:
+        """Stack, pad, and (async-)dispatch one batch.
+
+        Dispatch errors (bad shapes, XLA compile failures — both raise
+        synchronously) fail this batch's futures here; device-side
+        runtime errors surface at materialize time in the completer.
+        """
         n = len(items)
         bucket = next_bucket(n, self.max_batch_size)
         try:
@@ -235,16 +290,44 @@ class DynamicBatcher:
             queue_age = time.perf_counter() - items[0].enqueued_at
             t_run = time.perf_counter()
             out = self._run_batch(stacked)
-            run_seconds = time.perf_counter() - t_run
-            if self._on_batch:
-                self._on_batch(n, queue_age, run_seconds)
-            outputs = _split_outputs(out, n)
-            for i, item in enumerate(items):
-                item.future.set_result(outputs[i])
         except Exception as e:
             for item in items:
                 if not item.future.done():
                     item.future.set_exception(e)
+            return
+        # Blocks once max_inflight batches are dispatched-but-unfinished:
+        # backpressure that keeps device memory bounded.
+        self._inflight.put((items, n, out, queue_age, t_run))
+
+    def _completion_worker(self) -> None:
+        t_prev_done = 0.0
+        while True:
+            entry = self._inflight.get()
+            if entry is None:
+                return
+            items, n, out, queue_age, t_run = entry
+            try:
+                if self._materialize is not None:
+                    out = self._materialize(out)
+                done = time.perf_counter()
+                # Marginal run time: under pipelining, batch N+1's wait
+                # includes batch N's leftover device time; measuring
+                # from max(dispatch, previous completion) records the
+                # time THIS batch added to the pipeline (steady state =
+                # its device time), keeping the queue/run/overhead
+                # decomposition additive instead of double-counting.
+                run_seconds = done - max(t_run, t_prev_done)
+                t_prev_done = done
+                if self._on_batch:
+                    self._on_batch(n, queue_age, run_seconds)
+                outputs = _split_outputs(out, n)
+                for i, item in enumerate(items):
+                    if not item.future.done():  # stop() may have failed it
+                        item.future.set_result(outputs[i])
+            except Exception as e:
+                for item in items:
+                    if not item.future.done():
+                        item.future.set_exception(e)
 
 
 def _split_outputs(out: Any, n: int) -> list[Any]:

@@ -91,6 +91,19 @@ class InferenceEngine:
 
     def predict(self, inputs: Mapping[str, np.ndarray]) -> Any:
         """Run one already-batched input dict; returns numpy outputs."""
+        return self.materialize(self.predict_async(inputs))
+
+    def predict_async(self, inputs: Mapping[str, np.ndarray]) -> Any:
+        """Dispatch one already-batched input dict WITHOUT materializing.
+
+        Under ``jit``, XLA dispatch is asynchronous: the returned device
+        arrays are promises, so the caller can overlap forming/dispatching
+        the NEXT batch with this one's device execution (the
+        ``DynamicBatcher``'s pipelined mode).  Pair with
+        :meth:`materialize`, which blocks until the device is done.  On
+        the non-jittable (pyfunc) tier the call runs synchronously here —
+        ``materialize`` is then a cheap identity walk.
+        """
         sig = self._signature(inputs)
         with self._lock:
             new_sig = sig not in self._seen_signatures
@@ -101,9 +114,11 @@ class InferenceEngine:
                 self._on_compile()
             _log.info("new input signature %s (compiling)", sig)
         if self._jitted is not None:
-            out = self._jitted(dict(inputs))
-        else:
-            out = self._call_predict(inputs)
+            return self._jitted(dict(inputs))
+        return self._call_predict(inputs)
+
+    def materialize(self, out: Any) -> Any:
+        """Block until ``out``'s device computation finishes; numpy it."""
         return _to_numpy(out)
 
     def warmup(

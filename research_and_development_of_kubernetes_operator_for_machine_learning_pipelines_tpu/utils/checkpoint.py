@@ -1,19 +1,61 @@
-"""Checkpoint/resume for model parameters (SURVEY §5).
+"""Checkpoint/resume subsystem (SURVEY §5).
 
 The reference's only persisted state is the CR status subresource; model
-weights live in MLflow/MinIO and are pulled fresh by each predictor.  The
-rebuild adds orbax-backed checkpointing for the cases the reference cannot
-cover: sharded params written per-host from a multi-host slice, and local
-warm-restart of a server without re-pulling the artifact store.
+weights live in MLflow/MinIO and are pulled fresh by each predictor
+(``mlflow_operator.py:199,:214``).  The rebuild owns a data plane, so it
+owes the piece the reference delegates: durable, versioned weight state
+with sharded-on-load restore for multi-host predictors and warm restarts
+that skip the artifact store.
 
-``save``/``restore`` round-trip arbitrary param pytrees; ``restore`` can
-restore directly into a sharding (each host reads only its shards).
+Two layers:
+
+- :func:`save` / :func:`restore` — one-shot pytree round-trip (orbax
+  tensor I/O underneath; each host materializes only its shards when the
+  template carries shardings).
+- :class:`CheckpointManager` — the subsystem: a versioned step layout
+  with atomic publish (write to a scratch name, fsync-rename, then a
+  ``COMMITTED`` marker — a torn save is never listed), background/async
+  saves so a serving process snapshots without stalling its decode loop,
+  keep-N garbage collection, and JSON metadata per step (wall time,
+  user tags) for operational forensics.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
+import shutil
+import threading
+import time
 from pathlib import Path
 from typing import Any
+
+_log = logging.getLogger(__name__)
+
+_COMMITTED = "COMMITTED"  # marker file: step directory is fully written
+
+
+def _fsync_path(path: Path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(root: Path) -> None:
+    """fsync every file and directory under ``root`` (and root itself).
+
+    The atomic-publish guarantee needs the DATA durable before the
+    rename and the COMMITTED marker: a crash that persists the tiny
+    marker but not the tensor writes would otherwise surface a torn
+    checkpoint as restorable.
+    """
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            _fsync_path(Path(dirpath) / name)
+        _fsync_path(Path(dirpath))
 
 
 def save(path: str | Path, tree: Any) -> None:
@@ -34,3 +76,166 @@ def restore(path: str | Path, template: Any | None = None) -> Any:
         if template is None:
             return ckptr.restore(path)
         return ckptr.restore(path, template)
+
+
+class AsyncSaveHandle:
+    """Handle for a background save: ``wait()`` re-raises its failure."""
+
+    def __init__(self, thread: threading.Thread):
+        self._thread = thread
+        self.error: BaseException | None = None
+
+    def wait(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint save still running")
+        if self.error is not None:
+            raise self.error
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+
+class CheckpointManager:
+    """Versioned checkpoints under one root: ``<root>/step_<N>/``.
+
+    Guarantees:
+
+    - **Atomic publish.**  A step is written to ``.tmp_step_<N>``, then
+      renamed, then marked with a ``COMMITTED`` file.  ``steps()`` lists
+      only committed steps, so a crash mid-save leaves garbage (cleaned
+      on the next save) but never a restorable-looking torn checkpoint.
+    - **Monotonic steps.**  Re-saving an existing step is refused unless
+      ``overwrite=True`` — silent clobbering of a published version is
+      how serving fleets end up with two weight sets under one name.
+    - **Keep-N GC.**  After each successful save, committed steps beyond
+      ``max_to_keep`` (oldest first) are deleted.
+    - **Async.**  ``save_async`` runs the same path on a daemon thread;
+      the returned handle's ``wait()`` surfaces errors.  One in-flight
+      async save at a time (a second request waits) — concurrent orbax
+      writes into one root interleave badly.
+    """
+
+    def __init__(self, root: str | Path, max_to_keep: int | None = 3):
+        self.root = Path(root).absolute()
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._save_lock = threading.Lock()
+
+    # -- layout --------------------------------------------------------------
+
+    def _step_dir(self, step: int) -> Path:
+        return self.root / f"step_{step:08d}"
+
+    def steps(self) -> list[int]:
+        """Committed steps, ascending."""
+        out = []
+        for p in self.root.glob("step_*"):
+            if (p / _COMMITTED).exists():
+                try:
+                    out.append(int(p.name.split("_", 1)[1]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def metadata(self, step: int) -> dict:
+        return json.loads((self._step_dir(step) / _COMMITTED).read_text())
+
+    # -- save ----------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        tree: Any,
+        *,
+        tags: dict | None = None,
+        overwrite: bool = False,
+    ) -> Path:
+        with self._save_lock:
+            final = self._step_dir(step)
+            if (final / _COMMITTED).exists():
+                if not overwrite:
+                    raise FileExistsError(
+                        f"step {step} already committed at {final} "
+                        "(pass overwrite=True to replace)"
+                    )
+                shutil.rmtree(final)
+            # Scrap any torn leftovers from a previous crash.
+            tmp = self.root / f".tmp_{final.name}"
+            if tmp.exists():
+                shutil.rmtree(tmp)
+            if final.exists():  # renamed but never committed = torn
+                shutil.rmtree(final)
+
+            t0 = time.time()
+            save(tmp / "params", tree)
+            # Durability order: data -> rename -> parent dir -> marker ->
+            # parent dir.  Each fsync makes the previous step crash-safe
+            # before the next makes it visible.
+            _fsync_tree(tmp)
+            tmp.rename(final)
+            _fsync_path(self.root)
+            marker = final / _COMMITTED
+            marker.write_text(
+                json.dumps(
+                    {
+                        "step": step,
+                        "written_at_unix": round(t0, 3),
+                        "save_seconds": round(time.time() - t0, 3),
+                        "tags": tags or {},
+                    },
+                    indent=1,
+                )
+            )
+            _fsync_path(marker)
+            _fsync_path(final)
+            self._gc()
+            return final
+
+    def save_async(
+        self, step: int, tree: Any, *, tags: dict | None = None,
+        overwrite: bool = False,
+    ) -> AsyncSaveHandle:
+        """Snapshot without blocking the caller (e.g. a serving loop).
+
+        The tree's device buffers are captured by reference; JAX arrays
+        are immutable, so a concurrent decode step cannot mutate what
+        this thread writes.
+        """
+        def run():
+            try:
+                self.save(step, tree, tags=tags, overwrite=overwrite)
+            except BaseException as e:  # surfaced via handle.wait()
+                handle.error = e
+                _log.exception("async checkpoint save of step %d failed", step)
+
+        t = threading.Thread(target=run, daemon=True, name=f"ckpt-save-{step}")
+        handle = AsyncSaveHandle(t)
+        t.start()
+        return handle
+
+    # -- restore -------------------------------------------------------------
+
+    def restore(self, step: int | None = None, template: Any | None = None) -> Any:
+        """Restore ``step`` (default: latest committed)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no committed checkpoints in {self.root}")
+        final = self._step_dir(step)
+        if not (final / _COMMITTED).exists():
+            raise FileNotFoundError(f"step {step} is not committed in {self.root}")
+        return restore(final / "params", template)
+
+    # -- GC ------------------------------------------------------------------
+
+    def _gc(self) -> None:
+        if self.max_to_keep is None:
+            return
+        steps = self.steps()
+        for step in steps[: max(0, len(steps) - self.max_to_keep)]:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)

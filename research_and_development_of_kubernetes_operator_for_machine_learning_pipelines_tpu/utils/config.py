@@ -196,6 +196,10 @@ class TpuSpec:
     # near-linearly with slots until the KV cache dominates HBM traffic
     # (measured curve in bench.py llama_decode.slot_ladder).
     max_slots: int | None = None
+    # Batches allowed in flight on the device at once (async dispatch
+    # double-buffering): while batch N executes, batch N+1 is stacked and
+    # dispatched.  1 = fully serial (the pre-pipelining behavior).
+    max_inflight_batches: int = 2
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
     quantize: str = "none"  # none | int8 (weights) | int8kv (weights+KV cache)
     prefill_chunk: int | None = None  # chunked prefill (decode interleaving)
@@ -219,6 +223,7 @@ class TpuSpec:
             max_slots=(
                 int(spec["maxSlots"]) if spec.get("maxSlots") is not None else None
             ),
+            max_inflight_batches=int(spec.get("maxInflightBatches", 2)),
             compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
             quantize=_parse_quantize(spec.get("quantize", "none")),
             prefill_chunk=_parse_prefill_chunk(spec.get("prefillChunk")),

@@ -57,6 +57,66 @@ def test_checkpoint_restore_with_sharding_template(tmp_path):
     np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(tree["w"]))
 
 
+def test_checkpoint_manager_versioned_save_restore_and_gc(tmp_path):
+    mgr = checkpoint.CheckpointManager(tmp_path / "ckpts", max_to_keep=2)
+    assert mgr.latest_step() is None
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": jnp.full((4,), float(step))},
+                 tags={"version": f"v{step}"})
+    # keep-N GC: step 1 is gone, 2 and 3 remain.
+    assert mgr.steps() == [2, 3]
+    assert mgr.latest_step() == 3
+    np.testing.assert_array_equal(
+        mgr.restore()["w"], jnp.full((4,), 3.0)
+    )
+    np.testing.assert_array_equal(
+        mgr.restore(step=2)["w"], jnp.full((4,), 2.0)
+    )
+    assert mgr.metadata(3)["tags"] == {"version": "v3"}
+    # monotonic-step guard: silent clobbering refused.
+    import pytest
+
+    with pytest.raises(FileExistsError):
+        mgr.save(3, {"w": jnp.zeros((4,))})
+    mgr.save(3, {"w": jnp.full((4,), 30.0)}, overwrite=True)
+    np.testing.assert_array_equal(mgr.restore()["w"], jnp.full((4,), 30.0))
+
+
+def test_checkpoint_manager_torn_save_is_invisible(tmp_path):
+    """A crash mid-save must never surface as a restorable step: only
+    directories carrying the COMMITTED marker are listed."""
+    mgr = checkpoint.CheckpointManager(tmp_path / "ckpts", max_to_keep=None)
+    mgr.save(1, {"w": jnp.ones((2,))})
+    # Simulate a torn save: step dir exists, marker absent.
+    torn = mgr._step_dir(2)
+    torn.mkdir(parents=True)
+    (torn / "params").mkdir()
+    assert mgr.steps() == [1]
+    assert mgr.latest_step() == 1
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(step=2)
+    # The next save of step 2 clears the wreckage and commits cleanly.
+    mgr.save(2, {"w": jnp.full((2,), 2.0)})
+    assert mgr.steps() == [1, 2]
+
+
+def test_checkpoint_manager_async_save(tmp_path):
+    mgr = checkpoint.CheckpointManager(tmp_path / "ckpts")
+    handle = mgr.save_async(5, {"w": jnp.arange(8.0)}, tags={"async": True})
+    handle.wait(timeout=60)
+    assert handle.done()
+    assert mgr.latest_step() == 5
+    np.testing.assert_array_equal(mgr.restore()["w"], jnp.arange(8.0))
+    # Failure surfaces through wait(), not silently.
+    bad = mgr.save_async(5, {"w": jnp.zeros(1)})  # step exists
+    import pytest
+
+    with pytest.raises(FileExistsError):
+        bad.wait(timeout=60)
+
+
 def test_manifests_are_valid_yaml_with_expected_fields():
     crd = list(yaml.safe_load_all((PKG_DIR / "deploy" / "crd.yaml").read_text()))[0]
     assert crd["spec"]["group"] == "mlflow.nizepart.com"

@@ -87,6 +87,98 @@ def test_batcher_propagates_exceptions():
 # ---------------------------------------------------------------------------
 
 
+def test_pipelined_batcher_overlaps_dispatch_with_device_wait():
+    """Pipelined mode (VERDICT r3 #4): with ``materialize`` given, the
+    collector must dispatch batch N+1 while batch N is still waiting on
+    the device — proven with events, not wall-clock timing."""
+    dispatched = []
+    release_mat = threading.Event()
+    second_dispatched = threading.Event()
+
+    def run_batch(stacked):  # async dispatch stand-in: returns a token
+        tag = int(stacked["x"][0, 0])
+        dispatched.append(tag)
+        if len(dispatched) >= 2:
+            second_dispatched.set()
+        return ("promise", tag, stacked["x"].shape[0])
+
+    def materialize(out):  # device wait stand-in
+        _, tag, n = out
+        if tag == 0:
+            # batch 0 blocks on the "device" until the test releases it
+            assert release_mat.wait(timeout=5)
+        return np.full((n, 1), tag, np.float32)
+
+    b = DynamicBatcher(
+        run_batch, max_batch_size=1, max_batch_delay_ms=1,
+        materialize=materialize, max_inflight=2,
+    )
+    b.start()
+    try:
+        f0 = b.submit({"x": np.array([0], np.int64)})
+        f1 = b.submit({"x": np.array([1], np.int64)})
+        # batch 1 must dispatch WHILE batch 0 is still on the device.
+        assert second_dispatched.wait(timeout=5), "no overlap: pipelining broken"
+        assert not f0.done()
+        release_mat.set()
+        assert f0.result(timeout=5)[0] == 0.0
+        assert f1.result(timeout=5)[0] == 1.0
+    finally:
+        release_mat.set()
+        b.stop()
+
+
+def test_pipelined_batcher_materialize_error_fails_only_its_batch():
+    def run_batch(stacked):
+        return int(stacked["x"][0, 0])
+
+    def materialize(tag):
+        if tag == 0:
+            raise RuntimeError("device exploded")
+        return np.full((1, 1), tag, np.float32)
+
+    b = DynamicBatcher(
+        run_batch, max_batch_size=1, max_batch_delay_ms=1,
+        materialize=materialize, max_inflight=2,
+    )
+    b.start()
+    try:
+        f0 = b.submit({"x": np.array([0], np.int64)})
+        f1 = b.submit({"x": np.array([1], np.int64)})
+        with pytest.raises(RuntimeError, match="device exploded"):
+            f0.result(timeout=5)
+        assert f1.result(timeout=5)[0] == 1.0  # pipeline survives
+    finally:
+        b.stop()
+
+
+def test_pipelined_batcher_stop_fails_inflight_futures():
+    hold = threading.Event()
+
+    def run_batch(stacked):
+        return 0
+
+    def materialize(tag):
+        hold.wait(timeout=5)
+        return np.zeros((1, 1), np.float32)
+
+    b = DynamicBatcher(
+        run_batch, max_batch_size=1, max_batch_delay_ms=1,
+        materialize=materialize, max_inflight=2,
+    )
+    b.start()
+    futs = [b.submit({"x": np.array([i], np.int64)}) for i in range(4)]
+    time.sleep(0.1)  # let some batches reach the in-flight queue
+    hold.set()
+    b.stop()
+    for f in futs:
+        assert f.done()
+        try:
+            f.result()
+        except RuntimeError:
+            pass  # "server shutting down" for anything still queued
+
+
 def test_apply_seq_pad_buckets_and_synthesizes_mask():
     from tpumlops.server.batching import apply_seq_pad
 

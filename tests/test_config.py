@@ -54,6 +54,10 @@ def test_tpu_spec_parsing():
     assert cfg.tpu.mesh_shape == {"dp": 2, "tp": 4}
     assert cfg.tpu.num_devices == 8
     assert cfg.tpu.max_batch_size == 64
+    assert cfg.tpu.max_inflight_batches == 2  # pipelined batcher default
+    assert (
+        TpuSpec.from_spec({"maxInflightBatches": 1}).max_inflight_batches == 1
+    )
 
 
 def test_canary_policy_validation():

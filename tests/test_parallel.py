@@ -105,6 +105,69 @@ def test_ring_shift_rotates_blocks():
     )
 
 
+def test_ring_shift_bidirectional_moves_halves_opposite_ways():
+    from tpumlops.parallel.collectives import ring_shift_bidirectional
+
+    mesh = local_mesh({"tp": 8})
+    # Two scalar blocks per device: rows 2i, 2i+1 live on device i.
+    x = jnp.arange(16.0).reshape(16, 1)
+
+    f = shard_map(
+        lambda blk: ring_shift_bidirectional(blk, "tp", axis=0),
+        mesh=mesh,
+        in_specs=PartitionSpec("tp", None),
+        out_specs=PartitionSpec("tp", None),
+    )
+    out = np.asarray(f(x)).reshape(8, 2)
+    ref = np.arange(16.0).reshape(8, 2)
+    # Front halves (col 0) shifted +1 (from the left neighbor), back
+    # halves (col 1) shifted -1 (from the right neighbor).
+    np.testing.assert_array_equal(out[:, 0], np.roll(ref[:, 0], 1))
+    np.testing.assert_array_equal(out[:, 1], np.roll(ref[:, 1], -1))
+
+
+def test_hierarchical_psum_matches_flat_psum():
+    from tpumlops.parallel.collectives import hierarchical_psum
+
+    mesh = local_mesh({"dp": 2, "tp": 4})
+    x = jnp.arange(64.0).reshape(8, 8) + 0.5
+
+    flat = shard_map(
+        lambda b: jax.lax.psum(jax.lax.psum(b, "tp"), "dp"),
+        mesh=mesh,
+        in_specs=PartitionSpec(("dp", "tp"), None),
+        out_specs=PartitionSpec(("dp", "tp"), None),
+    )(x)
+    hier = shard_map(
+        # scatter over axis 1 (the locally-full axis): each device block
+        # is [1, 8] under this spec and 8 % tp == 0.
+        lambda b: hierarchical_psum(b, fast_axis="tp", slow_axis="dp",
+                                    scatter_axis=1),
+        mesh=mesh,
+        in_specs=PartitionSpec(("dp", "tp"), None),
+        out_specs=PartitionSpec(("dp", "tp"), None),
+    )(x)
+    np.testing.assert_allclose(np.asarray(hier), np.asarray(flat), rtol=1e-6)
+
+
+def test_all_to_all_swap_reshards_heads_to_sequence():
+    from tpumlops.parallel.collectives import all_to_all_swap
+
+    mesh = local_mesh({"sp": 8})
+    # Global [seq=8, heads=8]: start sequence-sharded, pivot to
+    # head-sharded (each device then holds ALL positions of one head).
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    f = shard_map(
+        lambda blk: all_to_all_swap(blk, "sp", split_axis=1, concat_axis=0),
+        mesh=mesh,
+        in_specs=PartitionSpec("sp", None),
+        out_specs=PartitionSpec(None, "sp"),
+    )
+    out = np.asarray(f(x))
+    np.testing.assert_array_equal(out, np.arange(64.0).reshape(8, 8))
+
+
 def test_dp_mean_loss_matches_single_device():
     mesh = build_mesh({"dp": 8})
     x = jnp.arange(32.0).reshape(8, 4)

@@ -223,6 +223,18 @@ def test_dead_backend_gives_502_and_metric(world):
     )
 
 
+def test_latency_ring_drains_exact_samples(world):
+    """/router/latencies returns one exact sample per proxied request and
+    clears on read (the bench's tail-attribution instrument)."""
+    world.admin.drain_latencies()  # clear whatever earlier tests left
+    for _ in range(5):
+        ask(world.port)
+    lats = world.admin.drain_latencies()
+    assert len(lats) == 5
+    assert all(0 < v < 5.0 for v in lats)  # localhost echo: sane seconds
+    assert world.admin.drain_latencies() == []  # read-and-clear
+
+
 def test_config_replace_preserves_histograms(world):
     for _ in range(4):
         ask(world.port)
