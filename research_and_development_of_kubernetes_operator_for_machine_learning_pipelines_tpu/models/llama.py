@@ -788,7 +788,18 @@ def _commit_rows(buf: jax.Array, vals: jax.Array, lengths: jax.Array) -> jax.Arr
         start = (z, jnp.asarray(b, jnp.int32), jnp.asarray(lengths[b], jnp.int32)) + (
             z,
         ) * (buf.ndim - 3)
-        return jax.lax.dynamic_update_slice(acc, slab.astype(acc.dtype), start)
+
+        def write(a):
+            return jax.lax.dynamic_update_slice(a, slab.astype(a.dtype), start)
+
+        # Match the scatter's out-of-bounds semantics: `.at[...].set`
+        # DROPS a write at lengths[b] == T, while dynamic_update_slice
+        # CLAMPS the start and would overwrite the row's last real K/V —
+        # a full resident row (e.g. a finished request parked at
+        # capacity while others decode) must not corrupt itself.
+        return jax.lax.cond(
+            lengths[b] < buf.shape[2], write, lambda a: a, acc
+        )
 
     return jax.lax.fori_loop(0, buf.shape[1], body, buf)
 

@@ -820,7 +820,10 @@ void finish_request(const BackendPtr& b, int code, double seconds,
   if (!feedback) b->client_latency.observe(seconds);
   b->by_code[{std::to_string(code), feedback ? "feedback" : "predictions"}]
       .observe(seconds);
-  if (g_recent_us.size() < kMaxRecent)
+  // The exact-latency ring mirrors the histogram's scope: predictions
+  // only, so concurrent feedback posts (a different code path) cannot
+  // contaminate the router-internal tail attribution.
+  if (!feedback && g_recent_us.size() < kMaxRecent)
     g_recent_us.push_back((uint32_t)(seconds * 1e6));
   g_state.proxied_total++;
 }

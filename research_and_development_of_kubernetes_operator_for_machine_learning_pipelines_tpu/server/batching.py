@@ -297,6 +297,19 @@ class DynamicBatcher:
             return
         # Blocks once max_inflight batches are dispatched-but-unfinished:
         # backpressure that keeps device memory bounded.
+        if self._stop:
+            # stop() may already have drained the in-flight queue and let
+            # the completer exit on its sentinel (e.g. this dispatch sat
+            # in a multi-minute compile past the join timeout).  Putting
+            # the entry there now would strand its futures forever — fail
+            # them directly, matching what stop()'s drain does to every
+            # other in-flight batch.
+            for item in items:
+                if not item.future.done():
+                    item.future.set_exception(
+                        RuntimeError("server shutting down")
+                    )
+            return
         self._inflight.put((items, n, out, queue_age, t_run))
 
     def _completion_worker(self) -> None:

@@ -456,18 +456,19 @@ def _stream_native_params(
     import queue as _queue
     import threading
 
-    import jax.numpy as jnp
-
     t_wall = time.perf_counter()
     timing = {"disk_s": 0.0, "quantize_s": 0.0, "transfer_s": 0.0,
               "read_bytes": 0}
     q: _queue.Queue = _queue.Queue(maxsize=2)
     reader_error: list[BaseException] = []
+    abort = threading.Event()  # consumer died: reader must stop + clean up
 
     def reader() -> None:
         try:
             with np.load(npz_path) as z:
                 for k in z.files:
+                    if abort.is_set():
+                        return
                     t0 = time.perf_counter()
                     arr = z[k]
                     if arr.dtype.kind == "V" and arr.dtype.itemsize == 2:
@@ -482,9 +483,48 @@ def _stream_native_params(
         finally:
             q.put(None)
 
-    threading.Thread(target=reader, daemon=True, name="npz-reader").start()
+    rthread = threading.Thread(target=reader, daemon=True, name="npz-reader")
+    rthread.start()
 
     leaves: dict[str, Any] = {}
+    try:
+        _consume_leaves(q, leaves, quantize_leaves, timing)
+    except BaseException:
+        # A consumer failure (e.g. device OOM in jnp.asarray) must not
+        # strand the reader on the bounded q.put — that would leak the
+        # thread, the open npz handle, and buffered leaves for the life
+        # of the process (a server retrying load_predictor accumulates
+        # one wedged reader per attempt).  Signal + drain so the reader
+        # observes the abort and its `with np.load` closes.
+        abort.set()
+        while True:
+            try:
+                if q.get_nowait() is None:
+                    break
+            except _queue.Empty:
+                if not rthread.is_alive():
+                    break
+                time.sleep(0.01)
+        raise
+    if reader_error:
+        raise reader_error[0]
+    if stats is not None:
+        stats.update(
+            disk_s=round(timing["disk_s"], 2),
+            quantize_s=round(timing["quantize_s"], 2),
+            transfer_s=round(timing["transfer_s"], 2),
+            wall_s=round(time.perf_counter() - t_wall, 2),
+            read_gib=round(timing["read_bytes"] / 2**30, 2),
+        )
+    return _unflatten(leaves)
+
+
+def _consume_leaves(
+    q, leaves: dict, quantize_leaves: tuple, timing: dict
+) -> None:
+    """Drain the reader queue, quantizing/transferring each leaf."""
+    import jax.numpy as jnp
+
     while True:
         item = q.get()
         if item is None:
@@ -518,17 +558,6 @@ def _stream_native_params(
             leaves[k] = jnp.asarray(arr)
             timing["transfer_s"] += time.perf_counter() - t0
             del arr
-    if reader_error:
-        raise reader_error[0]
-    if stats is not None:
-        stats.update(
-            disk_s=round(timing["disk_s"], 2),
-            quantize_s=round(timing["quantize_s"], 2),
-            transfer_s=round(timing["transfer_s"], 2),
-            wall_s=round(time.perf_counter() - t_wall, 2),
-            read_gib=round(timing["read_bytes"] / 2**30, 2),
-        )
-    return _unflatten(leaves)
 
 
 def load_predictor(

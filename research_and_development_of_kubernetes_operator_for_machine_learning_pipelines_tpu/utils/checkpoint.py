@@ -157,30 +157,58 @@ class CheckpointManager:
     ) -> Path:
         with self._save_lock:
             final = self._step_dir(step)
-            if (final / _COMMITTED).exists():
-                if not overwrite:
-                    raise FileExistsError(
-                        f"step {step} already committed at {final} "
-                        "(pass overwrite=True to replace)"
-                    )
-                shutil.rmtree(final)
-            # Scrap any torn leftovers from a previous crash.
             tmp = self.root / f".tmp_{final.name}"
-            if tmp.exists():
+            old = self.root / f".replaced_{final.name}"
+            if tmp.exists():  # torn leftover from a previous crash
                 shutil.rmtree(tmp)
-            if final.exists():  # renamed but never committed = torn
-                shutil.rmtree(final)
+            if old.exists():
+                if (final / _COMMITTED).exists():
+                    # The previous overwrite committed; the leftover is
+                    # just its trash.
+                    shutil.rmtree(old)
+                else:
+                    # Crashed between renaming the predecessor away and
+                    # committing its replacement: the .replaced_ copy is
+                    # the ONLY committed data for this step.  Restore it
+                    # before doing anything destructive — deleting it
+                    # here and then failing the new write would lose a
+                    # step save() once reported durable.
+                    if final.exists():
+                        shutil.rmtree(final)  # uncommitted replacement
+                    old.rename(final)
+                    _fsync_path(self.root)
+            replacing = (final / _COMMITTED).exists()
+            if replacing and not overwrite:
+                raise FileExistsError(
+                    f"step {step} already committed at {final} "
+                    "(pass overwrite=True to replace)"
+                )
+            if final.exists() and not replacing:
+                shutil.rmtree(final)  # renamed but never committed = torn
 
             t0 = time.time()
             save(tmp / "params", tree)
             # Durability order: data -> rename -> parent dir -> marker ->
             # parent dir.  Each fsync makes the previous step crash-safe
-            # before the next makes it visible.
+            # before the next makes it visible.  On overwrite the committed
+            # predecessor stays in place (and restorable) until the
+            # replacement's data is fully fsynced — the exposure window is
+            # two renames + a marker write, not the multi-second orbax
+            # save; a crash inside that window leaves both datasets on
+            # disk (the predecessor under .replaced_*, scrapped next save).
             _fsync_tree(tmp)
+            if replacing:
+                final.rename(old)
+                _fsync_path(self.root)
             tmp.rename(final)
             _fsync_path(self.root)
+            # Marker goes through temp + rename so its existence is
+            # all-or-nothing: a crash mid-write must not leave a
+            # truncated COMMITTED file that steps() lists but
+            # metadata() cannot parse.
             marker = final / _COMMITTED
-            marker.write_text(
+            marker_tmp = final / (_COMMITTED + ".tmp")
+            marker_tmp.write_text(
                 json.dumps(
                     {
                         "step": step,
@@ -191,8 +219,11 @@ class CheckpointManager:
                     indent=1,
                 )
             )
-            _fsync_path(marker)
+            _fsync_path(marker_tmp)
+            marker_tmp.rename(marker)
             _fsync_path(final)
+            if old.exists():
+                shutil.rmtree(old)
             self._gc()
             return final
 

@@ -239,3 +239,86 @@ def test_example_crs_parse_through_operator_config():
     assert cfg.canary.rollback_on_failure is True
     assert cfg.canary.warmup_requests == 20
     assert cfg.canary.attempt_delay_s == 10
+
+
+def test_checkpoint_manager_overwrite_crash_keeps_predecessor(tmp_path, monkeypatch):
+    """overwrite=True must not destroy the committed predecessor before
+    the replacement's data is on disk: a crash during the (potentially
+    multi-minute) orbax write would otherwise lose BOTH versions of the
+    step — the durability story the COMMITTED marker exists to provide."""
+    import pytest
+
+    mgr = checkpoint.CheckpointManager(tmp_path / "ckpts", max_to_keep=None)
+    mgr.save(3, {"w": jnp.full((4,), 3.0)})
+
+    def boom(path, tree):
+        raise RuntimeError("simulated crash mid-save")
+
+    monkeypatch.setattr(checkpoint, "save", boom)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        mgr.save(3, {"w": jnp.full((4,), 99.0)}, overwrite=True)
+    monkeypatch.undo()
+
+    # The predecessor is still committed and restorable, bit-for-bit.
+    assert mgr.steps() == [3]
+    restored = mgr.restore(step=3)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.full((4,), 3.0))
+
+    # And a successful overwrite replaces it cleanly afterwards.
+    mgr.save(3, {"w": jnp.full((4,), 7.0)}, overwrite=True)
+    restored = mgr.restore(step=3)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.full((4,), 7.0))
+    assert not list((tmp_path / "ckpts").glob(".replaced_*"))
+
+
+def test_checkpoint_manager_interrupted_swap_recovers_predecessor(tmp_path, monkeypatch):
+    """Crash BETWEEN renaming the predecessor away and committing its
+    replacement leaves the only committed copy under .replaced_*.  A
+    retried save must restore it before attempting the new write — and a
+    second failure must still leave the step restorable."""
+    import pytest
+
+    mgr = checkpoint.CheckpointManager(tmp_path / "ckpts", max_to_keep=None)
+    mgr.save(5, {"w": jnp.full((3,), 5.0)})
+
+    # Simulate the crash window: predecessor renamed away, replacement
+    # data present but never committed.
+    final = mgr._step_dir(5)
+    final.rename(tmp_path / "ckpts" / ".replaced_step_00000005")
+    final.mkdir()
+    (final / "params").mkdir()
+    assert mgr.steps() == []  # the step is invisible mid-window...
+
+    def boom(path, tree):
+        raise RuntimeError("second crash")
+
+    monkeypatch.setattr(checkpoint, "save", boom)
+    with pytest.raises(RuntimeError, match="second crash"):
+        mgr.save(5, {"w": jnp.zeros((3,))}, overwrite=True)
+    monkeypatch.undo()
+
+    # ...but the retry recovered the predecessor before the new write,
+    # so the second failure cost nothing.
+    assert mgr.steps() == [5]
+    restored = mgr.restore(step=5)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.full((3,), 5.0))
+
+    # A clean retry then replaces it for real.
+    mgr.save(5, {"w": jnp.full((3,), 6.0)}, overwrite=True)
+    np.testing.assert_array_equal(
+        np.asarray(mgr.restore(step=5)["w"]), np.full((3,), 6.0)
+    )
+
+
+def test_checkpoint_manager_marker_is_atomic(tmp_path):
+    """The COMMITTED marker is published via temp+rename: no observable
+    state may have a marker that exists but does not parse."""
+    mgr = checkpoint.CheckpointManager(tmp_path / "ckpts")
+    mgr.save(1, {"w": jnp.ones((2,))}, tags={"k": "v"})
+    assert mgr.metadata(1)["tags"] == {"k": "v"}
+    # A torn temp marker (crash mid-write) is invisible to listing.
+    torn = mgr._step_dir(2)
+    torn.mkdir(parents=True)
+    (torn / "params").mkdir()
+    (torn / "COMMITTED.tmp").write_text('{"truncat')
+    assert mgr.steps() == [1]

@@ -350,3 +350,26 @@ def test_seq_pad_uncapped_spec_overflow_is_a_value_error():
         apply_seq_pad(
             {"input_ids": np.ones((1, (1 << 20) + 1), np.int8)}, spec
         )
+
+
+def test_dispatch_after_stop_fails_futures_instead_of_stranding():
+    """A dispatch that finishes AFTER stop() has drained the in-flight
+    queue and retired the completer (e.g. a multi-minute XLA compile
+    outliving the join timeout) must fail its futures directly — an
+    entry put into the unconsumed queue would strand its HTTP requests
+    until the client's own timeout."""
+    from concurrent.futures import Future
+
+    from tpumlops.server.batching import DynamicBatcher, _Item
+
+    b = DynamicBatcher(
+        run_batch=lambda stacked: stacked["x"],
+        materialize=lambda out: out,
+    )
+    b._stop = True  # stop() already ran; completer is gone
+    fut: Future = Future()
+    b._dispatch([_Item({"x": np.ones((1, 2), np.float32)}, fut)])
+    assert fut.done()
+    with pytest.raises(RuntimeError, match="shutting down"):
+        fut.result()
+    assert b._inflight.empty()  # nothing stranded

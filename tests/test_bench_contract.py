@@ -204,3 +204,39 @@ def test_compact_line_sheds_to_budget_without_losing_contract():
     parsed = bench.compact_line(full)
     for k in ("metric", "value", "unit", "vs_baseline"):
         assert k in parsed
+
+
+def test_scan_delta_donated_carry_aliases_in_place():
+    """The donated carry must alias into the scan loop state.
+
+    XLA expresses donation as input->output buffer pairs; round 4 found
+    the timed region returning only the probe ys, which left the donated
+    multi-GiB KV cache nothing to alias into ("Some donated buffers were
+    not usable") — the cache lived twice and the 7B 32-slot fit argument
+    was void.  Pin: donate_carry produces zero donation warnings.
+    """
+    import warnings
+
+    import jax.numpy as jnp
+
+    def step(p, c):
+        c2 = c * p + 1e-6
+        return c2, c2[0, 0]
+
+    def carry_at(i):
+        return jnp.ones((128, 128), jnp.float32) * (1.0 + 1e-5 * i)
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        try:
+            bench._scan_delta_timed(
+                step, carry_at, runs=3, n1=2, n2=6,
+                params=jnp.float32(1.0), donate_carry=True,
+            )
+        except RuntimeError:
+            # The anti-elision timing guards can fire on a sub-ms CPU
+            # workload; the donation warning (what this test pins) is
+            # emitted at trace time, before any timing check.
+            pass
+    bad = [w for w in caught if "donated" in str(w.message).lower()]
+    assert not bad, f"donation failed to alias: {bad[0].message}"

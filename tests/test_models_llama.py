@@ -156,3 +156,23 @@ def test_decode_self_attention_at_exact_window_boundary():
     at_boundary = run(window=W)      # position W, window W
     oracle = run(window=64)          # same state, window covers all
     np.testing.assert_allclose(at_boundary, oracle, rtol=2e-5, atol=2e-5)
+
+
+def test_commit_rows_drops_write_at_capacity():
+    """A row whose length equals cache capacity must NOT be written: the
+    scatter spelling (`.at[...].set`) drops out-of-bounds updates, and
+    the dynamic_update_slice spelling must not silently clamp onto the
+    row's last real K/V (a finished request parked at capacity while
+    other slots decode would corrupt itself)."""
+    from tpumlops.models.llama import _commit_rows
+
+    L, B, T, H, D = 2, 3, 4, 2, 3
+    buf = jnp.zeros((L, B, T, H, D), jnp.float32)
+    vals = jnp.ones((L, B, H, D), jnp.float32)
+    lengths = jnp.array([1, T, 3], jnp.int32)  # row 1 is AT capacity
+    out = jax.jit(_commit_rows)(buf, vals, lengths)
+    np.testing.assert_array_equal(np.asarray(out[:, 0, 1]), 1.0)
+    np.testing.assert_array_equal(np.asarray(out[:, 2, 3]), 1.0)
+    # Row 1: untouched everywhere, including the last position a clamped
+    # start would have overwritten.
+    np.testing.assert_array_equal(np.asarray(out[:, 1]), 0.0)

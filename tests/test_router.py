@@ -203,6 +203,20 @@ def test_feedback_proxied_and_counted_by_service(world):
     assert m.request_count == 6
 
 
+def test_latency_ring_excludes_feedback(world):
+    """The exact-latency ring mirrors the client histogram's scope:
+    predictions only.  Feedback posts ride a different code path, so
+    letting them into the ring would contaminate the bench's
+    router-internal tail attribution with no trace in the sample count."""
+    world.admin.set_weights({"v1": 100, "v2": 0})
+    world.admin.drain_latencies()
+    for _ in range(4):
+        ask(world.port)
+    for _ in range(5):
+        ask(world.port, path="/api/v1.0/feedback", body={"reward": 1.0})
+    assert len(world.admin.drain_latencies()) == 4
+
+
 def test_dead_backend_gives_502_and_metric(world):
     dead = free_port()  # nothing listens here
     world.admin.set_config(

@@ -679,3 +679,30 @@ def test_bert_server_buckets_variable_lengths(tmp_path):
             np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
     finally:
         handle.stop()
+
+
+def test_streaming_loader_consumer_crash_releases_reader(tmp_path, monkeypatch):
+    """A consumer failure (e.g. device OOM mid-transfer) must not strand
+    the npz reader thread on the bounded queue: the thread would hold the
+    open npz handle plus buffered leaves for the life of the process, and
+    a server retrying load_predictor would accumulate one wedged reader
+    per attempt."""
+    from tpumlops.server import loader as loader_mod
+
+    npz = tmp_path / "params.npz"
+    np.savez(npz, **{f"leaf{i}": np.ones((64, 64), np.float32) for i in range(8)})
+
+    def boom(q, leaves, quantize_leaves, timing):
+        raise MemoryError("simulated device OOM")
+
+    monkeypatch.setattr(loader_mod, "_consume_leaves", boom)
+    with pytest.raises(MemoryError, match="simulated device OOM"):
+        loader_mod._stream_native_params(npz)
+
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if not any(t.name == "npz-reader" for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    alive = [t.name for t in threading.enumerate() if t.name == "npz-reader"]
+    assert not alive, f"reader threads still wedged: {alive}"
