@@ -154,7 +154,7 @@ class DynamicBatcher:
         run_batch: Callable[[dict[str, np.ndarray]], Any],
         max_batch_size: int = 32,
         max_batch_delay_ms: float = 5.0,
-        on_batch: Callable[[int, float, float], None] | None = None,
+        on_batch: Callable[[int, float, float, float], None] | None = None,
         materialize: Callable[[Any], Any] | None = None,
         max_inflight: int = 2,
     ):
@@ -329,10 +329,14 @@ class DynamicBatcher:
                 # time THIS batch added to the pipeline (steady state =
                 # its device time), keeping the queue/run/overhead
                 # decomposition additive instead of double-counting.
+                # The time spent waiting BEHIND the predecessor is its
+                # own term (pipeline_wait) so it doesn't masquerade as
+                # server overhead in the residual.
                 run_seconds = done - max(t_run, t_prev_done)
+                pipeline_wait = max(0.0, t_prev_done - t_run)
                 t_prev_done = done
                 if self._on_batch:
-                    self._on_batch(n, queue_age, run_seconds)
+                    self._on_batch(n, queue_age, run_seconds, pipeline_wait)
                 outputs = _split_outputs(out, n)
                 for i, item in enumerate(items):
                     if not item.future.done():  # stop() may have failed it

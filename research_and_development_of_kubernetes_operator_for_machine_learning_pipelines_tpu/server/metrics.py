@@ -90,6 +90,18 @@ class ServerMetrics:
             buckets=_LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # Pipelined mode only: time a dispatched batch waited behind its
+        # predecessor's device run before its own materialize began.
+        # Without this term the wait pools into the residual "overhead"
+        # (total - queue - run), misreading pipeline occupancy as server
+        # glue cost.
+        self.pipeline_wait_seconds = Histogram(
+            "tpumlops_pipeline_wait_seconds",
+            "Wait behind the previous in-flight batch before materialize",
+            ident_labels,
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
         self.compilations = Counter(
             "tpumlops_compilations_total",
             "XLA compilations triggered (by bucket signature)",
@@ -153,11 +165,18 @@ class ServerMetrics:
         self.feedback_reward.labels(**self.identity).inc(reward)
 
     def observe_batch(
-        self, size: int, queue_seconds: float, run_seconds: float = 0.0
+        self,
+        size: int,
+        queue_seconds: float,
+        run_seconds: float = 0.0,
+        pipeline_wait_seconds: float = 0.0,
     ):
         self.batch_size.labels(**self.identity).observe(size)
         self.queue_seconds.labels(**self.identity).observe(queue_seconds)
         self.batch_run_seconds.labels(**self.identity).observe(run_seconds)
+        self.pipeline_wait_seconds.labels(**self.identity).observe(
+            pipeline_wait_seconds
+        )
 
     def observe_decode_step(self, active_slots: int, seconds: float):
         self.decode_batch.labels(**self.identity).observe(active_slots)

@@ -121,6 +121,30 @@ class CheckpointManager:
         self.root.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
         self._save_lock = threading.Lock()
+        self._recover_interrupted()
+
+    def _recover_interrupted(self) -> None:
+        """Heal crash leftovers at open time, for EVERY step.
+
+        An overwrite that crashed between parking the committed
+        predecessor under ``.replaced_<step>`` and committing its
+        replacement leaves the step's only committed bytes under a name
+        ``steps()`` never lists.  Waiting for a same-step ``save()`` to
+        notice would hide the step from ``restore()`` indefinitely (and
+        leak the directory if that step is never re-saved) — so the scan
+        runs on open: restore the predecessor when the step is
+        uncommitted, scrap the leftover when the overwrite did commit.
+        """
+        with self._save_lock:
+            for old in self.root.glob(".replaced_step_*"):
+                final = self.root / old.name[len(".replaced_"):]
+                if (final / _COMMITTED).exists():
+                    shutil.rmtree(old)  # overwrite committed; this is trash
+                else:
+                    if final.exists():
+                        shutil.rmtree(final)  # uncommitted replacement
+                    old.rename(final)
+                    _fsync_path(self.root)
 
     # -- layout --------------------------------------------------------------
 

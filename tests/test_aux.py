@@ -322,3 +322,23 @@ def test_checkpoint_manager_marker_is_atomic(tmp_path):
     (torn / "params").mkdir()
     (torn / "COMMITTED.tmp").write_text('{"truncat')
     assert mgr.steps() == [1]
+
+
+def test_checkpoint_manager_open_recovers_interrupted_swap(tmp_path):
+    """A NEW manager over a root holding an interrupted overwrite swap
+    must surface the parked predecessor immediately — recovery cannot
+    wait for a same-step save() that may never come (steps are
+    monotonic), and the .replaced_ copy must not leak."""
+    mgr = checkpoint.CheckpointManager(tmp_path / "ckpts", max_to_keep=None)
+    mgr.save(9, {"w": jnp.full((2,), 9.0)})
+    final = mgr._step_dir(9)
+    final.rename(tmp_path / "ckpts" / ".replaced_step_00000009")
+    final.mkdir()
+    (final / "params").mkdir()  # uncommitted replacement wreckage
+
+    fresh = checkpoint.CheckpointManager(tmp_path / "ckpts", max_to_keep=None)
+    assert fresh.steps() == [9]
+    np.testing.assert_array_equal(
+        np.asarray(fresh.restore(step=9)["w"]), np.full((2,), 9.0)
+    )
+    assert not list((tmp_path / "ckpts").glob(".replaced_*"))

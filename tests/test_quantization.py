@@ -329,13 +329,16 @@ def test_loader_bert_int8(tmp_path):
         load_predictor(str(art), quantize="int8kv")
 
 
-def test_streamed_host_quantize_matches_device_quantize(tmp_path):
-    """The loader's host-side (numpy) quantize-on-arrival must implement
-    the same scheme as quantization.quantize_tensor (device): identical
-    scales and q8 within one rounding ulp.  Host-side is the round-3
-    default — it halves load transfer bytes and the HBM peak."""
+def test_streamed_host_quantize_matches_device_quantize(tmp_path, monkeypatch):
+    """The loader's host-side (numpy) quantize-on-arrival — the
+    TPUMLOPS_HOST_QUANTIZE=1 fallback since round 4 made on-device
+    quantize the streaming default — must implement the same scheme as
+    quantization.quantize_tensor: identical scales and q8 within one
+    rounding ulp."""
     import jax
     import jax.numpy as jnp
+
+    monkeypatch.setenv("TPUMLOPS_HOST_QUANTIZE", "1")
 
     from tpumlops.models import llama
     from tpumlops.models.quantization import quantize_llama
@@ -369,3 +372,42 @@ def test_streamed_host_quantize_matches_device_quantize(tmp_path):
             np.asarray(s_leaf["q8"], np.int32) - np.asarray(r_leaf["q8"], np.int32)
         )
         assert diff.max() <= 1, (name, diff.max())  # rounding-tie ulp only
+
+
+def test_streamed_device_quantize_is_exact(tmp_path):
+    """The default streaming path quantizes ON DEVICE through the one
+    canonical quantize_tensor, so its output must be bit-identical to
+    quantizing the loaded bf16 tree in one shot."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.models.quantization import quantize_llama
+    from tpumlops.server.loader import load_predictor, save_native_model
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(11), cfg, dtype=jnp.bfloat16)
+    art = tmp_path / "llq2"
+    save_native_model(
+        art, "llama-generate", params,
+        config={
+            "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size, "max_seq": cfg.max_seq,
+        },
+    )
+    streamed = load_predictor(str(art), quantize="int8").causal_lm["params"]
+    ref = quantize_llama(load_predictor(str(art)).causal_lm["params"])
+    for name in ("q", "k", "v", "o", "gate", "up", "down"):
+        np.testing.assert_array_equal(
+            np.asarray(streamed["layers"][name]["q8"]),
+            np.asarray(ref["layers"][name]["q8"]), err_msg=name,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(streamed["layers"][name]["scale"]),
+            np.asarray(ref["layers"][name]["scale"]), err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(streamed["lm_head"]["q8"]), np.asarray(ref["lm_head"]["q8"])
+    )
