@@ -32,13 +32,56 @@ from .common import rms_norm
 from .quantization import dequantize_tensor, is_quantized
 
 
+# Decode attention dispatch: "xla" (einsum chain), "pallas" (fused
+# ops/decode_attention kernel), or "auto" (pallas on TPU backends, xla
+# elsewhere — the kernel needs a real Mosaic lowering; CPU tests take the
+# XLA path and the kernel's parity is pinned in interpret mode).
+# A/B on chip: scripts/ab_attention.py.
+_DECODE_ATTN = "auto"
+
+
+def _decode_attn_impl() -> str:
+    if _DECODE_ATTN != "auto":
+        return _DECODE_ATTN
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return "xla"
+    return "pallas" if platform in ("tpu", "axon") else "xla"
+
+
 def _mat(w, dtype):
     """Weight leaf -> matmul operand: raw array or int8 {"q8","scale"}.
 
-    The dequantize is elementwise on the operand, so XLA fuses it into the
-    matmul's HBM read — int8 bytes stream from memory, bf16 enters the MXU.
+    Prefer :func:`_qmatmul` on the hot paths — materializing the
+    dequantized operand risks XLA writing a full-precision weight copy
+    to HBM when the fusion heuristics decline (round-4 profile: a
+    "weights-only" decode step cost 3-4x the int8 stream floor).
     """
     return dequantize_tensor(w, dtype) if is_quantized(w) else w.astype(dtype)
+
+
+def _qmatmul(x, w):
+    """``x @ dequantize(w)`` with the scale applied to the OUTPUT.
+
+    The int8 scheme's scale is per-output-channel (``axis=-2`` reduce,
+    shape ``[..., 1, out]``), so ``x @ (q8 * scale) == (x @ q8) * scale``
+    exactly — the multiply moves from the ``[in, out]`` weight matrix to
+    the ``[rows, out]`` result.  That guarantees the GEMM's HBM read is
+    the RAW int8 buffer with only a convert on the operand (a fusion XLA
+    performs reliably), instead of relying on it fusing a broadcast
+    multiply — when that fusion declines, a bf16 copy of every weight
+    matrix hits HBM and decode pays ~3x the weight traffic (round-4
+    profile, scripts/profile_decode.py).  int8 values are exact in bf16,
+    and the f32 scale multiplies the f32 accumulator, so numerics are at
+    least as good as dequantize-then-matmul.
+    """
+    if is_quantized(w):
+        y = jnp.matmul(
+            x, w["q8"].astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return y * w["scale"].astype(jnp.float32)
+    return jnp.matmul(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
 
 
 @dataclass(frozen=True)
@@ -111,7 +154,7 @@ class RaggedKVCache(NamedTuple):
     assignment; this type is the pure-JAX state it schedules over.
     """
 
-    k: jax.Array
+    k: jax.Array  # [L, B, NKV, T, D] — head-major (see QuantRaggedKVCache)
     v: jax.Array
     lengths: jax.Array  # int32 [B]: valid positions per slot
 
@@ -119,7 +162,7 @@ class RaggedKVCache(NamedTuple):
     def create(
         cls, cfg: LlamaConfig, batch: int, dtype=jnp.bfloat16
     ) -> "RaggedKVCache":
-        shape = (cfg.num_layers, batch, cfg.max_seq, cfg.num_kv_heads, cfg.head_dim)
+        shape = (cfg.num_layers, batch, cfg.num_kv_heads, cfg.max_seq, cfg.head_dim)
         return cls(
             k=jnp.zeros(shape, dtype),
             v=jnp.zeros(shape, dtype),
@@ -144,15 +187,19 @@ class QuantRaggedKVCache(NamedTuple):
     ``spec.tpu.quantize: int8kv``.
     """
 
-    k8: jax.Array  # int8   [L, B, T, NKV, D]
-    k_scale: jax.Array  # f32 [L, B, T, NKV, 1]
+    k8: jax.Array  # int8   [L, B, NKV, T, D] — head-major: one (slot,
+    #   kv-head)'s attended window is CONTIGUOUS, which is both the DMA-
+    #   friendly order for decode reads and the block shape the fused
+    #   Pallas kernel requires (ops/decode_attention.py; last two block
+    #   dims must be the tile-aligned (W, D)).
+    k_scale: jax.Array  # f32 [L, B, NKV, T, 1]
     v8: jax.Array
     v_scale: jax.Array
     lengths: jax.Array  # int32 [B]
 
     @classmethod
     def create(cls, cfg: LlamaConfig, batch: int) -> "QuantRaggedKVCache":
-        shape = (cfg.num_layers, batch, cfg.max_seq, cfg.num_kv_heads, cfg.head_dim)
+        shape = (cfg.num_layers, batch, cfg.num_kv_heads, cfg.max_seq, cfg.head_dim)
         sshape = shape[:-1] + (1,)
         return cls(
             k8=jnp.zeros(shape, jnp.int8),
@@ -302,9 +349,9 @@ def _block(
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = jnp.matmul(xn, _mat(lp["q"], xn.dtype), preferred_element_type=jnp.float32)
-    k = jnp.matmul(xn, _mat(lp["k"], xn.dtype), preferred_element_type=jnp.float32)
-    v = jnp.matmul(xn, _mat(lp["v"], xn.dtype), preferred_element_type=jnp.float32)
+    q = _qmatmul(xn, lp["q"])
+    k = _qmatmul(xn, lp["k"])
+    v = _qmatmul(xn, lp["v"])
     q = q.astype(x.dtype).reshape(b, s, nh, hd)
     k = k.astype(x.dtype).reshape(b, s, nkv, hd)
     v = v.astype(x.dtype).reshape(b, s, nkv, hd)
@@ -389,18 +436,14 @@ def _block(
         scores = scores + mask_bias[:, None]  # [B or 1, 1, 1, S, T]
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         ctx = jnp.einsum("bngqk,bknd->bqngd", probs, vv).reshape(b, s, nh * hd)
-    attn_out = jnp.matmul(
-        ctx, _mat(lp["o"], ctx.dtype), preferred_element_type=jnp.float32
-    ).astype(x.dtype)
+    attn_out = _qmatmul(ctx, lp["o"]).astype(x.dtype)
     x = x + attn_out
 
     xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-    gate = jnp.matmul(xn, _mat(lp["gate"], xn.dtype), preferred_element_type=jnp.float32)
-    up = jnp.matmul(xn, _mat(lp["up"], xn.dtype), preferred_element_type=jnp.float32)
+    gate = _qmatmul(xn, lp["gate"])
+    up = _qmatmul(xn, lp["up"])
     act = jax.nn.silu(gate) * up
-    down = jnp.matmul(
-        act.astype(x.dtype), _mat(lp["down"], x.dtype), preferred_element_type=jnp.float32
-    ).astype(x.dtype)
+    down = _qmatmul(act.astype(x.dtype), lp["down"]).astype(x.dtype)
     return x + down, cache_k, cache_v
 
 
@@ -437,9 +480,9 @@ def _block_decode_deferred(
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = jnp.matmul(xn, _mat(lp["q"], xn.dtype), preferred_element_type=jnp.float32)
-    k = jnp.matmul(xn, _mat(lp["k"], xn.dtype), preferred_element_type=jnp.float32)
-    v = jnp.matmul(xn, _mat(lp["v"], xn.dtype), preferred_element_type=jnp.float32)
+    q = _qmatmul(xn, lp["q"])
+    k = _qmatmul(xn, lp["k"])
+    v = _qmatmul(xn, lp["v"])
     q = q.astype(x.dtype).reshape(b, s, nh, hd)
     k = k.astype(x.dtype).reshape(b, s, nkv, hd)
     v = v.astype(x.dtype).reshape(b, s, nkv, hd)
@@ -449,23 +492,54 @@ def _block_decode_deferred(
     group = nh // nkv
     qg = q.reshape(b, s, nkv, group, hd)
     quant_cache = isinstance(cache_k, tuple)
+    if quant_cache and _decode_attn_impl() == "pallas":
+        # Fused Pallas path: one program per (slot, kv-head) does both
+        # MXU dots over the VMEM-resident int8 window with scales folded
+        # into score/prob rows and the self-term joined in-softmax —
+        # replacing the ~15-op XLA chain below (ops/decode_attention.py;
+        # dispatch measured by scripts/ab_attention.py).
+        from ..ops.decode_attention import decode_attention
+
+        k8, ks = cache_k
+        v8, vs = cache_v
+        ctx4 = decode_attention(
+            qg[:, 0],                                   # [B, NKV, G, D]
+            k8[:, :, :window],
+            ks[:, :, :window],                          # [B, NKV, W, 1]
+            v8[:, :, :window],
+            vs[:, :, :window],
+            k[:, 0][:, :, None, :],                     # [B, NKV, 1, D]
+            v[:, 0][:, :, None, :],
+            mask_bias[:, 0],                            # [B, 1, W]
+        )
+        ctx = ctx4[:, None].astype(x.dtype).reshape(b, s, nh * hd)
+        attn_out = _qmatmul(ctx, lp["o"]).astype(x.dtype)
+        x = x + attn_out
+        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = _qmatmul(xn, lp["gate"])
+        up = _qmatmul(xn, lp["up"])
+        act = jax.nn.silu(gate) * up
+        down = _qmatmul(act.astype(x.dtype), lp["down"]).astype(x.dtype)
+        return x + down, k, v
     if quant_cache:
         k8, ks = cache_k
         v8, vs = cache_v
-        k8, ks = k8[:, :window], ks[:, :window]
-        v8, vs = v8[:, :window], vs[:, :window]
+        k8, ks = k8[:, :, :window], ks[:, :, :window]
+        v8, vs = v8[:, :, :window], vs[:, :, :window]
         scores = jnp.einsum(
-            "bqngd,bknd->bngqk",
+            "bqngd,bnkd->bngqk",
             qg,
             k8.astype(x.dtype),
             preferred_element_type=jnp.float32,
         ) / jnp.sqrt(jnp.float32(hd))
-        kscale = jnp.moveaxis(ks[..., 0], 1, 2)[:, :, None, None, :]
+        # ks: [B, NKV, W, 1] -> [B, NKV, 1, 1, W] — head-major layout
+        # means NO transposed copy, just a reshape of the window slice.
+        kscale = ks[..., 0][:, :, None, None, :]
         scores = scores * kscale
     else:
-        kk = cache_k[:, :window].astype(x.dtype)
+        kk = cache_k[:, :, :window].astype(x.dtype)
         scores = jnp.einsum(
-            "bqngd,bknd->bngqk", qg, kk, preferred_element_type=jnp.float32
+            "bqngd,bnkd->bngqk", qg, kk, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.float32(hd))
     scores = scores + mask_bias[:, None]
 
@@ -479,28 +553,24 @@ def _block_decode_deferred(
     probs_cache, prob_self = probs[..., :-1], probs[..., -1:]
 
     if quant_cache:
-        vscale = jnp.moveaxis(vs[..., 0], 1, 2)[:, :, None, None, :]
+        vscale = vs[..., 0][:, :, None, None, :]
         probs_cache = (probs_cache * vscale).astype(x.dtype)
-        ctx = jnp.einsum("bngqk,bknd->bqngd", probs_cache, v8.astype(x.dtype))
+        ctx = jnp.einsum("bngqk,bnkd->bqngd", probs_cache, v8.astype(x.dtype))
     else:
-        vv = cache_v[:, :window].astype(x.dtype)
-        ctx = jnp.einsum("bngqk,bknd->bqngd", probs_cache.astype(x.dtype), vv)
+        vv = cache_v[:, :, :window].astype(x.dtype)
+        ctx = jnp.einsum("bngqk,bnkd->bqngd", probs_cache.astype(x.dtype), vv)
     ctx = ctx + jnp.einsum(
         "bngqk,bknd->bqngd", prob_self.astype(x.dtype), v
     )
     ctx = ctx.reshape(b, s, nh * hd)
 
-    attn_out = jnp.matmul(
-        ctx, _mat(lp["o"], ctx.dtype), preferred_element_type=jnp.float32
-    ).astype(x.dtype)
+    attn_out = _qmatmul(ctx, lp["o"]).astype(x.dtype)
     x = x + attn_out
     xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-    gate = jnp.matmul(xn, _mat(lp["gate"], xn.dtype), preferred_element_type=jnp.float32)
-    up = jnp.matmul(xn, _mat(lp["up"], xn.dtype), preferred_element_type=jnp.float32)
+    gate = _qmatmul(xn, lp["gate"])
+    up = _qmatmul(xn, lp["up"])
     act = jax.nn.silu(gate) * up
-    down = jnp.matmul(
-        act.astype(x.dtype), _mat(lp["down"], x.dtype), preferred_element_type=jnp.float32
-    ).astype(x.dtype)
+    down = _qmatmul(act.astype(x.dtype), lp["down"]).astype(x.dtype)
     return x + down, k, v
 
 
@@ -545,9 +615,7 @@ def forward(
         scan_body, x, (params["layers"], cache.k, cache.v)
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = jnp.matmul(
-        x, _mat(params["lm_head"], x.dtype), preferred_element_type=jnp.float32
-    )
+    logits = _qmatmul(x, params["lm_head"])
     new_cache = KVCache(k=new_k, v=new_v, length=start + s)
     return logits, new_cache
 
@@ -648,7 +716,7 @@ def decode_ragged(
     positions = lengths[:, None]  # [B, 1]
     cos, sin = rope_cos_sin(positions, cfg, jnp.float32)  # [B, 1, head_dim]
 
-    capacity = (cache.k8 if quant else cache.k).shape[2]
+    capacity = (cache.k8 if quant else cache.k).shape[3]  # [L,B,NKV,T,D]
     if window is None:
         window = capacity
     window = min(int(window), capacity)
@@ -742,9 +810,7 @@ def _finish_decode(params, x, k_news, v_news, cache, lengths, active, quant, cfg
     """
     b = x.shape[0]
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = jnp.matmul(
-        x, _mat(params["lm_head"], x.dtype), preferred_element_type=jnp.float32
-    )
+    logits = _qmatmul(x, params["lm_head"])
     advance = (
         jnp.ones((b,), jnp.int32) if active is None else active.astype(jnp.int32)
     )
@@ -766,9 +832,10 @@ def _finish_decode(params, x, k_news, v_news, cache, lengths, active, quant, cfg
 
 
 def _commit_rows(buf: jax.Array, vals: jax.Array, lengths: jax.Array) -> jax.Array:
-    """Write row ``b``'s new K/V at ``(..., b, lengths[b], ...)`` in place.
+    """Write row ``b``'s new K/V at its own position, in place.
 
-    ``buf`` is ``[L, B, T, ...]``, ``vals`` ``[L, B, ...]``.  A single
+    ``buf`` is head-major ``[L, B, NKV, T, ...]``, ``vals`` ``[L, B, NKV,
+    ...]``; row ``b`` writes at position ``lengths[b]`` on axis 3.  A single
     batched scatter (``buf.at[:, rows, lengths].set``) is the obvious
     spelling, but measured on v5e it makes XLA materialize a full copy of
     the cache buffer every decode step once the buffer is also consumed
@@ -780,14 +847,15 @@ def _commit_rows(buf: jax.Array, vals: jax.Array, lengths: jax.Array) -> jax.Arr
     updates the loop-carried buffer exactly once.
     """
     def body(b, acc):
-        # [L, 1, 1, ...] slab for row b at its own position.  All start
-        # indices share one dtype (x64 mode would otherwise mix the
-        # loop's int64 counter with int32 zeros).
-        slab = jax.lax.dynamic_slice_in_dim(vals, b, 1, axis=1)[:, :, None]
+        # [L, 1, NKV, 1, ...] slab for row b at its own position.  All
+        # start indices share one dtype (x64 mode would otherwise mix
+        # the loop's int64 counter with int32 zeros).
+        slab = jax.lax.dynamic_slice_in_dim(vals, b, 1, axis=1)[:, :, :, None]
         z = jnp.zeros((), jnp.int32)
-        start = (z, jnp.asarray(b, jnp.int32), jnp.asarray(lengths[b], jnp.int32)) + (
-            z,
-        ) * (buf.ndim - 3)
+        start = (
+            z, jnp.asarray(b, jnp.int32), z,
+            jnp.asarray(lengths[b], jnp.int32),
+        ) + (z,) * (buf.ndim - 4)
 
         def write(a):
             return jax.lax.dynamic_update_slice(a, slab.astype(a.dtype), start)
@@ -798,7 +866,7 @@ def _commit_rows(buf: jax.Array, vals: jax.Array, lengths: jax.Array) -> jax.Arr
         # a full resident row (e.g. a finished request parked at
         # capacity while others decode) must not corrupt itself.
         return jax.lax.cond(
-            lengths[b] < buf.shape[2], write, lambda a: a, acc
+            lengths[b] < buf.shape[3], write, lambda a: a, acc
         )
 
     return jax.lax.fori_loop(0, buf.shape[1], body, buf)
@@ -822,9 +890,14 @@ def insert_sequence(
     slot = jnp.asarray(slot, jnp.int32)
     z = jnp.zeros((), jnp.int32)
     lengths = cache.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
+    # prefill's KVCache is position-major [L, 1, Tp, NKV, D]; the ragged
+    # cache is head-major [L, B, NKV, T, D] — one transpose per insert
+    # (prefill-rate, not decode-rate, so the copy is off the hot path).
+    seq_k = jnp.swapaxes(seq.k, 2, 3)
+    seq_v = jnp.swapaxes(seq.v, 2, 3)
     if isinstance(cache, QuantRaggedKVCache):
-        k8, ks = _quant_kv(seq.k)
-        v8, vs = _quant_kv(seq.v)
+        k8, ks = _quant_kv(seq_k)
+        v8, vs = _quant_kv(seq_v)
         ins = lambda buf, vals: lax.dynamic_update_slice(
             buf, vals.astype(buf.dtype), (z, slot, z, z, z)
         )
@@ -836,10 +909,10 @@ def insert_sequence(
             lengths,
         )
     k = lax.dynamic_update_slice(
-        cache.k, seq.k.astype(cache.k.dtype), (z, slot, z, z, z)
+        cache.k, seq_k.astype(cache.k.dtype), (z, slot, z, z, z)
     )
     v = lax.dynamic_update_slice(
-        cache.v, seq.v.astype(cache.v.dtype), (z, slot, z, z, z)
+        cache.v, seq_v.astype(cache.v.dtype), (z, slot, z, z, z)
     )
     return RaggedKVCache(k, v, lengths)
 

@@ -1,0 +1,140 @@
+"""Fused ragged-decode attention over an int8 KV window (Pallas, TPU).
+
+One decode step's attention for one layer is, in XLA, ~15 small ops per
+layer: two dequant-scale transposes, two einsums, mask add, self-term
+concat, softmax, weighted-sum split.  Each reduce breaks fusion, and at
+single-token shapes the per-op latency — not bandwidth — dominates
+(round-4 profile: a weights-only decode step ran ~3x the int8 stream
+floor with the GEMMs themselves measured at 76-87% of peak, leaving
+~90 us/layer of elementwise soup).  This kernel collapses the block to
+ONE program per (slot, kv-head): both MXU dots back-to-back over the
+VMEM-resident K/V window, the int8 scales folded into score/probability
+rows (exact — see below), the mask added in-register, and the current
+token's self-term joined into the softmax without a concat.
+
+Exactness of the scale folding (same algebra as ``models.llama._qmatmul``):
+the cache scale is per (position, kv-head) over head_dim, so
+
+  q . (k8[w] * ks[w]) == (q . k8[w]) * ks[w]          (score row scale)
+  sum_w p[w] * (v8[w] * vs[w]) == (p * vs) @ v8        (prob row scale)
+
+— int8 values convert exactly to f32, so the kernel is bit-compatible
+with dequantize-then-attend up to f32 summation order.
+
+Layouts (B slots, W window, NKV kv heads, G = heads/kv_head, D head_dim):
+
+  q       [B, NKV, G, D]   current token's queries, grouped by kv head
+  k8, v8  [B, NKV, W, D]   int8 cache window (head-major cache layout —
+                           one (slot, head)'s window is contiguous)
+  ks, vs  [B, NKV, W, 1]   f32 scales (the cache's window slice as-is;
+                           the trailing 1 keeps the block tile-legal)
+  k_self  [B, NKV, 1, D]   current token's K/V (exact, never quantized)
+  v_self  [B, NKV, 1, D]
+  mask    [B, 1, W]        f32 additive bias (0 keep / -1e30 drop),
+                           STRICT: position w < lengths[b]
+  out     [B, NKV, G, D]   f32
+
+Reference behavior is pinned against the XLA path in
+``tests/test_ops.py`` (interpret mode, so the parity runs on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _decode_attn_kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                        kself_ref, vself_ref, mask_ref, o_ref, *, scale):
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [G, D]
+    k = k_ref[0, 0].astype(jnp.float32)                  # [W, D] (int8 exact)
+    ks = ks_ref[0, 0, :, 0].astype(jnp.float32)          # [W]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                    # [G, W]
+    s = s * ks[None, :] + mask_ref[0]
+
+    k_self = kself_ref[0, 0].astype(jnp.float32)         # [1, D]
+    s_self = jnp.sum(q * k_self, axis=-1, keepdims=True)  # [G, 1]
+
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), s_self)
+    p = jnp.exp(s - m)                                   # [G, W]
+    p_self = jnp.exp(s_self - m)                         # [G, 1]
+    denom = jnp.sum(p, axis=-1, keepdims=True) + p_self
+
+    vs = vs_ref[0, 0, :, 0].astype(jnp.float32)          # [W]
+    v = v_ref[0, 0].astype(jnp.float32)                  # [W, D]
+    ctx = jax.lax.dot_general(
+        p * vs[None, :], v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                    # [G, D]
+    v_self = vself_ref[0, 0].astype(jnp.float32)         # [1, D]
+    ctx = (ctx + p_self * v_self) / denom
+    o_ref[0, 0] = ctx
+
+
+def decode_attention(
+    q: jax.Array,
+    k8: jax.Array,
+    ks: jax.Array,
+    v8: jax.Array,
+    vs: jax.Array,
+    k_self: jax.Array,
+    v_self: jax.Array,
+    mask: jax.Array,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused int8-KV decode attention; see module docstring for layouts."""
+    b, nkv, g, d = q.shape
+    w = k8.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    if not interpret and jax.devices()[0].platform == "cpu":
+        # No Mosaic lowering on CPU: interpret transparently so the
+        # integrated pallas path stays testable off-chip.
+        interpret = True
+    kernel = functools.partial(_decode_attn_kernel, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), jnp.float32),
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda i, j: (i, j, 0, 0)),   # q
+            pl.BlockSpec((1, 1, w, d), lambda i, j: (i, j, 0, 0)),   # k8
+            pl.BlockSpec((1, 1, w, 1), lambda i, j: (i, j, 0, 0)),   # ks
+            pl.BlockSpec((1, 1, w, d), lambda i, j: (i, j, 0, 0)),   # v8
+            pl.BlockSpec((1, 1, w, 1), lambda i, j: (i, j, 0, 0)),   # vs
+            pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, j, 0, 0)),   # k_self
+            pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, j, 0, 0)),   # v_self
+            pl.BlockSpec((1, 1, w), lambda i, j: (i, 0, 0)),         # mask
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d), lambda i, j: (i, j, 0, 0)),
+        interpret=interpret,
+    )(q, k8, ks, v8, vs, k_self, v_self, mask)
+
+
+def decode_attention_reference(
+    q, k8, ks, v8, vs, k_self, v_self, mask
+) -> jax.Array:
+    """Pure-XLA oracle with the identical contract (f32 everywhere)."""
+    d = q.shape[-1]
+    qf = q.astype(jnp.float32) / (d ** 0.5)
+    s = jnp.einsum("bngd,bnwd->bngw", qf, k8.astype(jnp.float32))
+    s = s * ks[..., 0][:, :, None, :] + mask[:, :, None, :]
+    s_self = jnp.einsum(
+        "bngd,bnsd->bngs", qf, k_self.astype(jnp.float32)
+    )
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), s_self)
+    p = jnp.exp(s - m)
+    p_self = jnp.exp(s_self - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True) + p_self
+    ctx = jnp.einsum("bngw,bnwd->bngd", p * vs[..., 0][:, :, None, :],
+                     v8.astype(jnp.float32))
+    ctx = ctx + p_self * v_self.astype(jnp.float32)
+    return ctx / denom

@@ -49,7 +49,8 @@ def _ref(params, cfg, prompt, n):
 
 
 def _fresh_cache(cfg, batch):
-    shape = (cfg.num_layers, batch, cfg.max_seq, cfg.num_kv_heads, cfg.head_dim)
+    # Head-major ragged layout: [L, B, NKV, T, D] (models/llama.py).
+    shape = (cfg.num_layers, batch, cfg.num_kv_heads, cfg.max_seq, cfg.head_dim)
     return llama.RaggedKVCache(
         jnp.zeros(shape, jnp.float64),
         jnp.zeros(shape, jnp.float64),

@@ -166,13 +166,13 @@ def test_commit_rows_drops_write_at_capacity():
     other slots decode would corrupt itself)."""
     from tpumlops.models.llama import _commit_rows
 
-    L, B, T, H, D = 2, 3, 4, 2, 3
-    buf = jnp.zeros((L, B, T, H, D), jnp.float32)
+    L, B, H, T, D = 2, 3, 2, 4, 3
+    buf = jnp.zeros((L, B, H, T, D), jnp.float32)
     vals = jnp.ones((L, B, H, D), jnp.float32)
     lengths = jnp.array([1, T, 3], jnp.int32)  # row 1 is AT capacity
     out = jax.jit(_commit_rows)(buf, vals, lengths)
-    np.testing.assert_array_equal(np.asarray(out[:, 0, 1]), 1.0)
-    np.testing.assert_array_equal(np.asarray(out[:, 2, 3]), 1.0)
+    np.testing.assert_array_equal(np.asarray(out[:, 0, :, 1]), 1.0)
+    np.testing.assert_array_equal(np.asarray(out[:, 2, :, 3]), 1.0)
     # Row 1: untouched everywhere, including the last position a clamped
     # start would have overwritten.
     np.testing.assert_array_equal(np.asarray(out[:, 1]), 0.0)
