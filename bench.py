@@ -169,6 +169,46 @@ def _scan_delta_timed(
         probes[slot] = out
         return dt, replayed
 
+    def chained_wall(f, i, m):
+        """Wall seconds for ``m`` DATA-CHAINED dispatches of ``f``: each
+        call's carry is the previous call's final carry, and the probe of
+        the last call is pulled through the data path — the whole chain
+        (m x scan-length iterations) is serially dependent, so neither
+        pipelining, early acks, nor replay caches can shorten it.  The
+        fallback methodology when the scan-delta's elision guards fire
+        (VERDICT r4 #4): per-dispatch overhead still cancels in the
+        two-length difference because both lengths pay m dispatches."""
+        carry = make_carry(i)
+        t0 = time.perf_counter()
+        probes = None
+        for _ in range(m):
+            args = (carry,) if params is None else (params, carry)
+            carry, probes = f(*args)
+        np.asarray(probes)
+        return time.perf_counter() - t0
+
+    def chained_fallback(reason: str):
+        # Carry indices continue PAST the main loop's range (2*runs) so
+        # no make_carry(i) value repeats — a colliding index would
+        # recreate the bit-identical arguments whose replay this
+        # fallback exists to defeat.
+        base = 2 * runs
+        m, runs_c = 3, 5
+        samples_c = []
+        for r in range(runs_c):
+            w1 = chained_wall(f1, base + 2 * r, m)
+            w2 = chained_wall(f2, base + 2 * r + 1, m)
+            samples_c.append(max(0.0, (w2 - w1) / (m * (n2 - n1))))
+        pc = _percentiles(samples_c)
+        if pc[50] <= 0.0:
+            raise RuntimeError(
+                f"{reason}; chained-dispatch fallback also collapsed "
+                "to zero — device path unusable"
+            )
+        pc[99] = _trimmed_tail(samples_c, pc[50])
+        pc["method"] = "chained"
+        return pc
+
     samples = []
     tainted = 0
     for r in range(runs):
@@ -179,29 +219,28 @@ def _scan_delta_timed(
             continue
         samples.append(max(0.0, (w2 - w1) / (n2 - n1)))
     if not samples:
-        raise RuntimeError(
+        return chained_fallback(
             f"all {tainted} scan-delta sample pairs were replayed cached "
-            "results — the device tunnel is not executing the computation"
+            "results"
         )
     p = _percentiles(samples)
     if p[50] <= 0.0:
-        raise RuntimeError(
-            "scan-delta collapsed to zero — the device tunnel elided the "
-            "timed computation despite varied carries"
-        )
-    # Jitter-robust tail (VERDICT r3 weak #6): each sample is a MEAN over
-    # (n2 - n1) chained on-device iterations, so genuine chip-side
-    # variation is already averaged down to <1%; a sample several MADs
-    # above the median is a host/tunnel stall that happened to land in
-    # the longer scan, not the chip taking 25% longer that run.  p50 is
-    # over ALL samples; the tail is over samples within 3 MADs (floor
-    # 1% of median, so a zero-MAD set still tolerates float noise).
-    med = p[50]
+        return chained_fallback("scan-delta collapsed to zero")
+    p["method"] = "scan_delta"
+    p[99] = _trimmed_tail(samples, p[50])
+    return p
+
+
+def _trimmed_tail(samples: list[float], med: float) -> float:
+    """p99 over samples within 3 MADs of the median (floor 1% of median,
+    so a zero-MAD set still tolerates float noise).  Each sample is a
+    MEAN over many chained on-device iterations, so genuine chip-side
+    variation is already averaged down to <1%; a sample several MADs
+    above the median is a host/tunnel stall that landed in the longer
+    scan, not the chip taking 25% longer that run (VERDICT r3 weak #6)."""
     mad = _percentiles([abs(s - med) for s in samples])[50]
     cut = med + 3 * max(mad, 0.01 * med)
-    kept = [s for s in samples if s <= cut]
-    p[99] = _percentiles(kept)[99]
-    return p
+    return _percentiles([s for s in samples if s <= cut])[99]
 
 
 def _gate(c, logits):
@@ -671,10 +710,17 @@ def bench_time_to_100() -> dict:
         def status():
             return kube.get(CRREF).get("status") or {}
 
-        deadline = time.monotonic() + 60
+        # Both waits are capped against the global bench deadline (with
+        # a margin for teardown + the remaining secondaries): gate
+        # minSampleCount warm-up retries burned the round-4 wall and the
+        # record died with the process (VERDICT r4 weak #6).
+        warmup_s = min(60.0, max(10.0, _remaining() - 120.0))
+        deadline = time.monotonic() + warmup_s
         while status().get("phase") != "Stable" and time.monotonic() < deadline:
             time.sleep(0.05)
-        assert status().get("phase") == "Stable", status()
+        assert status().get("phase") == "Stable", (
+            f"initial rollout not Stable within {warmup_s:.0f}s: {status()}"
+        )
 
         def component_sums() -> dict[str, float]:
             import re
@@ -700,7 +746,8 @@ def bench_time_to_100() -> dict:
         registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
         registry.set_alias("iris", "prod", "2")
         t0 = time.monotonic()
-        deadline = time.monotonic() + 120
+        canary_s = min(120.0, max(15.0, _remaining() - 60.0))
+        deadline = time.monotonic() + canary_s
         while time.monotonic() < deadline:
             s = status()
             if s.get("phase") == "Stable" and s.get("currentModelVersion") == "2":
@@ -778,7 +825,8 @@ def bench_iris() -> dict:
     p = _scan_delta_timed(
         step, lambda i: x + 0.001 * i, n1=512, n2=8192, params=params
     )
-    return {"p50_us": round(p[50] * 1e6, 1), "batch": 32}
+    return {"p50_us": round(p[50] * 1e6, 1), "batch": 32,
+            "method": p.get("method", "scan_delta")}
 
 
 def bench_xgboost() -> dict:
@@ -839,6 +887,7 @@ def bench_xgboost() -> dict:
         "trees": n_trees,
         "batch": 256,
         "eval_form": form,
+        "method": p.get("method", "scan_delta"),
     }
 
 
@@ -1395,22 +1444,49 @@ def compact_line(full: dict) -> dict:
     return line
 
 
-def emit_record(full: dict) -> None:
-    """Persist the full record, then print the compact driver line.
+_DETAIL_PATH = os.environ.get(
+    "BENCH_DETAIL_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"),
+)
 
-    stdout gets ONE line (the driver contract); the full record goes to
-    ``BENCH_DETAIL.json`` next to this file and to stderr for the log.
-    """
-    detail_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
-    )
+# The record-in-progress.  main() keeps it current after every completed
+# phase so the SIGTERM/SIGINT handler (and any late failure path) can
+# flush whatever has been measured so far — round 3 lost its record to a
+# stdout-tail overflow, round 4 lost it to an external wall-clock kill
+# landing before the single end-of-run print (VERDICT r4 missing #1).
+_CURRENT: dict | None = None
+
+# Absolute monotonic deadline derived from BENCH_BUDGET_S; benches with
+# internal waits consult _remaining() so a slow warm-up cannot eat the
+# wall past the point where the record would be lost.
+_DEADLINE: float | None = None
+
+
+def _remaining(default: float = 1e9) -> float:
+    if _DEADLINE is None:
+        return default
+    return max(0.0, _DEADLINE - time.monotonic())
+
+
+def _write_detail(full: dict) -> None:
+    """Rewrite BENCH_DETAIL.json (atomically) with the current record.
+
+    Called after EVERY completed phase, not once at the end: an external
+    kill between secondaries must leave the last completed state on
+    disk, never a stale or torn file (round 4 committed a pre-fix stale
+    one, VERDICT r4 missing #2)."""
     try:
-        with open(detail_path, "w") as f:
+        tmp = _DETAIL_PATH + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(full, f, indent=1)
             f.write("\n")
+        os.replace(tmp, _DETAIL_PATH)
     except OSError as e:
-        print(f"could not write {detail_path}: {e}", file=sys.stderr)
-    print("FULL " + json.dumps(full), file=sys.stderr)
+        print(f"could not write {_DETAIL_PATH}: {e}", file=sys.stderr)
+
+
+def _print_compact(full: dict) -> None:
+    """Print the compact driver line to stdout, flushed immediately."""
     out = json.dumps(compact_line(full))
     if len(out) > COMPACT_BUDGET_BYTES + 200:
         # Never crash before printing (a missing line is a total record
@@ -1419,31 +1495,66 @@ def emit_record(full: dict) -> None:
             {k: full.get(k) for k in ("metric", "value", "unit", "vs_baseline")}
             | {"truncated": True, "detail": "BENCH_DETAIL.json"}
         )
-    print(out)
+    print(out, flush=True)
+
+
+def emit_record(full: dict) -> None:
+    """Persist the full record, then print the compact driver line.
+
+    The driver parses the LAST parseable stdout line; the full record
+    goes to ``BENCH_DETAIL.json`` next to this file and to stderr."""
+    _write_detail(full)
+    print("FULL " + json.dumps(full), file=sys.stderr, flush=True)
+    _print_compact(full)
+
+
+def _flush_on_signal(signum, frame) -> None:
+    """Last-gasp flush: persist + print whatever has been measured.
+
+    Installed for SIGTERM/SIGINT in main().  ``timeout(1)`` and the
+    driver both deliver SIGTERM before any SIGKILL escalation; emitting
+    the current record here turns an external kill into a truncated but
+    PARSEABLE run (remaining secondaries read "skipped")."""
+    full = _CURRENT
+    if full is None:
+        # Nothing measured yet (killed during the headline phase) or the
+        # final emission already happened: die with conventional signal
+        # status so the wrapper sees a killed run, NOT a successful
+        # empty one — exit 0 with no record would be a silent loss.
+        os._exit(128 + signum)
+    for name, entry in (full.get("secondary") or {}).items():
+        if entry is None:
+            full["secondary"][name] = {
+                "skipped": f"killed by signal {signum} mid-bench"
+            }
+    emit_record(full)
+    # os._exit: a jax dispatch may be wedged on the tunnel socket in the
+    # main thread's C frame; normal interpreter teardown could block
+    # behind it and eat the grace period before SIGKILL.
+    os._exit(0)
 
 
 def main() -> None:
-    b = bench_bert()
-    tpu = b["int8"]
-    try:
-        ref = bench_torch_cpu()
-        vs_baseline = ref[99] / tpu[99]
-        baseline_ms = ref[99] * 1000
-    except Exception as e:  # torch baseline is best-effort
-        print(f"baseline measurement failed: {e}", file=sys.stderr)
-        vs_baseline = None
-        baseline_ms = None
+    global _CURRENT, _DEADLINE
+    import signal
 
-    # Importance-ordered under a wall budget: this dev env's
-    # remote-compile tunnel misses the persistent cache, so every scan
-    # length is a real compile and the expensive benches can eat tens of
-    # minutes cold.  Past the budget the remaining entries are marked
-    # skipped — the headline line must always print, and the entries
-    # VERDICT r2 demands (decode ladder, real 7B) run before the tail.
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+    # Wall budget measured from PROCESS START, headline phase included
+    # (round 4's default only metered the secondaries and exceeded the
+    # driver's kill point).  1100 s default: comfortably under the
+    # observed ~20-40 min external ceilings, enough for the headline +
+    # cheap secondaries cold; a full-record run sets BENCH_BUDGET_S
+    # explicitly.
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1100"))
     t_start = time.monotonic()
-    secondary = {}
-    for name, fn in (
+    _DEADLINE = t_start + budget_s
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _flush_on_signal)
+        except (ValueError, OSError):
+            pass  # non-main thread / platform quirk: flush-on-kill is
+            # best-effort, the early emission below still stands
+
+    bench_order = (
         # Cost-ordered under the wall budget (measured end-to-end run:
         # ~55 min cold): cheap entries and the 1.35B ladder land first;
         # the 7B goes LAST because its checkpoint load alone has taken
@@ -1456,15 +1567,18 @@ def main() -> None:
         ("llama_1p35b_decode", bench_llama_decode),
         ("serve_path_http", bench_serve_path),
         ("llama_7b_decode", bench_llama_7b_decode),
-    ):
-        if time.monotonic() - t_start > budget_s:
-            secondary[name] = {"skipped": f"wall budget {budget_s:.0f}s spent"}
-            continue
-        try:
-            secondary[name] = fn()
-        except Exception as e:
-            secondary[name] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"secondary bench {name} failed: {e}", file=sys.stderr)
+    )
+
+    b = bench_bert()
+    tpu = b["int8"]
+    try:
+        ref = bench_torch_cpu()
+        vs_baseline = ref[99] / tpu[99]
+        baseline_ms = ref[99] * 1000
+    except Exception as e:  # torch baseline is best-effort
+        print(f"baseline measurement failed: {e}", file=sys.stderr)
+        vs_baseline = None
+        baseline_ms = None
 
     line = {
         "metric": "bert_base_b32_s128_p99_batch_latency_per_chip",
@@ -1494,8 +1608,46 @@ def main() -> None:
             ),
         },
         "hardware": "TPU v5e (1 chip)",
-        "secondary": secondary,
+        "secondary": {name: None for name, _ in bench_order},
     }
+    _CURRENT = line
+
+    # FIRST emission, the moment the headline exists: even if every
+    # secondary is lost to a kill harder than SIGTERM, this parseable
+    # line (BERT p99 + MFU + vs_baseline) is already in the stdout tail.
+    emit_record(line)
+
+    for name, fn in bench_order:
+        if time.monotonic() >= _DEADLINE:
+            line["secondary"][name] = {
+                "skipped": f"wall budget {budget_s:.0f}s spent"
+            }
+            _write_detail(line)
+            continue
+        if name == "llama_7b_decode" and "BENCH_7B_TIMEOUT_S" not in os.environ:
+            # The 7B subprocess must die (salvaging its partial ladder)
+            # before the overall deadline, not at its own 2400 s default.
+            # Under ~3 min of budget there is no point even starting (the
+            # load alone exceeds that) and a floor would overshoot the
+            # deadline — skip explicitly instead.
+            if _remaining() < 180.0:
+                line["secondary"][name] = {
+                    "skipped": f"{_remaining():.0f}s of budget left, "
+                               "under the 7B load cost"
+                }
+                _write_detail(line)
+                continue
+            os.environ["BENCH_7B_TIMEOUT_S"] = str(round(_remaining() - 60.0))
+        try:
+            line["secondary"][name] = fn()
+        except Exception as e:
+            line["secondary"][name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"secondary bench {name} failed: {e}", file=sys.stderr)
+        _write_detail(line)  # incremental: a kill loses at most ONE bench
+
+    line["wall_s"] = round(time.monotonic() - t_start, 1)
+    _CURRENT = None
+    # FINAL emission: the driver takes the last parseable line.
     emit_record(line)
 
 

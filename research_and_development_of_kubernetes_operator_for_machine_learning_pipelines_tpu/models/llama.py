@@ -44,8 +44,17 @@ def _decode_attn_impl() -> str:
     if _DECODE_ATTN != "auto":
         return _DECODE_ATTN
     try:
-        platform = jax.devices()[0].platform
+        devices = jax.devices()
+        platform = devices[0].platform
     except Exception:
+        return "xla"
+    # Multichip serving shards the KV cache NKV-over-'tp'
+    # (__graft_entry__.py cache_spec); pallas_call has no SPMD
+    # partitioning rule for that layout, so until the kernel is wrapped
+    # in shard_map and verified on real multichip hardware, "auto" only
+    # picks pallas when a single device is visible.  Force with
+    # _DECODE_ATTN="pallas" to A/B anyway.
+    if len(devices) != 1:
         return "xla"
     return "pallas" if platform in ("tpu", "axon") else "xla"
 

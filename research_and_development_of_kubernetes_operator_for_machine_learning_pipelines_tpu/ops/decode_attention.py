@@ -30,7 +30,10 @@ Layouts (B slots, W window, NKV kv heads, G = heads/kv_head, D head_dim):
                            the trailing 1 keeps the block tile-legal)
   k_self  [B, NKV, 1, D]   current token's K/V (exact, never quantized)
   v_self  [B, NKV, 1, D]
-  mask    [B, 1, W]        f32 additive bias (0 keep / -1e30 drop),
+  mask    [B, 1, W]        f32 additive bias (0 keep / large negative
+                           drop — any magnitude that underflows exp()
+                           to 0 in f32; the production caller
+                           ``decode_ragged`` passes -1e9),
                            STRICT: position w < lengths[b]
   out     [B, NKV, G, D]   f32
 
@@ -45,9 +48,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-
-NEG_INF = -1e30
-
 
 def _decode_attn_kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref,
                         kself_ref, vself_ref, mask_ref, o_ref, *, scale):

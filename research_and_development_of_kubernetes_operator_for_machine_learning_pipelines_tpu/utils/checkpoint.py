@@ -22,6 +22,7 @@ Two layers:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -30,6 +31,11 @@ import threading
 import time
 from pathlib import Path
 from typing import Any
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: single-process use only
+    fcntl = None
 
 _log = logging.getLogger(__name__)
 
@@ -123,6 +129,31 @@ class CheckpointManager:
         self._save_lock = threading.Lock()
         self._recover_interrupted()
 
+    @contextlib.contextmanager
+    def _os_lock(self):
+        """Cross-PROCESS exclusion for every root-mutating section.
+
+        ``_save_lock`` only serializes threads of one process; recovery
+        at open time also mutates the root, so a second process opening
+        the manager during another process's overwrite window (between
+        ``final.rename(old)`` and ``tmp.rename(final)``) would "restore"
+        the parked predecessor and break the in-flight saver's final
+        rename.  An flock on ``<root>/.lock`` closes that window: saves
+        and open-time recovery block each other across processes.  On
+        platforms without fcntl this degrades to the documented
+        single-writer-process assumption.
+        """
+        if fcntl is None:
+            yield
+            return
+        fd = os.open(self.root / ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
     def _recover_interrupted(self) -> None:
         """Heal crash leftovers at open time, for EVERY step.
 
@@ -135,7 +166,7 @@ class CheckpointManager:
         runs on open: restore the predecessor when the step is
         uncommitted, scrap the leftover when the overwrite did commit.
         """
-        with self._save_lock:
+        with self._save_lock, self._os_lock():
             for old in self.root.glob(".replaced_step_*"):
                 final = self.root / old.name[len(".replaced_"):]
                 if (final / _COMMITTED).exists():
@@ -179,7 +210,7 @@ class CheckpointManager:
         tags: dict | None = None,
         overwrite: bool = False,
     ) -> Path:
-        with self._save_lock:
+        with self._save_lock, self._os_lock():
             final = self._step_dir(step)
             tmp = self.root / f".tmp_{final.name}"
             old = self.root / f".replaced_{final.name}"

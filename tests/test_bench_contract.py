@@ -206,6 +206,109 @@ def test_compact_line_sheds_to_budget_without_losing_contract():
         assert k in parsed
 
 
+_STUB_MAIN = r'''
+import sys, time
+sys.path.insert(0, {repo!r})
+import bench
+bench.bench_bert = lambda: {{
+    "int8": {{50: 0.004, 99: 0.0045}}, "bf16": {{50: 0.007, 99: 0.0075}},
+    "parity": {{"argmax_agreement": 1.0, "max_logit_delta": 0.03}},
+    "tflops_int8": 88.0, "tflops_bf16": 44.0,
+    "mfu_int8": 0.22, "mfu_bf16": 0.22,
+}}
+bench.bench_torch_cpu = lambda iters=3: {{50: 0.4, 99: 0.45}}
+def fast():
+    return {{"p50_us": 10.0}}
+def slow():
+    time.sleep(120)
+for name in ("bench_time_to_100", "bench_iris"):
+    setattr(bench, name, fast)
+for name in ("bench_xgboost", "bench_resnet", "bench_llama_decode",
+             "bench_serve_path", "bench_llama_7b_decode"):
+    setattr(bench, name, {tail_fn})
+bench.main()
+'''
+
+
+def test_sigterm_mid_bench_still_emits_parseable_record(tmp_path):
+    """The round-4 failure mode: an external kill mid-secondaries must
+    leave (a) a parseable headline line on stdout and (b) a current
+    BENCH_DETAIL.json containing every completed secondary.  SIGTERM is
+    what both ``timeout(1)`` and the driver deliver first."""
+    import os
+    import signal
+    import subprocess
+    import time as _time
+
+    detail = tmp_path / "detail.json"
+    env = dict(os.environ, BENCH_DETAIL_PATH=str(detail))
+    code = _STUB_MAIN.format(
+        repo=str(Path(__file__).resolve().parent.parent), tail_fn="slow"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=tmp_path,
+    )
+    try:
+        # Wait for the early emission (headline + fast secondaries), then
+        # kill while a slow secondary is "running".
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline and not detail.exists():
+            _time.sleep(0.1)
+        _time.sleep(1.0)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    parsed = None
+    for line in reversed([l for l in out.splitlines() if l.strip()]):
+        try:
+            parsed = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    assert parsed is not None, out
+    assert parsed["metric"] == "bert_base_b32_s128_p99_batch_latency_per_chip"
+    assert parsed["value"] == 4.5
+    assert parsed["mfu_vs_s8_peak"] == 0.22
+    full = json.loads(detail.read_text())
+    # Completed secondaries survive; the in-flight one reads skipped/None.
+    assert full["secondary"]["time_to_100pct_traffic"] == {"p50_us": 10.0}
+    assert full["secondary"]["iris_sklearn_linear"] == {"p50_us": 10.0}
+
+
+def test_early_emission_precedes_secondaries(tmp_path):
+    """stdout must carry a parseable headline BEFORE any secondary runs
+    (first emission), and a final line after: >= 2 parseable lines on a
+    clean run."""
+    import os
+    import subprocess
+
+    detail = tmp_path / "detail.json"
+    env = dict(os.environ, BENCH_DETAIL_PATH=str(detail))
+    code = _STUB_MAIN.format(
+        repo=str(Path(__file__).resolve().parent.parent), tail_fn="fast"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=60, cwd=tmp_path,
+    )
+    parseable = []
+    for line in proc.stdout.splitlines():
+        try:
+            parseable.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    assert len(parseable) >= 2, proc.stdout
+    # First emission: headline present, secondaries pending (null).
+    assert parseable[0]["value"] == 4.5
+    assert all(v is None for v in parseable[0]["secondary"].values())
+    # Final emission: all secondaries filled in.
+    assert all(v is not None for v in parseable[-1]["secondary"].values())
+    assert parseable[-1]["secondary"]["llama_7b_decode"] == {"p50_us": 10.0}
+
+
 def test_scan_delta_donated_carry_aliases_in_place():
     """The donated carry must alias into the scan loop state.
 

@@ -192,12 +192,33 @@ class TestDecodeAttention:
             rtol=2e-2, atol=2e-2,
         )
         # The commit path is shared, but upstream activations differ by
-        # bf16 ulps between the two attention implementations, so the
-        # committed int8 rows may differ by one quantization step.
+        # bf16 ulps between the two attention implementations.  Two
+        # independent mechanisms each move a committed int8 value by at
+        # most one quantization step: (1) the value itself rounds the
+        # other way when it sits near a step boundary (bf16 ulp ~2^-8
+        # relative vs a step of absmax/127 ~ 0.8% of absmax — comparable
+        # magnitudes); (2) the per-row scale is the row absmax, which can
+        # itself differ by a bf16 ulp and rescales EVERY element of the
+        # row, shifting boundary-adjacent ones again.  Hence the bound is
+        # 2 steps on the raw codes, while the dequantized values must
+        # agree to a small multiple of the step size.
         dq = np.abs(
             np.asarray(out_cache.k8, np.int32) - np.asarray(ref_cache.k8, np.int32)
         )
-        assert dq.max() <= 1, dq.max()
+        assert dq.max() <= 2, dq.max()
+        # >1-step disagreements are the rare double-boundary cases only.
+        assert (dq > 1).mean() < 0.01, (dq > 1).mean()
+        def _steps(scale, ndim):
+            s = np.asarray(scale, np.float32)
+            return s.reshape(s.shape + (1,) * (ndim - s.ndim))
+
+        k8 = np.asarray(out_cache.k8, np.float32)
+        out_deq = k8 * _steps(out_cache.k_scale, k8.ndim)
+        ref_deq = np.asarray(ref_cache.k8, np.float32) * _steps(
+            ref_cache.k_scale, k8.ndim)
+        step = np.maximum(_steps(ref_cache.k_scale, k8.ndim), 1e-30)
+        worst = float(np.max(np.abs(out_deq - ref_deq) / step))
+        assert worst < 3.0, worst
         np.testing.assert_array_equal(
             np.asarray(out_cache.lengths), np.asarray(ref_cache.lengths)
         )
