@@ -21,6 +21,7 @@ long-context/distributed first-class citizen.
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass
 from typing import Any, NamedTuple
 
@@ -33,30 +34,35 @@ from .quantization import dequantize_tensor, is_quantized
 
 
 # Decode attention dispatch: "xla" (einsum chain), "pallas" (fused
-# ops/decode_attention kernel), or "auto" (pallas on TPU backends, xla
-# elsewhere — the kernel needs a real Mosaic lowering; CPU tests take the
-# XLA path and the kernel's parity is pinned in interpret mode).
-# A/B on chip: scripts/ab_attention.py.
+# ops/decode_attention kernels), "pallas_single" (one program per
+# (slot, head)), or "auto".  "auto" resolves to XLA: measured on a v5e
+# chip at 1.35B geometry (scripts/ab_attention.py, in-process A/B), the
+# einsum chain beats both pallas kernels at every slot count — 2.80 vs
+# 6.99 ms/step at 8 slots, 14.42 vs 34.2 (batched) / 36.4 (per-slot) at
+# 32.  The reason is structural, not kernel overhead: with
+# num_heads == num_kv_heads (llama-1.35B/7B), G = 1 and each head's
+# score/ctx dot is a 1-row matvec, so the MXU's 8-sublane tiling floor
+# (~512 cycles per [1,W]x[W,D] pass) dominates — a cost XLA's batched
+# dot emitter already sits at, which the extra pallas dispatch and
+# VMEM conversions only add to.  The kernels stay selectable for A/B
+# and for future grouped-query (G >= 8) models where the blocked dots
+# fill the sublanes and the fused-softmax VMEM path should win.
 _DECODE_ATTN = "auto"
+
+_DECODE_ATTN_IMPLS = ("auto", "xla", "pallas", "pallas_single", "pallas_vpu")
 
 
 def _decode_attn_impl() -> str:
+    if _DECODE_ATTN not in _DECODE_ATTN_IMPLS:
+        # Reject, don't reroute: a typo'd variant silently running a
+        # DIFFERENT implementation would mislabel A/B benchmark rows.
+        raise ValueError(
+            f"unknown _DECODE_ATTN {_DECODE_ATTN!r}; "
+            f"expected one of {_DECODE_ATTN_IMPLS}"
+        )
     if _DECODE_ATTN != "auto":
         return _DECODE_ATTN
-    try:
-        devices = jax.devices()
-        platform = devices[0].platform
-    except Exception:
-        return "xla"
-    # Multichip serving shards the KV cache NKV-over-'tp'
-    # (__graft_entry__.py cache_spec); pallas_call has no SPMD
-    # partitioning rule for that layout, so until the kernel is wrapped
-    # in shard_map and verified on real multichip hardware, "auto" only
-    # picks pallas when a single device is visible.  Force with
-    # _DECODE_ATTN="pallas" to A/B anyway.
-    if len(devices) != 1:
-        return "xla"
-    return "pallas" if platform in ("tpu", "axon") else "xla"
+    return "xla"
 
 
 def _mat(w, dtype):
@@ -501,17 +507,40 @@ def _block_decode_deferred(
     group = nh // nkv
     qg = q.reshape(b, s, nkv, group, hd)
     quant_cache = isinstance(cache_k, tuple)
-    if quant_cache and _decode_attn_impl() == "pallas":
-        # Fused Pallas path: one program per (slot, kv-head) does both
+    impl = _decode_attn_impl()
+    if impl == "pallas_vpu" and (group != 1 or window % 128 != 0):
+        # The VPU kernel is the G == 1 formulation over [W/128, 128]
+        # lane tiles; grouped-head models or sub-lane windows take the
+        # XLA chain instead of failing at trace time.  LOUDLY: an A/B
+        # labeled "pallas_vpu" that silently measured XLA would produce
+        # a false "VPU has no benefit" row.
+        warnings.warn(
+            f"pallas_vpu requires G == 1 and window % 128 == 0 "
+            f"(got G={group}, window={window}); falling back to the XLA "
+            "decode-attention chain — timings from this trace measure "
+            "XLA, not the VPU kernel",
+            stacklevel=2,
+        )
+        impl = "xla"
+    if quant_cache and impl.startswith("pallas"):
+        # Fused Pallas path: program(s) over (slot-block, kv-head) do both
         # MXU dots over the VMEM-resident int8 window with scales folded
         # into score/prob rows and the self-term joined in-softmax —
         # replacing the ~15-op XLA chain below (ops/decode_attention.py;
-        # dispatch measured by scripts/ab_attention.py).
-        from ..ops.decode_attention import decode_attention
+        # dispatch measured by scripts/ab_attention.py).  "pallas" is the
+        # slot-batched kernel (grid divided by the slot block — the
+        # per-program overhead was a ~1 ms/slot linear term at 1.35B);
+        # "pallas_single" keeps one program per (slot, head) for A/B.
+        from ..ops.decode_attention import (
+            decode_attention, decode_attention_batched, decode_attention_vpu)
 
+        attn_fn = {
+            "pallas_single": decode_attention,
+            "pallas_vpu": decode_attention_vpu,
+        }.get(impl, decode_attention_batched)
         k8, ks = cache_k
         v8, vs = cache_v
-        ctx4 = decode_attention(
+        ctx4 = attn_fn(
             qg[:, 0],                                   # [B, NKV, G, D]
             k8[:, :, :window],
             ks[:, :, :window],                          # [B, NKV, W, 1]

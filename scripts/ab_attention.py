@@ -22,6 +22,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", default="8,16,32")
+    ap.add_argument("--variants", default="xla,pallas",
+                    help="comma list of xla,pallas,pallas_single,pallas_vpu")
     ap.add_argument("--rounds", type=int, default=2)
     ap.add_argument("--window", type=int, default=512)
     ap.add_argument("--position", type=int, default=256)
@@ -41,11 +43,12 @@ def main() -> None:
     )
     params = quantize_llama(llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16))
 
+    variants = tuple(args.variants.split(","))
     out: dict = {}
     for slots in (int(s) for s in args.slots.split(",")):
-        best = {"xla": float("inf"), "pallas": float("inf")}
+        best = {v: float("inf") for v in variants}
         for _ in range(args.rounds):
-            for variant in ("xla", "pallas"):
+            for variant in variants:
                 llama._DECODE_ATTN = variant
                 dt = bench._decode_device_loop(
                     jax, params, cfg, slots, kv_quant=True,

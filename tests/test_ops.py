@@ -136,6 +136,66 @@ class TestDecodeAttention:
             np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
         )
 
+    def test_vpu_kernel_matches_reference(self):
+        """The VPU (multiply+reduce, no dot_general) kernel must match
+        the oracle bit-for-bit up to f32 summation order — the G == 1
+        fast path for ungrouped-head models."""
+        from tpumlops.ops.decode_attention import (
+            decode_attention_reference, decode_attention_vpu)
+
+        args = self._rand_inputs(G=1, W=256)  # W % 128 == 0 required
+        ref = decode_attention_reference(*args)
+        out = decode_attention_vpu(*args, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_batched_kernel_matches_reference(self):
+        """The slot-batched kernel (bb slots per program) must be
+        numerically identical to the per-slot kernel's oracle, including
+        when b is not divisible by 8 (falls back to a smaller block)."""
+        from tpumlops.ops.decode_attention import (
+            decode_attention_batched, decode_attention_reference)
+
+        args = self._rand_inputs()
+        ref = decode_attention_reference(*args)
+        out = decode_attention_batched(*args, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_batched_kernel_multi_slot_block(self):
+        """B=8 drives bb=8 — one program per kv head unrolling all eight
+        slots — so the t > 0 unroll and the bb-sized BlockSpec index
+        maps are actually exercised (B=3 degenerates to bb=1)."""
+        import jax
+
+        from tpumlops.ops.decode_attention import (
+            _slot_block, decode_attention_batched, decode_attention_reference)
+
+        assert _slot_block(8) == 8
+        B, W, NKV, G, D = 8, 64, 2, 2, 32
+        ks = [jax.random.key(100 + i) for i in range(8)]
+        q = jax.random.normal(ks[0], (B, NKV, G, D), jnp.float32)
+        k8 = jax.random.randint(ks[1], (B, NKV, W, D), -127, 128, jnp.int8)
+        v8 = jax.random.randint(ks[2], (B, NKV, W, D), -127, 128, jnp.int8)
+        kscale = jnp.abs(jax.random.normal(ks[3], (B, NKV, W, 1))) * 0.01 + 1e-3
+        vscale = jnp.abs(jax.random.normal(ks[4], (B, NKV, W, 1))) * 0.01 + 1e-3
+        k_self = jax.random.normal(ks[5], (B, NKV, 1, D), jnp.float32)
+        v_self = jax.random.normal(ks[6], (B, NKV, 1, D), jnp.float32)
+        # Distinct lengths per slot so a block-index bug (e.g. block i
+        # offset i instead of i*bb) changes some row's mask/output.
+        lengths = jnp.arange(B) * (W // B)
+        mask = jnp.where(
+            jnp.arange(W)[None, :] < lengths[:, None], 0.0, -1e30
+        ).astype(jnp.float32)[:, None, :]
+        args = (q, k8, kscale, v8, vscale, k_self, v_self, mask)
+        ref = decode_attention_reference(*args)
+        out = decode_attention_batched(*args, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
     def test_zero_length_row_attends_only_self(self):
         from tpumlops.ops.decode_attention import decode_attention
 
