@@ -44,9 +44,11 @@ from .quantization import dequantize_tensor, is_quantized
 # score/ctx dot is a 1-row matvec, so the MXU's 8-sublane tiling floor
 # (~512 cycles per [1,W]x[W,D] pass) dominates — a cost XLA's batched
 # dot emitter already sits at, which the extra pallas dispatch and
-# VMEM conversions only add to.  The kernels stay selectable for A/B
-# and for future grouped-query (G >= 8) models where the blocked dots
-# fill the sublanes and the fused-softmax VMEM path should win.
+# VMEM conversions only add to.  The kernels stay selectable for A/B;
+# grouped-query geometry does NOT flip the result — measured at G=8
+# (nh=16/nkv=2), XLA still wins: 1.99 vs 2.22 ms/step at 8 slots, 3.79
+# vs 5.13 at 32 — and GQA decode is near-streaming-bound there
+# (8437 tok/s @ 32 slots, ~0.51 bw_util; docs/PERF.md round 5).
 _DECODE_ATTN = "auto"
 
 _DECODE_ATTN_IMPLS = ("auto", "xla", "pallas", "pallas_single", "pallas_vpu")
