@@ -71,6 +71,46 @@ def prefill_bucket(length: int, capacity: int) -> int:
     return min(max(_MIN_BUCKET, next_bucket(length, capacity)), capacity)
 
 
+def decode_window_bucket(length: int, capacity: int) -> int:
+    """Attention-window bucket: smallest of {2^k, 3*2^(k-1)} >= length.
+
+    Decode attention cost is LINEAR in the attended window W at the
+    G=1 MXU matvec floor (docs/PERF.md round 5), so pure power-of-two
+    buckets overpay up to 2x just under each boundary (serving at
+    position 260 attends 512).  The 1.5x intermediate steps (96, 192,
+    384, 768, ...) cap the overshoot at 33% for one more compiled
+    variant per octave — measured on chip at 1.35B/32 slots, window
+    384 vs 512 is 1.085x the step rate (15.10 -> 13.92 ms/step; the
+    weight-stream constant dilutes the linear attention term)."""
+    w = prefill_bucket(length, capacity)
+    # The 3/4 step applies only to an UNCAPPED power-of-two bucket: when
+    # next_bucket was clamped to a non-power capacity, 3*(w//4) is an
+    # arbitrary value the warmup enumeration never compiles, and a lazy
+    # compile on the scheduler thread is exactly what buckets prevent.
+    if length > 0 and w >= 2 * _MIN_BUCKET and w & (w - 1) == 0:
+        threeq = 3 * (w // 4)
+        if length <= threeq:
+            return threeq
+    return w
+
+
+def decode_window_buckets(capacity: int) -> list[int]:
+    """Every window :func:`decode_window_bucket` can return, ascending —
+    the warmup sweep compiles exactly this set (pinned by an exhaustive
+    reachability test over power and non-power capacities)."""
+    out = {min(capacity, _MIN_BUCKET)}
+    b = _MIN_BUCKET
+    while b < capacity:
+        out.add(b)
+        # 3*(b//2) is reachable only when the NEXT power of two (2b) is
+        # itself an admissible uncapped bucket.
+        if 2 * b <= capacity:
+            out.add(3 * (b // 2))
+        b *= 2
+    out.add(capacity)
+    return sorted(out)
+
+
 @dataclass
 class _Slot:
     future: Future
@@ -387,9 +427,10 @@ class GenerationEngine:
             # must compile the same buckets or the first bucket crossing
             # stalls the whole slice.
             inactive = np.zeros((self.max_slots,), bool)
-            window = prefill_bucket(1, self.capacity)
-            while window < self.capacity:
-                window = min(window * 2, self.capacity)
+            smallest = decode_window_bucket(1, self.capacity)
+            for window in decode_window_buckets(self.capacity):
+                if window == smallest:
+                    continue  # both variants already compiled above
                 self._dispatch_step(inactive, window, False)
                 self._dispatch_step(inactive, window, True)
             # Fused-prefill buckets: each power-of-two prompt bucket is its
@@ -856,7 +897,7 @@ class GenerationEngine:
             for s in self._slots
             if s is not None
         )
-        window = prefill_bucket(needed, self.capacity)
+        window = decode_window_bucket(needed, self.capacity)
         t0 = time.perf_counter()
         sampling = any(s is not None and s.sampling for s in self._slots)
         self._dispatch_step(active_np, window, sampling)

@@ -591,3 +591,67 @@ def test_chunked_prefill_validation_and_shutdown_cancel(tiny):
     with pytest.raises(Exception):  # cancelled (or failed by shutdown)
         pending.result(timeout=10)
     assert blocker.done()
+
+
+def test_decode_window_bucket_sequence():
+    """1.5x intermediate buckets: attention cost is linear in W at the
+    G=1 matvec floor, so pure power-of-two windows overpay up to 2x
+    just under a boundary; {2^k, 3*2^(k-1)} caps the overshoot at 33%."""
+    from tpumlops.server.generation import (
+        _MIN_BUCKET, decode_window_bucket, decode_window_buckets)
+
+    cases = (
+        (1, 1024, _MIN_BUCKET), (64, 1024, 64), (65, 1024, 96),
+        (96, 1024, 96), (97, 1024, 128), (129, 1024, 192),
+        (193, 1024, 256), (260, 1024, 384), (385, 1024, 512),
+        (600, 1024, 768), (800, 1024, 1024),
+        # capacity caps every bucket, including non-power capacities
+        (260, 300, 300), (1, 32, _MIN_BUCKET),
+    )
+    for n, cap, want in cases:
+        assert decode_window_bucket(n, cap) == want, (n, cap)
+    # Monotone and always sufficient.
+    prev = 0
+    for n in range(1, 1025):
+        w = decode_window_bucket(n, 1024)
+        assert w >= n and w >= prev
+        prev = w
+    # The warmup sweep enumerates exactly the reachable windows — at
+    # power AND non-power capacities (a capacity-capped bucket must not
+    # produce a 3/4 step the sweep never compiled: a lazy compile would
+    # stall the scheduler thread mid-traffic).
+    for cap in (17, 48, 64, 100, 300, 768, 1024):
+        enumerated = set(decode_window_buckets(cap))
+        reachable = {decode_window_bucket(n, cap) for n in range(1, cap + 1)}
+        assert reachable <= enumerated, (cap, sorted(reachable - enumerated))
+
+
+def test_engine_uses_intermediate_window_bucket(tiny):
+    """A request whose positions land between 2^k buckets must decode at
+    the 3*2^(k-1) window, not the next power of two."""
+    from tpumlops.server.generation import GenerationEngine, decode_window_bucket
+
+    params, cfg = tiny  # capacity 64
+    engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float64)
+    # Observe the windows the engine ACTUALLY dispatches — a regression
+    # to the power-of-two bucket would still generate correct tokens.
+    seen: list[int] = []
+    real_dispatch = engine._dispatch_step
+
+    def spy(active_np, window, sampling):
+        seen.append(int(window))
+        return real_dispatch(active_np, window, sampling)
+
+    engine._dispatch_step = spy
+    engine.start(warmup=False)
+    try:
+        # prompt 30 + 8 new tokens -> write positions 30..37: steps at
+        # 30..32 fit window 32, the rest take the intermediate 48 — the
+        # power-of-two 64 must never be dispatched.
+        fut = engine.submit(list(range(1, 31)), 8)
+        out = fut.result(timeout=120)
+        assert len(out) == 8
+        assert seen, "no decode steps observed"
+        assert 48 in seen and 64 not in seen, seen
+    finally:
+        engine.shutdown()
