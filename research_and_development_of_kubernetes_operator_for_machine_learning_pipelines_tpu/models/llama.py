@@ -875,41 +875,32 @@ def _commit_rows(buf: jax.Array, vals: jax.Array, lengths: jax.Array) -> jax.Arr
     """Write row ``b``'s new K/V at its own position, in place.
 
     ``buf`` is head-major ``[L, B, NKV, T, ...]``, ``vals`` ``[L, B, NKV,
-    ...]``; row ``b`` writes at position ``lengths[b]`` on axis 3.  A single
-    batched scatter (``buf.at[:, rows, lengths].set``) is the obvious
-    spelling, but measured on v5e it makes XLA materialize a full copy of
-    the cache buffer every decode step once the buffer is also consumed
-    as the layer scan's xs — 4.5 ms/step at 1.35B/32 slots, 14 ms at 64
-    (round-4 profile; the standalone scatter on a carried buffer is
-    0.2 ms, so it is the xs-read + scatter interplay that defeats copy
-    elimination).  A ``fori_loop`` of per-row ``dynamic_update_slice``
-    is the pattern XLA's in-place analysis handles: each iteration
-    updates the loop-carried buffer exactly once.
-    """
-    def body(b, acc):
-        # [L, 1, NKV, 1, ...] slab for row b at its own position.  All
-        # start indices share one dtype (x64 mode would otherwise mix
-        # the loop's int64 counter with int32 zeros).
-        slab = jax.lax.dynamic_slice_in_dim(vals, b, 1, axis=1)[:, :, :, None]
-        z = jnp.zeros((), jnp.int32)
-        start = (
-            z, jnp.asarray(b, jnp.int32), z,
-            jnp.asarray(lengths[b], jnp.int32),
-        ) + (z,) * (buf.ndim - 4)
+    ...]``; row ``b`` writes at position ``lengths[b]`` on axis 3, and a
+    row parked at capacity (``lengths[b] == T``) must be DROPPED, never
+    clamped onto its last real position.
 
-        def write(a):
-            return jax.lax.dynamic_update_slice(a, slab.astype(a.dtype), start)
-
-        # Match the scatter's out-of-bounds semantics: `.at[...].set`
-        # DROPS a write at lengths[b] == T, while dynamic_update_slice
-        # CLAMPS the start and would overwrite the row's last real K/V —
-        # a full resident row (e.g. a finished request parked at
-        # capacity while others decode) must not corrupt itself.
-        return jax.lax.cond(
-            lengths[b] < buf.shape[3], write, lambda a: a, acc
-        )
-
-    return jax.lax.fori_loop(0, buf.shape[1], body, buf)
+    One batched scatter with drop semantics.  History, because this spot
+    has flip-flopped on measurement twice: round 4 found the scatter
+    forcing a full cache copy per step — but only because the layer scan
+    then consumed the cache as its xs, and the xs-read + scatter
+    interplay defeated XLA's copy elimination; the fix was a fori-loop
+    of per-row ``dynamic_update_slice``.  Round 5's layer walk reads the
+    ORIGINAL buffers via ``dynamic_index_in_dim`` (no xs packing), and
+    re-measuring in the production-shaped program showed the fori form
+    itself had become the step's dominant linear term — 6.0 ms of a
+    14.9 ms step at 1.35B/32 slots (~0.2 ms per slot, ~1500x the bytes
+    actually written) against ~3.8 ms for this scatter, with the no-op
+    commit at 8.9 ms as the floor.  In-process A/B of both spellings
+    plus a vmapped-DUS variant: scatter 12.68 / fori 14.92 / vmap 28.7
+    ms/step at 32 slots."""
+    b = buf.shape[1]
+    rows = jnp.arange(b)
+    # Advanced indices at axes 1 and 3 broadcast to (B,) and move to the
+    # front: the updates tensor is [B, L, NKV, ...].
+    v = jnp.moveaxis(vals, 1, 0).astype(buf.dtype)
+    return buf.at[:, rows, :, lengths].set(
+        v, mode="drop", unique_indices=True
+    )
 
 
 def insert_sequence(

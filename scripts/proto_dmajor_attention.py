@@ -1,0 +1,162 @@
+"""Prototype: D-major KV layout + VPU decode attention (round-5 probe).
+
+The shipped decode attention sits at the MXU's G=1 matvec tiling floor
+(~0.5 us per slot-head dot; docs/PERF.md round 5), because every
+formulation over the head-major ``[.., W, D]`` cache either pays MXU
+passes with one live row or (VPU spelling) burns its advantage on
+Mosaic relayouts.  This probe measures the remaining candidate: store
+the window TRANSPOSED, ``[B, NKV, D, W]`` — D on sublanes, W on lanes —
+so
+
+- scores  = sublane-reduce of q[:, None] * k   ->  [1, W]  (lane-dense)
+- softmax = lane ops on [1, W] directly
+- context = lane-reduce  of p * v              ->  [D, 1]
+
+with no transposes inside the kernel and no dot_general anywhere.
+
+Run on chip:  python scripts/proto_dmajor_attention.py [--slots 8,32]
+Compares per-STEP attention-only cost (24 layer-calls) against the
+production XLA einsum chain on the same values (parity-checked).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NKV, D, W = 16, 128, 512
+LAYERS = 24  # per-step multiplier: one attention call per layer
+
+
+def _kernel_dmajor(q_ref, k_ref, ks_ref, v_ref, vs_ref, mask_ref, o_ref,
+                   *, scale, bb):
+    for t in range(bb):
+        q = q_ref[t, 0].astype(jnp.float32) * scale        # [D, 1]
+        k = k_ref[t, 0].astype(jnp.float32)                # [D, W]
+        s = jnp.sum(k * q, axis=0, keepdims=True)          # [1, W] sublane-red
+        s = s * ks_ref[t, 0] + mask_ref[t]                 # [1, W]
+        m = jnp.max(s, axis=1, keepdims=True)              # [1, 1]
+        p = jnp.exp(s - m)                                 # [1, W]
+        denom = jnp.sum(p, axis=1, keepdims=True)          # [1, 1]
+        pv = p * vs_ref[t, 0]                              # [1, W]
+        v = v_ref[t, 0].astype(jnp.float32)                # [D, W]
+        ctx = jnp.sum(v * pv, axis=1, keepdims=True)       # [D, 1] lane-red
+        o_ref[t, 0] = ctx / denom
+
+
+def attn_dmajor(q, k8t, ks, v8t, vs, mask, *, interpret=False):
+    """q [B,NKV,D,1]; k8t/v8t [B,NKV,D,W] int8; ks/vs [B,NKV,1,W] f32;
+    mask [B,1,W] -> out [B,NKV,D,1] f32."""
+    b = q.shape[0]
+    bb = 8 if b % 8 == 0 else (4 if b % 4 == 0 else 1)
+    kernel = functools.partial(_kernel_dmajor, scale=1.0 / D ** 0.5, bb=bb)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, NKV, D, 1), jnp.float32),
+        grid=(b // bb, NKV),
+        in_specs=[
+            pl.BlockSpec((bb, 1, D, 1), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bb, 1, D, W), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bb, 1, 1, W), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bb, 1, D, W), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bb, 1, 1, W), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bb, 1, W), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bb, 1, D, 1), lambda i, j: (i, j, 0, 0)),
+        interpret=interpret,
+    )(q, k8t, ks, v8t, vs, mask)
+
+
+def attn_xla(q, k8, ks, v8, vs, mask):
+    """Production-shaped einsum chain on head-major [B,NKV,W,D] (the
+    no-self-term core, matching the prototype's contract)."""
+    qf = q.astype(jnp.float32) / (D ** 0.5)               # [B,NKV,1,D]
+    s = jnp.einsum("bngd,bnwd->bngw", qf, k8.astype(jnp.float32))
+    s = s * ks[..., 0][:, :, None, :] + mask[:, :, None, :]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    ctx = jnp.einsum("bngw,bnwd->bngd",
+                     p * vs[..., 0][:, :, None, :], v8.astype(jnp.float32))
+    return ctx / denom
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", default="8,32")
+    ap.add_argument("--rounds", type=int, default=2)
+    args = ap.parse_args()
+    import numpy as np
+
+    import bench
+
+    bench._setup_jax()
+    out = {}
+    for b in (int(s) for s in args.slots.split(",")):
+        key = jax.random.key(0)
+        ks_ = jax.random.split(key, 6)
+        k8 = jax.random.randint(ks_[0], (b, NKV, W, D), -127, 128, jnp.int8)
+        v8 = jax.random.randint(ks_[1], (b, NKV, W, D), -127, 128, jnp.int8)
+        ksc = jnp.abs(jax.random.normal(ks_[2], (b, NKV, W, 1))) * 0.01 + 1e-3
+        vsc = jnp.abs(jax.random.normal(ks_[3], (b, NKV, W, 1))) * 0.01 + 1e-3
+        q = jax.random.normal(ks_[4], (b, NKV, 1, D), jnp.float32)
+        lengths = jnp.arange(b, dtype=jnp.int32) * (W // max(b, 1)) + 1
+        mask = jnp.where(jnp.arange(W)[None, :] < lengths[:, None],
+                         0.0, -1e9).astype(jnp.float32)[:, None, :]
+        # D-major copies of the same values
+        k8t = jnp.swapaxes(k8, 2, 3)
+        v8t = jnp.swapaxes(v8, 2, 3)
+        kst = jnp.swapaxes(ksc, 2, 3)
+        vst = jnp.swapaxes(vsc, 2, 3)
+        qt = jnp.swapaxes(q, 2, 3)
+
+        # Parity first.
+        ref = attn_xla(q, k8, ksc, v8, vsc, mask)
+        got = attn_dmajor(qt, k8t, kst, v8t, vst, mask)
+        delta = float(jnp.max(jnp.abs(
+            jnp.swapaxes(got, 2, 3) - ref)))
+        assert delta < 1e-3, delta
+
+        # Timed with the bench's scan-delta machinery: each scan
+        # iteration is ONE attention call, the q carry chains them, the
+        # big buffers ride as explicit params.
+        def step_x(pr, c):
+            kk, kks, vv, vvs, mm = pr
+            o = attn_xla(c, kk, kks, vv, vvs, mm)
+            return c + 1e-6 * o, o[0, 0, 0, 0]
+
+        def step_d(pr, c):
+            kk, kks, vv, vvs, mm = pr
+            o = attn_dmajor(c, kk, kks, vv, vvs, mm)
+            return c + 1e-6 * o, o[0, 0, 0, 0]
+
+        res = {}
+        for name, step, qin, pr in (
+            ("xla", step_x, q, (k8, ksc, v8, vsc, mask)),
+            ("dmajor", step_d, qt, (k8t, kst, v8t, vst, mask)),
+        ):
+            p = bench._scan_delta_timed(
+                step, lambda i, qin=qin: qin + 1e-5 * i,
+                runs=max(3, args.rounds * 2), n1=LAYERS, n2=LAYERS * 5,
+                params=pr,
+            )
+            res[name] = p[50] * LAYERS  # per 24-layer decode step
+        out[str(b)] = {f"{k}_ms_per_step": round(v * 1e3, 3)
+                       for k, v in res.items()} | {
+            "speedup": round(res["xla"] / res["dmajor"], 2)}
+        print(b, json.dumps(out[str(b)]), flush=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
