@@ -2,13 +2,17 @@
 # (nizepart/mlflow-operator:latest, README.md:32); this framework builds
 # its three first-party images from source.
 
+# bash, not sh: the verify recipe needs pipefail/PIPESTATUS (dash has
+# neither and dies on `set -o pipefail`).
+SHELL    := /bin/bash
+
 PKG      := research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu
 REGISTRY ?= tpumlops
 TAG      ?= latest
 DOCKER   ?= docker
 
 .PHONY: images operator-image server-image router-image router-bin \
-        install uninstall test bench
+        install uninstall test test-fast test-e2e test-all verify bench
 
 images: operator-image server-image router-image
 
@@ -56,6 +60,18 @@ test-e2e:
 
 test-all:
 	python -m pytest tests/ -x -q
+
+# The EXACT tier-1 command from ROADMAP.md (the driver's acceptance
+# gate): not-slow tranche, collection errors tolerated, 870 s wall cap,
+# DOTS_PASSED echoed from the captured dot lines.
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
 
 bench:
 	python bench.py

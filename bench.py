@@ -25,6 +25,10 @@ north star:
 - ``iris_sklearn_linear`` / ``xgboost_forest`` — µs-scale tabular configs.
 - ``resnet50`` — batch ladder (b8 latency point through b128 throughput)
   with per-point MFU.
+- ``prefix_cache_serving`` — shared-prefix workload through the real
+  engine scheduler: TTFT cold vs warm and the prefill-chunk-call drop
+  when the radix prefix KV cache reuses a cached prompt prefix
+  (server/prefix_cache.py).
 - ``llama_1p35b_decode`` — decode slot ladder 8..64 (int8 weights + int8
   KV + windowed attention) with HBM bw_util and an int8kv logit-parity
   gate (models/llama.py, server/generation.py).
@@ -1010,24 +1014,50 @@ def _decode_device_loop(jax, params, cfg, slots: int, *, kv_quant: bool,
         f = jax.jit(step, donate_argnums=(1,))
 
         def chain(i, m):
+            # The replay probe is the SUM of every step's sampled token.
+            # The per-step probes are only APPENDED to a host list inside
+            # the timed window (free — no extra device op may enter the
+            # loop: a per-step dispatch would scale with chain length and
+            # NOT cancel in the delta); the one summing dispatch + sync
+            # runs after t1.  Distinct chain lengths from distinct carries
+            # must produce distinct sums, so a result-replaying tunnel
+            # shows up as identical probes, not just as a near-zero wall
+            # (ADVICE r5 #3 — the primary scan path has tainted-pair
+            # detection; this carries the equivalent).
             carry = carry_at(i)
+            plist = []
             t0 = time.perf_counter()
-            probe = None
             for _ in range(m):
                 carry, probe = f(params, carry)
-            np.asarray(probe)
-            return time.perf_counter() - t0
+                plist.append(probe)
+            np.asarray(plist[-1])  # sync: the chain really ran to the end
+            wall = time.perf_counter() - t0
+            acc = int(np.asarray(jnp.stack(plist).sum()))
+            return wall, acc
 
         chain(-11, 2)  # compile + warm
-        samples = []
-        for r in range(3):
-            w1 = chain(5000 + 2 * r, n1)
-            w2 = chain(5000 + 2 * r + 1, n2)
+        samples, probes = [], []
+        for r in range(5):  # 5 rounds, raw samples recorded for audit
+            w1, a1 = chain(5000 + 2 * r, n1)
+            w2, a2 = chain(5000 + 2 * r + 1, n2)
             samples.append(max(0.0, (w2 - w1) / (n2 - n1)))
+            probes.append([a1, a2])
+        # Auditability: _run_slot_ladder embeds these on chained points.
+        _decode_device_loop.last_chained = {
+            "raw_ms_per_step": [round(s * 1000, 3) for s in samples],
+            "probe_sums": probes,
+        }
         med = _percentiles(samples)[50]
         if med <= 0.0:
             raise RuntimeError(
                 "chained-step fallback collapsed to zero — replay/elision"
+            )
+        if all(a1 == a2 for a1, a2 in probes):
+            # n1- and n2-length chains from distinct carries summed to the
+            # same value in EVERY round: the tunnel is replaying results.
+            raise RuntimeError(
+                "chained-step probe sums identical across chain lengths "
+                "in all rounds — replay suspected"
             )
         return med
 
@@ -1110,8 +1140,12 @@ def _run_slot_ladder(
         if scan_error is not None:
             # Provenance: the primary methodology's actual failure, so a
             # chained-step point never claims a failure mode it didn't
-            # have (compile rejection vs anti-elision guard vs OOM).
+            # have (compile rejection vs anti-elision guard vs OOM) —
+            # plus the fallback's raw samples and probe sums for audit.
             entry["scan_error"] = scan_error
+            audit = getattr(_decode_device_loop, "last_chained", None)
+            if audit is not None:
+                entry["chained_audit"] = audit
         ladder[str(slots)] = entry
         if best is None or entry["tok_per_s"] > best[1]["tok_per_s"]:
             best = (slots, entry)
@@ -1128,6 +1162,110 @@ def _decode_hbm_bytes(params, cfg, slots: int, window: int, kv_quant: bool) -> i
     if kv_quant:  # per-(pos, head) f32 scale, head_dim amortized
         kv += 2 * kv_elem // cfg.head_dim * 4
     return quantized_bytes(params) + kv
+
+
+def bench_prefix_cache() -> dict:
+    """Shared-prefix serving scenario: radix prefix KV cache
+    (server/prefix_cache.py) at a small llama shape.
+
+    Thousands of requests sharing one system prompt re-prefill it today;
+    with the cache, the prefix's K/V is copied (one seed op) and only the
+    unique suffix runs real prefill.  Reported: TTFT (submit -> first
+    token through the real engine scheduler) cold vs warm, and the
+    prefill-chunk-call counter per admission — the direct evidence that
+    cached admits skip recomputation.  TTFT here rides this
+    environment's per-dispatch tunnel cost (~65 ms/op), so the chunk
+    counts are the environment-independent signal; on a real host the
+    TTFT ratio approaches the chunk ratio."""
+    import threading
+
+    jax = _setup_jax()
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+    from tpumlops.server.prefix_cache import PrefixCacheConfig
+
+    cfg = llama.LlamaConfig(
+        vocab_size=4000, hidden_size=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, intermediate_size=704, max_seq=768,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    C = 128
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, size=512, dtype=np.int64)
+    engine = GenerationEngine(
+        params, cfg, max_slots=4, dtype=jnp.bfloat16,
+        prefix_cache=PrefixCacheConfig(
+            enabled=True, budget_bytes=64 * 2**20, chunk_tokens=C
+        ),
+    )
+    engine.start(warmup=True)
+
+    def one_request(suffix_seed: int) -> float:
+        """Submit shared-prefix + unique-suffix; return TTFT seconds."""
+        sfx = np.random.default_rng(1000 + suffix_seed).integers(
+            1, cfg.vocab_size, size=32, dtype=np.int64
+        )
+        prompt = np.concatenate([shared, sfx]).tolist()
+        first = threading.Event()
+        t0 = time.perf_counter()
+        fut = engine.submit(prompt, 4, on_token=lambda _t: first.set())
+        assert first.wait(timeout=300), "no first token"
+        ttft = time.perf_counter() - t0
+        fut.result(timeout=300)
+        return ttft
+
+    try:
+        chunks0 = engine.prefill_chunks_dispatched
+        cold_ttft = one_request(1)
+        chunks_cold = engine.prefill_chunks_dispatched - chunks0
+        warm_ttfts = []
+        warm_chunks = []  # per-admission: EVERY warm admit must shrink
+        for i in range(4):
+            before = engine.prefill_chunks_dispatched
+            warm_ttfts.append(one_request(2 + i))
+            warm_chunks.append(engine.prefill_chunks_dispatched - before)
+        warm_ttft = sorted(warm_ttfts)[len(warm_ttfts) // 2]
+        hits = engine.prefix_hits
+        cached = engine.prefix_cached_tokens
+        evictions = engine.prefix_evictions
+    finally:
+        engine.shutdown()
+    # 544-token prompt, 128-token chunks: cold = 5 chunk calls, warm = 1
+    # (512 cached) — the counter drop IS the skipped recomputation.  Every
+    # warm admission is checked, not just the last: one silent miss would
+    # otherwise hide behind its siblings.
+    chunks_warm = max(warm_chunks)
+    assert chunks_warm < chunks_cold, (warm_chunks, chunks_cold)
+    assert hits >= 4 and cached >= 4 * 512, (hits, cached)
+    prompt_tokens = 512 + 32
+    return {
+        "cold_ttft_ms": round(cold_ttft * 1000, 1),
+        "warm_ttft_ms": round(warm_ttft * 1000, 1),
+        "ttft_speedup": round(cold_ttft / warm_ttft, 2),
+        # Admission throughput: prompt tokens made decode-ready per second
+        # of TTFT (warm counts the cache-seeded 512 as served — they are).
+        "prefill_tok_per_s_cold": round(prompt_tokens / cold_ttft, 1),
+        "prefill_tok_per_s_warm": round(prompt_tokens / warm_ttft, 1),
+        "chunks_cold": chunks_cold,
+        "chunks_warm": chunks_warm,
+        "chunks_per_warm_admit": warm_chunks,
+        "cached_tokens_per_warm_hit": cached // hits,
+        "hits": hits,
+        "evictions": evictions,
+        "note": (
+            "engine-loop TTFT rides the dev tunnel's ~65 ms/dispatch; the "
+            "chunk-call drop (cold 5 -> warm 1 per admission) is the "
+            "environment-independent number"
+        ),
+    }
 
 
 def bench_llama_decode() -> dict:
@@ -1528,6 +1666,8 @@ _COMPACT_KEYS = {
     "resnet50": ("img_per_s", "p50_ms", "mfu"),
     "llama_1p35b_decode": (
         "device_tok_per_s", "slots", "bw_util_at_best"),
+    "prefix_cache_serving": (
+        "cold_ttft_ms", "warm_ttft_ms", "chunks_cold", "chunks_warm"),
     "serve_path_http": (
         "server_queue_mean_ms", "server_device_run_mean_ms",
         "server_pipeline_wait_mean_ms", "server_observed_mean_ms",
@@ -1540,12 +1680,17 @@ _COMPACT_KEYS = {
 
 # Top-level keys dropped one by one (least headline-y first) if the
 # compact line still exceeds the budget after secondary compaction.
+# p99_raw_ms sheds LAST before the secondaries (ADVICE r5 #2): the
+# untrimmed tail is the guard that keeps a masked >15% sustained
+# regression visible on the driver-visible line, so every cosmetic field
+# goes before it (the bf16 raw99 still goes early — the headline raw99
+# is the guard of record).
 _SHED_ORDER = (
-    "bf16_p99_raw_ms", "p99_raw_ms", "numerics", "hardware",
+    "bf16_p99_raw_ms", "numerics", "hardware",
     "parity_vs_bf16_erf", "bf16_tflops",
     "bf16_mfu", "baseline_cpu_p99_ms", "throughput_seq_per_s",
     "bf16_p99_ms", "tflops", "vs_gpu_baseline", "device_p99_ms",
-    "secondary",
+    "p99_raw_ms", "secondary",
 )
 
 
@@ -1711,6 +1856,7 @@ def main() -> None:
         ("iris_sklearn_linear", bench_iris),
         ("xgboost_forest", bench_xgboost),
         ("resnet50", bench_resnet),
+        ("prefix_cache_serving", bench_prefix_cache),
         ("llama_1p35b_decode", bench_llama_decode),
         ("serve_path_http", bench_serve_path),
         ("llama_7b_decode", bench_llama_7b_decode),

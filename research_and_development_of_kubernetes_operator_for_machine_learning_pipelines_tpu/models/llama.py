@@ -49,6 +49,14 @@ from .quantization import dequantize_tensor, is_quantized
 # (nh=16/nkv=2), XLA still wins: 1.99 vs 2.22 ms/step at 8 slots, 3.79
 # vs 5.13 at 32 — and GQA decode is near-streaming-bound there
 # (8437 tok/s @ 32 slots, ~0.51 bw_util; docs/PERF.md round 5).
+# NOTE (pallas_vpu + 1.5x window buckets): the engine's intermediate
+# decode windows (96, 192, 384, 768, ... — generation.decode_window_
+# bucket) are not all multiples of 128, and the VPU kernel requires
+# W % 128 == 0 — so under that opt-in config only the W%128==0 buckets
+# run the VPU kernel; the rest warn-and-fall-back to the XLA chain
+# (_block_decode_deferred), i.e. the attention impl varies per window
+# bucket within one stream.  Harmless for the default ("auto" -> xla);
+# A/B runs labeled "pallas_vpu" should pin a 128-multiple window.
 _DECODE_ATTN = "auto"
 
 _DECODE_ATTN_IMPLS = ("auto", "xla", "pallas", "pallas_single", "pallas_vpu")

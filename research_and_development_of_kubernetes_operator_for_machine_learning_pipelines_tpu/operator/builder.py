@@ -106,6 +106,9 @@ def _tpu_pod_spec(
             "--compile-cache-dir", tpu.compile_cache_dir or "",
             "--quantize", tpu.quantize,
             "--prefill-chunk", str(tpu.prefill_chunk or 0),
+            "--prefix-cache", "1" if tpu.prefix_cache.enabled else "0",
+            "--prefix-cache-budget-mb", str(tpu.prefix_cache.budget_mb),
+            "--prefix-cache-chunk", str(tpu.prefix_cache.chunk_tokens),
         ],
         "env": [
             {"name": "TPU_TOPOLOGY", "value": tpu.topology},

@@ -32,7 +32,7 @@ from .sharding import (
     logical_spec,
     shard_pytree,
 )
-from .collectives import ring_shift
+from .collectives import ring_shift, shard_map_compat
 from .distributed import maybe_initialize_distributed
 
 __all__ = [
@@ -57,5 +57,6 @@ __all__ = [
     "logical_sharding",
     "shard_pytree",
     "ring_shift",
+    "shard_map_compat",
     "maybe_initialize_distributed",
 ]

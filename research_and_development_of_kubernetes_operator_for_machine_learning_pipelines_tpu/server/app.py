@@ -600,6 +600,18 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
     """
     from .generation import GenerationEngine
 
+    prefix_cache = None
+    if config.tpu.prefix_cache.enabled:
+        from .prefix_cache import PrefixCacheConfig
+
+        # Same spec on leader and followers (this one construction site):
+        # the derived prefill-chunk size must agree or lockstep replay
+        # runs mismatched chunk shapes.
+        prefix_cache = PrefixCacheConfig(
+            enabled=True,
+            budget_bytes=config.tpu.prefix_cache.budget_mb * 2**20,
+            chunk_tokens=config.tpu.prefix_cache.chunk_tokens,
+        )
     return GenerationEngine(
         predictor.causal_lm["params"],
         predictor.causal_lm["cfg"],
@@ -613,6 +625,9 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
         channel=channel,
         kv_quant=config.tpu.quantize == "int8kv",
         prefill_chunk=config.tpu.prefill_chunk,
+        prefix_cache=prefix_cache,
+        on_prefix_hit=metrics.observe_prefix_hit if metrics else None,
+        on_prefix_evict=metrics.inc_prefix_evictions if metrics else None,
     )
 
 
@@ -728,6 +743,27 @@ def main(argv: list[str] | None = None) -> None:
         "stalling in-flight decode streams",
     )
     ap.add_argument(
+        "--prefix-cache",
+        type=int,
+        default=0,
+        help="1 enables the radix prefix KV cache (shared prompt prefixes "
+        "prefill once and are copied thereafter)",
+    )
+    ap.add_argument(
+        "--prefix-cache-budget-mb",
+        type=int,
+        default=256,
+        help="host-memory byte budget for cached prefix K/V (LRU eviction)",
+    )
+    ap.add_argument(
+        "--prefix-cache-chunk",
+        type=int,
+        default=0,
+        help="prefix reuse unit in tokens (0 = follow --prefill-chunk, or "
+        "64 when that is unset too); an explicit mismatch with "
+        "--prefill-chunk is rejected at startup",
+    )
+    ap.add_argument(
         "--quantize",
         default="none",
         choices=["none", "int8", "int8kv"],
@@ -767,6 +803,11 @@ def main(argv: list[str] | None = None) -> None:
                 "maxBatchDelayMs": args.max_batch_delay_ms,
                 "quantize": args.quantize,
                 "prefillChunk": args.prefill_chunk or None,
+                "prefixCache": {
+                    "enabled": bool(args.prefix_cache),
+                    "budgetMB": args.prefix_cache_budget_mb,
+                    "chunkTokens": args.prefix_cache_chunk or None,
+                },
             }
         ),
     )

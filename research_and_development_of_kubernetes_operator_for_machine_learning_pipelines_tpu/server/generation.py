@@ -81,7 +81,13 @@ def decode_window_bucket(length: int, capacity: int) -> int:
     384, 768, ...) cap the overshoot at 33% for one more compiled
     variant per octave — measured on chip at 1.35B/32 slots, window
     384 vs 512 is 1.085x the step rate (15.10 -> 13.92 ms/step; the
-    weight-stream constant dilutes the linear attention term)."""
+    weight-stream constant dilutes the linear attention term).
+
+    Interaction with ``_DECODE_ATTN='pallas_vpu'``: the 3/4 steps are
+    not all multiples of 128, and the VPU kernel requires W % 128 == 0,
+    so that opt-in config runs the VPU kernel only on the W%128==0
+    buckets and warn-falls-back to XLA on the others (see the
+    ``_DECODE_ATTN`` note in models/llama.py)."""
     w = prefill_bucket(length, capacity)
     # The 3/4 step applies only to an UNCAPPED power-of-two bucket: when
     # next_bucket was clamped to a non-power capacity, 3*(w//4) is an
@@ -125,11 +131,19 @@ class _Slot:
 
 @dataclass
 class _PrefillProgress:
-    """A chunked admission in flight (one at a time)."""
+    """A chunked admission in flight (one at a time).
+
+    ``chunks`` covers only the UNCACHED suffix when a radix-cached
+    prefix was found at admission (``cached_tokens`` > 0): the prefix's
+    K/V is seeded straight into the sequence cache (``cached_kv``, one
+    host pair per chunk) and never re-prefilled."""
 
     req: _Request
-    chunks: list  # padded [1, C] int32 arrays
+    chunks: list  # padded [1, C] int32 arrays (uncached suffix only)
     next_idx: int = 0
+    cached_tokens: int = 0
+    cached_kv: list = field(default_factory=list)
+    seeded: bool = False
 
 
 @dataclass
@@ -167,6 +181,9 @@ class GenerationEngine:
         channel=None,
         kv_quant: bool = False,
         prefill_chunk: int | None = None,
+        prefix_cache=None,  # PrefixCacheConfig | None
+        on_prefix_hit: Callable[[int], None] | None = None,
+        on_prefix_evict: Callable[[], None] | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -194,6 +211,29 @@ class GenerationEngine:
         # prefill.  None = whole-prompt bucketed prefill (fused, fastest
         # time-to-first-token when nothing else is decoding).
         self._prefill_chunk_size = int(prefill_chunk) if prefill_chunk else None
+        # Radix prefix KV cache (cross-request prompt reuse).  The reuse
+        # unit IS the prefill chunk, so enabling the cache enables chunked
+        # prefill at ``chunk_tokens`` when prefillChunk is unset; when both
+        # are set they must agree — a mismatched reuse unit would make
+        # cached chunk boundaries fall mid-prefill-chunk.
+        self._prefix_cache = None
+        self._on_prefix_hit = on_prefix_hit
+        self._on_prefix_evict = on_prefix_evict
+        prefix_enabled = prefix_cache is not None and prefix_cache.enabled
+        if prefix_enabled:
+            ct = int(prefix_cache.chunk_tokens)
+            if ct <= 0:
+                raise ValueError(
+                    f"prefixCache.chunkTokens must be positive, got {ct}"
+                )
+            if self._prefill_chunk_size is None:
+                self._prefill_chunk_size = ct
+            elif self._prefill_chunk_size != ct:
+                raise ValueError(
+                    f"prefixCache.chunkTokens {ct} must equal prefillChunk "
+                    f"{self._prefill_chunk_size}: the prefill chunk is the "
+                    "prefix reuse unit"
+                )
         if self._prefill_chunk_size is not None:
             C = self._prefill_chunk_size
             if C <= 0:
@@ -205,6 +245,14 @@ class GenerationEngine:
                     f"prefill_chunk {C} must divide KV capacity "
                     f"{self.capacity}"
                 )
+        if prefix_enabled:
+            from .prefix_cache import RadixPrefixCache
+
+            self._prefix_cache = RadixPrefixCache(
+                budget_bytes=int(prefix_cache.budget_bytes),
+                chunk_tokens=self._prefill_chunk_size,
+                on_evict=self._note_prefix_evict,
+            )
         self._reset_device_state()
 
         def make_cache(k, v, lengths):
@@ -299,6 +347,35 @@ class GenerationEngine:
             _prefill_one_chunk, donate_argnums=(2, 3)
         )
 
+        from jax.lax import dynamic_slice as lax_ds
+        from jax.lax import dynamic_update_slice as lax_dus
+
+        def _seed_chunk(sk, sv, ck, cv, start):
+            # Prefix-cache hit: copy one cached chunk's K/V into the
+            # in-progress sequence cache at its absolute offset.  ``start``
+            # is traced, the chunk shape is fixed — ONE compiled program
+            # serves every cached chunk at every offset (vs a forward pass
+            # per chunk on the cold path).
+            z = jnp.int32(0)
+            sk = lax_dus(sk, ck.astype(sk.dtype), (z, z, start, z, z))
+            sv = lax_dus(sv, cv.astype(sv.dtype), (z, z, start, z, z))
+            return sk, sv
+
+        self._seed_chunk = jax.jit(_seed_chunk, donate_argnums=(0, 1))
+
+        def _read_chunk(sk, sv, start):
+            # Prefix-cache write-back: pull one freshly prefilled chunk's
+            # K/V slice off the device.  Traced ``start`` -> one program.
+            C = self._prefill_chunk_size
+            z = jnp.int32(0)
+            size = (sk.shape[0], sk.shape[1], C, sk.shape[3], sk.shape[4])
+            return (
+                lax_ds(sk, (z, z, start, z, z), size),
+                lax_ds(sv, (z, z, start, z, z), size),
+            )
+
+        self._read_chunk = jax.jit(_read_chunk)
+
         def _insert_only(
             last_logits, k, v, lengths, toks, slot, actual_len,
             keys, temps, tks, tps, slot_key, temp, tk, tp, sk, sv, last_idx,
@@ -344,6 +421,12 @@ class GenerationEngine:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.tokens_generated = 0
+        # Prefix-cache observability (also read by bench.py's shared-prefix
+        # scenario and the Prometheus hookups in app.make_gen_engine).
+        self.prefix_hits = 0
+        self.prefix_cached_tokens = 0
+        self.prefix_evictions = 0
+        self.prefill_chunks_dispatched = 0
 
     def _reset_device_state(self) -> None:
         """(Re)allocate the KV cache and token buffers.
@@ -400,6 +483,22 @@ class GenerationEngine:
         t0 = time.perf_counter()
         self._in_warmup = True
         try:
+            if self._prefix_cache is not None:
+                # Compile the prefix-cache seed (dispatched: followers
+                # must compile it too) and the leader-side chunk read-back
+                # before readiness — a lazy compile on the first warm
+                # admission would stall the scheduler thread.  Runs FIRST:
+                # the admissions below dispatch fresh-chunk + insert ops
+                # that drop the seeded scratch buffers on every host.
+                C = self._prefill_chunk_size
+                shape = (
+                    self._cfg.num_layers, 1, C,
+                    self._cfg.num_kv_heads, self._cfg.head_dim,
+                )
+                zk = np.asarray(jnp.zeros(shape, self._dtype))
+                self._dispatch_seed([(zk, zk)], C)
+                _, sk, sv, _slen = self._seq_state
+                self._read_chunk(sk, sv, jnp.int32(0))
             self._admit_now(
                 _Request(
                     prompt=np.array([1], np.int32),
@@ -631,9 +730,7 @@ class GenerationEngine:
         if self._prefill_chunk_size is None:
             self._admit(req)
             return
-        self._pending = _PrefillProgress(
-            req=req, chunks=self._split_chunks(req.prompt)
-        )
+        self._pending = self._make_progress(req)
         while self._pending is not None:
             self._chunk_tick()
 
@@ -717,6 +814,54 @@ class GenerationEngine:
         padded[:L] = prompt
         return [padded[i * C : (i + 1) * C][None, :] for i in range(n)]
 
+    def _make_progress(self, req: _Request) -> _PrefillProgress:
+        """Chunked-admission plan: longest radix-cached prefix (to seed)
+        plus the uncached suffix (to prefill).  Warmup prompts never
+        consult or populate the cache."""
+        cached_tokens, cached_kv = 0, []
+        if self._prefix_cache is not None and not self._in_warmup:
+            cached_tokens, cached_kv = self._prefix_cache.lookup(req.prompt)
+        return _PrefillProgress(
+            req=req,
+            chunks=self._split_chunks(req.prompt[cached_tokens:]),
+            cached_tokens=cached_tokens,
+            cached_kv=cached_kv,
+        )
+
+    def _note_prefix_evict(self, nbytes: int) -> None:
+        self.prefix_evictions += 1
+        if self._on_prefix_evict is not None and not self._in_warmup:
+            self._on_prefix_evict()
+
+    def _maybe_cache_chunk(self, prog: _PrefillProgress) -> None:
+        """Write the chunk just prefilled (index ``prog.next_idx``) back
+        into the radix cache — leader-side only (the scheduler thread),
+        full real-token chunks only (a padded tail carries pad-garbage
+        K/V that must never be reused).
+
+        The ``np.asarray`` is a device sync: the scheduler waits for the
+        chunk's forward pass before dispatching the next decode tick, so
+        it is paid at most ONCE per unique chunk — ``has_chunk`` skips
+        both the transfer and the sync for chunks already cached (the
+        steady state for shared-prefix traffic)."""
+        if self._prefix_cache is None or self._in_warmup:
+            return
+        import jax.numpy as jnp
+
+        C = self._prefill_chunk_size
+        L = int(prog.req.prompt.size)
+        start = prog.cached_tokens + prog.next_idx * C
+        if start + C > L:
+            return
+        chunk_idx = start // C
+        if self._prefix_cache.has_chunk(prog.req.prompt, chunk_idx):
+            return
+        _, sk, sv, _slen = self._seq_state
+        ck, cv = self._read_chunk(sk, sv, jnp.int32(start))
+        self._prefix_cache.insert_chunk(
+            prog.req.prompt, chunk_idx, np.asarray(ck), np.asarray(cv)
+        )
+
     def _dispatch_chunk(self, ids: np.ndarray, fresh: bool) -> None:
         if self._channel is None:
             self._device_chunk(ids, fresh)
@@ -742,6 +887,55 @@ class GenerationEngine:
 
     def replay_chunk(self, ids, fresh) -> None:
         self._device_chunk(np.asarray(ids), bool(fresh))
+
+    def _dispatch_seed(self, cached_kv: list, length: int) -> None:
+        """Broadcast (multihost) then seed the sequence cache from the
+        radix-cached prefix chunks.  The payload carries the host K/V so
+        followers stay in lockstep without their own cache.
+
+        Known multihost cost: the payload scales with the cached prefix
+        (MBs at large geometries) and rides the serialized unit channel,
+        where chunk ops are ~KBs.  Follower-local cache replicas (replay
+        the write-back index instead of the bytes; eviction is already
+        deterministic) would shrink the seed op to a scalar — future
+        work, single-host serving is unaffected."""
+        if self._channel is None:
+            self._device_seed(cached_kv, length)
+            return
+        from .multihost import OP_GEN_SEED, encode_message
+
+        payload = encode_message(
+            OP_GEN_SEED,
+            {
+                "ks": [np.asarray(k) for k, _ in cached_kv],
+                "vs": [np.asarray(v) for _, v in cached_kv],
+                "length": int(length),
+            },
+        )
+        self._channel.run(payload, lambda: self._device_seed(cached_kv, length))
+
+    def _device_seed(self, cached_kv: list, length: int) -> None:
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        seq = llama.KVCache.create(self._cfg, 1, self._dtype)
+        sk, sv = seq.k, seq.v
+        C = self._prefill_chunk_size
+        off = 0
+        for ck, cv in cached_kv:
+            sk, sv = self._seed_chunk(
+                sk, sv, jnp.asarray(ck), jnp.asarray(cv), jnp.int32(off)
+            )
+            off += C
+        # No last_logits yet: at least one real suffix chunk ALWAYS follows
+        # (lookup caps the match strictly below the prompt length), and its
+        # prefill provides the logits the insert samples from.
+        self._seq_state = (None, sk, sv, jnp.asarray(int(length), jnp.int32))
+
+    def replay_seed(self, ks, vs, length) -> None:
+        """Follower side of :meth:`_dispatch_seed` (multihost lockstep)."""
+        self._device_seed(list(zip(ks, vs)), int(length))
 
     def _dispatch_insert(self, slot_idx, L, slot_key, temp, tk, tp, last_idx):
         import jax
@@ -823,12 +1017,27 @@ class GenerationEngine:
         return jax.random.key(int(req.seed))
 
     def _chunk_tick(self) -> None:
-        """Advance the in-flight chunked admission by ONE chunk; on the
-        final chunk, install the sequence into its slot."""
+        """Advance the in-flight chunked admission by ONE device op (a
+        prefix-cache seed or one prefill chunk); on the final chunk,
+        install the sequence into its slot."""
         prog = self._pending
         assert prog is not None
+        if prog.cached_tokens and not prog.seeded:
+            # Cached-prefix hit: one seed op copies the radix-cached K/V
+            # into a fresh sequence cache — those tokens never re-prefill.
+            self._dispatch_seed(prog.cached_kv, prog.cached_tokens)
+            prog.seeded = True
+            prog.cached_kv = []  # host copies handed off; free the refs
+            self.prefix_hits += 1
+            self.prefix_cached_tokens += prog.cached_tokens
+            if self._on_prefix_hit is not None and not self._in_warmup:
+                self._on_prefix_hit(prog.cached_tokens)
+            return  # suffix chunks start next tick (decode cadence kept)
         ids = prog.chunks[prog.next_idx]
-        self._dispatch_chunk(ids, fresh=prog.next_idx == 0)
+        self._dispatch_chunk(ids, fresh=prog.next_idx == 0 and not prog.seeded)
+        if not self._in_warmup:
+            self.prefill_chunks_dispatched += 1
+        self._maybe_cache_chunk(prog)
         prog.next_idx += 1
         if prog.next_idx < len(prog.chunks):
             return
@@ -842,7 +1051,7 @@ class GenerationEngine:
         t0 = time.perf_counter()
         first = self._dispatch_insert(
             slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p,
-            last_idx=(L - 1) - C * (len(prog.chunks) - 1),
+            last_idx=(L - 1) - prog.cached_tokens - C * (len(prog.chunks) - 1),
         )
         self._slots[slot_idx] = _Slot(
             future=req.future,
@@ -1005,9 +1214,7 @@ class GenerationEngine:
                     req.future.cancel()
                 return False
             if self._prefill_chunk_size is not None:
-                self._pending = _PrefillProgress(
-                    req=req, chunks=self._split_chunks(req.prompt)
-                )
+                self._pending = self._make_progress(req)
                 return True  # first chunk runs next iteration's admit phase
             try:
                 self._admit(req)

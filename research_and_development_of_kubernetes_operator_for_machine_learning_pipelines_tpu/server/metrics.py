@@ -128,6 +128,27 @@ class ServerMetrics:
             buckets=_LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # Prefix KV cache (server/prefix_cache.py): the promotion gate's
+        # operator can watch hit rate / cached-token volume per predictor
+        # to judge whether a canary inherits the production prefix mix.
+        self.prefix_cache_hits = Counter(
+            "tpumlops_prefix_cache_hits",
+            "Admissions that reused a radix-cached prompt prefix",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.prefix_cache_cached_tokens = Counter(
+            "tpumlops_prefix_cache_cached_tokens",
+            "Prompt tokens served from the prefix KV cache (prefill skipped)",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.prefix_cache_evictions = Counter(
+            "tpumlops_prefix_cache_evictions",
+            "Prefix-cache chunks evicted under the byte budget (LRU)",
+            ident_labels,
+            registry=self.registry,
+        )
         self.ready = Gauge(
             "tpumlops_model_ready",
             "1 once the model is loaded and warmed",
@@ -181,6 +202,15 @@ class ServerMetrics:
     def observe_decode_step(self, active_slots: int, seconds: float):
         self.decode_batch.labels(**self.identity).observe(active_slots)
         self.decode_step_seconds.labels(**self.identity).observe(seconds)
+
+    def observe_prefix_hit(self, cached_tokens: int):
+        self.prefix_cache_hits.labels(**self.identity).inc()
+        self.prefix_cache_cached_tokens.labels(**self.identity).inc(
+            cached_tokens
+        )
+
+    def inc_prefix_evictions(self, n: int = 1):
+        self.prefix_cache_evictions.labels(**self.identity).inc(n)
 
     def inc_generated_tokens(self, n: int = 1):
         # Separate from observe_decode_step: the first token of every

@@ -46,6 +46,7 @@ OP_GEN_STEP = "gen_step"  # continuous-batching decode tick (replayed)
 OP_GEN_RESET = "gen_reset"  # leader recovered from a failed step: drop state
 OP_GEN_CHUNK = "gen_chunk"  # chunked-prefill: one prompt chunk (replayed)
 OP_GEN_INSERT = "gen_insert"  # chunked-prefill: install sequence into slot
+OP_GEN_SEED = "gen_seed"  # prefix-cache hit: seed seq cache from cached K/V
 
 # Fixed-size round-1 header: payload byte length as uint32.  Round 2 is the
 # payload itself.  Two rounds because ``broadcast_one_to_all`` needs every
@@ -285,10 +286,15 @@ def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None
                 if gen_engine is None:
                     raise RuntimeError("GEN op on a unit without a gen engine")
                 gen_engine.replay_insert(**inputs)
+            elif op == OP_GEN_SEED:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_seed(**inputs)
             else:  # unknown op: skip rather than desync the group
                 _log.warning("follower ignoring unknown op %r", op)
         except Exception:
-            if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET, OP_GEN_CHUNK, OP_GEN_INSERT):
+            if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET, OP_GEN_CHUNK,
+                      OP_GEN_INSERT, OP_GEN_SEED):
                 # Generation is STATEFUL: if this host failed a step the
                 # leader executed, its cache/lengths shards now disagree
                 # with every other host's, and all in-flight sequences

@@ -1,0 +1,219 @@
+"""Radix-tree prefix KV cache: cross-request prompt reuse.
+
+The dominant redundant work in the generation data plane is prefill:
+thousands of requests share the same system-prompt/chat-template prefix,
+and every one of them recomputes its full K/V.  Prompt K/V is a pure
+function of the token prefix (causal attention: position ``p`` depends
+only on tokens ``<= p``), so K/V computed once for a prefix can be
+copied — not recomputed — into every later request that shares it.
+
+Design:
+
+- The reuse unit is the engine's PREFILL CHUNK (``prefill_chunk`` /
+  ``spec.tpu.prefixCache.chunkTokens``): prompts are already split into
+  fixed-size chunks by the chunked-prefill path, each chunk's K/V spans
+  a contiguous cache slice, and one chunk shape means one compiled
+  insert program.
+- The index is a radix tree over chunks.  Each node is one chunk; the
+  path from the root IS the cumulative key (node identity = the entire
+  token prefix up to and including its chunk), so two prompts sharing
+  ``k`` leading chunks share exactly ``k`` nodes.  Edges are keyed by
+  the chunk's exact token bytes rather than a digest — a hash collision
+  here would silently splice another prompt's K/V into a request and
+  corrupt its logits, and the bytes are small (4 B/token).
+- Each node owns host copies of its chunk's K/V (``[L, 1, C, NKV, D]``,
+  the seq-prefill layout) written back after the chunk's fresh prefill
+  completes.  Only FULL chunks made of real prompt tokens are cached;
+  a padded tail chunk carries pad-token garbage K/V.
+- Eviction is LRU over leaves under a byte budget.  Interior nodes are
+  never evicted (their descendants' keys would dangle); a cold branch
+  drains leaf-first, which is also reference-count order.
+
+Thread-safety: all calls happen on the engine's single scheduler
+thread; no locking needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Engine-side knobs (parsed from ``spec.tpu.prefixCache``)."""
+
+    enabled: bool = False
+    budget_bytes: int = 256 * 2**20
+    # Reuse unit; must equal the engine's prefill chunk (or, when
+    # prefillChunk is unset, becomes it — enabling the cache enables
+    # chunked prefill).
+    chunk_tokens: int = 64
+
+
+class _Node:
+    __slots__ = ("key", "kv", "nbytes", "parent", "children", "last_used")
+
+    def __init__(self, key: bytes, kv, nbytes: int, parent: "_Node | None"):
+        self.key = key
+        self.kv = kv  # (k, v) host arrays, or None on the root
+        self.nbytes = nbytes
+        self.parent = parent
+        self.children: dict[bytes, _Node] = {}
+        self.last_used = 0
+
+
+def _chunk_key(prompt: np.ndarray, idx: int, chunk_tokens: int) -> bytes:
+    chunk = np.asarray(
+        prompt[idx * chunk_tokens : (idx + 1) * chunk_tokens], np.int32
+    )
+    return chunk.tobytes()
+
+
+class RadixPrefixCache:
+    """Radix tree of prompt chunks with an LRU-evicted host K/V pool."""
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        chunk_tokens: int,
+        on_evict: Callable[[int], None] | None = None,
+    ):
+        if budget_bytes <= 0:
+            raise ValueError(
+                f"prefix cache budget must be positive, got {budget_bytes}"
+            )
+        if chunk_tokens <= 0:
+            raise ValueError(
+                f"prefix cache chunk_tokens must be positive, got {chunk_tokens}"
+            )
+        self.budget_bytes = int(budget_bytes)
+        self.chunk_tokens = int(chunk_tokens)
+        self._root = _Node(b"", None, 0, None)
+        self._on_evict = on_evict
+        # Leaves tracked incrementally: eviction runs on the engine's
+        # single scheduler thread (between decode ticks), so it must not
+        # walk the whole tree per evicted node.
+        self._leaves: set[_Node] = set()
+        self.bytes = 0
+        self.lookups = 0
+        self.evictions = 0
+        self._tick = 0
+
+    # -- queries -------------------------------------------------------------
+
+    def lookup(self, prompt: np.ndarray) -> tuple[int, list]:
+        """Longest cached prefix of ``prompt`` in whole chunks.
+
+        Returns ``(matched_tokens, [(k, v), ...])`` — one host K/V pair
+        per matched chunk, in order.  The match is capped STRICTLY below
+        the prompt length: at least one token must run real prefill so
+        the admission has final-position logits to sample the first
+        generated token from (``matched <= ((len - 1) // C) * C``).
+        Touches every matched node (LRU recency).
+        """
+        self.lookups += 1
+        self._tick += 1
+        C = self.chunk_tokens
+        max_chunks = (int(np.asarray(prompt).size) - 1) // C
+        node = self._root
+        out: list = []
+        for i in range(max_chunks):
+            child = node.children.get(_chunk_key(prompt, i, C))
+            if child is None:
+                break
+            child.last_used = self._tick
+            out.append(child.kv)
+            node = child
+        return len(out) * C, out
+
+    # -- inserts / eviction --------------------------------------------------
+
+    def has_chunk(self, prompt: np.ndarray, chunk_idx: int) -> bool:
+        """Existence probe (no LRU touch): lets the engine skip the
+        device-to-host K/V read for chunks already cached — the read is
+        a sync on the scheduler thread, so it must only be paid once per
+        unique chunk."""
+        C = self.chunk_tokens
+        node = self._root
+        for i in range(chunk_idx + 1):
+            node = node.children.get(_chunk_key(prompt, i, C))
+            if node is None:
+                return False
+        return True
+
+    def insert_chunk(
+        self, prompt: np.ndarray, chunk_idx: int, k: np.ndarray, v: np.ndarray
+    ) -> bool:
+        """Attach chunk ``chunk_idx`` of ``prompt`` with its K/V.
+
+        The parent path (chunks ``0..chunk_idx-1``) must already exist —
+        admissions insert chunks in order, so it does unless an
+        interleaved admission evicted it; in that case the insert is
+        dropped (returns False) rather than attaching K/V under a wrong
+        cumulative key.  Returns True when the chunk is (now) cached.
+        """
+        self._tick += 1
+        C = self.chunk_tokens
+        node = self._root
+        for i in range(chunk_idx):
+            child = node.children.get(_chunk_key(prompt, i, C))
+            if child is None:
+                return False
+            child.last_used = self._tick
+            node = child
+        key = _chunk_key(prompt, chunk_idx, C)
+        existing = node.children.get(key)
+        if existing is not None:
+            existing.last_used = self._tick
+            return True
+        k = np.asarray(k)
+        v = np.asarray(v)
+        nbytes = k.nbytes + v.nbytes
+        if nbytes > self.budget_bytes:
+            return False  # one chunk bigger than the whole pool
+        child = _Node(key, (k, v), nbytes, node)
+        child.last_used = self._tick
+        node.children[key] = child
+        if node is not self._root:
+            self._leaves.discard(node)  # gained a child: interior now
+        self._leaves.add(child)
+        self.bytes += nbytes
+        while self.bytes > self.budget_bytes and self._evict_lru():
+            pass
+        return key in node.children
+
+    def _evict_lru(self) -> bool:
+        """Drop the least-recently-used LEAF (interior nodes anchor their
+        descendants' cumulative keys and are never evicted directly)."""
+        if not self._leaves:
+            return False
+        # Tie-break equal recencies on the chunk key: set iteration order
+        # varies across processes, and eviction must stay deterministic
+        # (multihost follower replicas are future work; determinism now
+        # costs nothing and unblocks it).
+        victim = min(self._leaves, key=lambda n: (n.last_used, n.key))
+        parent = victim.parent
+        assert parent is not None
+        del parent.children[victim.key]
+        self._leaves.discard(victim)
+        if not parent.children and parent is not self._root:
+            self._leaves.add(parent)  # lost its last child: leaf again
+        self.bytes -= victim.nbytes
+        self.evictions += 1
+        if self._on_evict is not None:
+            self._on_evict(victim.nbytes)
+        return True
+
+    # -- introspection -------------------------------------------------------
+
+    def __len__(self) -> int:
+        n = 0
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            n += len(node.children)
+            stack.extend(node.children.values())
+        return n

@@ -89,8 +89,24 @@ def enable_persistent_compile_cache(
         "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs
     )
     jax.config.update("jax_compilation_cache_max_size", max_size_bytes)
+    _reset_jax_cache_singleton(jax)
     _log.info("persistent compile cache at %s", cache_dir)
     return True
+
+
+def _reset_jax_cache_singleton(jax) -> None:
+    """Drop jax's latched cache object so the new dir takes effect.
+
+    jax initializes its persistent-cache singleton on the FIRST compile
+    and never re-reads ``jax_compilation_cache_dir`` afterwards — if any
+    jit ran before this helper (or the helper runs twice with different
+    dirs), the config update is silently ignored without this reset."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # private API: absence degrades to the old behavior
+        pass
 
 
 def cache_entry_count(cache_dir: str) -> int:

@@ -162,6 +162,67 @@ def _parse_prefill_chunk(value) -> int | None:
     return chunk
 
 
+@dataclass(frozen=True)
+class PrefixCacheSpec:
+    """``spec.tpu.prefixCache``: radix-tree prompt-prefix KV reuse.
+
+    ``chunk_tokens`` is the reuse unit and must equal ``prefillChunk``
+    when both are set (the server rejects a mismatch at startup); when
+    ``prefillChunk`` is unset, enabling the cache turns on chunked
+    prefill at ``chunk_tokens``.  Disabled by default: an unannotated CR
+    behaves exactly as before.
+    """
+
+    enabled: bool = False
+    budget_mb: int = 256
+    chunk_tokens: int = 64
+
+    @classmethod
+    def from_spec(
+        cls,
+        spec: Mapping[str, Any] | None,
+        prefill_chunk: int | None = None,
+    ) -> "PrefixCacheSpec":
+        spec = spec or {}
+        enabled = bool(spec.get("enabled", False))
+        # Unset chunkTokens follows prefillChunk (the common case: one
+        # knob already set); an EXPLICIT mismatch is rejected HERE, at
+        # reconcile time, so it lands in CR status — not as a server
+        # CrashLoopBackOff from GenerationEngine's own guard.
+        chunk_tokens = spec.get("chunkTokens")
+        if chunk_tokens is None:
+            chunk_tokens = prefill_chunk or 64
+        chunk_tokens = int(chunk_tokens)
+        if (
+            enabled
+            and prefill_chunk is not None
+            and chunk_tokens != prefill_chunk
+        ):
+            raise ValueError(
+                f"prefixCache.chunkTokens {chunk_tokens} must equal "
+                f"prefillChunk {prefill_chunk} (the prefill chunk is the "
+                "prefix reuse unit); omit chunkTokens to follow prefillChunk"
+            )
+        return cls(
+            enabled=enabled,
+            budget_mb=int(spec.get("budgetMB", 256)),
+            chunk_tokens=chunk_tokens,
+        )
+
+    def __post_init__(self):
+        if self.enabled:
+            # Reject at reconcile time, not as a pod CrashLoopBackOff.
+            if self.budget_mb < 1:
+                raise ValueError(
+                    f"prefixCache.budgetMB must be >= 1, got {self.budget_mb}"
+                )
+            if self.chunk_tokens < 1:
+                raise ValueError(
+                    "prefixCache.chunkTokens must be >= 1, got "
+                    f"{self.chunk_tokens}"
+                )
+
+
 def _parse_quantize(value) -> str:
     """Reject bad quantize values at reconcile time — a typo'd CR field must
     surface in status, not as a pod CrashLoopBackOff at argparse."""
@@ -203,6 +264,9 @@ class TpuSpec:
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
     quantize: str = "none"  # none | int8 (weights) | int8kv (weights+KV cache)
     prefill_chunk: int | None = None  # chunked prefill (decode interleaving)
+    # Radix prefix KV cache: shared prompt prefixes (system prompts, chat
+    # templates) prefill once and are copied thereafter.
+    prefix_cache: PrefixCacheSpec = field(default_factory=PrefixCacheSpec)
     # Warm the FULL batch x seq-length compile grid at startup instead of
     # the edges (batch 1 / max per length).  Costs |batch buckets| x
     # |length buckets| cold compiles; buys zero first-hit compile stalls
@@ -213,6 +277,7 @@ class TpuSpec:
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
         spec = spec or {}
         mesh = dict(spec.get("meshShape") or {"dp": 1, "tp": 8})
+        prefill_chunk = _parse_prefill_chunk(spec.get("prefillChunk"))
         return cls(
             topology=str(spec.get("tpuTopology", "v5e-8")),
             mesh_shape=mesh,
@@ -226,7 +291,10 @@ class TpuSpec:
             max_inflight_batches=int(spec.get("maxInflightBatches", 2)),
             compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
             quantize=_parse_quantize(spec.get("quantize", "none")),
-            prefill_chunk=_parse_prefill_chunk(spec.get("prefillChunk")),
+            prefill_chunk=prefill_chunk,
+            prefix_cache=PrefixCacheSpec.from_spec(
+                spec.get("prefixCache"), prefill_chunk=prefill_chunk
+            ),
             warmup_full_grid=bool(spec.get("warmupFullGrid", False)),
         )
 

@@ -5,7 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec
-from jax import shard_map
+
+from tpumlops.parallel import shard_map_compat as shard_map
 
 from tpumlops.parallel import (
     AXIS_DATA,
