@@ -12,7 +12,7 @@ TAG      ?= latest
 DOCKER   ?= docker
 
 .PHONY: images operator-image server-image router-image router-bin \
-        install uninstall test test-fast test-e2e test-all verify bench
+        install uninstall test test-fast test-e2e test-all lint verify bench
 
 images: operator-image server-image router-image
 
@@ -61,10 +61,23 @@ test-e2e:
 test-all:
 	python -m pytest tests/ -x -q
 
+# Ruff (config in pyproject.toml [tool.ruff]): pyflakes/pycodestyle
+# error classes over the first-party tree.  Soft dependency — the
+# serving image does not bake a linter, so environments without ruff
+# skip with a notice instead of failing verify (CI images install it:
+# `pip install ruff`).
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check .; \
+	else \
+	  echo "lint: ruff not installed; skipping (pip install ruff)"; \
+	fi
+
 # The EXACT tier-1 command from ROADMAP.md (the driver's acceptance
-# gate): not-slow tranche, collection errors tolerated, 870 s wall cap,
-# DOTS_PASSED echoed from the captured dot lines.
-verify:
+# gate) chained behind lint: not-slow tranche, collection errors
+# tolerated, 870 s wall cap, DOTS_PASSED echoed from the captured dot
+# lines.
+verify: lint
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

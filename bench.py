@@ -29,6 +29,11 @@ north star:
   engine scheduler: TTFT cold vs warm and the prefill-chunk-call drop
   when the radix prefix KV cache reuses a cached prompt prefix
   (server/prefix_cache.py).
+- ``speculative_serving`` — self-speculative n-gram decoding through
+  the engine scheduler: tokens/s, acceptance rate, accepted-length
+  distribution, and decode forwards per emitted token (< 1 = the HBM
+  weight stream amortized) on a repetitive corpus and a random-token
+  worst case (server/speculative.py).
 - ``llama_1p35b_decode`` — decode slot ladder 8..64 (int8 weights + int8
   KV + windowed attention) with HBM bw_util and an int8kv logit-parity
   gate (models/llama.py, server/generation.py).
@@ -1268,6 +1273,163 @@ def bench_prefix_cache() -> dict:
     }
 
 
+def bench_speculative() -> dict:
+    """Self-speculative n-gram decoding through the real engine scheduler
+    (server/speculative.py + models/llama.verify_ragged).
+
+    Decode streams the full weight tree per tick; speculation verifies k
+    drafted tokens in ONE forward, so accepted drafts multiply tokens
+    per weight stream.  Two corpora bound the behavior:
+
+    - ``repetitive``: prefixes of the model's own greedy rollouts.
+      Untrained greedy trajectories collapse into short cycles, so the
+      continuation re-emits spans already in the context — exactly the
+      structure prompt-lookup drafting converts (stand-in for templated
+      /extraction traffic on a trained model).
+    - ``random``: uniform token prompts, the adversarial case — drafts
+      rarely match and the adaptive controller parks slots back onto the
+      plain single-token step.
+
+    The environment-independent signal is ``forwards_per_token`` (decode
+    dispatches / decode-emitted tokens): < 1 means the weight stream was
+    amortized end-to-end.  Engine-loop tok/s rides this environment's
+    ~65 ms/dispatch tunnel — which UNDERSTATES the on-host win less than
+    it distorts raw latency, since speculation's whole effect is fewer
+    dispatches per token."""
+    jax = _setup_jax()
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+    from tpumlops.server.speculative import SpeculativeConfig
+
+    cfg = llama.LlamaConfig(
+        vocab_size=4000, hidden_size=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, intermediate_size=704, max_seq=256,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    N_REQ, PROMPT, NEW, DRAFT = 4, 64, 48, 4
+
+    def run_corpora(engine, corpora, acc_pairs=None):
+        out = {}
+        for name, corpus in corpora.items():
+            if acc_pairs is not None:
+                acc_pairs.clear()
+            f0, tk0 = engine.decode_forwards, engine.decode_tokens
+            p0, a0 = engine.spec_proposed_tokens, engine.spec_accepted_tokens
+            t0 = time.perf_counter()
+            futs = [engine.submit(p, NEW) for p in corpus]
+            toks = [np.asarray(f.result(timeout=600)).tolist() for f in futs]
+            wall = time.perf_counter() - t0
+            emitted = engine.decode_tokens - tk0
+            forwards = engine.decode_forwards - f0
+            proposed = engine.spec_proposed_tokens - p0
+            accepted = engine.spec_accepted_tokens - a0
+            hist: dict[int, int] = {}
+            for _, a in acc_pairs or ():
+                hist[a] = hist.get(a, 0) + 1
+            out[name] = {
+                "wall_s": round(wall, 2),
+                "tok_per_s": round(N_REQ * NEW / wall, 1),
+                "forwards": forwards,
+                "emitted_tokens": emitted,
+                "forwards_per_token": round(forwards / max(1, emitted), 3),
+                "acceptance_rate": (
+                    round(accepted / proposed, 3) if proposed else None
+                ),
+                "proposed": proposed,
+                "accepted": accepted,
+                "accepted_len_hist": {str(k): v for k, v in sorted(hist.items())},
+                "outputs": toks,
+            }
+        return out
+
+    # Corpus construction + the non-speculative baseline, one engine.
+    base = GenerationEngine(params, cfg, max_slots=4, dtype=jnp.bfloat16)
+    base.start(warmup=True)
+    try:
+        templated = []
+        for i in range(N_REQ):
+            roll = np.asarray(
+                base.generate([17 + i], PROMPT + 30, timeout=600)
+            ).tolist()
+            templated.append(([17 + i] + roll)[:PROMPT])
+        rng = np.random.default_rng(0)
+        corpora = {
+            "repetitive": templated,
+            "random": [
+                rng.integers(1, cfg.vocab_size, size=PROMPT).tolist()
+                for _ in range(N_REQ)
+            ],
+        }
+        plain = run_corpora(base, corpora)
+    finally:
+        base.shutdown()
+
+    acc_pairs: list = []
+    engine = GenerationEngine(
+        params, cfg, max_slots=4, dtype=jnp.bfloat16,
+        speculative=SpeculativeConfig(
+            enabled=True, draft_tokens=DRAFT, ngram_min=1, ngram_max=4,
+            adaptive=True,
+        ),
+        on_spec=lambda p, a: acc_pairs.append((p, a)),
+    )
+    engine.start(warmup=True)
+    try:
+        spec = run_corpora(engine, corpora, acc_pairs)
+    finally:
+        engine.shutdown()
+
+    rep, rnd = spec["repetitive"], spec["random"]
+    # The acceptance bar: on the repetitive corpus the weight stream must
+    # be amortized END TO END — fewer decode forwards than emitted tokens.
+    assert rep["forwards_per_token"] < 1.0, rep
+    for name in corpora:
+        # bf16 near-tie argmaxes can differ between the 1-token and
+        # k+1-token programs; report agreement rather than assert it
+        # (the f64 bit-identity proof lives in tests/test_speculative.py).
+        a = [t for o in plain[name]["outputs"] for t in o]
+        b = [t for o in spec[name]["outputs"] for t in o]
+        spec[name]["token_agreement"] = round(
+            float(np.mean([x == y for x, y in zip(a, b)])), 3
+        )
+        del plain[name]["outputs"], spec[name]["outputs"]
+
+    return {
+        "draft_tokens": DRAFT,
+        "requests": N_REQ,
+        "new_tokens_per_request": NEW,
+        "rep_forwards_per_token": rep["forwards_per_token"],
+        "rep_acceptance_rate": rep["acceptance_rate"],
+        "rep_tok_per_s": rep["tok_per_s"],
+        "rnd_forwards_per_token": rnd["forwards_per_token"],
+        # Same batching on both sides, so the plain engine's ratio (1 /
+        # active slots) is the baseline the speculative drop is read
+        # against.
+        "plain_forwards_per_token": plain["repetitive"]["forwards_per_token"],
+        "speedup_vs_plain_repetitive": round(
+            plain["repetitive"]["wall_s"] / rep["wall_s"], 2
+        ),
+        "speedup_vs_plain_random": round(
+            plain["random"]["wall_s"] / rnd["wall_s"], 2
+        ),
+        "plain": plain,
+        "speculative": spec,
+        "note": (
+            "engine-loop walls ride the dev tunnel's ~65 ms/dispatch; "
+            "forwards_per_token is the environment-independent number "
+            "(each forward is one full HBM weight stream)"
+        ),
+    }
+
+
 def bench_llama_decode() -> dict:
     """Continuous-batching decode at a 1.35B shape: int8 weights + int8 KV
     cache + windowed attention, slots laddered 8..64 (VERDICT r2 #2).
@@ -1668,6 +1830,9 @@ _COMPACT_KEYS = {
         "device_tok_per_s", "slots", "bw_util_at_best"),
     "prefix_cache_serving": (
         "cold_ttft_ms", "warm_ttft_ms", "chunks_cold", "chunks_warm"),
+    "speculative_serving": (
+        "rep_forwards_per_token", "plain_forwards_per_token",
+        "rep_acceptance_rate", "speedup_vs_plain_repetitive"),
     "serve_path_http": (
         "server_queue_mean_ms", "server_device_run_mean_ms",
         "server_pipeline_wait_mean_ms", "server_observed_mean_ms",
@@ -1857,6 +2022,7 @@ def main() -> None:
         ("xgboost_forest", bench_xgboost),
         ("resnet50", bench_resnet),
         ("prefix_cache_serving", bench_prefix_cache),
+        ("speculative_serving", bench_speculative),
         ("llama_1p35b_decode", bench_llama_decode),
         ("serve_path_http", bench_serve_path),
         ("llama_7b_decode", bench_llama_7b_decode),

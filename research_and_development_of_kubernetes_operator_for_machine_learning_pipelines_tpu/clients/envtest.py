@@ -36,7 +36,6 @@ Runs entirely on loopback TCP — the same bytes a real apiserver would see.
 from __future__ import annotations
 
 import json
-import queue
 import threading
 import time
 import uuid

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import warnings
 from dataclasses import dataclass
-from typing import Any, NamedTuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +57,12 @@ from .quantization import dequantize_tensor, is_quantized
 # (_block_decode_deferred), i.e. the attention impl varies per window
 # bucket within one stream.  Harmless for the default ("auto" -> xla);
 # A/B runs labeled "pallas_vpu" should pin a 128-multiple window.
+# NOTE (speculative verify): the multi-token verify layer
+# (_block_verify_deferred) always uses the XLA einsum chain — the
+# Pallas kernels are single-query formulations.  No cost under the
+# measured default (auto -> xla everywhere), but an opt-in pallas*
+# config combined with spec.tpu.speculative runs verify ticks on XLA
+# while plain ticks run the kernel; pin one or the other for A/B runs.
 _DECODE_ATTN = "auto"
 
 _DECODE_ATTN_IMPLS = ("auto", "xla", "pallas", "pallas_single", "pallas_vpu")
@@ -312,13 +318,13 @@ def from_torch(torch_model, cfg: LlamaConfig) -> dict:
 
 
 def rope_cos_sin(positions: jax.Array, cfg: LlamaConfig, dtype=jnp.float32):
-    """cos/sin tables for ``positions`` [S] -> each [S, head_dim]."""
+    """cos/sin tables for ``positions`` [S] (or [B, S]) -> [..., head_dim]."""
     hd = cfg.head_dim
     inv_freq = 1.0 / (
         cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
     )
-    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, hd/2]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, hd]
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., hd]
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
 
 
@@ -847,6 +853,271 @@ def decode_ragged(
     )
     return _finish_decode(
         params, x, k_news, v_news, cache, lengths, active, quant, cfg
+    )
+
+
+def _block_verify_deferred(
+    x: jax.Array,
+    lp: dict,
+    cache_k,
+    cache_v,
+    cos: jax.Array,
+    sin: jax.Array,
+    mask_bias: jax.Array,
+    chunk_bias: jax.Array,
+    cfg: LlamaConfig,
+    window: int,
+):
+    """One decoder layer for MULTI-token ragged verify with the cache
+    READ-ONLY: ``x`` is ``[B, S, H]`` where row ``i``'s S tokens sit at
+    positions ``lengths[i] .. lengths[i]+S-1``.  Returns ``(y, k_new,
+    v_new)`` with the chunk's fresh K/V ``[B, S, NKV, D]`` — the caller
+    commits every layer with one scatter pass after the scan, exactly
+    like :func:`_block_decode_deferred` (whose S == 1 case this
+    generalizes; see that docstring for the deferred-write traffic
+    argument).
+
+    Attention decomposes into two exact terms: the cache window (strict
+    mask ``key_pos < lengths[i]`` — no chunk position has been written
+    yet) and an in-chunk causal term over the S fresh K/V rows
+    (``chunk_bias``: key j attends query q iff ``j <= q``), joined in
+    one softmax.  This is what verifies k draft tokens under ONE weight
+    stream instead of k sequential decode steps.
+    """
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = _qmatmul(xn, lp["q"])
+    k = _qmatmul(xn, lp["k"])
+    v = _qmatmul(xn, lp["v"])
+    q = q.astype(x.dtype).reshape(b, s, nh, hd)
+    k = k.astype(x.dtype).reshape(b, s, nkv, hd)
+    v = v.astype(x.dtype).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    group = nh // nkv
+    qg = q.reshape(b, s, nkv, group, hd)
+    quant_cache = isinstance(cache_k, tuple)
+    if quant_cache:
+        k8, ks = cache_k
+        v8, vs = cache_v
+        k8, ks = k8[:, :, :window], ks[:, :, :window]
+        v8, vs = v8[:, :, :window], vs[:, :, :window]
+        scores = jnp.einsum(
+            "bqngd,bnkd->bngqk",
+            qg,
+            k8.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(jnp.float32(hd))
+        kscale = ks[..., 0][:, :, None, None, :]
+        scores = scores * kscale
+    else:
+        kk = cache_k[:, :, :window].astype(x.dtype)
+        scores = jnp.einsum(
+            "bqngd,bnkd->bngqk", qg, kk, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.float32(hd))
+    scores = scores + mask_bias[:, None]  # [B,1,1,W] -> over (n, g, q)
+
+    # In-chunk causal scores over the fresh (not-yet-written) K rows.
+    # Only the SELF position (j == q) may use the exact full-precision
+    # term — that mirrors _block_decode_deferred, where the current
+    # token is attended in-flight.  Every EARLIER chunk position was, on
+    # the sequential path, already committed to the cache before being
+    # attended — on the int8 cache that means a quantize round-trip —
+    # so the chunk term must read those positions through the same
+    # round-trip (raw int8 contraction, scales folded out, exactly like
+    # the cache-window term above) or verify logits diverge from plain
+    # int8kv decode by the QUANTIZATION error, not mere reduction
+    # rounding, and near-tie argmaxes break token parity.
+    score_self = jnp.einsum(
+        "bqngd,bjnd->bngqj", qg, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(hd))
+    if quant_cache:
+        k8c, kscc = _quant_kv(k)  # [B,S,NKV,D] / [B,S,NKV,1]
+        score_rt = jnp.einsum(
+            "bqngd,bjnd->bngqj",
+            qg,
+            k8c.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(jnp.float32(hd))
+        kscale_c = jnp.moveaxis(kscc[..., 0], 1, 2)[:, :, None, None, :]
+        score_rt = score_rt * kscale_c
+        eye = jnp.eye(s, dtype=bool)[None, None, None]
+        score_chunk = jnp.where(eye, score_self, score_rt)
+    else:
+        score_chunk = score_self
+    score_chunk = score_chunk + chunk_bias  # [1,1,1,S,S]
+    full = jnp.concatenate([scores, score_chunk], axis=-1)
+    probs = jax.nn.softmax(full, axis=-1)
+    probs_cache, probs_chunk = probs[..., :-s], probs[..., -s:]
+
+    if quant_cache:
+        vscale = vs[..., 0][:, :, None, None, :]
+        probs_cache = (probs_cache * vscale).astype(x.dtype)
+        ctx = jnp.einsum("bngqk,bnkd->bqngd", probs_cache, v8.astype(x.dtype))
+        # Chunk V: self row full-precision, earlier rows through the
+        # int8 round-trip (scales folded into the probabilities, like
+        # the cache-window term).
+        v8c, vscc = _quant_kv(v)
+        vscale_c = jnp.moveaxis(vscc[..., 0], 1, 2)[:, :, None, None, :]
+        eyef = eye.astype(probs.dtype)
+        ctx = ctx + jnp.einsum(
+            "bngqj,bjnd->bqngd", (probs_chunk * eyef).astype(x.dtype), v
+        )
+        ctx = ctx + jnp.einsum(
+            "bngqj,bjnd->bqngd",
+            (probs_chunk * (1.0 - eyef) * vscale_c).astype(x.dtype),
+            v8c.astype(x.dtype),
+        )
+    else:
+        vv = cache_v[:, :, :window].astype(x.dtype)
+        ctx = jnp.einsum("bngqk,bnkd->bqngd", probs_cache.astype(x.dtype), vv)
+        ctx = ctx + jnp.einsum(
+            "bngqj,bjnd->bqngd", probs_chunk.astype(x.dtype), v
+        )
+    ctx = ctx.reshape(b, s, nh * hd)
+
+    attn_out = _qmatmul(ctx, lp["o"]).astype(x.dtype)
+    x = x + attn_out
+    xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    gate = _qmatmul(xn, lp["gate"])
+    up = _qmatmul(xn, lp["up"])
+    act = jax.nn.silu(gate) * up
+    down = _qmatmul(act.astype(x.dtype), lp["down"]).astype(x.dtype)
+    return x + down, k, v
+
+
+def verify_ragged(
+    params: dict,
+    token_ids: jax.Array,
+    cache: "RaggedKVCache | QuantRaggedKVCache",
+    cfg: LlamaConfig,
+    dtype=jnp.bfloat16,
+    window: int | None = None,
+):
+    """Score S tokens per slot in ONE forward (self-speculative verify).
+
+    ``token_ids`` is ``[B, S]``: row ``i``'s column 0 is the slot's last
+    emitted (pending) token and columns ``1..S-1`` are drafted
+    continuations; position ``j`` occupies absolute position
+    ``lengths[i] + j``.  Returns ``(logits [B, S, vocab] float32, cache)``
+    with every chunk position's K/V committed but ``lengths`` UNCHANGED —
+    the caller advances each row by its accepted count + 1, which IS the
+    rollback of rejected writes: positions at or beyond the truncated
+    length are never attended (the cache mask is strict) and are
+    overwritten by later writes before the sequence reaches them — the
+    same invariant that makes slot reuse safe (see :func:`decode_ragged`).
+
+    One compiled variant per (S, window) pair; S = 1 degenerates to a
+    single-token decode step (the engine uses :func:`decode_ragged`
+    there — this path exists for the draft lengths).
+    """
+    b, s = token_ids.shape
+    quant = isinstance(cache, QuantRaggedKVCache)
+    lengths = cache.lengths
+    x = jnp.take(params["embed"], token_ids, axis=0).astype(dtype)
+
+    positions = lengths[:, None] + jnp.arange(s)[None, :]  # [B, S]
+    cos, sin = rope_cos_sin(positions, cfg, jnp.float32)  # [B, S, head_dim]
+
+    capacity = (cache.k8 if quant else cache.k).shape[3]
+    if window is None:
+        window = capacity
+    window = min(int(window), capacity)
+    key_pos = jnp.arange(window)
+    # STRICT cache mask shared by every chunk query: no chunk position has
+    # been written yet, so all of them see exactly key_pos < lengths[i];
+    # positions lengths[i]..lengths[i]+q-1 are the chunk's own earlier
+    # tokens, attended through the exact in-chunk term.
+    valid = key_pos[None, :] < lengths[:, None]  # [B, W]
+    mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[:, None, None]
+    qpos = jnp.arange(s)
+    chunk_causal = qpos[:, None] >= qpos[None, :]  # key j <= query q
+    chunk_bias = jnp.where(chunk_causal, 0.0, -1e9).astype(jnp.float32)[
+        None, None, None
+    ]
+
+    nlayers = cfg.num_layers
+    kv_dtype = x.dtype
+    acc_k = jnp.zeros((nlayers, b, s, cfg.num_kv_heads, cfg.head_dim), kv_dtype)
+    acc_v = jnp.zeros_like(acc_k)
+
+    def idx(tree, l):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, l, axis=0, keepdims=False),
+            tree,
+        )
+
+    def layer_body(l, carry):
+        x, acc_k, acc_v = carry
+        lp = idx(params["layers"], l)
+        if quant:
+            ck = (
+                lax.dynamic_index_in_dim(cache.k8, l, 0, keepdims=False),
+                lax.dynamic_index_in_dim(cache.k_scale, l, 0, keepdims=False),
+            )
+            cv = (
+                lax.dynamic_index_in_dim(cache.v8, l, 0, keepdims=False),
+                lax.dynamic_index_in_dim(cache.v_scale, l, 0, keepdims=False),
+            )
+        else:
+            ck = lax.dynamic_index_in_dim(cache.k, l, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cache.v, l, 0, keepdims=False)
+        y, k_new, v_new = _block_verify_deferred(
+            x, lp, ck, cv, cos, sin, mask_bias, chunk_bias, cfg, window=window
+        )
+        acc_k = lax.dynamic_update_slice_in_dim(
+            acc_k, k_new[None].astype(kv_dtype), l, axis=0
+        )
+        acc_v = lax.dynamic_update_slice_in_dim(
+            acc_v, v_new[None].astype(kv_dtype), l, axis=0
+        )
+        return y, acc_k, acc_v
+
+    x, k_news, v_news = lax.fori_loop(0, nlayers, layer_body, (x, acc_k, acc_v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = _qmatmul(x, params["lm_head"])
+    return logits, _commit_chunk(cache, k_news, v_news, lengths, quant)
+
+
+def _commit_chunk(cache, k_news, v_news, lengths, quant):
+    """Commit a verify chunk's K/V: row ``b``'s token ``j`` lands at
+    position ``lengths[b] + j``, ONE batched drop-scatter per buffer
+    over the ``[B, S]`` index grid — sequential per-``j`` passes would
+    re-pay the scatter's full-buffer walk S times (the round-5 commit
+    measurements put one pass at ~3.8 ms at the 1.35B/32-slot shape),
+    taxing exactly the tick speculation exists to accelerate.
+    ``lengths`` is returned UNCHANGED: acceptance decides the advance."""
+    s = k_news.shape[2]
+
+    def commit(buf, vals):
+        # buf [L, B, NKV, T, ...]; vals [L, B, S, NKV, ...].  Advanced
+        # indices rows [B,1] (axis 1) and positions [B,S] (axis 3)
+        # broadcast to [B, S] and move to the front: updates are
+        # [B, S, L, NKV, ...].  Indices stay unique (distinct j per
+        # row); rows spilling past capacity drop, never clamp.
+        b = buf.shape[1]
+        rows = jnp.arange(b)[:, None]
+        pos = lengths[:, None] + jnp.arange(s)[None, :]
+        v = jnp.moveaxis(vals, (1, 2), (0, 1)).astype(buf.dtype)
+        return buf.at[:, rows, :, pos].set(
+            v, mode="drop", unique_indices=True
+        )
+
+    if quant:
+        kq, kqs = _quant_kv(k_news)
+        vq, vqs = _quant_kv(v_news)
+        return QuantRaggedKVCache(
+            commit(cache.k8, kq),
+            commit(cache.k_scale, kqs),
+            commit(cache.v8, vq),
+            commit(cache.v_scale, vqs),
+            lengths,
+        )
+    return RaggedKVCache(
+        commit(cache.k, k_news), commit(cache.v, v_news), lengths
     )
 
 

@@ -77,6 +77,45 @@ def sample_logits(
     return jnp.where(temperature > 0, sampled_tok, greedy_tok)
 
 
+def speculative_accept(
+    tokens: jax.Array,
+    greedy: jax.Array,
+    draft_len: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Exact greedy acceptance for self-speculative verify.
+
+    ``tokens`` int32 ``[B, S]``: column 0 is the row's last emitted
+    (pending) token, columns ``1..S-1`` the drafted continuation (padded
+    past ``draft_len``).  ``greedy`` int32 ``[B, S]`` is the verify
+    forward's argmax at each position — ``greedy[:, j]`` is the model's
+    true next token AFTER ``tokens[:, j]``.  ``draft_len`` int32 ``[B]``
+    caps acceptance at each row's REAL draft count (padding can match by
+    coincidence, but a matching token is by definition the greedy token —
+    the cap only exists so rows never accept positions they did not
+    propose, e.g. when their remaining-token budget is short).
+
+    Returns ``(accepted, next_token)``: ``accepted[i]`` in
+    ``[0, draft_len[i]]`` is the longest draft prefix that agrees with
+    greedy argmax, and ``next_token[i] = greedy[i, accepted[i]]`` is the
+    bonus token — emitted tokens are the accepted drafts plus this one,
+    so every verify yields at least one token (never slower in tokens
+    per forward than the plain step).  Greedy-exact by construction:
+    accepted tokens ARE the argmax chain the non-speculative path would
+    have produced.
+    """
+    b, s = tokens.shape
+    if s == 1:
+        return jnp.zeros((b,), jnp.int32), greedy[:, 0]
+    match = (tokens[:, 1:] == greedy[:, :-1]).astype(jnp.int32)  # [B, S-1]
+    prefix = jnp.cumprod(match, axis=-1)
+    in_budget = (jnp.arange(s - 1)[None, :] < draft_len[:, None]).astype(
+        jnp.int32
+    )
+    accepted = jnp.sum(prefix * in_budget, axis=-1).astype(jnp.int32)
+    nxt = jnp.take_along_axis(greedy, accepted[:, None], axis=1)[:, 0]
+    return accepted, nxt.astype(jnp.int32)
+
+
 def split_keys(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Advance a batch of per-row PRNG keys: returns (carry, use)."""
     pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)

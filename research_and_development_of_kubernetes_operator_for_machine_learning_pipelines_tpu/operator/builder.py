@@ -109,6 +109,11 @@ def _tpu_pod_spec(
             "--prefix-cache", "1" if tpu.prefix_cache.enabled else "0",
             "--prefix-cache-budget-mb", str(tpu.prefix_cache.budget_mb),
             "--prefix-cache-chunk", str(tpu.prefix_cache.chunk_tokens),
+            "--speculative", "1" if tpu.speculative.enabled else "0",
+            "--speculative-draft-tokens", str(tpu.speculative.draft_tokens),
+            "--speculative-ngram-min", str(tpu.speculative.ngram_min),
+            "--speculative-ngram-max", str(tpu.speculative.ngram_max),
+            "--speculative-adaptive", "1" if tpu.speculative.adaptive else "0",
         ],
         "env": [
             {"name": "TPU_TOPOLOGY", "value": tpu.topology},

@@ -59,6 +59,23 @@ _NP_TO_V2 = {
 }
 
 
+# Recognized /generate parameters.  Unknown keys 400 instead of being
+# silently ignored — a typo'd knob ("max_new_token") quietly generating
+# the default is the worst failure mode for a client.  The check itself
+# is the CRD-side unknown-key rejection (utils/config), so the error
+# contract (key named + allowed set) stays spelled once.
+_GEN_PARAM_KEYS = frozenset(
+    {"max_new_tokens", "eos_id", "temperature", "top_k", "top_p", "seed",
+     "stream"}
+)
+
+
+def _check_gen_params(params: dict, allowed: frozenset) -> None:
+    from ..utils.config import _reject_unknown_keys
+
+    _reject_unknown_keys(params, allowed, "generate parameters")
+
+
 class TpuInferenceServer:
     def __init__(
         self,
@@ -291,10 +308,14 @@ class TpuInferenceServer:
                     # "lengths" if 0 is a real token in your vocabulary).
                     prompts = [np.trim_zeros(row, "b") for row in rows]
                 params = body.get("parameters", {})
+                _check_gen_params(params, _GEN_PARAM_KEYS)
             else:
                 raw = body["prompt_ids"]
                 prompts = [raw] if raw and np.isscalar(raw[0]) else list(raw)
                 params = body
+                _check_gen_params(
+                    params, _GEN_PARAM_KEYS | {"prompt_ids", "id"}
+                )
             if not prompts:  # covers both forms (zero-row tensor, empty list)
                 raise ValueError("prompt_ids is empty")
             max_new = int(params.get("max_new_tokens", 16))
@@ -612,6 +633,20 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
             budget_bytes=config.tpu.prefix_cache.budget_mb * 2**20,
             chunk_tokens=config.tpu.prefix_cache.chunk_tokens,
         )
+    speculative = None
+    if config.tpu.speculative.enabled:
+        from .speculative import SpeculativeConfig
+
+        # Same draft geometry on leader and followers (this one
+        # construction site): a verify tick is replayed in lockstep, so
+        # the compiled (draft length, window) variants must agree.
+        speculative = SpeculativeConfig(
+            enabled=True,
+            draft_tokens=config.tpu.speculative.draft_tokens,
+            ngram_min=config.tpu.speculative.ngram_min,
+            ngram_max=config.tpu.speculative.ngram_max,
+            adaptive=config.tpu.speculative.adaptive,
+        )
     return GenerationEngine(
         predictor.causal_lm["params"],
         predictor.causal_lm["cfg"],
@@ -628,6 +663,8 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
         prefix_cache=prefix_cache,
         on_prefix_hit=metrics.observe_prefix_hit if metrics else None,
         on_prefix_evict=metrics.inc_prefix_evictions if metrics else None,
+        speculative=speculative,
+        on_spec=metrics.observe_speculative if metrics else None,
     )
 
 
@@ -764,6 +801,39 @@ def main(argv: list[str] | None = None) -> None:
         "--prefill-chunk is rejected at startup",
     )
     ap.add_argument(
+        "--speculative",
+        type=int,
+        default=0,
+        help="1 enables self-speculative n-gram decoding (draft from the "
+        "sequence's own history, verify k+1 positions per weight stream; "
+        "greedy-exact output)",
+    )
+    ap.add_argument(
+        "--speculative-draft-tokens",
+        type=int,
+        default=4,
+        help="max draft tokens per slot per verify tick",
+    )
+    ap.add_argument(
+        "--speculative-ngram-min",
+        type=int,
+        default=1,
+        help="shortest history suffix the n-gram drafter may match",
+    )
+    ap.add_argument(
+        "--speculative-ngram-max",
+        type=int,
+        default=4,
+        help="longest history suffix tried first",
+    )
+    ap.add_argument(
+        "--speculative-adaptive",
+        type=int,
+        default=1,
+        help="1: per-slot draft length halves on consecutive zero-accept "
+        "verifies and regrows on success; 0: fixed draft length",
+    )
+    ap.add_argument(
         "--quantize",
         default="none",
         choices=["none", "int8", "int8kv"],
@@ -807,6 +877,13 @@ def main(argv: list[str] | None = None) -> None:
                     "enabled": bool(args.prefix_cache),
                     "budgetMB": args.prefix_cache_budget_mb,
                     "chunkTokens": args.prefix_cache_chunk or None,
+                },
+                "speculative": {
+                    "enabled": bool(args.speculative),
+                    "draftTokens": args.speculative_draft_tokens,
+                    "ngramMin": args.speculative_ngram_min,
+                    "ngramMax": args.speculative_ngram_max,
+                    "adaptive": bool(args.speculative_adaptive),
                 },
             }
         ),

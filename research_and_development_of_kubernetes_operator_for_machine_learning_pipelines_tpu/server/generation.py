@@ -127,6 +127,16 @@ class _Slot:
     prompt_len: int = 0  # for the decode attention window (host mirror)
     generated: list[int] = field(default_factory=list)
     t_start: float = 0.0
+    # Self-speculative decoding (engine speculative config only): an
+    # incrementally-appended history buffer holding prompt + generated
+    # tokens (the drafter context, built WITHOUT a per-tick
+    # re-concatenation — at long context that copy would be serial
+    # scheduler-thread work ahead of every dispatch; the prompt alone is
+    # ``history[:prompt_len]``), and the slot's adaptive draft budget.
+    # Both None when speculation is disabled.
+    history: np.ndarray | None = None  # int64 [capacity]; valid: [:hist_len]
+    hist_len: int = 0
+    draft: "object | None" = None  # speculative.DraftState
 
 
 @dataclass
@@ -176,7 +186,7 @@ class GenerationEngine:
         max_slots: int = 4,
         dtype=None,
         eos_id: int | None = None,
-        on_step: Callable[[int, float], None] | None = None,
+        on_step: Callable[[int, float, int], None] | None = None,
         on_tokens: Callable[[int], None] | None = None,
         channel=None,
         kv_quant: bool = False,
@@ -184,6 +194,8 @@ class GenerationEngine:
         prefix_cache=None,  # PrefixCacheConfig | None
         on_prefix_hit: Callable[[int], None] | None = None,
         on_prefix_evict: Callable[[], None] | None = None,
+        speculative=None,  # speculative.SpeculativeConfig | None
+        on_spec: Callable[[int, int], None] | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -193,7 +205,8 @@ class GenerationEngine:
         self._params = params
         self._cfg = cfg
         self._eos_default = eos_id
-        self._on_step = on_step  # (active_slots, step_seconds) per decode tick
+        # (active_slots, step_seconds, queue_depth) per decode/verify tick
+        self._on_step = on_step
         self._on_tokens = on_tokens  # (n,) per token delivered to a client
         # multihost.UnitChannel: leader broadcasts every device call so
         # follower processes replay it in lockstep (None = single-host).
@@ -253,6 +266,31 @@ class GenerationEngine:
                 chunk_tokens=self._prefill_chunk_size,
                 on_evict=self._note_prefix_evict,
             )
+        # Self-speculative n-gram decoding: disabled (None) = byte-for-byte
+        # the plain single-token tick.  Enabled: greedy-only ticks draft up
+        # to draft_tokens continuations per slot from the slot's own
+        # history and verify them in ONE batched forward (_verify below);
+        # any tick with a sampling slot falls back to the plain step —
+        # exact acceptance is a greedy-argmax rule.
+        self._spec = None
+        self._spec_chain: tuple[int, ...] = ()
+        self._on_spec = on_spec
+        if speculative is not None and speculative.enabled:
+            from .speculative import draft_chain
+
+            dt = int(speculative.draft_tokens)
+            if dt < 1:
+                raise ValueError(
+                    f"speculative.draftTokens must be >= 1, got {dt}"
+                )
+            if not (1 <= int(speculative.ngram_min) <= int(speculative.ngram_max)):
+                raise ValueError(
+                    "speculative ngram bounds must satisfy "
+                    f"1 <= ngramMin <= ngramMax, got "
+                    f"[{speculative.ngram_min}, {speculative.ngram_max}]"
+                )
+            self._spec = speculative
+            self._spec_chain = draft_chain(dt)
         self._reset_device_state()
 
         def make_cache(k, v, lengths):
@@ -305,6 +343,35 @@ class GenerationEngine:
 
         self._decode_greedy = jax.jit(
             _decode_greedy, donate_argnums=(2, 3), static_argnums=(6,)
+        )
+
+        def _verify(params, toks, k, v, lengths, active, draft_len, window):
+            # Self-speculative verify: toks [B, S] (col 0 = pending token,
+            # cols 1.. = draft, padded past draft_len).  ONE forward
+            # scores all S positions per slot; acceptance is exact greedy
+            # argmax, so emitted tokens are bit-identical to S sequential
+            # _decode_greedy steps — but the weight tree streams from HBM
+            # once instead of up to S times.  Rejected K/V writes roll
+            # back by PER-ROW LENGTH TRUNCATION: lengths advance only by
+            # accepted+1, and positions at/beyond the truncated length
+            # are never attended before being overwritten (the same
+            # invariant that makes slot reuse safe).  One compiled
+            # variant per (S, window); K/V donated like _decode.
+            from ..models.sampling import speculative_accept
+
+            cache = make_cache(k, v, lengths)
+            logits, cache = llama.verify_ragged(
+                params, toks, cache, cfg, dtype=dtype, window=window
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+            accepted, nxt = speculative_accept(toks, greedy, draft_len)
+            toks2 = jnp.where(active, nxt, toks[:, 0])[:, None]
+            advance = jnp.where(active, accepted + 1, 0).astype(jnp.int32)
+            ck, cv = cache_repr(cache)
+            return toks2, ck, cv, cache.lengths + advance, greedy, accepted
+
+        self._verify = jax.jit(
+            _verify, donate_argnums=(2, 3), static_argnums=(7,)
         )
 
         def _prefill_insert(
@@ -427,6 +494,18 @@ class GenerationEngine:
         self.prefix_cached_tokens = 0
         self.prefix_evictions = 0
         self.prefill_chunks_dispatched = 0
+        # Speculative observability (also read by bench.py's
+        # speculative_serving scenario): decode_forwards counts every
+        # weight-streaming decode/verify dispatch, decode_tokens every
+        # token those dispatches emitted.  Without speculation the ratio
+        # is exactly 1/(active slots); acceptance drives it lower still —
+        # each accepted draft is a token the weight stream did not have
+        # to be re-paid for.
+        self.decode_forwards = 0
+        self.decode_tokens = 0
+        self.spec_verify_ticks = 0
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
 
     def _reset_device_state(self) -> None:
         """(Re)allocate the KV cache and token buffers.
@@ -532,6 +611,22 @@ class GenerationEngine:
                     continue  # both variants already compiled above
                 self._dispatch_step(inactive, window, False)
                 self._dispatch_step(inactive, window, True)
+            if self._spec is not None:
+                # Verify variants: one executable per (draft length,
+                # window) pair — draft lengths are capped to the halving
+                # chain so this sweep stays |chain| x |buckets|, not
+                # draftTokens x |buckets|.  Dispatched (not raw): lazy
+                # compiles on a follower would stall the whole slice at
+                # the first live verify.
+                zero_draft = np.zeros((self.max_slots,), np.int32)
+                for window in decode_window_buckets(self.capacity):
+                    for s_draft in self._spec_chain:
+                        toks = np.zeros(
+                            (self.max_slots, s_draft + 1), np.int32
+                        )
+                        self._dispatch_verify(
+                            toks, inactive, zero_draft, window
+                        )
             # Fused-prefill buckets: each power-of-two prompt bucket is its
             # own executable (the padded ids shape is static), so admit one
             # dummy prompt per bucket — otherwise the first live request at
@@ -720,9 +815,30 @@ class GenerationEngine:
             on_token=req.on_token,
             prompt_len=L,
             t_start=t0,
+            **self._spec_slot_state(req),
         )
         self._slots[slot_idx] = slot
         self._record_token(slot_idx, int(first))
+
+    def _spec_slot_state(self, req: _Request) -> dict:
+        """Per-slot speculative state (empty when speculation is off)."""
+        if self._spec is None:
+            return {}
+        from .speculative import DraftState
+
+        # validate() caps prompt + max_new_tokens at capacity, so the
+        # buffer never overflows; generated tokens append in
+        # _record_token.
+        history = np.empty((self.capacity,), np.int64)
+        L = int(req.prompt.size)
+        history[:L] = req.prompt
+        return {
+            "history": history,
+            "hist_len": L,
+            "draft": DraftState(
+                self._spec.draft_tokens, adaptive=self._spec.adaptive
+            ),
+        }
 
     def _admit_now(self, req: _Request) -> None:
         """Synchronous admission (warmup): runs the whole chunked pipeline
@@ -1061,6 +1177,7 @@ class GenerationEngine:
             on_token=req.on_token,
             prompt_len=L,
             t_start=t0,
+            **self._spec_slot_state(req),
         )
         self._record_token(slot_idx, int(first))
 
@@ -1077,6 +1194,9 @@ class GenerationEngine:
             self._slots[slot_idx] = None
             return
         slot.generated.append(token)
+        if slot.history is not None and slot.hist_len < slot.history.size:
+            slot.history[slot.hist_len] = token
+            slot.hist_len += 1
         slot.remaining -= 1
         if not self._in_warmup:
             self.tokens_generated += 1
@@ -1095,9 +1215,19 @@ class GenerationEngine:
             self._slots[slot_idx] = None
 
     def _step(self) -> None:
-        """One batched decode tick over every occupied slot."""
+        """One batched decode tick over every occupied slot.
+
+        With speculation enabled and every occupied slot greedy, the tick
+        tries a draft+verify (multi-token) pass first; a tick with no
+        drafts anywhere — or any sampling slot — runs the original
+        single-token step unchanged."""
         active_np = np.array([s is not None for s in self._slots])
         if not active_np.any():
+            # Still report occupancy: without this the gauges freeze at
+            # their last busy values and an idle server reads as loaded.
+            # (observe_decode_step skips its histograms at 0 active.)
+            if self._on_step is not None and not self._in_warmup:
+                self._on_step(0, 0.0, self._queue.qsize())
             return
         # Attention window: smallest bucket covering every active row's
         # next write position (prompt + tokens emitted so far).
@@ -1107,15 +1237,155 @@ class GenerationEngine:
             if s is not None
         )
         window = decode_window_bucket(needed, self.capacity)
-        t0 = time.perf_counter()
         sampling = any(s is not None and s.sampling for s in self._slots)
+        if self._spec is not None and not sampling and not self._in_warmup:
+            drafts = self._collect_drafts()
+            if any(drafts):
+                self._verify_tick(active_np, window, drafts)
+                return
+        t0 = time.perf_counter()
         self._dispatch_step(active_np, window, sampling)
         toks = np.asarray(self._tokens)[:, 0]
-        if self._on_step is not None and not self._in_warmup:
-            self._on_step(int(active_np.sum()), time.perf_counter() - t0)
+        self._note_tick(active_np, t0)
         for i, was_active in enumerate(active_np):
             if was_active and self._slots[i] is not None:
                 self._record_token(i, int(toks[i]))
+                if not self._in_warmup:
+                    self.decode_tokens += 1
+
+    def _note_tick(self, active_np, t0: float) -> None:
+        if self._in_warmup:
+            return
+        self.decode_forwards += 1
+        if self._on_step is not None:
+            self._on_step(
+                int(active_np.sum()),
+                time.perf_counter() - t0,
+                self._queue.qsize(),
+            )
+
+    # -- self-speculative decoding (n-gram draft + batched verify) -----------
+
+    def _collect_drafts(self) -> list[list[int]]:
+        """Per-slot draft proposals for this tick (``[]`` = no draft).
+
+        The budget is the slot's adaptive draft length capped at
+        ``remaining - 1``: acceptance emits up to budget+1 tokens and a
+        slot must never be asked to emit past its request."""
+        drafts: list[list[int]] = []
+        for slot in self._slots:
+            if slot is None or slot.draft is None:
+                drafts.append([])
+                continue
+            budget = min(slot.draft.budget(), slot.remaining - 1)
+            if budget < 1:
+                drafts.append([])
+                continue
+            drafts.append(self._propose(slot, budget))
+        return drafts
+
+    def _propose(self, slot: _Slot, budget: int) -> list[int]:
+        """N-gram ("prompt lookup") draft from the slot's own history.
+        Separate method so tests can swap in an oracle drafter."""
+        from .speculative import propose_ngram
+
+        return propose_ngram(
+            slot.history[: slot.hist_len], budget,
+            self._spec.ngram_min, self._spec.ngram_max,
+        )
+
+    def _verify_tick(self, active_np, window: int, drafts) -> None:
+        """One draft+verify pass: k+1 positions per slot under ONE weight
+        stream; per-slot greedy acceptance decides how many emit."""
+        from .speculative import pad_to_chain
+
+        s_draft = pad_to_chain(
+            max(len(d) for d in drafts), self._spec_chain
+        )
+        toks = np.zeros((self.max_slots, s_draft + 1), np.int32)
+        draft_len = np.zeros((self.max_slots,), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            toks[i, 0] = slot.generated[-1]  # pending (emitted, unfed) token
+            d = drafts[i]
+            toks[i, 1 : 1 + len(d)] = d
+            draft_len[i] = len(d)
+        t0 = time.perf_counter()
+        greedy, accepted = self._dispatch_verify(
+            toks, active_np, draft_len, window
+        )
+        self._note_tick(active_np, t0)
+        if not self._in_warmup:
+            self.spec_verify_ticks += 1
+        for i, was_active in enumerate(active_np):
+            if not was_active or self._slots[i] is None:
+                continue
+            slot = self._slots[i]
+            n_prop, n_acc = int(draft_len[i]), int(accepted[i])
+            if slot.draft is not None:
+                slot.draft.observe(n_prop, n_acc)
+            if n_prop and not self._in_warmup:
+                self.spec_proposed_tokens += n_prop
+                self.spec_accepted_tokens += n_acc
+                if self._on_spec is not None:
+                    self._on_spec(n_prop, n_acc)
+            # Emit the accepted draft prefix plus the bonus token; stop
+            # early if the slot finishes (eos / budget) or cancels.
+            for j in range(n_acc + 1):
+                self._record_token(i, int(greedy[i, j]))
+                if not self._in_warmup:
+                    self.decode_tokens += 1
+                if self._slots[i] is None:
+                    break
+
+    def _dispatch_verify(self, toks, active_np, draft_len, window):
+        if self._channel is None:
+            return self._device_verify(toks, active_np, draft_len, window)
+        from .multihost import OP_GEN_VERIFY, encode_message
+
+        payload = encode_message(
+            OP_GEN_VERIFY,
+            {
+                "toks": toks,
+                "active": active_np,
+                "draft_len": draft_len,
+                "window": int(window),
+            },
+        )
+        return self._channel.run(
+            payload,
+            lambda: self._device_verify(toks, active_np, draft_len, window),
+        )
+
+    def _device_verify(self, toks, active_np, draft_len, window):
+        import jax.numpy as jnp
+
+        (
+            self._tokens,
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            greedy,
+            accepted,
+        ) = self._verify(
+            self._params,
+            jnp.asarray(toks),
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            jnp.asarray(active_np),
+            jnp.asarray(draft_len),
+            int(window),
+        )
+        return np.asarray(greedy), np.asarray(accepted)
+
+    def replay_verify(self, toks, active, draft_len, window) -> None:
+        """Follower side of a verify tick (multihost lockstep)."""
+        self._device_verify(
+            np.asarray(toks), np.asarray(active),
+            np.asarray(draft_len), int(window),
+        )
 
     def _dispatch_step(self, active_np, window, sampling) -> None:
         if self._channel is None:

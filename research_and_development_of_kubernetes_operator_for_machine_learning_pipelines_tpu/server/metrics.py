@@ -149,6 +149,56 @@ class ServerMetrics:
             ident_labels,
             registry=self.registry,
         )
+        # Engine occupancy telemetry (fed per decode tick from the
+        # engine's on_step callback): lets the operator correlate
+        # speculative acceptance — and every other per-tick rate — with
+        # batch occupancy and admission backlog.
+        self.engine_active_slots = Gauge(
+            "tpumlops_engine_active_slots",
+            "Occupied decode slots at the most recent engine tick",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.engine_queue_depth = Gauge(
+            "tpumlops_engine_queue_depth",
+            "Requests waiting in the generation admission queue",
+            ident_labels,
+            registry=self.registry,
+        )
+        # Self-speculative decoding (server/speculative.py): proposed vs
+        # accepted draft tokens, plus per-verify distributions.  The
+        # counters give the exact acceptance rate over any window
+        # (rate(accepted)/rate(proposed)); the histograms show its shape
+        # — a healthy repetitive workload piles acceptance at the draft
+        # cap, adversarial text piles it at 0.
+        self.spec_proposed_tokens = Counter(
+            "tpumlops_spec_proposed_tokens",
+            "Draft tokens proposed by the n-gram speculative drafter",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.spec_accepted_tokens = Counter(
+            "tpumlops_spec_accepted_tokens",
+            "Draft tokens accepted by greedy verification",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.spec_accepted_len = Histogram(
+            "tpumlops_spec_accepted_len",
+            "Accepted draft length per (slot, verify)",
+            ident_labels,
+            # Top finite bucket matches the draftTokens ceiling (64) so
+            # high-draft tunings keep a readable distribution shape.
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64),
+            registry=self.registry,
+        )
+        self.spec_acceptance_rate = Histogram(
+            "tpumlops_spec_acceptance_rate",
+            "accepted/proposed per (slot, verify)",
+            ident_labels,
+            buckets=(0.0, 0.25, 0.5, 0.75, 0.999, 1.0),
+            registry=self.registry,
+        )
         self.ready = Gauge(
             "tpumlops_model_ready",
             "1 once the model is loaded and warmed",
@@ -199,9 +249,25 @@ class ServerMetrics:
             pipeline_wait_seconds
         )
 
-    def observe_decode_step(self, active_slots: int, seconds: float):
-        self.decode_batch.labels(**self.identity).observe(active_slots)
-        self.decode_step_seconds.labels(**self.identity).observe(seconds)
+    def observe_decode_step(
+        self, active_slots: int, seconds: float, queue_depth: int = 0
+    ):
+        # active_slots == 0 is the engine's idle heartbeat: refresh the
+        # occupancy gauges but keep the per-tick histograms tick-only.
+        if active_slots > 0:
+            self.decode_batch.labels(**self.identity).observe(active_slots)
+            self.decode_step_seconds.labels(**self.identity).observe(seconds)
+        self.engine_active_slots.labels(**self.identity).set(active_slots)
+        self.engine_queue_depth.labels(**self.identity).set(queue_depth)
+
+    def observe_speculative(self, proposed: int, accepted: int):
+        self.spec_proposed_tokens.labels(**self.identity).inc(proposed)
+        self.spec_accepted_tokens.labels(**self.identity).inc(accepted)
+        self.spec_accepted_len.labels(**self.identity).observe(accepted)
+        if proposed > 0:
+            self.spec_acceptance_rate.labels(**self.identity).observe(
+                accepted / proposed
+            )
 
     def observe_prefix_hit(self, cached_tokens: int):
         self.prefix_cache_hits.labels(**self.identity).inc()

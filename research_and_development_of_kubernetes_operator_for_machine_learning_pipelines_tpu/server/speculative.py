@@ -1,0 +1,173 @@
+"""Self-speculative n-gram decoding: host-side drafter + adaptive control.
+
+Steady-state decode is HBM-bandwidth-bound — every tick streams the full
+weight tree to emit ONE token per slot.  Speculative decoding multiplies
+tokens per weight stream by the acceptance length: a drafter proposes k
+continuation tokens per slot, a single batched verify forward scores all
+k+1 positions (``models.llama.verify_ragged``), and the longest draft
+prefix agreeing with greedy argmax is accepted — output stays
+bit-identical to the non-speculative greedy path because acceptance IS
+the argmax chain (``models.sampling.speculative_accept``).
+
+The drafter here is the "prompt lookup" n-gram scheme: no second model —
+a slot's own history (prompt + generated tokens) is searched for an
+earlier occurrence of its current suffix, and the tokens that followed
+that occurrence become the draft.  Free to compute (host-side numpy on
+sequences the scheduler already mirrors), and effective exactly on the
+traffic where decode dominates: templated/repetitive continuations
+(code, JSON, chat templates, extraction tasks that re-emit prompt
+spans).  On adversarial (random) text it proposes little or nothing and
+the engine falls back to the plain single-token step per slot.
+
+:class:`DraftState` is the per-slot adaptive controller: consecutive
+zero-accept verifies halve that slot's draft budget (eventually to 0 =
+plain decode for that slot), any acceptance regrows it, and a parked
+slot re-probes after a cooldown so a phase change in the stream
+(entering a repetitive region) is picked back up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class SpeculativeConfig:
+    """Engine-side knobs (one construction site: ``app.make_gen_engine``
+    builds this from ``spec.tpu.speculative`` for leader and followers —
+    lockstep replay needs identical draft geometry on every host)."""
+
+    enabled: bool = False
+    draft_tokens: int = 4  # max draft length k (verify scores k+1 positions)
+    ngram_min: int = 1  # shortest history suffix the drafter may match
+    ngram_max: int = 4  # longest history suffix tried first
+    adaptive: bool = True  # per-slot halve-on-zero-accept / regrow-on-success
+
+
+def draft_chain(draft_tokens: int) -> tuple[int, ...]:
+    """The static draft lengths the engine compiles: the halving chain
+    ``{k, k//2, ..., 1}`` (ascending).  A tick's draft length is padded
+    UP to the nearest chain value, so the compiled-variant count stays
+    logarithmic in ``draftTokens`` instead of linear — same philosophy
+    as the power-of-two decode window buckets."""
+    if draft_tokens < 1:
+        raise ValueError(f"draft_tokens must be >= 1, got {draft_tokens}")
+    chain = set()
+    k = int(draft_tokens)
+    while k >= 1:
+        chain.add(k)
+        k //= 2
+    return tuple(sorted(chain))
+
+
+def pad_to_chain(want: int, chain: tuple[int, ...]) -> int:
+    """Smallest compiled draft length >= ``want``."""
+    for c in chain:
+        if c >= want:
+            return c
+    return chain[-1]
+
+
+# Trailing-history bound for the n-gram scan (tokens).  Covers typical
+# system-prompt + recent-generation reuse while capping per-tick host
+# work; matches past the window are simply not found (fallback: plain
+# single-token decode).
+_SCAN_WINDOW = 2048
+
+
+def propose_ngram(
+    context: np.ndarray,
+    max_tokens: int,
+    ngram_min: int,
+    ngram_max: int,
+) -> list[int]:
+    """Prompt-lookup draft: longest-suffix match against the sequence's
+    own history.
+
+    Tries suffix lengths ``ngram_max`` down to ``ngram_min``; on the
+    first (longest) suffix with an earlier occurrence, drafts
+    ``max_tokens`` tokens under the copy hypothesis the match implies:
+    ``context[j] == context[j - d]`` where ``d`` is the distance between
+    the suffix and its MOST RECENT earlier occurrence (recent context
+    predicts the continuation best).  For ``d >= max_tokens`` that is
+    simply the tokens that followed the match; for shorter distances —
+    a period-``d`` repetition, the common shape of greedy loops and
+    templated fills — the draft tiles the cycle so short periods still
+    fill the whole budget.  Returns ``[]`` when nothing matches — the
+    caller falls back to the plain single-token step for that slot.
+    """
+    arr = np.asarray(context, dtype=np.int64).reshape(-1)
+    # Bound the searched history so drafting stays CONSTANT serial work
+    # per tick on the scheduler thread regardless of context length
+    # (at 8k context x 64 slots an unbounded scan would be millions of
+    # comparisons ahead of every dispatch).  Recency also predicts the
+    # continuation best, so the truncation costs little acceptance.
+    if arr.size > _SCAN_WINDOW:
+        arr = arr[-_SCAN_WINDOW:]
+    L = int(arr.size)
+    if max_tokens < 1 or L < ngram_min + 1:
+        return []
+    history = arr[:-1]  # candidate windows must END strictly before L-1
+    for n in range(min(int(ngram_max), L - 1), int(ngram_min) - 1, -1):
+        suffix = arr[L - n :]
+        windows = np.lib.stride_tricks.sliding_window_view(history, n)
+        hits = np.nonzero((windows == suffix).all(axis=1))[0]
+        if hits.size:
+            start = int(hits[-1]) + n  # token AFTER the most recent match
+            d = L - start
+            idx = start + (np.arange(int(max_tokens)) % d)
+            return arr[idx].astype(np.int64).tolist()
+    return []
+
+
+class DraftState:
+    """Per-slot adaptive draft budget.
+
+    - ``budget()`` is how many tokens the slot may draft this tick
+      (0 = parked: the slot rides the plain single-token step).
+    - After ``HALVE_AFTER`` CONSECUTIVE zero-accept verifies, the budget
+      halves (4 -> 2 -> 1 -> 0): a slot in adversarial text stops paying
+      verify compute it never converts.
+    - Any acceptance resets the streak and doubles the budget back
+      toward the configured maximum.
+    - A parked slot re-probes at budget 1 after ``REPROBE_AFTER`` plain
+      ticks, so a stream that ENTERS a repetitive region is picked up.
+
+    With ``adaptive=False`` the budget is pinned to the maximum.
+    """
+
+    HALVE_AFTER = 2
+    REPROBE_AFTER = 16
+
+    def __init__(self, max_draft: int, adaptive: bool = True) -> None:
+        self.max = int(max_draft)
+        self.adaptive = bool(adaptive)
+        self.length = self.max
+        self.zero_streak = 0
+        self.parked_ticks = 0
+
+    def budget(self) -> int:
+        if not self.adaptive:
+            return self.max
+        if self.length == 0:
+            self.parked_ticks += 1
+            if self.parked_ticks >= self.REPROBE_AFTER:
+                self.parked_ticks = 0
+                return 1  # probation draft; observe() decides its fate
+            return 0
+        return self.length
+
+    def observe(self, proposed: int, accepted: int) -> None:
+        """Feed back one verify outcome (no-op when nothing was drafted)."""
+        if not self.adaptive or proposed <= 0:
+            return
+        if accepted > 0:
+            self.zero_streak = 0
+            self.length = min(self.max, max(1, self.length * 2))
+            return
+        self.zero_streak += 1
+        if self.zero_streak >= self.HALVE_AFTER:
+            self.zero_streak = 0
+            self.length //= 2  # 1 -> 0 parks the slot

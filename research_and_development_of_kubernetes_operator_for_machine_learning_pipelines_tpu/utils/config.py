@@ -149,6 +149,25 @@ class CanaryPolicy:
             raise ValueError("maxAttempts must be >= 1")
 
 
+def _reject_unknown_keys(
+    spec: Mapping[str, Any], allowed: frozenset, path: str
+) -> None:
+    """Fail loudly on unknown spec keys at reconcile time.
+
+    The CRD schema is permissive about extra properties, so a typo'd
+    knob (``draftToken`` for ``draftTokens``) used to be SILENTLY
+    ignored — the CR applied cleanly and served with the default, the
+    worst failure mode for a performance knob.  Rejecting here lands the
+    error in CR status (and in the server log at startup), naming both
+    the bad key and the accepted set."""
+    unknown = sorted(set(spec) - allowed)
+    if unknown:
+        raise ValueError(
+            f"unknown key(s) {unknown} in {path}; "
+            f"allowed: {sorted(allowed)}"
+        )
+
+
 def _parse_prefill_chunk(value) -> int | None:
     """Positivity is checkable here; divisibility into the model's KV
     capacity is not (max_seq lives in the artifact, not the CR) — that
@@ -184,6 +203,11 @@ class PrefixCacheSpec:
         prefill_chunk: int | None = None,
     ) -> "PrefixCacheSpec":
         spec = spec or {}
+        _reject_unknown_keys(
+            spec,
+            frozenset({"enabled", "budgetMB", "chunkTokens"}),
+            "spec.tpu.prefixCache",
+        )
         enabled = bool(spec.get("enabled", False))
         # Unset chunkTokens follows prefillChunk (the common case: one
         # knob already set); an EXPLICIT mismatch is rejected HERE, at
@@ -220,6 +244,59 @@ class PrefixCacheSpec:
                 raise ValueError(
                     "prefixCache.chunkTokens must be >= 1, got "
                     f"{self.chunk_tokens}"
+                )
+
+
+@dataclass(frozen=True)
+class SpeculativeSpec:
+    """``spec.tpu.speculative``: self-speculative n-gram decoding.
+
+    A host-side "prompt lookup" drafter proposes up to ``draft_tokens``
+    continuations per slot from the sequence's own history (no draft
+    model), and ONE batched verify forward scores all of them — tokens
+    emitted per HBM weight stream multiply by the acceptance length
+    while output stays bit-identical to plain greedy decode (exact
+    argmax acceptance).  Disabled by default: an unannotated CR behaves
+    exactly as before.  Greedy traffic only — a tick with any sampling
+    slot falls back to the single-token step.
+    """
+
+    enabled: bool = False
+    draft_tokens: int = 4
+    ngram_min: int = 1
+    ngram_max: int = 4
+    adaptive: bool = True
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "SpeculativeSpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec,
+            frozenset(
+                {"enabled", "draftTokens", "ngramMin", "ngramMax", "adaptive"}
+            ),
+            "spec.tpu.speculative",
+        )
+        return cls(
+            enabled=bool(spec.get("enabled", False)),
+            draft_tokens=int(spec.get("draftTokens", 4)),
+            ngram_min=int(spec.get("ngramMin", 1)),
+            ngram_max=int(spec.get("ngramMax", 4)),
+            adaptive=bool(spec.get("adaptive", True)),
+        )
+
+    def __post_init__(self):
+        if self.enabled:
+            # Reject at reconcile time, not as a pod CrashLoopBackOff.
+            if not (1 <= self.draft_tokens <= 64):
+                raise ValueError(
+                    "speculative.draftTokens must be in [1, 64], got "
+                    f"{self.draft_tokens}"
+                )
+            if not (1 <= self.ngram_min <= self.ngram_max):
+                raise ValueError(
+                    "speculative ngram bounds must satisfy 1 <= ngramMin "
+                    f"<= ngramMax, got [{self.ngram_min}, {self.ngram_max}]"
                 )
 
 
@@ -267,6 +344,9 @@ class TpuSpec:
     # Radix prefix KV cache: shared prompt prefixes (system prompts, chat
     # templates) prefill once and are copied thereafter.
     prefix_cache: PrefixCacheSpec = field(default_factory=PrefixCacheSpec)
+    # Self-speculative n-gram decoding: batched multi-token verify
+    # amortizes the per-tick HBM weight stream over accepted drafts.
+    speculative: SpeculativeSpec = field(default_factory=SpeculativeSpec)
     # Warm the FULL batch x seq-length compile grid at startup instead of
     # the edges (batch 1 / max per length).  Costs |batch buckets| x
     # |length buckets| cold compiles; buys zero first-hit compile stalls
@@ -276,6 +356,19 @@ class TpuSpec:
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
         spec = spec or {}
+        _reject_unknown_keys(
+            spec,
+            frozenset(
+                {
+                    "tpuTopology", "meshShape", "replicas", "dtype",
+                    "maxBatchSize", "maxBatchDelayMs", "maxSlots",
+                    "maxInflightBatches", "compileCacheDir", "quantize",
+                    "prefillChunk", "prefixCache", "speculative",
+                    "warmupFullGrid",
+                }
+            ),
+            "spec.tpu",
+        )
         mesh = dict(spec.get("meshShape") or {"dp": 1, "tp": 8})
         prefill_chunk = _parse_prefill_chunk(spec.get("prefillChunk"))
         return cls(
@@ -295,6 +388,7 @@ class TpuSpec:
             prefix_cache=PrefixCacheSpec.from_spec(
                 spec.get("prefixCache"), prefill_chunk=prefill_chunk
             ),
+            speculative=SpeculativeSpec.from_spec(spec.get("speculative")),
             warmup_full_grid=bool(spec.get("warmupFullGrid", False)),
         )
 

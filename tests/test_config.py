@@ -2,7 +2,7 @@
 
 import pytest
 
-from tpumlops.utils.config import CanaryPolicy, GateThresholds, OperatorConfig, TpuSpec
+from tpumlops.utils.config import CanaryPolicy, OperatorConfig, TpuSpec
 
 
 def minimal_spec(**extra):
@@ -88,3 +88,66 @@ def test_tpu_quantize_validated_at_parse():
     assert TpuSpec.from_spec({"quantize": "INT8"}).quantize == "int8"
     with pytest.raises(ValueError, match="quantize"):
         TpuSpec.from_spec({"quantize": "int4"})
+
+
+def test_tpu_spec_rejects_unknown_keys():
+    """A typo'd spec.tpu knob must fail CRD validation with a clear
+    error naming the key — not be silently ignored (a performance knob
+    silently running at its default is the worst failure mode)."""
+    from tpumlops.utils.config import TpuSpec
+
+    with pytest.raises(ValueError, match="maxSlot"):
+        TpuSpec.from_spec({"maxSlot": 16})  # missing the trailing s
+    with pytest.raises(ValueError, match="draftToken"):
+        TpuSpec.from_spec(
+            {"speculative": {"enabled": True, "draftToken": 8}}
+        )
+    with pytest.raises(ValueError, match="budgetMb"):
+        TpuSpec.from_spec({"prefixCache": {"budgetMb": 64}})  # wrong case
+    # The error names the allowed set so the fix is self-serve.
+    with pytest.raises(ValueError, match="draftTokens"):
+        TpuSpec.from_spec({"speculative": {"draftToken": 8}})
+    # Every known key still parses.
+    TpuSpec.from_spec(
+        {
+            "tpuTopology": "v5e-8",
+            "meshShape": {"tp": 8},
+            "replicas": 1,
+            "dtype": "bfloat16",
+            "maxBatchSize": 8,
+            "maxBatchDelayMs": 5,
+            "maxSlots": 8,
+            "maxInflightBatches": 2,
+            "compileCacheDir": "/tmp/x",
+            "quantize": "none",
+            "prefillChunk": 64,
+            "prefixCache": {"enabled": True, "budgetMB": 64},
+            "speculative": {"enabled": True, "draftTokens": 4},
+            "warmupFullGrid": False,
+        }
+    )
+
+
+def test_operator_config_speculative_round_trip():
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            backend="tpu",
+            tpu={
+                "tpuTopology": "v5e-8",
+                "meshShape": {"dp": 1, "tp": 8},
+                "speculative": {
+                    "enabled": True,
+                    "draftTokens": 6,
+                    "ngramMin": 2,
+                    "ngramMax": 5,
+                    "adaptive": False,
+                },
+            },
+        )
+    )
+    s = cfg.tpu.speculative
+    assert (s.enabled, s.draft_tokens, s.ngram_min, s.ngram_max, s.adaptive) \
+        == (True, 6, 2, 5, False)
+    # Defaults: disabled, inert.
+    assert OperatorConfig.from_spec(minimal_spec()).tpu.speculative.enabled \
+        is False

@@ -11,7 +11,7 @@ import time
 import pytest
 
 from tpumlops.clients.base import ObjectRef
-from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.clients.fakes import FakeKube
 from tpumlops.operator.leader import LEASE, LeaderElector
 from tpumlops.utils.clock import FakeClock
 

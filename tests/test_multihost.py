@@ -15,7 +15,6 @@ from tpumlops.models.registry import Predictor
 from tpumlops.server.engine import InferenceEngine
 from tpumlops.server.multihost import (
     JaxProcessTransport,
-    LocalGroupTransport,
     MultihostEngine,
     _LocalGroup,
     decode_message,

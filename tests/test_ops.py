@@ -4,7 +4,6 @@ on the virtual sp mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpumlops.ops import attention_reference, flash_attention, rmsnorm, rmsnorm_reference
 from tpumlops.ops.ring_attention import ring_attention_sharded

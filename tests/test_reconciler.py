@@ -13,7 +13,7 @@ from tpumlops.clients.base import (
 )
 from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
 from tpumlops.operator.reconciler import Reconciler
-from tpumlops.operator.state import Phase, PromotionState
+from tpumlops.operator.state import Phase
 from tpumlops.utils.clock import FakeClock
 
 NS = "models"

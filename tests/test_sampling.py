@@ -1,0 +1,168 @@
+"""``models.sampling`` edge cases (fast tranche: tiny vocab, no engine).
+
+These invariants guard the speculative verify path's exact-acceptance
+rule (``speculative_accept``): verification accepts a draft token iff it
+equals greedy argmax, and the sampling controls must degenerate to that
+same argmax at their boundaries (temperature -> 0, top_k = 1, top_p -> 0)
+or the "greedy traffic" fast paths and the sampled paths would disagree
+about what greedy means.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumlops.models.sampling import sample_logits, speculative_accept
+
+
+def _keys(n, seed=0):
+    return jax.random.split(jax.random.key(seed), n)
+
+
+def _call(logits, temps, tks, tps, seed=0):
+    b = logits.shape[0]
+    return sample_logits(
+        jnp.asarray(logits, jnp.float32),
+        _keys(b, seed),
+        jnp.asarray(temps, jnp.float32),
+        jnp.asarray(tks, jnp.int32),
+        jnp.asarray(tps, jnp.float32),
+    )
+
+
+LOGITS = np.asarray(
+    [
+        [0.1, 3.0, 2.0, -1.0, 0.5],
+        [5.0, -2.0, 4.9, 0.0, 1.0],
+        [-3.0, -3.0, -2.0, -9.0, -2.5],
+    ],
+    np.float32,
+)
+GREEDY = LOGITS.argmax(-1).tolist()
+
+
+def test_temperature_zero_and_limit_equal_greedy():
+    b = LOGITS.shape[0]
+    ones = np.ones(b)
+    # Exact zero takes the argmax branch.
+    assert _call(LOGITS, 0.0 * ones, 0 * ones, ones).tolist() == GREEDY
+    # The -> 0 limit must converge to the same argmax (the scaled
+    # distribution collapses onto the top token), for any key.
+    for seed in range(8):
+        out = _call(LOGITS, 1e-6 * ones, 0 * ones, ones, seed=seed)
+        assert out.tolist() == GREEDY, (seed, out.tolist())
+
+
+def test_top_k_one_equals_greedy_at_any_temperature():
+    b = LOGITS.shape[0]
+    ones = np.ones(b)
+    for temp in (0.5, 1.0, 10.0, 100.0):
+        for seed in range(4):
+            out = _call(LOGITS, temp * ones, 1 * ones, ones, seed=seed)
+            assert out.tolist() == GREEDY, (temp, seed)
+
+
+def test_top_p_tiny_equals_greedy():
+    b = LOGITS.shape[0]
+    ones = np.ones(b)
+    for seed in range(4):
+        out = _call(LOGITS, 10.0 * ones, 0 * ones, 1e-9 * ones, seed=seed)
+        assert out.tolist() == GREEDY
+
+
+def test_top_p_boundary_keeps_smallest_covering_set():
+    # Top token holds ~0.6 of the mass (at temperature 1 — the top-p
+    # mask operates on the TEMPERATURE-SCALED distribution): p below the
+    # top mass keeps only the top token ("smallest set whose mass >= p"),
+    # p above it admits the runner-up, and the truncated distribution
+    # never leaks the ~0 tail tokens either way.
+    logits = np.log(np.asarray([[0.6, 0.4, 1e-9, 1e-9, 1e-9]], np.float32))
+    one = np.asarray([1.0])
+    seen_below, seen_above = set(), set()
+    for seed in range(64):
+        seen_below.add(int(_call(logits, one, [0], [0.5], seed=seed)[0]))
+        seen_above.add(int(_call(logits, one, [0], [0.95], seed=seed)[0]))
+    assert seen_below == {0}
+    assert seen_above == {0, 1}
+
+
+def test_top_p_exact_tie_at_the_boundary():
+    # Two exactly-equal tokens (softmax mass 0.5 each, exact in binary
+    # fp): p = 0.5 keeps ONLY the first — the exclusive cumsum before
+    # the second is 0.5, which is not < 0.5 — i.e. ties at the boundary
+    # resolve toward the smaller set, deterministically.
+    logits = np.asarray([[2.0, 2.0, -40.0, -40.0, -40.0]], np.float32)
+    seen = set()
+    for seed in range(32):
+        seen.add(int(_call(logits, [1.0], [0], [0.5], seed=seed)[0]))
+    assert seen == {0}
+
+
+def test_top_p_first_token_always_survives():
+    # Even p ~ 0 keeps the top token (the exclusive cumsum before rank 0
+    # is 0 < p for any positive p) — a draw must always be possible.
+    logits = np.asarray([[2.0, 1.0, 0.0, -1.0, -2.0]], np.float32)
+    out = _call(logits, [5.0], [0], [1e-30])
+    assert int(out[0]) == 0
+
+
+def test_greedy_tie_is_deterministic_across_paths():
+    # Exact ties resolve to the first index (argmax convention) in BOTH
+    # the temperature-0 branch and the top_k=1 branch: the verify path's
+    # acceptance (argmax equality) must agree with whichever path emitted
+    # the token.
+    logits = np.asarray([[1.5, 1.5, 0.0, 1.5, -1.0]], np.float32)
+    a = _call(logits, [0.0], [0], [1.0])
+    b = _call(logits, [3.0], [1], [1.0])
+    assert int(a[0]) == int(b[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# speculative_accept (the exact-acceptance rule itself)
+# ---------------------------------------------------------------------------
+
+
+def _accept(tokens, greedy, draft_len):
+    acc, nxt = speculative_accept(
+        jnp.asarray(tokens, jnp.int32),
+        jnp.asarray(greedy, jnp.int32),
+        jnp.asarray(draft_len, jnp.int32),
+    )
+    return np.asarray(acc).tolist(), np.asarray(nxt).tolist()
+
+
+def test_speculative_accept_prefix_rule():
+    # Row 0: full match; row 1: diverges at draft pos 2; row 2: immediate
+    # mismatch; row 3: padded row capped by draft_len.
+    tokens = [
+        [7, 10, 11, 12],
+        [7, 20, 21, 99],
+        [7, 30, 31, 32],
+        [7, 40, 0, 0],
+    ]
+    greedy = [
+        [10, 11, 12, 13],
+        [20, 21, 22, 23],
+        [99, 31, 32, 33],
+        [40, 0, 0, 99],  # padding "matches" by coincidence
+    ]
+    acc, nxt = _accept(tokens, greedy, [3, 3, 3, 1])
+    assert acc == [3, 2, 0, 1]
+    # Bonus token = greedy at the first unverified position.
+    assert nxt == [13, 22, 99, 0]
+
+
+def test_speculative_accept_s1_degenerates_to_plain_decode():
+    acc, nxt = _accept([[5], [9]], [[17], [3]], [0, 0])
+    assert acc == [0, 0]
+    assert nxt == [17, 3]
+
+
+def test_speculative_accept_never_exceeds_budget():
+    # A fully matching row still caps at its declared draft length.
+    tokens = [[1, 2, 3, 4]]
+    greedy = [[2, 3, 4, 5]]
+    for budget, want in ((0, 0), (1, 1), (2, 2), (3, 3)):
+        acc, nxt = _accept(tokens, greedy, [budget])
+        assert acc == [want]
+        assert nxt == [greedy[0][want]]

@@ -19,7 +19,6 @@ from tpumlops.server.loader import (
     save_native_model,
     save_sklearn_model,
 )
-from tpumlops.server.metrics import ServerMetrics
 from tpumlops.utils.config import ServerConfig, TpuSpec
 
 
@@ -447,6 +446,39 @@ def test_generate_endpoint_multi_sequence_and_v2_form(llm_server):
     outs = resp.json()["outputs"]
     assert len(outs) == 2
     assert all(len(o["data"]) == 4 for o in outs)
+
+
+@pytest.mark.slow
+def test_generate_unknown_parameter_400s(llm_server):
+    """A typo'd generation knob must 400 with the key named, never be
+    silently ignored (the request-level mirror of the spec.tpu
+    unknown-key audit in utils/config.py)."""
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_token": 6},  # missing 's'
+        timeout=30,
+    )
+    assert resp.status_code == 400
+    assert "max_new_token" in resp.json()["error"]
+    assert "max_new_tokens" in resp.json()["error"]  # the allowed set
+    # V2 form: typo inside "parameters".
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={
+            "inputs": [
+                {
+                    "name": "prompt_ids",
+                    "datatype": "INT32",
+                    "shape": [1, 3],
+                    "data": [5, 9, 2],
+                }
+            ],
+            "parameters": {"max_new_tokens": 4, "temprature": 0.5},
+        },
+        timeout=30,
+    )
+    assert resp.status_code == 400
+    assert "temprature" in resp.json()["error"]
 
 
 @pytest.mark.slow

@@ -1,6 +1,5 @@
 """PromotionState transitions and status round-trips (SURVEY §3.5(2) fix)."""
 
-import pytest
 
 from tpumlops.operator.state import Phase, PromotionState
 
