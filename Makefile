@@ -12,7 +12,8 @@ TAG      ?= latest
 DOCKER   ?= docker
 
 .PHONY: images operator-image server-image router-image router-bin \
-        install uninstall test test-fast test-e2e test-all lint verify bench
+        install uninstall test test-fast test-e2e test-all lint \
+        bench-contract verify bench
 
 images: operator-image server-image router-image
 
@@ -73,11 +74,20 @@ lint:
 	  echo "lint: ruff not installed; skipping (pip install ruff)"; \
 	fi
 
+# Bench driver-contract gate: a --dry-run invocation (validates the
+# scenario registry and prints the schema contract without touching a
+# device) plus the contract tests that pin it — scenario schema drift
+# fails HERE, locally, instead of surfacing as a missing field in a
+# round's official record.
+bench-contract:
+	python bench.py --dry-run > /dev/null
+	python -m pytest tests/test_bench_contract.py -q
+
 # The EXACT tier-1 command from ROADMAP.md (the driver's acceptance
-# gate) chained behind lint: not-slow tranche, collection errors
-# tolerated, 870 s wall cap, DOTS_PASSED echoed from the captured dot
-# lines.
-verify: lint
+# gate) chained behind lint + the bench contract: not-slow tranche,
+# collection errors tolerated, 870 s wall cap, DOTS_PASSED echoed from
+# the captured dot lines.
+verify: lint bench-contract
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -61,14 +61,18 @@ class FakeRegistry:
         try:
             version = self._aliases[(model_name, alias)]
         except KeyError:
-            raise AliasNotFound(f"alias {alias!r} not found on model {model_name!r}")
+            raise AliasNotFound(
+                f"alias {alias!r} not found on model {model_name!r}"
+            ) from None
         return self._versions[(model_name, version)]
 
     def get_version(self, model_name: str, version: str) -> ModelVersion:
         try:
             return self._versions[(model_name, version)]
         except KeyError:
-            raise RegistryError(f"model {model_name!r} has no version {version!r}")
+            raise RegistryError(
+                f"model {model_name!r} has no version {version!r}"
+            ) from None
 
 
 class FakeKube:
@@ -108,7 +112,7 @@ class FakeKube:
             try:
                 return copy.deepcopy(self._objects[self._key(ref)])
             except KeyError:
-                raise NotFound(f"{ref.plural}/{ref.name}")
+                raise NotFound(f"{ref.plural}/{ref.name}") from None
 
     def list(self, ref: ObjectRef) -> list[dict]:
         with self._lock:

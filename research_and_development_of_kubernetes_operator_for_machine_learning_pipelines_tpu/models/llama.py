@@ -996,6 +996,7 @@ def verify_ragged(
     cfg: LlamaConfig,
     dtype=jnp.bfloat16,
     window: int | None = None,
+    active: jax.Array | None = None,
 ):
     """Score S tokens per slot in ONE forward (self-speculative verify).
 
@@ -1013,6 +1014,10 @@ def verify_ragged(
     One compiled variant per (S, window) pair; S = 1 degenerates to a
     single-token decode step (the engine uses :func:`decode_ragged`
     there — this path exists for the draft lengths).
+
+    ``active`` (bool ``[B]`` or None) parks inactive rows' K/V writes
+    (see :func:`_commit_chunk`): an inactive slot may be mid-packed-
+    prefill and its rows belong to the admission path this tick.
     """
     b, s = token_ids.shape
     quant = isinstance(cache, QuantRaggedKVCache)
@@ -1079,18 +1084,28 @@ def verify_ragged(
     x, k_news, v_news = lax.fori_loop(0, nlayers, layer_body, (x, acc_k, acc_v))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = _qmatmul(x, params["lm_head"])
-    return logits, _commit_chunk(cache, k_news, v_news, lengths, quant)
+    return logits, _commit_chunk(cache, k_news, v_news, lengths, quant, active)
 
 
-def _commit_chunk(cache, k_news, v_news, lengths, quant):
+def _commit_chunk(cache, k_news, v_news, lengths, quant, active=None):
     """Commit a verify chunk's K/V: row ``b``'s token ``j`` lands at
     position ``lengths[b] + j``, ONE batched drop-scatter per buffer
     over the ``[B, S]`` index grid — sequential per-``j`` passes would
     re-pay the scatter's full-buffer walk S times (the round-5 commit
     measurements put one pass at ~3.8 ms at the 1.35B/32-slot shape),
     taxing exactly the tick speculation exists to accelerate.
-    ``lengths`` is returned UNCHANGED: acceptance decides the advance."""
+    ``lengths`` is returned UNCHANGED: acceptance decides the advance.
+
+    ``active`` (bool [B] or None) parks INACTIVE rows' writes at
+    capacity so the drop-mode scatter discards them: an empty slot may
+    be mid-packed-prefill (its K/V written by the admission path, not
+    this tick), and the old always-write garbage row would corrupt it.
+    """
     s = k_news.shape[2]
+    capacity = (cache.k8 if quant else cache.k).shape[3]
+    write_base = lengths
+    if active is not None:
+        write_base = jnp.where(active, lengths, jnp.int32(capacity))
 
     def commit(buf, vals):
         # buf [L, B, NKV, T, ...]; vals [L, B, S, NKV, ...].  Advanced
@@ -1100,7 +1115,7 @@ def _commit_chunk(cache, k_news, v_news, lengths, quant):
         # row); rows spilling past capacity drop, never clamp.
         b = buf.shape[1]
         rows = jnp.arange(b)[:, None]
-        pos = lengths[:, None] + jnp.arange(s)[None, :]
+        pos = write_base[:, None] + jnp.arange(s)[None, :]
         v = jnp.moveaxis(vals, (1, 2), (0, 1)).astype(buf.dtype)
         return buf.at[:, rows, :, pos].set(
             v, mode="drop", unique_indices=True
@@ -1121,6 +1136,148 @@ def _commit_chunk(cache, k_news, v_news, lengths, quant):
     )
 
 
+def prefill_chunks_ragged(
+    params: dict,
+    token_ids: jax.Array,
+    cache: "RaggedKVCache | QuantRaggedKVCache",
+    slots: jax.Array,
+    offsets: jax.Array,
+    cfg: LlamaConfig,
+    dtype=jnp.bfloat16,
+):
+    """Packed multi-admission prefill: one forward for ``B_p`` sequences'
+    next prompt chunks under ONE weight stream.
+
+    ``token_ids`` is ``[B_p, C]``: row ``b`` is the next uncached chunk
+    of an in-flight admission whose K/V lives in cache row ``slots[b]``
+    and whose ``offsets[b]`` tokens (earlier chunks and/or a radix-cached
+    prefix) are already written there; chunk position ``j`` occupies
+    absolute position ``offsets[b] + j``.  This is :func:`verify_ragged`
+    with a per-row cache-row indirection: the attention decomposes into
+    the strict cache window (``key_pos < offsets[b]``, gathered from row
+    ``slots[b]``) and the exact in-chunk causal term, joined in one
+    softmax — so serial chunked prefill (B_p sequential batch-1 chunk
+    forwards, each streaming the full weight tree) collapses to one
+    forward whose weight stream is amortized across all B_p admissions.
+
+    Rows may be PARKED by passing ``offsets[b] == capacity``: the commit
+    scatter drops their writes (``mode="drop"``) and their logits are
+    garbage the caller ignores — that is how a packed call padded up to
+    a power-of-two B_p bucket keeps every shape static.
+
+    Returns ``(logits [B_p, C, vocab] float32, cache)`` with each real
+    row's chunk K/V committed at ``(slots[b], offsets[b] + j)`` by one
+    batched drop-scatter per buffer and ``lengths`` UNCHANGED — the
+    engine's finalize step sets a slot's length when its LAST chunk
+    lands (until then the row stays inactive and decode ticks park
+    their writes for it; see :func:`_finish_decode`).
+    """
+    b, s = token_ids.shape
+    quant = isinstance(cache, QuantRaggedKVCache)
+    x = jnp.take(params["embed"], token_ids, axis=0).astype(dtype)
+
+    positions = offsets[:, None] + jnp.arange(s)[None, :]  # [B_p, C]
+    cos, sin = rope_cos_sin(positions, cfg, jnp.float32)
+
+    capacity = (cache.k8 if quant else cache.k).shape[3]
+    key_pos = jnp.arange(capacity)
+    # STRICT cache mask, exactly verify_ragged's: no chunk position has
+    # been written yet, so every chunk query sees key_pos < offsets[b];
+    # in-chunk positions are attended through the exact causal term.
+    valid = key_pos[None, :] < offsets[:, None]  # [B_p, T]
+    mask_bias = jnp.where(valid, 0.0, -1e9).astype(jnp.float32)[:, None, None]
+    qpos = jnp.arange(s)
+    chunk_causal = qpos[:, None] >= qpos[None, :]
+    chunk_bias = jnp.where(chunk_causal, 0.0, -1e9).astype(jnp.float32)[
+        None, None, None
+    ]
+
+    nlayers = cfg.num_layers
+    kv_dtype = x.dtype
+    acc_k = jnp.zeros((nlayers, b, s, cfg.num_kv_heads, cfg.head_dim), kv_dtype)
+    acc_v = jnp.zeros_like(acc_k)
+
+    def idx(tree, l):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, l, axis=0, keepdims=False),
+            tree,
+        )
+
+    def layer_body(l, carry):
+        x, acc_k, acc_v = carry
+        # Gather the B_p admissions' cache rows out of the full slot
+        # batch: the compute (and the weight stream it amortizes) scales
+        # with the B_p bucket, not max_slots.
+        if quant:
+            ck = (
+                lax.dynamic_index_in_dim(cache.k8, l, 0, keepdims=False)[slots],
+                lax.dynamic_index_in_dim(
+                    cache.k_scale, l, 0, keepdims=False
+                )[slots],
+            )
+            cv = (
+                lax.dynamic_index_in_dim(cache.v8, l, 0, keepdims=False)[slots],
+                lax.dynamic_index_in_dim(
+                    cache.v_scale, l, 0, keepdims=False
+                )[slots],
+            )
+        else:
+            ck = lax.dynamic_index_in_dim(cache.k, l, 0, keepdims=False)[slots]
+            cv = lax.dynamic_index_in_dim(cache.v, l, 0, keepdims=False)[slots]
+        y, k_new, v_new = _block_verify_deferred(
+            x, idx(params["layers"], l), ck, cv, cos, sin, mask_bias,
+            chunk_bias, cfg, window=capacity,
+        )
+        acc_k = lax.dynamic_update_slice_in_dim(
+            acc_k, k_new[None].astype(kv_dtype), l, axis=0
+        )
+        acc_v = lax.dynamic_update_slice_in_dim(
+            acc_v, v_new[None].astype(kv_dtype), l, axis=0
+        )
+        return y, acc_k, acc_v
+
+    x, k_news, v_news = lax.fori_loop(0, nlayers, layer_body, (x, acc_k, acc_v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = _qmatmul(x, params["lm_head"])
+    return logits, _commit_chunk_at(cache, k_news, v_news, slots, offsets, quant)
+
+
+def _commit_chunk_at(cache, k_news, v_news, slots, offsets, quant):
+    """Commit a packed prefill chunk's K/V: row ``b``'s token ``j`` lands
+    at ``(slots[b], offsets[b] + j)`` — :func:`_commit_chunk` with a
+    per-row cache-row indirection.  Parked rows (``offsets[b] ==
+    capacity``) drop every write.  ``unique_indices`` contract — the
+    (slot, position) tuples must be pairwise distinct, which holds when
+    (a) REAL rows carry distinct slots (the engine reserves one cache
+    row per admission) with in-range positions, and (b) PARKED rows
+    carry slots distinct from each other (their positions start at
+    ``capacity``, so they cannot collide with a real row's tuple even
+    on an equal slot value)."""
+    s = k_news.shape[2]
+
+    def commit(buf, vals):
+        rows = slots[:, None]
+        pos = offsets[:, None] + jnp.arange(s)[None, :]
+        v = jnp.moveaxis(vals, (1, 2), (0, 1)).astype(buf.dtype)
+        return buf.at[:, rows, :, pos].set(
+            v, mode="drop", unique_indices=True
+        )
+
+    if quant:
+        kq, kqs = _quant_kv(k_news)
+        vq, vqs = _quant_kv(v_news)
+        return QuantRaggedKVCache(
+            commit(cache.k8, kq),
+            commit(cache.k_scale, kqs),
+            commit(cache.v8, vq),
+            commit(cache.v_scale, vqs),
+            cache.lengths,
+        )
+    return RaggedKVCache(
+        commit(cache.k, k_news), commit(cache.v, v_news), cache.lengths
+    )
+
+
 def _finish_decode(params, x, k_news, v_news, cache, lengths, active, quant, cfg):
     """Shared decode tail: final norm, lm_head, and the cache commit.
 
@@ -1133,19 +1290,27 @@ def _finish_decode(params, x, k_news, v_news, cache, lengths, active, quant, cfg
     advance = (
         jnp.ones((b,), jnp.int32) if active is None else active.astype(jnp.int32)
     )
+    # Inactive rows write NOTHING (positions parked at capacity, dropped
+    # by the scatter): an empty slot may be mid-packed-prefill, and its
+    # rows are being written by the admission path — the old
+    # always-write garbage token would corrupt the prefilled prompt.
+    capacity = (cache.k8 if quant else cache.k).shape[3]
+    write_pos = lengths
+    if active is not None:
+        write_pos = jnp.where(active, lengths, jnp.int32(capacity))
     if quant:
         kq, kqs = _quant_kv(k_news)
         vq, vqs = _quant_kv(v_news)
         return logits, QuantRaggedKVCache(
-            _commit_rows(cache.k8, kq, lengths),
-            _commit_rows(cache.k_scale, kqs, lengths),
-            _commit_rows(cache.v8, vq, lengths),
-            _commit_rows(cache.v_scale, vqs, lengths),
+            _commit_rows(cache.k8, kq, write_pos),
+            _commit_rows(cache.k_scale, kqs, write_pos),
+            _commit_rows(cache.v8, vq, write_pos),
+            _commit_rows(cache.v_scale, vqs, write_pos),
             lengths + advance,
         )
     return logits, RaggedKVCache(
-        _commit_rows(cache.k, k_news.astype(cache.k.dtype), lengths),
-        _commit_rows(cache.v, v_news.astype(cache.v.dtype), lengths),
+        _commit_rows(cache.k, k_news.astype(cache.k.dtype), write_pos),
+        _commit_rows(cache.v, v_news.astype(cache.v.dtype), write_pos),
         lengths + advance,
     )
 

@@ -55,7 +55,7 @@ def get_builder(flavor: str) -> Callable[..., Predictor]:
     except KeyError:
         raise KeyError(
             f"unknown model flavor {flavor!r}; registered: {sorted(_BUILDERS)}"
-        )
+        ) from None
 
 
 def list_flavors() -> list[str]:

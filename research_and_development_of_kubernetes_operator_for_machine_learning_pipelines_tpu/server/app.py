@@ -665,6 +665,14 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
         on_prefix_evict=metrics.inc_prefix_evictions if metrics else None,
         speculative=speculative,
         on_spec=metrics.observe_speculative if metrics else None,
+        # Packed multi-admission prefill: same batch geometry on leader
+        # and followers (this one construction site) — the compiled B_p
+        # bucket variants must agree for lockstep replay.
+        prefill_batch=config.tpu.prefill_batch,
+        prefill_token_budget=config.tpu.prefill_token_budget,
+        on_prefill_batch=metrics.observe_prefill_batch if metrics else None,
+        on_admission_wait=metrics.observe_admission_wait if metrics else None,
+        on_ttft=metrics.observe_ttft if metrics else None,
     )
 
 
@@ -780,6 +788,23 @@ def main(argv: list[str] | None = None) -> None:
         "stalling in-flight decode streams",
     )
     ap.add_argument(
+        "--prefill-batch",
+        type=int,
+        default=1,
+        help="concurrent admissions whose next prompt chunks batch into "
+        "ONE prefill call per tick (amortizes the weight stream under "
+        "bursty load; 1 = single-admission pipeline, requires "
+        "--prefill-chunk or --prefix-cache when > 1)",
+    )
+    ap.add_argument(
+        "--prefill-token-budget",
+        type=int,
+        default=0,
+        help="prompt tokens prefilled per engine tick, Sarathi-style "
+        "(0 = uncapped); bounds decode-cadence jitter under long-prompt "
+        "bursts",
+    )
+    ap.add_argument(
         "--prefix-cache",
         type=int,
         default=0,
@@ -873,6 +898,8 @@ def main(argv: list[str] | None = None) -> None:
                 "maxBatchDelayMs": args.max_batch_delay_ms,
                 "quantize": args.quantize,
                 "prefillChunk": args.prefill_chunk or None,
+                "prefillBatch": args.prefill_batch,
+                "prefillTokenBudget": args.prefill_token_budget,
                 "prefixCache": {
                     "enabled": bool(args.prefix_cache),
                     "budgetMB": args.prefix_cache_budget_mb,

@@ -23,7 +23,16 @@ Design (vLLM-style scheduling, TPU-static shapes):
   next queued request takes it — no barrier on batch completion
   ("continuous batching").
 - Inactive slots still compute (the MXU does not care) and advance
-  nothing; their sampled tokens are discarded host-side.
+  nothing; their sampled tokens are discarded host-side (and their cache
+  writes DROP — an inactive row may belong to a packed admission
+  mid-prefill).
+- Packed multi-admission prefill (``prefill_batch`` > 1): a queue of
+  in-flight admissions each reserves a cache row, and every engine tick
+  up to ``prefill_batch`` of their next prompt chunks run as ONE batched
+  call — the per-chunk HBM weight stream is paid once per tick instead
+  of once per admission, which is what holds TTFT through a cold-start
+  burst or traffic ramp.  ``prefill_token_budget`` caps the packed work
+  per tick so decode cadence survives long-prompt bursts.
 
 The big cache buffers are donated through both jitted programs, so steady
 state allocates no new HBM per token.  Greedy decoding only — matching
@@ -44,6 +53,14 @@ from typing import Callable, Sequence
 import numpy as np
 
 _log = logging.getLogger("tpumlops.generation")
+
+
+class EngineShutdown(RuntimeError):
+    """The engine shut down before this request's admission completed.
+
+    Raised into the futures of queued (not-yet-admitted) and mid-prefill
+    requests at shutdown, so callers get a clear error instead of a bare
+    ``CancelledError`` (or a hang on a future nobody will resolve)."""
 
 
 def _safe_resolve(fut: Future, value) -> None:
@@ -139,9 +156,16 @@ class _Slot:
     draft: "object | None" = None  # speculative.DraftState
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: list membership/removal must
+# never field-compare (numpy prompt arrays make == a broadcast, not a bool)
 class _PrefillProgress:
-    """A chunked admission in flight (one at a time).
+    """A chunked admission in flight.
+
+    Single-admission mode (``prefillBatch`` 1, the default) holds at
+    most one of these and threads a batch-1 scratch cache through the
+    engine's ``_seq_state``; packed mode holds a queue of them, each
+    with a RESERVED cache row (``slot``) its chunks are written into
+    directly.
 
     ``chunks`` covers only the UNCACHED suffix when a radix-cached
     prefix was found at admission (``cached_tokens`` > 0): the prefix's
@@ -154,6 +178,7 @@ class _PrefillProgress:
     cached_tokens: int = 0
     cached_kv: list = field(default_factory=list)
     seeded: bool = False
+    slot: int = -1  # packed mode: reserved cache row (-1 = scratch path)
 
 
 @dataclass
@@ -167,6 +192,7 @@ class _Request:
     top_p: float = 1.0  # >= 1: disabled
     seed: int | None = None  # None: engine-assigned (boot-nonce fold_in)
     on_token: Callable[[int], None] | None = None  # streaming callback
+    t_submit: float = 0.0  # perf_counter at submit (admission-wait / TTFT)
 
 
 class GenerationEngine:
@@ -186,7 +212,7 @@ class GenerationEngine:
         max_slots: int = 4,
         dtype=None,
         eos_id: int | None = None,
-        on_step: Callable[[int, float, int], None] | None = None,
+        on_step: Callable[[int, float, int, int], None] | None = None,
         on_tokens: Callable[[int], None] | None = None,
         channel=None,
         kv_quant: bool = False,
@@ -196,6 +222,11 @@ class GenerationEngine:
         on_prefix_evict: Callable[[], None] | None = None,
         speculative=None,  # speculative.SpeculativeConfig | None
         on_spec: Callable[[int, int], None] | None = None,
+        prefill_batch: int = 1,
+        prefill_token_budget: int = 0,
+        on_prefill_batch: Callable[[int], None] | None = None,
+        on_admission_wait: Callable[[float], None] | None = None,
+        on_ttft: Callable[[float], None] | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -205,7 +236,9 @@ class GenerationEngine:
         self._params = params
         self._cfg = cfg
         self._eos_default = eos_id
-        # (active_slots, step_seconds, queue_depth) per decode/verify tick
+        # (active_slots, step_seconds, queue_depth, admitting) per
+        # decode/verify tick — queue_depth is QUEUED-BUT-UNADMITTED only;
+        # admitting counts in-flight (mid-prefill) admissions.
         self._on_step = on_step
         self._on_tokens = on_tokens  # (n,) per token delivered to a client
         # multihost.UnitChannel: leader broadcasts every device call so
@@ -258,6 +291,33 @@ class GenerationEngine:
                     f"prefill_chunk {C} must divide KV capacity "
                     f"{self.capacity}"
                 )
+        # Packed multi-admission prefill: up to prefill_batch in-flight
+        # admissions' next chunks run as ONE batched forward per tick —
+        # the per-chunk weight stream amortizes across admissions the
+        # way PR 2's verify amortized decode.  1 (the default) keeps the
+        # single-admission pipeline byte-for-byte.
+        self._prefill_batch = 1 if prefill_batch is None else int(prefill_batch)
+        if self._prefill_batch < 1:
+            raise ValueError(
+                f"prefill_batch must be >= 1, got {prefill_batch}"
+            )
+        if self._prefill_batch > 1 and self._prefill_chunk_size is None:
+            raise ValueError(
+                "prefill_batch > 1 requires chunked prefill: set "
+                "prefillChunk (or enable prefixCache, which implies it)"
+            )
+        # More concurrent admissions than cache rows cannot exist.
+        self._prefill_batch = min(self._prefill_batch, self.max_slots)
+        self._prefill_token_budget = int(prefill_token_budget or 0)
+        if self._prefill_token_budget < 0:
+            raise ValueError(
+                "prefill_token_budget must be >= 0, got "
+                f"{prefill_token_budget}"
+            )
+        self._packed = self._prefill_batch > 1
+        self._on_prefill_batch = on_prefill_batch
+        self._on_admission_wait = on_admission_wait
+        self._on_ttft = on_ttft
         if prefix_enabled:
             from .prefix_cache import RadixPrefixCache
 
@@ -361,7 +421,8 @@ class GenerationEngine:
 
             cache = make_cache(k, v, lengths)
             logits, cache = llama.verify_ragged(
-                params, toks, cache, cfg, dtype=dtype, window=window
+                params, toks, cache, cfg, dtype=dtype, window=window,
+                active=active,
             )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
             accepted, nxt = speculative_accept(toks, greedy, draft_len)
@@ -471,10 +532,119 @@ class GenerationEngine:
 
         self._insert_only = jax.jit(_insert_only, donate_argnums=(1, 2))
 
+        max_slots_static = self.max_slots
+
+        def _prefill_chunks_batched(
+            params, ids, k, v, lengths, toks, keys, temps, tks, tps,
+            slots, offsets, last_pos, final_lens,
+            slot_keys, r_temps, r_tks, r_tps,
+        ):
+            # Packed admission: B_p in-flight admissions' next chunks in
+            # ONE forward (llama.prefill_chunks_ragged), plus the
+            # finalize step for rows whose chunk completes the prompt
+            # (last_pos >= 0): install the slot's sampling state and
+            # sample the first token — the per-sequence _insert_only
+            # discipline, batched.  Non-final (and pad) rows scatter to
+            # the out-of-range slot index and drop.  One compiled
+            # variant per power-of-two B_p bucket (the ids shape).
+            from ..models.sampling import sample_logits, split_keys
+
+            cache = make_cache(k, v, lengths)
+            logits, cache = llama.prefill_chunks_ragged(
+                params, ids, cache, slots, offsets, cfg, dtype=dtype
+            )
+            is_final = last_pos >= 0
+            row = jnp.take_along_axis(
+                logits, jnp.maximum(last_pos, 0)[:, None, None], axis=1
+            )[:, 0]  # [B_p, vocab]
+            carry, use = split_keys(slot_keys)
+            firsts = sample_logits(row, use, r_temps, r_tks, r_tps)
+            tgt = jnp.where(is_final, slots, jnp.int32(max_slots_static))
+            kd = jax.random.key_data(keys)
+            keys2 = jax.random.wrap_key_data(
+                kd.at[tgt].set(jax.random.key_data(carry), mode="drop")
+            )
+            temps2 = temps.at[tgt].set(r_temps, mode="drop")
+            tks2 = tks.at[tgt].set(r_tks, mode="drop")
+            tps2 = tps.at[tgt].set(r_tps, mode="drop")
+            lengths2 = cache.lengths.at[tgt].set(final_lens, mode="drop")
+            toks2 = toks.at[tgt, 0].set(firsts, mode="drop")
+            ck, cv = cache_repr(cache)
+            return ck, cv, lengths2, toks2, keys2, temps2, tks2, tps2, firsts
+
+        self._prefill_chunks = jax.jit(
+            _prefill_chunks_batched, donate_argnums=(2, 3)
+        )
+
+        def _seed_chunk_slot(k, v, ck, cv, slot, start):
+            # Packed-mode prefix-cache hit: copy one cached chunk's K/V
+            # straight into the reserved cache row at its absolute
+            # offset (the scratch-path _seed_chunk, retargeted at a slot
+            # of the ragged cache).  ck/cv arrive position-major
+            # [L, 1, C, NKV, D] — the radix cache's storage layout, so
+            # entries stay interchangeable between modes.
+            z = jnp.int32(0)
+            ckh = jnp.swapaxes(ck, 2, 3)  # -> head-major [L,1,NKV,C,D]
+            cvh = jnp.swapaxes(cv, 2, 3)
+            if self._kv_quant:
+                from ..models.llama import _quant_kv
+
+                k8, ksc = _quant_kv(ckh.astype(dtype))
+                v8, vsc = _quant_kv(cvh.astype(dtype))
+                kb, ks = k
+                vb, vs = v
+                at = (z, slot, z, start, z)
+                return (
+                    (lax_dus(kb, k8, at), lax_dus(ks, ksc, at)),
+                    (lax_dus(vb, v8, at), lax_dus(vs, vsc, at)),
+                )
+            at = (z, slot, z, start, z)
+            return (
+                lax_dus(k, ckh.astype(k.dtype), at),
+                lax_dus(v, cvh.astype(v.dtype), at),
+            )
+
+        self._seed_slot = jax.jit(_seed_chunk_slot, donate_argnums=(0, 1))
+
+        def _read_chunk_slot(k, v, slot, start):
+            # Packed-mode prefix-cache write-back: pull one freshly
+            # prefilled chunk's K/V off the reserved cache row, returned
+            # position-major (the radix cache's storage layout).  An
+            # int8kv cache dequantizes on the way out — lossless round
+            # trip: re-quantizing q8*scale reproduces q8 and scale
+            # exactly (the per-head max is preserved).
+            C = self._prefill_chunk_size
+            z = jnp.int32(0)
+            at = (z, slot, z, start, z)
+
+            def pull(buf, width):
+                size = (buf.shape[0], 1, buf.shape[2], C, width)
+                return lax_ds(buf, at, size)
+
+            if self._kv_quant:
+                kb, ks = k
+                vb, vs = v
+                ck = pull(kb, kb.shape[4]).astype(dtype) * pull(ks, 1)
+                cv = pull(vb, vb.shape[4]).astype(dtype) * pull(vs, 1)
+            else:
+                ck = pull(k, k.shape[4])
+                cv = pull(v, v.shape[4])
+            return (
+                jnp.swapaxes(ck, 2, 3).astype(dtype),
+                jnp.swapaxes(cv, 2, 3).astype(dtype),
+            )
+
+        self._read_slot = jax.jit(_read_chunk_slot)
+
         self._slots: list[_Slot | None] = [None] * self.max_slots
-        self._pending: _PrefillProgress | None = None
-        # Chunked-prefill scratch (leader and follower both thread the
-        # in-progress sequence cache through here; one admission at a time).
+        self._pending: list[_PrefillProgress] = []
+        # Packed mode: cache rows reserved by in-flight admissions (their
+        # chunks are being written there; decode must not hand them out).
+        self._reserved: set[int] = set()
+        # Single-admission chunked-prefill scratch (leader and follower
+        # both thread the in-progress sequence cache through here; it is
+        # what serializes that mode to one admission at a time — packed
+        # mode writes straight into reserved cache rows and never uses it).
         self._seq_state = None  # (last_logits, seq_k, seq_v, seq_len)
         # Engine-assigned sampling keys: fold a per-boot nonce so unseeded
         # requests never collide with the user-visible seed space (and never
@@ -484,6 +654,10 @@ class GenerationEngine:
 
         self._boot_key = jax.random.key(int.from_bytes(_os.urandom(7), "little"))
         self._seed_counter = 0
+        # Constant pad-row key material for packed calls, computed ONCE:
+        # rebuilding it per tick would put a device dispatch + D2H sync
+        # on the scheduler thread ahead of every packed dispatch.
+        self._zero_kd = np.asarray(jax.random.key_data(jax.random.key(0)))
         self._queue: queue.Queue[_Request | None] = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -494,6 +668,12 @@ class GenerationEngine:
         self.prefix_cached_tokens = 0
         self.prefix_evictions = 0
         self.prefill_chunks_dispatched = 0
+        # Weight-streaming prefill dispatches (fused prefills, serial
+        # chunk forwards, packed batched calls each count 1): the
+        # packed_prefill_serving bench reads the packed-vs-serial drop
+        # here — every dispatch avoided is a full HBM weight stream
+        # the admissions shared instead of re-paying.
+        self.prefill_forwards = 0
         # Speculative observability (also read by bench.py's
         # speculative_serving scenario): decode_forwards counts every
         # weight-streaming decode/verify dispatch, decode_tokens every
@@ -557,6 +737,7 @@ class GenerationEngine:
         compiled one would stall the single scheduler thread (and every
         in-flight stream) for seconds the first time traffic crosses a
         bucket boundary."""
+        import jax
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
@@ -575,9 +756,29 @@ class GenerationEngine:
                     self._cfg.num_kv_heads, self._cfg.head_dim,
                 )
                 zk = np.asarray(jnp.zeros(shape, self._dtype))
-                self._dispatch_seed([(zk, zk)], C)
-                _, sk, sv, _slen = self._seq_state
-                self._read_chunk(sk, sv, jnp.int32(0))
+                if self._packed:
+                    # Packed mode seeds/reads the reserved cache row
+                    # directly — different executables than the scratch
+                    # path (zeros into row 0 == the freshly allocated
+                    # state, so nothing to clean up after).
+                    self._dispatch_seed_slot([(zk, zk)], 0, C)
+                    self._read_slot(
+                        self._cache_k, self._cache_v,
+                        jnp.int32(0), jnp.int32(0),
+                    )
+                else:
+                    self._dispatch_seed([(zk, zk)], C)
+                    _, sk, sv, _slen = self._seq_state
+                    self._read_chunk(sk, sv, jnp.int32(0))
+            if self._packed:
+                # Packed-prefill variants: one executable per B_p bucket
+                # (the ids shape is what jit caches on).  Dispatched, not
+                # raw: followers of a multihost unit must compile the
+                # same buckets.  The fully parked batch shares the live
+                # path's construction site, so warmed shapes cannot
+                # drift from what _packed_tick dispatches.
+                for bucket in self._pack_buckets():
+                    self._dispatch_chunks(*self._parked_batch(bucket))
             self._admit_now(
                 _Request(
                     prompt=np.array([1], np.int32),
@@ -661,13 +862,20 @@ class GenerationEngine:
         self._queue.put(None)  # unblock the scheduler
         if self._thread is not None:
             self._thread.join(timeout=30)
-        if self._pending is not None:
+        for prog in self._pending:
             # A chunked admission in flight is in neither the queue nor a
-            # slot; cancel it or its client awaits forever.
-            if not self._pending.req.future.done():
-                self._pending.req.future.cancel()
-            self._pending = None
-            self._seq_state = None
+            # slot; fail it LOUDLY or its client awaits forever.
+            if not prog.req.future.done():
+                _safe_fail(
+                    prog.req.future,
+                    EngineShutdown(
+                        "engine shut down mid-prefill; retry on another "
+                        "replica"
+                    ),
+                )
+        self._pending = []
+        self._reserved.clear()
+        self._seq_state = None
         for slot in self._slots:
             if slot is not None and not slot.future.done():
                 slot.future.cancel()
@@ -677,7 +885,17 @@ class GenerationEngine:
             except queue.Empty:
                 break
             if req is not None and not req.future.done():
-                req.future.cancel()
+                # Queued-but-unadmitted: a clear EngineShutdown beats a
+                # bare CancelledError — callers can distinguish "the
+                # server is going away, retry elsewhere" from a client-
+                # side cancel.
+                _safe_fail(
+                    req.future,
+                    EngineShutdown(
+                        "engine shut down before admission; retry on "
+                        "another replica"
+                    ),
+                )
 
     # -- client API ----------------------------------------------------------
 
@@ -765,6 +983,7 @@ class GenerationEngine:
                 top_p=float(top_p),
                 seed=seed,
                 on_token=on_token,
+                t_submit=time.perf_counter(),
             )
         )
         return fut
@@ -786,7 +1005,7 @@ class GenerationEngine:
 
     def _free_slot(self) -> int | None:
         for i, s in enumerate(self._slots):
-            if s is None:
+            if s is None and i not in self._reserved:
                 return i
         return None
 
@@ -807,6 +1026,8 @@ class GenerationEngine:
         first = self._dispatch_admit(
             ids, slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p
         )
+        if not self._in_warmup:
+            self.prefill_forwards += 1
         slot = _Slot(
             future=req.future,
             remaining=req.max_new_tokens,
@@ -818,7 +1039,22 @@ class GenerationEngine:
             **self._spec_slot_state(req),
         )
         self._slots[slot_idx] = slot
+        self._note_ttft(req)
         self._record_token(slot_idx, int(first))
+
+    def _note_ttft(self, req: _Request) -> None:
+        """First token produced for ``req``: record submit->token wall."""
+        if self._in_warmup or req.t_submit <= 0.0:
+            return
+        if self._on_ttft is not None:
+            self._on_ttft(time.perf_counter() - req.t_submit)
+
+    def _note_admission_wait(self, req: _Request) -> None:
+        """``req`` left the submission queue and its admission began."""
+        if self._in_warmup or req.t_submit <= 0.0:
+            return
+        if self._on_admission_wait is not None:
+            self._on_admission_wait(time.perf_counter() - req.t_submit)
 
     def _spec_slot_state(self, req: _Request) -> dict:
         """Per-slot speculative state (empty when speculation is off)."""
@@ -846,9 +1082,18 @@ class GenerationEngine:
         if self._prefill_chunk_size is None:
             self._admit(req)
             return
-        self._pending = self._make_progress(req)
-        while self._pending is not None:
-            self._chunk_tick()
+        prog = self._make_progress(req)
+        if self._packed:
+            slot = self._free_slot()
+            assert slot is not None
+            prog.slot = slot
+            self._reserved.add(slot)
+        self._pending.append(prog)
+        while prog in self._pending:
+            if self._packed:
+                self._packed_tick()
+            else:
+                self._chunk_tick()
 
     def _dispatch_admit(self, ids, slot_idx, L, slot_key, temp, tk, tp):
         """Broadcast (multihost) then run the prefill+insert device call."""
@@ -1124,6 +1369,284 @@ class GenerationEngine:
         slot_key = jax.random.wrap_key_data(np.asarray(key_data))
         self._device_insert(slot, length, slot_key, temp, tk, tp, last_idx)
 
+    # -- packed multi-admission prefill (prefillBatch > 1) -------------------
+
+    def _pack_buckets(self) -> list[int]:
+        """Power-of-two B_p buckets up to ``prefill_batch`` (which caps
+        the set even when it is not itself a power of two), ascending —
+        one compiled packed-call variant each, all swept at warmup."""
+        out, b = [], 1
+        while b < self._prefill_batch:
+            out.append(b)
+            b *= 2
+        out.append(self._prefill_batch)
+        return out
+
+    def _pack_bucket(self, n: int) -> int:
+        for b in self._pack_buckets():
+            if b >= n:
+                return b
+        return self._prefill_batch
+
+    def _parked_batch(self, bucket: int) -> tuple:
+        """A fully PARKED packed-call argument set — (ids, slots,
+        offsets, last_pos, final_lens, key_data, temps, tks, tps) where
+        every row writes nothing (offset == capacity drops), finalizes
+        nothing (last_pos == -1), and carries neutral sampling params.
+        The warmup bucket sweep dispatches it as-is; :meth:`_packed_tick`
+        overwrites rows ``[0, n)`` with the real admissions — ONE
+        construction site, so the warmed shapes can never drift from the
+        live call's.  Pad slots are pairwise distinct (and their parked
+        positions start at capacity, so equality with a REAL row's
+        reserved slot cannot collide index tuples — see
+        llama._commit_chunk_at's unique-indices contract)."""
+        C = self._prefill_chunk_size
+        return (
+            np.zeros((bucket, C), np.int32),
+            np.arange(bucket, dtype=np.int32),
+            np.full((bucket,), self.capacity, np.int32),
+            np.full((bucket,), -1, np.int32),
+            np.zeros((bucket,), np.int32),
+            np.broadcast_to(
+                self._zero_kd, (bucket,) + self._zero_kd.shape
+            ).copy(),
+            np.zeros((bucket,), np.float32),
+            np.zeros((bucket,), np.int32),
+            np.ones((bucket,), np.float32),
+        )
+
+    def _packed_tick(self) -> None:
+        """Advance up to ``prefill_batch`` in-flight admissions by one
+        chunk each — ONE batched device call (plus one seed op per
+        admission entering with a radix-cached prefix).  The token-budget
+        knob caps the chunks packed per tick, Sarathi-style: decode ticks
+        interleave every tick regardless, so bounding prefill work per
+        tick bounds the decode-cadence jitter long prompts can inject."""
+        C = self._prefill_chunk_size
+        max_chunks = self._prefill_batch
+        if self._prefill_token_budget:
+            max_chunks = min(
+                max_chunks, max(1, self._prefill_token_budget // C)
+            )
+        take = self._pending[:max_chunks]
+        chunk_progs = []
+        for prog in take:
+            if prog.cached_tokens and not prog.seeded:
+                # Cached-prefix hit: seed the radix K/V straight into the
+                # reserved cache row; those tokens never re-prefill.
+                self._dispatch_seed_slot(
+                    prog.cached_kv, prog.slot, prog.cached_tokens
+                )
+                prog.seeded = True
+                prog.cached_kv = []
+                self.prefix_hits += 1
+                self.prefix_cached_tokens += prog.cached_tokens
+                if self._on_prefix_hit is not None and not self._in_warmup:
+                    self._on_prefix_hit(prog.cached_tokens)
+            else:
+                chunk_progs.append(prog)
+        if not chunk_progs:
+            return
+        import jax
+
+        n = len(chunk_progs)
+        bucket = self._pack_bucket(n)
+        (
+            ids, slots, offsets, last_pos, final_lens,
+            key_data, r_temps, r_tks, r_tps,
+        ) = self._parked_batch(bucket)
+        for i, prog in enumerate(chunk_progs):
+            req = prog.req
+            ids[i] = prog.chunks[prog.next_idx][0]
+            slots[i] = prog.slot
+            offsets[i] = prog.cached_tokens + prog.next_idx * C
+            if prog.next_idx == len(prog.chunks) - 1:
+                L = int(req.prompt.size)
+                last_pos[i] = (L - 1) - int(offsets[i])
+                final_lens[i] = L
+                r_temps[i] = req.temperature
+                r_tks[i] = req.top_k
+                r_tps[i] = req.top_p
+                key_data[i] = np.asarray(
+                    jax.random.key_data(self._slot_key_for(req))
+                )
+        t0 = time.perf_counter()
+        firsts = self._dispatch_chunks(
+            ids, slots, offsets, last_pos, final_lens,
+            key_data, r_temps, r_tks, r_tps,
+        )
+        if not self._in_warmup:
+            self.prefill_chunks_dispatched += n
+            self.prefill_forwards += 1
+            if self._on_prefill_batch is not None:
+                self._on_prefill_batch(n)
+        for i, prog in enumerate(chunk_progs):
+            self._maybe_cache_chunk_slot(prog)
+            prog.next_idx += 1
+            if prog.next_idx < len(prog.chunks):
+                continue
+            # Final chunk landed: the packed call already installed the
+            # slot's device state and sampled its first token.
+            self._pending.remove(prog)
+            self._reserved.discard(prog.slot)
+            req = prog.req
+            self._slots[prog.slot] = _Slot(
+                future=req.future,
+                remaining=req.max_new_tokens,
+                eos_id=req.eos_id,
+                sampling=req.temperature > 0,
+                on_token=req.on_token,
+                prompt_len=int(req.prompt.size),
+                t_start=t0,
+                **self._spec_slot_state(req),
+            )
+            self._note_ttft(req)
+            self._record_token(prog.slot, int(firsts[i]))
+
+    def _maybe_cache_chunk_slot(self, prog: _PrefillProgress) -> None:
+        """Packed-mode prefix write-back: like :meth:`_maybe_cache_chunk`
+        but the freshly prefilled chunk is read from the reserved cache
+        row, not the batch-1 scratch."""
+        if self._prefix_cache is None or self._in_warmup:
+            return
+        import jax.numpy as jnp
+
+        C = self._prefill_chunk_size
+        L = int(prog.req.prompt.size)
+        start = prog.cached_tokens + prog.next_idx * C
+        if start + C > L:
+            return
+        chunk_idx = start // C
+        if self._prefix_cache.has_chunk(prog.req.prompt, chunk_idx):
+            return
+        ck, cv = self._read_slot(
+            self._cache_k, self._cache_v,
+            jnp.int32(prog.slot), jnp.int32(start),
+        )
+        self._prefix_cache.insert_chunk(
+            prog.req.prompt, chunk_idx, np.asarray(ck), np.asarray(cv)
+        )
+
+    def _dispatch_chunks(
+        self, ids, slots, offsets, last_pos, final_lens,
+        key_data, r_temps, r_tks, r_tps,
+    ):
+        """Broadcast (multihost) then run the packed prefill call."""
+        args = (
+            ids, slots, offsets, last_pos, final_lens,
+            key_data, r_temps, r_tks, r_tps,
+        )
+        if self._channel is None:
+            return self._device_chunks(*args)
+        from .multihost import OP_GEN_CHUNKS, encode_message
+
+        payload = encode_message(
+            OP_GEN_CHUNKS,
+            {
+                "ids": ids,
+                "slots": slots,
+                "offsets": offsets,
+                "last_pos": last_pos,
+                "final_lens": final_lens,
+                "key_data": key_data,
+                "temps": r_temps,
+                "tks": r_tks,
+                "tps": r_tps,
+            },
+        )
+        return self._channel.run(payload, lambda: self._device_chunks(*args))
+
+    def _device_chunks(
+        self, ids, slots, offsets, last_pos, final_lens,
+        key_data, r_temps, r_tks, r_tps,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        slot_keys = jax.random.wrap_key_data(jnp.asarray(key_data))
+        (
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._tokens,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+            firsts,
+        ) = self._prefill_chunks(
+            self._params,
+            jnp.asarray(ids),
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._tokens,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+            jnp.asarray(slots),
+            jnp.asarray(offsets),
+            jnp.asarray(last_pos),
+            jnp.asarray(final_lens),
+            slot_keys,
+            jnp.asarray(r_temps),
+            jnp.asarray(r_tks),
+            jnp.asarray(r_tps),
+        )
+        return np.asarray(firsts)
+
+    def replay_chunks(
+        self, ids, slots, offsets, last_pos, final_lens,
+        key_data, temps, tks, tps,
+    ) -> None:
+        """Follower side of :meth:`_dispatch_chunks` (multihost lockstep)."""
+        self._device_chunks(
+            np.asarray(ids), np.asarray(slots), np.asarray(offsets),
+            np.asarray(last_pos), np.asarray(final_lens),
+            np.asarray(key_data), np.asarray(temps), np.asarray(tks),
+            np.asarray(tps),
+        )
+
+    def _dispatch_seed_slot(self, cached_kv: list, slot: int, length: int):
+        """Broadcast (multihost) then seed a reserved cache row from the
+        radix-cached prefix chunks (packed-mode sibling of
+        :meth:`_dispatch_seed`; same payload-size caveat)."""
+        if self._channel is None:
+            self._device_seed_slot(cached_kv, slot, length)
+            return
+        from .multihost import OP_GEN_SEED_SLOT, encode_message
+
+        payload = encode_message(
+            OP_GEN_SEED_SLOT,
+            {
+                "ks": [np.asarray(k) for k, _ in cached_kv],
+                "vs": [np.asarray(v) for _, v in cached_kv],
+                "slot": int(slot),
+                "length": int(length),
+            },
+        )
+        self._channel.run(
+            payload, lambda: self._device_seed_slot(cached_kv, slot, length)
+        )
+
+    def _device_seed_slot(self, cached_kv: list, slot: int, length: int):
+        import jax.numpy as jnp
+
+        C = self._prefill_chunk_size
+        off = 0
+        for ck, cv in cached_kv:
+            self._cache_k, self._cache_v = self._seed_slot(
+                self._cache_k, self._cache_v,
+                jnp.asarray(ck), jnp.asarray(cv),
+                jnp.int32(slot), jnp.int32(off),
+            )
+            off += C
+
+    def replay_seed_slot(self, ks, vs, slot, length) -> None:
+        """Follower side of :meth:`_dispatch_seed_slot`."""
+        self._device_seed_slot(list(zip(ks, vs)), int(slot), int(length))
+
     def _slot_key_for(self, req: _Request):
         import jax
 
@@ -1135,9 +1658,11 @@ class GenerationEngine:
     def _chunk_tick(self) -> None:
         """Advance the in-flight chunked admission by ONE device op (a
         prefix-cache seed or one prefill chunk); on the final chunk,
-        install the sequence into its slot."""
-        prog = self._pending
-        assert prog is not None
+        install the sequence into its slot.  Single-admission mode only
+        (the batch-1 scratch cache serializes admissions); packed mode
+        advances through :meth:`_packed_tick`."""
+        assert self._pending
+        prog = self._pending[0]
         if prog.cached_tokens and not prog.seeded:
             # Cached-prefix hit: one seed op copies the radix-cached K/V
             # into a fresh sequence cache — those tokens never re-prefill.
@@ -1153,12 +1678,13 @@ class GenerationEngine:
         self._dispatch_chunk(ids, fresh=prog.next_idx == 0 and not prog.seeded)
         if not self._in_warmup:
             self.prefill_chunks_dispatched += 1
+            self.prefill_forwards += 1
         self._maybe_cache_chunk(prog)
         prog.next_idx += 1
         if prog.next_idx < len(prog.chunks):
             return
         req = prog.req
-        self._pending = None
+        self._pending.pop(0)
         slot_idx = self._free_slot()
         assert slot_idx is not None  # reserved by the admission policy
         L = int(req.prompt.size)
@@ -1179,6 +1705,7 @@ class GenerationEngine:
             t_start=t0,
             **self._spec_slot_state(req),
         )
+        self._note_ttft(req)
         self._record_token(slot_idx, int(first))
 
     def replay_reset(self) -> None:
@@ -1227,7 +1754,7 @@ class GenerationEngine:
             # their last busy values and an idle server reads as loaded.
             # (observe_decode_step skips its histograms at 0 active.)
             if self._on_step is not None and not self._in_warmup:
-                self._on_step(0, 0.0, self._queue.qsize())
+                self._on_step(0, 0.0, self._queue.qsize(), len(self._pending))
             return
         # Attention window: smallest bucket covering every active row's
         # next write position (prompt + tokens emitted so far).
@@ -1258,10 +1785,14 @@ class GenerationEngine:
             return
         self.decode_forwards += 1
         if self._on_step is not None:
+            # queue depth counts QUEUED-BUT-UNADMITTED requests only; the
+            # in-flight admission count rides separately so saturation
+            # and admission-latency alerts stop conflating the two.
             self._on_step(
                 int(active_np.sum()),
                 time.perf_counter() - t0,
                 self._queue.qsize(),
+                len(self._pending),
             )
 
     # -- self-speculative decoding (n-gram draft + batched verify) -----------
@@ -1453,18 +1984,23 @@ class GenerationEngine:
     def _admit_phase(self) -> bool:
         """Admission work for one scheduler iteration.
 
-        Fused mode drains every free slot; chunked mode advances the
-        in-flight admission by ONE chunk (or starts a new one), so the
-        decode tick that follows is never more than one chunk of prefill
-        away — in-flight streams keep their token cadence under long
-        prompts.  Returns False on the shutdown sentinel."""
-        if self._pending is not None:
-            prog = self._pending  # _chunk_tick clears _pending on finish
+        Fused mode drains every free slot; single-admission chunked mode
+        advances the in-flight admission by ONE chunk (or starts a new
+        one); packed mode tops up the admission queue (one reserved cache
+        row each) and advances up to ``prefill_batch`` of them with ONE
+        batched call.  In every mode the decode tick that follows is
+        never more than one prefill tick away — in-flight streams keep
+        their token cadence under long prompts.  Returns False on the
+        shutdown sentinel."""
+        if self._packed:
+            return self._admit_phase_packed()
+        if self._pending:
+            prog = self._pending[0]  # _chunk_tick pops it on finish
             try:
                 self._chunk_tick()
             except Exception as exc:
                 _log.exception("chunked prefill failed")
-                self._pending = None
+                self._pending = []
                 self._seq_state = None
                 if not prog.req.future.done():
                     _safe_fail(prog.req.future, exc)
@@ -1478,13 +2014,20 @@ class GenerationEngine:
                 break
             if req is None or self._stop.is_set():
                 # A real request dequeued during shutdown is in neither
-                # the queue nor a slot — cancel it here or its client
+                # the queue nor a slot — fail it here or its client
                 # awaits a future nobody will ever resolve.
                 if req is not None and not req.future.done():
-                    req.future.cancel()
+                    _safe_fail(
+                        req.future,
+                        EngineShutdown(
+                            "engine shut down before admission; retry on "
+                            "another replica"
+                        ),
+                    )
                 return False
+            self._note_admission_wait(req)
             if self._prefill_chunk_size is not None:
-                self._pending = self._make_progress(req)
+                self._pending.append(self._make_progress(req))
                 return True  # first chunk runs next iteration's admit phase
             try:
                 self._admit(req)
@@ -1493,6 +2036,50 @@ class GenerationEngine:
                 if not req.future.done():
                     _safe_fail(req.future, exc)
                 self._fail_all_and_recover()
+        return True
+
+    def _admit_phase_packed(self) -> bool:
+        """Packed-mode admission: top up the in-flight queue (each new
+        admission reserves a free cache row), then advance up to
+        ``prefill_batch`` admissions with one batched call."""
+        popped = False
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            idle = not self._pending and all(s is None for s in self._slots)
+            try:
+                req = self._queue.get(block=idle and not popped, timeout=1.0)
+            except queue.Empty:
+                break
+            if req is None or self._stop.is_set():
+                if req is not None and not req.future.done():
+                    _safe_fail(
+                        req.future,
+                        EngineShutdown(
+                            "engine shut down before admission; retry on "
+                            "another replica"
+                        ),
+                    )
+                return False
+            self._note_admission_wait(req)
+            prog = self._make_progress(req)
+            prog.slot = slot
+            self._reserved.add(slot)
+            self._pending.append(prog)
+            popped = True
+        if not self._pending:
+            return True
+        try:
+            self._packed_tick()
+        except Exception as exc:
+            _log.exception("packed prefill failed")
+            for prog in self._pending:
+                if not prog.req.future.done():
+                    _safe_fail(prog.req.future, exc)
+            self._pending = []
+            self._reserved.clear()
+            self._fail_all_and_recover()
         return True
 
     def _fail_all_and_recover(self) -> None:
@@ -1510,6 +2097,22 @@ class GenerationEngine:
                     RuntimeError("generation step failed; see server log"),
                 )
             self._slots[i] = None
+        if self._packed:
+            # Packed admissions prefill STRAIGHT into the donated cache
+            # rows, so the reset below destroys their half-written
+            # prompts (single-mode admissions live in the untouched
+            # batch-1 scratch and survive).  Fail them — continuing over
+            # zeroed K/V would stream corrupted completions as 200s.
+            for prog in self._pending:
+                if not prog.req.future.done():
+                    _safe_fail(
+                        prog.req.future,
+                        RuntimeError(
+                            "generation step failed; see server log"
+                        ),
+                    )
+            self._pending = []
+            self._reserved.clear()
         if self._channel is not None:
             # Followers replayed the op that just failed here; their buffers
             # are invalidated (or their state now diverges).  Broadcast the

@@ -161,8 +161,46 @@ class ServerMetrics:
         )
         self.engine_queue_depth = Gauge(
             "tpumlops_engine_queue_depth",
-            "Requests waiting in the generation admission queue",
+            "Requests queued but NOT yet admitted (excludes in-flight "
+            "admissions — see tpumlops_engine_admitting)",
             ident_labels,
+            registry=self.registry,
+        )
+        # Separate from queue depth so saturation alerts (queue grows)
+        # and admission-latency alerts (admissions in flight pile up
+        # behind long prefills) stop conflating the two populations.
+        self.engine_admitting = Gauge(
+            "tpumlops_engine_admitting",
+            "Admissions mid-prefill (dequeued, no first token yet)",
+            ident_labels,
+            registry=self.registry,
+        )
+        # Packed multi-admission prefill (server/generation.py
+        # prefillBatch): real chunks per batched prefill call.  Mean
+        # fill near 1 under light load is expected; under bursts it
+        # should track min(concurrent admissions, prefillBatch) — a
+        # flat 1 under load means packing is not engaging.
+        self.prefill_batch_fill = Histogram(
+            "tpumlops_prefill_batch_fill",
+            "Admission chunks packed into one batched prefill call",
+            ident_labels,
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+            registry=self.registry,
+        )
+        self.admission_wait_ms = Histogram(
+            "tpumlops_admission_wait_ms",
+            "Milliseconds a request waited in the queue before its "
+            "admission began",
+            ident_labels,
+            buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+                     2500, 5000, 10000),
+            registry=self.registry,
+        )
+        self.ttft_seconds = Histogram(
+            "tpumlops_ttft_seconds",
+            "Submit-to-first-token latency per generation request",
+            ident_labels,
+            buckets=_LATENCY_BUCKETS,
             registry=self.registry,
         )
         # Self-speculative decoding (server/speculative.py): proposed vs
@@ -250,7 +288,11 @@ class ServerMetrics:
         )
 
     def observe_decode_step(
-        self, active_slots: int, seconds: float, queue_depth: int = 0
+        self,
+        active_slots: int,
+        seconds: float,
+        queue_depth: int = 0,
+        admitting: int = 0,
     ):
         # active_slots == 0 is the engine's idle heartbeat: refresh the
         # occupancy gauges but keep the per-tick histograms tick-only.
@@ -259,6 +301,16 @@ class ServerMetrics:
             self.decode_step_seconds.labels(**self.identity).observe(seconds)
         self.engine_active_slots.labels(**self.identity).set(active_slots)
         self.engine_queue_depth.labels(**self.identity).set(queue_depth)
+        self.engine_admitting.labels(**self.identity).set(admitting)
+
+    def observe_prefill_batch(self, fill: int):
+        self.prefill_batch_fill.labels(**self.identity).observe(fill)
+
+    def observe_admission_wait(self, seconds: float):
+        self.admission_wait_ms.labels(**self.identity).observe(seconds * 1000)
+
+    def observe_ttft(self, seconds: float):
+        self.ttft_seconds.labels(**self.identity).observe(seconds)
 
     def observe_speculative(self, proposed: int, accepted: int):
         self.spec_proposed_tokens.labels(**self.identity).inc(proposed)

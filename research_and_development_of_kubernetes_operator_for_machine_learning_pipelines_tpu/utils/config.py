@@ -168,6 +168,30 @@ def _reject_unknown_keys(
         )
 
 
+def _parse_prefill_batch(value) -> int:
+    """``spec.tpu.prefillBatch``: concurrent admissions whose next prompt
+    chunks batch into ONE prefill call per engine tick (1 = today's
+    one-at-a-time pipeline, byte-for-byte)."""
+    batch = int(value) if value is not None else 1
+    if batch < 1:
+        raise ValueError(
+            f"spec.tpu.prefillBatch must be >= 1, got {value!r}"
+        )
+    return batch
+
+
+def _parse_prefill_token_budget(value) -> int:
+    """``spec.tpu.prefillTokenBudget``: Sarathi-style cap on prompt tokens
+    prefilled per engine tick (0 = uncapped); bounds the decode-cadence
+    jitter a burst of long prompts can inject."""
+    budget = int(value) if value is not None else 0
+    if budget < 0:
+        raise ValueError(
+            f"spec.tpu.prefillTokenBudget must be >= 0, got {value!r}"
+        )
+    return budget
+
+
 def _parse_prefill_chunk(value) -> int | None:
     """Positivity is checkable here; divisibility into the model's KV
     capacity is not (max_seq lives in the artifact, not the CR) — that
@@ -341,6 +365,16 @@ class TpuSpec:
     compile_cache_dir: str | None = "/tmp/jax_compile_cache"
     quantize: str = "none"  # none | int8 (weights) | int8kv (weights+KV cache)
     prefill_chunk: int | None = None  # chunked prefill (decode interleaving)
+    # Packed multi-admission prefill: concurrent admissions' next chunks
+    # batch into ONE prefill call, amortizing the per-chunk HBM weight
+    # stream across waiting prompts (TTFT under bursty load).  1 = the
+    # single-admission pipeline, byte-for-byte.  > 1 requires chunked
+    # prefill (prefillChunk, or prefixCache which implies it).
+    prefill_batch: int = 1
+    # Prompt tokens prefilled per engine tick (0 = uncapped): caps how
+    # much prefill work a tick may batch so in-flight decode streams
+    # keep their token cadence under long-prompt bursts (Sarathi-style).
+    prefill_token_budget: int = 0
     # Radix prefix KV cache: shared prompt prefixes (system prompts, chat
     # templates) prefill once and are copied thereafter.
     prefix_cache: PrefixCacheSpec = field(default_factory=PrefixCacheSpec)
@@ -363,14 +397,30 @@ class TpuSpec:
                     "tpuTopology", "meshShape", "replicas", "dtype",
                     "maxBatchSize", "maxBatchDelayMs", "maxSlots",
                     "maxInflightBatches", "compileCacheDir", "quantize",
-                    "prefillChunk", "prefixCache", "speculative",
-                    "warmupFullGrid",
+                    "prefillChunk", "prefillBatch", "prefillTokenBudget",
+                    "prefixCache", "speculative", "warmupFullGrid",
                 }
             ),
             "spec.tpu",
         )
         mesh = dict(spec.get("meshShape") or {"dp": 1, "tp": 8})
         prefill_chunk = _parse_prefill_chunk(spec.get("prefillChunk"))
+        prefill_batch = _parse_prefill_batch(spec.get("prefillBatch"))
+        prefix_cache = PrefixCacheSpec.from_spec(
+            spec.get("prefixCache"), prefill_chunk=prefill_chunk
+        )
+        if (
+            prefill_batch > 1
+            and prefill_chunk is None
+            and not prefix_cache.enabled
+        ):
+            # Reject at reconcile time, not as a pod CrashLoopBackOff:
+            # packed admission batches CHUNKS, so a chunk size must exist.
+            raise ValueError(
+                f"spec.tpu.prefillBatch {prefill_batch} requires chunked "
+                "prefill: set prefillChunk (or enable prefixCache, which "
+                "implies it)"
+            )
         return cls(
             topology=str(spec.get("tpuTopology", "v5e-8")),
             mesh_shape=mesh,
@@ -385,9 +435,11 @@ class TpuSpec:
             compile_cache_dir=spec.get("compileCacheDir", "/tmp/jax_compile_cache"),
             quantize=_parse_quantize(spec.get("quantize", "none")),
             prefill_chunk=prefill_chunk,
-            prefix_cache=PrefixCacheSpec.from_spec(
-                spec.get("prefixCache"), prefill_chunk=prefill_chunk
+            prefill_batch=prefill_batch,
+            prefill_token_budget=_parse_prefill_token_budget(
+                spec.get("prefillTokenBudget")
             ),
+            prefix_cache=prefix_cache,
             speculative=SpeculativeSpec.from_spec(spec.get("speculative")),
             warmup_full_grid=bool(spec.get("warmupFullGrid", False)),
         )

@@ -224,7 +224,8 @@ def slow():
 for name in ("bench_time_to_100", "bench_iris"):
     setattr(bench, name, fast)
 for name in ("bench_xgboost", "bench_resnet", "bench_prefix_cache",
-             "bench_speculative", "bench_llama_decode", "bench_serve_path",
+             "bench_speculative", "bench_packed_prefill",
+             "bench_llama_decode", "bench_serve_path",
              "bench_llama_7b_decode"):
     setattr(bench, name, {tail_fn})
 bench.main()
@@ -308,6 +309,86 @@ def test_early_emission_precedes_secondaries(tmp_path):
     # Final emission: all secondaries filled in.
     assert all(v is not None for v in parseable[-1]["secondary"].values())
     assert parseable[-1]["secondary"]["llama_7b_decode"] == {"p50_us": 10.0}
+
+
+def _run_bench_cli(*args):
+    import os
+    import subprocess
+
+    return subprocess.run(
+        [sys.executable, "bench.py", *args],
+        capture_output=True, text=True, timeout=60,
+        cwd=str(Path(__file__).resolve().parent.parent),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def test_unknown_scenario_exits_with_one_line_error():
+    """A typo'd scenario name must exit 2 with ONE line naming the valid
+    set — not a KeyError traceback."""
+    proc = _run_bench_cli("no_such_scenario", "--dry-run")
+    assert proc.returncode == 2, (proc.returncode, proc.stderr)
+    err_lines = [l for l in proc.stderr.splitlines() if l.strip()]
+    assert len(err_lines) == 1, proc.stderr
+    assert "no_such_scenario" in err_lines[0]
+    assert "packed_prefill_serving" in err_lines[0]  # the valid set
+    assert "Traceback" not in proc.stderr
+
+
+def test_dry_run_prints_packed_prefill_schema():
+    """``--dry-run`` must print the scenario schema contract as one JSON
+    line without touching a device (make verify runs exactly this)."""
+    proc = _run_bench_cli("packed_prefill_serving", "--dry-run")
+    assert proc.returncode == 0, proc.stderr
+    parsed = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert parsed["dry_run"] is True
+    schema = parsed["scenarios"]["packed_prefill_serving"]
+    for key in (
+        "serial_ttft_p50_ms", "serial_ttft_p99_ms", "serial_chunk_calls",
+        "packed_ttft_p50_ms", "packed_ttft_p99_ms", "packed_chunk_calls",
+        "ttft_p50_speedup", "chunk_call_reduction", "batch_fill_mean",
+    ):
+        assert key in schema, key
+
+
+def test_packed_prefill_schema_covers_compact_keys():
+    """Schema drift guard: every key the driver line keeps for a
+    scenario must be part of that scenario's published schema — a
+    renamed field would otherwise silently vanish from the headline."""
+    for name, keys in bench._COMPACT_KEYS.items():
+        schema = bench.SCENARIO_SCHEMAS.get(name)
+        if schema is None:
+            continue
+        missing = set(keys) - set(schema)
+        assert not missing, (name, missing)
+    # The new scenario is covered by both contracts.
+    assert "packed_prefill_serving" in bench.SCENARIO_SCHEMAS
+    assert "packed_prefill_serving" in bench._COMPACT_KEYS
+    assert "packed_prefill_serving" in {name for name, _ in bench.SCENARIOS}
+    # Every registry entry resolves to a real bench function.
+    for _name, attr in bench.SCENARIOS:
+        assert callable(getattr(bench, attr)), attr
+
+
+def test_compact_line_keeps_packed_prefill_headline():
+    full = _fat_full_record()
+    full["secondary"]["packed_prefill_serving"] = {
+        "requests": 8, "prompt_tokens": 512, "prefill_chunk": 128,
+        "prefill_batch": 8,
+        "serial_ttft_p50_ms": 1768.8, "serial_ttft_p99_ms": 2924.8,
+        "serial_chunk_calls": 32,
+        "packed_ttft_p50_ms": 1265.1, "packed_ttft_p99_ms": 1265.6,
+        "packed_chunk_calls": 4, "ttft_p50_speedup": 1.4,
+        "chunk_call_reduction": 8.0, "batch_fill_mean": 8.0,
+        "token_agreement": 1.0,
+        "note": "x" * 300,
+    }
+    parsed = bench.compact_line(full)
+    sec = parsed["secondary"]["packed_prefill_serving"]
+    assert sec["chunk_call_reduction"] == 8.0
+    assert sec["serial_chunk_calls"] == 32
+    assert "note" not in sec
+    assert len(json.dumps(bench.compact_line(full))) <= bench.COMPACT_BUDGET_BYTES
 
 
 def test_scan_delta_donated_carry_aliases_in_place():

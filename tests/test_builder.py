@@ -97,6 +97,31 @@ def test_tpu_manifest_places_on_v5e_pool():
     # Metric identity must match the gate's PromQL labels (ref :367).
     assert "--deployment-name iris" in args
     assert "--namespace models" in args
+    # Packed-prefill knobs thread CRD -> server CLI (defaults preserve
+    # the single-admission pipeline).
+    assert "--prefill-batch 1" in args
+    assert "--prefill-token-budget 0" in args
+
+
+def test_tpu_server_args_carry_packed_prefill_knobs():
+    config = cfg(
+        backend="tpu",
+        tpu={
+            "tpuTopology": "v5e-8",
+            "meshShape": {"dp": 1, "tp": 8},
+            "prefillChunk": 128,
+            "prefillBatch": 8,
+            "prefillTokenBudget": 1024,
+        },
+    )
+    sd = two_version_manifest(config)
+    container = sd["spec"]["predictors"][1]["componentSpecs"][0]["spec"][
+        "containers"
+    ][0]
+    args = " ".join(container["args"])
+    assert "--prefill-chunk 128" in args
+    assert "--prefill-batch 8" in args
+    assert "--prefill-token-budget 1024" in args
 
 
 def test_tpu_unknown_topology_rejected_at_parse():

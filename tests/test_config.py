@@ -121,11 +121,41 @@ def test_tpu_spec_rejects_unknown_keys():
             "compileCacheDir": "/tmp/x",
             "quantize": "none",
             "prefillChunk": 64,
+            "prefillBatch": 4,
+            "prefillTokenBudget": 512,
             "prefixCache": {"enabled": True, "budgetMB": 64},
             "speculative": {"enabled": True, "draftTokens": 4},
             "warmupFullGrid": False,
         }
     )
+
+
+def test_tpu_prefill_batch_validation():
+    """Packed-prefill knobs reject bad values at reconcile time, not as
+    a pod CrashLoopBackOff; prefillBatch > 1 needs a chunk size to pack
+    (prefillChunk, or prefixCache which implies one)."""
+    from tpumlops.utils.config import TpuSpec
+
+    spec = TpuSpec.from_spec(
+        {"prefillChunk": 64, "prefillBatch": 8, "prefillTokenBudget": 256}
+    )
+    assert spec.prefill_batch == 8
+    assert spec.prefill_token_budget == 256
+    # Defaults: byte-for-byte single-admission behavior.
+    d = TpuSpec.from_spec({})
+    assert d.prefill_batch == 1 and d.prefill_token_budget == 0
+    # prefixCache enables chunking, so packed admission composes with it.
+    assert TpuSpec.from_spec(
+        {"prefillBatch": 4, "prefixCache": {"enabled": True}}
+    ).prefill_batch == 4
+    with pytest.raises(ValueError, match="prefillBatch"):
+        TpuSpec.from_spec({"prefillChunk": 64, "prefillBatch": 0})
+    with pytest.raises(ValueError, match="prefillTokenBudget"):
+        TpuSpec.from_spec({"prefillChunk": 64, "prefillTokenBudget": -1})
+    with pytest.raises(ValueError, match="chunked prefill"):
+        TpuSpec.from_spec({"prefillBatch": 2})  # nothing to pack
+    with pytest.raises(ValueError, match="prefillBatc"):
+        TpuSpec.from_spec({"prefillBatc": 2})  # typo'd key named back
 
 
 def test_operator_config_speculative_round_trip():

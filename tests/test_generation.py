@@ -194,14 +194,19 @@ def test_engine_rejects_oversized_and_empty(tiny):
         engine.submit([1, 2], 0)
 
 
-def test_engine_shutdown_cancels_pending(tiny):
+def test_engine_shutdown_fails_queued_with_engine_shutdown(tiny):
+    from tpumlops.server.generation import EngineShutdown
+
     cfg = llama.LlamaConfig.tiny(max_seq=32)
     params = llama.init(jax.random.key(1), cfg, dtype=jnp.float64)
     engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float64)
-    # never started: queued requests must be cancelled on shutdown
+    # never started: queued (not-yet-admitted) requests must fail with a
+    # CLEAR EngineShutdown — not hang, and not a bare CancelledError a
+    # caller can't tell apart from its own cancel.
     fut = engine.submit([1, 2, 3], 4)
     engine.shutdown()
-    assert fut.cancelled()
+    with pytest.raises(EngineShutdown, match="before admission"):
+        fut.result(timeout=5)
 
 
 def test_engine_recovers_after_failed_step(tiny):
@@ -579,7 +584,6 @@ def test_chunked_prefill_validation_and_shutdown_cancel(tiny):
     engine = GenerationEngine(
         params, cfg, max_slots=1, dtype=jnp.float64, prefill_chunk=8
     )
-    engine._pending = None
     engine.start(warmup=False)
     blocker = engine.submit([5, 9, 2], 40)  # occupies the only slot
     import time as _t
@@ -588,7 +592,9 @@ def test_chunked_prefill_validation_and_shutdown_cancel(tiny):
     pending = engine.submit(list(range(2, 40)), 4)
     _t.sleep(0.1)
     engine.shutdown()
-    with pytest.raises(Exception):  # cancelled (or failed by shutdown)
+    from tpumlops.server.generation import EngineShutdown
+
+    with pytest.raises(EngineShutdown):  # queued or mid-prefill at shutdown
         pending.result(timeout=10)
     assert blocker.done()
 

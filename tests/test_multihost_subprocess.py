@@ -142,9 +142,11 @@ def test_jax_process_transport_framing_across_two_processes(tmp_path):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            raise AssertionError("framing deadlock: processes did not finish")
+            raise AssertionError(
+                "framing deadlock: processes did not finish"
+            ) from None
         outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
+    for rc, _out, err in outs:
         assert rc == 0, f"child failed:\n{err[-2000:]}"
     assert "LEADER_OK" in outs[0][1]
     assert "FOLLOWER_OK" in outs[1][1]
@@ -300,9 +302,11 @@ def test_predict_and_generation_replay_across_two_processes(tmp_path):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            raise AssertionError("replay deadlock: processes did not finish")
+            raise AssertionError(
+                "replay deadlock: processes did not finish"
+            ) from None
         outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
+    for rc, _out, err in outs:
         assert rc == 0, f"child failed:\\n{err[-3000:]}"
     assert "LEADER_OK" in outs[0][1]
     assert "FOLLOWER_OK" in outs[1][1]

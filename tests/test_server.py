@@ -630,7 +630,7 @@ def test_debug_profile_endpoint(iris_server):
     import os
 
     found = []
-    for root, _dirs, files in os.walk(out["trace_dir"]):
+    for _root, _dirs, files in os.walk(out["trace_dir"]):
         found += files
     assert found, "trace directory is empty"
     # non-finite durations rejected; the lock is released afterwards
@@ -711,6 +711,32 @@ def test_bert_server_buckets_variable_lengths(tmp_path):
             np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
     finally:
         handle.stop()
+
+
+def test_shutdown_drains_queued_requests_with_engine_shutdown():
+    """Graceful shutdown must FAIL queued (not-yet-admitted) requests
+    with a clear EngineShutdown instead of leaving callers hanging on
+    futures nobody will resolve (or a bare CancelledError they cannot
+    tell apart from their own cancel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import EngineShutdown, GenerationEngine
+
+    cfg = llama.LlamaConfig.tiny(max_seq=32)
+    params = llama.init(jax.random.key(2), cfg, dtype=jnp.float32)
+    # Never started: every submitted request is queued-but-unadmitted.
+    engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float32)
+    futs = [engine.submit([1, 2, 3], 4) for _ in range(3)]
+    engine.shutdown()
+    for fut in futs:
+        assert fut.done()
+        with pytest.raises(EngineShutdown, match="retry on another replica"):
+            fut.result(timeout=5)
+    # EngineShutdown is a RuntimeError: the HTTP layer's generic 500
+    # path already renders it with the message intact.
+    assert issubclass(EngineShutdown, RuntimeError)
 
 
 def test_streaming_loader_consumer_crash_releases_reader(tmp_path, monkeypatch):
