@@ -13,7 +13,7 @@ DOCKER   ?= docker
 
 .PHONY: images operator-image server-image router-image router-bin \
         install uninstall test test-fast test-e2e test-all lint \
-        bench-contract verify bench
+        bench-contract metrics-contract verify bench
 
 images: operator-image server-image router-image
 
@@ -83,11 +83,18 @@ bench-contract:
 	python bench.py --dry-run > /dev/null
 	python -m pytest tests/test_bench_contract.py -q
 
+# Metric-identity contract gate (SURVEY §7 hard part 4): the promotion
+# gate's PromQL — and every dashboard/alert — reads these exact family
+# names and label sets.  An accidental rename must fail HERE, locally,
+# not as a gate query silently reading 0 through its vector(0) fallback.
+metrics-contract:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_metrics_contract.py -q
+
 # The EXACT tier-1 command from ROADMAP.md (the driver's acceptance
 # gate) chained behind lint + the bench contract: not-slow tranche,
 # collection errors tolerated, 870 s wall cap, DOTS_PASSED echoed from
 # the captured dot lines.
-verify: lint bench-contract
+verify: lint bench-contract metrics-contract
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -55,13 +55,22 @@ def main(argv: list[str] | None = None) -> None:
         "--metrics-port",
         type=int,
         default=8080,
-        help="operator self-metrics /metrics listener; 0 disables",
+        help="operator self-metrics /metrics (+ /debug/spans) listener; "
+        "0 disables",
+    )
+    ap.add_argument(
+        "--log-format",
+        default="text",
+        choices=["text", "json"],
+        help="json: one JSON object per log line (machine-parseable)",
     )
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
+    from ..utils.logging import configure as configure_logging
+
+    configure_logging(
         level=getattr(logging, args.log_level.upper()),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        json_format=args.log_format == "json",
     )
 
     from ..clients.dataplane import DataPlaneWarmup

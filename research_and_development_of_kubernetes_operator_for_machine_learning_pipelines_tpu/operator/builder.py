@@ -116,6 +116,7 @@ def _tpu_pod_spec(
             "--speculative-ngram-min", str(tpu.speculative.ngram_min),
             "--speculative-ngram-max", str(tpu.speculative.ngram_max),
             "--speculative-adaptive", "1" if tpu.speculative.adaptive else "0",
+            "--trace-ring", str(tpu.observability.trace_ring),
         ],
         "env": [
             {"name": "TPU_TOPOLOGY", "value": tpu.topology},

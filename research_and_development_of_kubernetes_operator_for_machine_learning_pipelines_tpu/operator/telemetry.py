@@ -157,7 +157,45 @@ class OperatorTelemetry:
         return generate_latest(self.registry)
 
     def serve(self, port: int, addr: str = "0.0.0.0"):
-        """Expose /metrics on a daemon-thread HTTP server."""
-        from prometheus_client import start_http_server
+        """Expose /metrics AND /debug/spans on a daemon-thread listener.
 
-        start_http_server(port, addr=addr, registry=self.registry)
+        /debug/spans serves the ``utils/tracing.py`` GLOBAL_TRACER stats
+        (reconcile-step span timings) as JSON — the same payload shape
+        the data-plane server exposes, so one tool reads both planes."""
+        import json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ..utils.tracing import GLOBAL_TRACER
+
+        telemetry = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib handler contract)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = telemetry.exposition()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/debug/spans":
+                    body = json.dumps(
+                        {"spans": GLOBAL_TRACER.as_dict()}
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log events
+                pass
+
+        httpd = ThreadingHTTPServer((addr, port), _Handler)
+        httpd.daemon_threads = True
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="operator-metrics"
+        ).start()
+        return httpd

@@ -11,6 +11,9 @@ weights around it.  This package replaces that outsourced data plane:
 - ``metrics``  — Prometheus histograms with the exact metric names + identity
   labels the promotion gate queries (``mlflow_operator.py:367-415``)
 - ``app``      — V2 (kfserving) + Seldon-protocol HTTP endpoints
+- ``flight_recorder`` — bounded engine journal: per-tick records +
+  request traces, served at ``/debug/engine`` / ``/debug/trace``
+  (Perfetto-viewable Chrome trace export)
 """
 
 from .engine import InferenceEngine

@@ -24,7 +24,9 @@ import contextlib
 import json
 import logging
 import os
+import re
 import time
+import uuid
 from typing import Any
 
 import numpy as np
@@ -37,6 +39,55 @@ from .loader import load_predictor
 from .metrics import ServerMetrics
 
 _log = logging.getLogger(__name__)
+# One structured completion line per generation request (request-id
+# correlated; --log-format json emits it as a machine-parseable object).
+_req_log = logging.getLogger("tpumlops.request")
+
+# W3C traceparent: version-traceid-spanid-flags; the 32-hex trace id is
+# the request identity we adopt (so spans correlate across the mesh).
+_TRACEPARENT = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$"
+)
+
+
+def request_id_from_headers(headers) -> str:
+    """Inbound request identity: ``X-Request-Id`` verbatim, else the W3C
+    ``traceparent`` trace id, else a fresh uuid4 hex.  Always echoed back
+    as ``X-Request-Id`` so clients (and the router's access logs) can
+    correlate a slow response with the server's completion line and the
+    flight recorder's span."""
+    # Bound + sanitize: the id lands in log lines and trace JSON.  An id
+    # that sanitizes to nothing falls through to the next source — an
+    # empty identity would make the request uncorrelatable.
+    rid = "".join(
+        c for c in headers.get("X-Request-Id", "").strip()[:128]
+        if c.isprintable()
+    )
+    if rid:
+        return rid
+    tp = headers.get("traceparent", "").strip().lower()
+    m = _TRACEPARENT.match(tp)
+    if m:
+        return m.group(1)
+    return uuid.uuid4().hex
+
+
+@web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    rid = request["request_id"] = request_id_from_headers(request.headers)
+    try:
+        resp = await handler(request)
+    except web.HTTPException as exc:
+        # Router 404/405 and 413-over-max-size are raised, not returned
+        # — exactly the responses a client most needs to correlate.
+        exc.headers.setdefault("X-Request-Id", rid)
+        raise
+    # A streaming response has already sent its status line (its headers
+    # carry the id from _stream_generation); everything else gets the
+    # echo here, errors included.
+    if not getattr(resp, "prepared", False):
+        resp.headers.setdefault("X-Request-Id", rid)
+    return resp
 
 _V2_TO_NP = {
     "FP32": np.float32,
@@ -66,7 +117,7 @@ _NP_TO_V2 = {
 # contract (key named + allowed set) stays spelled once.
 _GEN_PARAM_KEYS = frozenset(
     {"max_new_tokens", "eos_id", "temperature", "top_k", "top_p", "seed",
-     "stream"}
+     "stream", "debug"}
 )
 
 
@@ -86,12 +137,14 @@ class TpuInferenceServer:
         max_batch_delay_ms: float = 5.0,
         gen_engine=None,
         max_inflight_batches: int = 2,
+        recorder=None,
     ):
         self.engine = engine
         self.metrics = metrics
         self.model_name = model_name
         self.ready = False
         self.gen_engine = gen_engine  # GenerationEngine for causal-LM flavors
+        self.recorder = recorder  # flight_recorder.FlightRecorder | None
         import threading
 
         self._profile_lock = threading.Lock()
@@ -348,40 +401,59 @@ class TpuInferenceServer:
                 base = sampling["seed"]
                 return None if base is None else (base + i) % (2**63)
 
+            rid = request.get("request_id") or request_id_from_headers(
+                request.headers
+            )
+            debug = bool(params.get("debug", False))
             if params.get("stream"):
                 if len(prompts) != 1:
                     raise ValueError("stream=true supports exactly one prompt")
                 codebox = {"code": 200}
                 try:
                     return await self._stream_generation(
-                        request, prompts[0], max_new, eos_id, sampling, codebox
+                        request, prompts[0], max_new, eos_id, sampling,
+                        codebox, rid,
                     )
                 finally:
                     code = codebox["code"]
+            from .flight_recorder import RequestTrace
+
+            traces = [
+                RequestTrace(
+                    request_id=rid if len(prompts) == 1 else f"{rid}/{i}"
+                )
+                for i in range(len(prompts))
+            ]
             futures = [
                 self.gen_engine.submit(
-                    p, max_new, eos_id, **{**sampling, "seed": row_seed(i)}
+                    p, max_new, eos_id,
+                    **{**sampling, "seed": row_seed(i)},
+                    request_id=traces[i].request_id,
+                    trace=traces[i],
                 )
                 for i, p in enumerate(prompts)
             ]
             outs = await asyncio.gather(
                 *(asyncio.wrap_future(f) for f in futures)
             )
-            return web.json_response(
-                {
-                    "model_name": self.model_name,
-                    "id": body.get("id", ""),
-                    "outputs": [
-                        {
-                            "name": f"output_ids_{i}",
-                            "datatype": "INT32",
-                            "shape": [int(o.size)],
-                            "data": o.tolist(),
-                        }
-                        for i, o in enumerate(outs)
-                    ],
-                }
-            )
+            summary = _timing_summary(rid, traces)
+            self._log_completion(summary, code=200)
+            payload = {
+                "model_name": self.model_name,
+                "id": body.get("id", ""),
+                "outputs": [
+                    {
+                        "name": f"output_ids_{i}",
+                        "datatype": "INT32",
+                        "shape": [int(o.size)],
+                        "data": o.tolist(),
+                    }
+                    for i, o in enumerate(outs)
+                ],
+            }
+            if debug:
+                payload["timing"] = summary
+            return web.json_response(payload)
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             code = 400
             return web.json_response({"error": str(e)}, status=400)
@@ -393,7 +465,8 @@ class TpuInferenceServer:
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
 
     async def _stream_generation(
-        self, request, prompt, max_new, eos_id, sampling, codebox
+        self, request, prompt, max_new, eos_id, sampling, codebox,
+        request_id: str = "",
     ) -> web.StreamResponse:
         """SSE token stream: one ``data:`` event per token, then a final
         event with the full sequence.  Client disconnect cancels the
@@ -404,14 +477,18 @@ class TpuInferenceServer:
         instead (500 on engine failure, 499 on cancel/disconnect): a broken
         engine serving only streams must still trip the canary gate's
         error-rate query."""
+        from .flight_recorder import RequestTrace
+
         loop = asyncio.get_running_loop()
         tokens: asyncio.Queue = asyncio.Queue()
 
         def on_token(t: int) -> None:  # scheduler thread -> event loop
             loop.call_soon_threadsafe(tokens.put_nowait, int(t))
 
+        trace = RequestTrace(request_id=request_id)
         fut = self.gen_engine.submit(
-            prompt, max_new, eos_id, **sampling, on_token=on_token
+            prompt, max_new, eos_id, **sampling, on_token=on_token,
+            request_id=request_id, trace=trace,
         )
         fut.add_done_callback(
             lambda f: loop.call_soon_threadsafe(tokens.put_nowait, None)
@@ -421,6 +498,9 @@ class TpuInferenceServer:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                # The status line commits before the middleware could add
+                # the echo, so the stream carries it itself.
+                "X-Request-Id": request_id,
             }
         )
         await resp.prepare(request)
@@ -460,9 +540,43 @@ class TpuInferenceServer:
             fut.cancel()
             codebox["code"] = 500
         finally:
+            # A cancel frees the engine slot only at the NEXT scheduler
+            # tick — finish the trace here (first writer wins: the
+            # engine's own later finish becomes a no-op) so the 499/500
+            # completion line never reports "in-flight" for exactly the
+            # requests an operator most needs to attribute.
+            if codebox["code"] != 200:
+                trace.finish(
+                    "cancelled" if codebox["code"] == 499 else "error"
+                )
+            self._log_completion(
+                _timing_summary(request_id, [trace]), code=codebox["code"]
+            )
             with contextlib.suppress(Exception):
                 await resp.write_eof()
         return resp
+
+    def _log_completion(self, summary: dict, code: int) -> None:
+        """One structured completion line per generation request (the
+        request-scoped counterpart of the aggregate histograms; carries
+        ``request_id`` as a record attribute for the JSON log format)."""
+        _req_log.info(
+            "generate done request_id=%s code=%d rows=%d tokens=%d "
+            "queue_ms=%s ttft_ms=%s prefill_chunks=%d cached_tokens=%d "
+            "spec_accepted=%d/%d finish=%s",
+            summary["request_id"],
+            code,
+            len(summary["rows"]),
+            summary["tokens"],
+            summary["queue_ms"],
+            summary["ttft_ms"],
+            summary["prefill_chunks"],
+            summary["cached_tokens"],
+            summary["spec_accepted"],
+            summary["spec_proposed"],
+            ",".join(summary["finish_reasons"]),
+            extra={"request_id": summary["request_id"]},
+        )
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """Capture a JAX/XLA device trace (SURVEY §5: the reference has no
@@ -514,6 +628,57 @@ class TpuInferenceServer:
             charset="utf-8",
         )
 
+    # -- flight recorder / span debug endpoints ------------------------------
+
+    def _recorder_or_none(self) -> web.Response | None:
+        if self.recorder is not None:
+            return None
+        return web.json_response(
+            {
+                "error": "flight recorder disabled; set "
+                "spec.tpu.observability.traceRing (--trace-ring) > 0"
+            },
+            status=404,
+        )
+
+    async def _debug_json(self, build) -> web.Response:
+        """Build + serialize a debug payload OFF the event loop: a full
+        ring renders to megabytes of JSON, and a synchronous dumps here
+        would stall /generate, health probes, and SSE mid-debugging —
+        observation must not perturb serving."""
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(None, lambda: json.dumps(build()))
+        return web.Response(text=text, content_type="application/json")
+
+    async def handle_debug_engine(self, request: web.Request) -> web.Response:
+        """Live engine snapshot: tick/event/trace rings verbatim."""
+        err = self._recorder_or_none()
+        if err is not None:
+            return err
+        return await self._debug_json(self.recorder.snapshot)
+
+    async def handle_debug_trace(self, request: web.Request) -> web.Response:
+        """Chrome trace-event export (open in Perfetto: ui.perfetto.dev)."""
+        err = self._recorder_or_none()
+        if err is not None:
+            return err
+        fmt = request.query.get("format", "chrome")
+        if fmt == "chrome":
+            return await self._debug_json(self.recorder.chrome_trace)
+        if fmt == "json":
+            return await self._debug_json(self.recorder.snapshot)
+        return web.json_response(
+            {"error": f"unknown format {fmt!r}; use chrome or json"},
+            status=400,
+        )
+
+    async def handle_debug_spans(self, request: web.Request) -> web.Response:
+        """GLOBAL_TRACER span stats (count/mean/max per name) — the
+        control-plane tracer finally readable off the data plane too."""
+        from ..utils.tracing import GLOBAL_TRACER
+
+        return web.json_response({"spans": GLOBAL_TRACER.as_dict()})
+
     async def handle_live(self, request: web.Request) -> web.Response:
         return web.json_response({"live": True})
 
@@ -536,7 +701,10 @@ class TpuInferenceServer:
     # -- app wiring ----------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app = web.Application(
+            client_max_size=256 * 1024 * 1024,
+            middlewares=[request_id_middleware],
+        )
         name = self.model_name
         app.router.add_get("/v2/health/live", self.handle_live)
         app.router.add_get("/v2/health/ready", self.handle_ready)
@@ -549,6 +717,9 @@ class TpuInferenceServer:
         app.router.add_post("/api/v1.0/feedback", self.handle_feedback)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_post("/debug/profile", self.handle_profile)
+        app.router.add_get("/debug/engine", self.handle_debug_engine)
+        app.router.add_get("/debug/trace", self.handle_debug_trace)
+        app.router.add_get("/debug/spans", self.handle_debug_spans)
 
         async def on_shutdown(_app):
             self.shutdown()
@@ -586,6 +757,30 @@ def _concat_batches(chunks: list[Any]) -> Any:
     return np.concatenate([np.asarray(c) for c in chunks], axis=0)
 
 
+def _timing_summary(request_id: str, traces) -> dict:
+    """Aggregate per-sequence :class:`RequestTrace` blocks into the one
+    request-level timing object (``"debug": true`` response field and the
+    completion log line).  Totals agree with the Prometheus counters the
+    request incremented — asserted in tests/test_server.py."""
+    rows = [t.timing_block() for t in traces]
+    queue = [r["queue_ms"] for r in rows if r["queue_ms"] is not None]
+    ttft = [r["ttft_ms"] for r in rows if r["ttft_ms"] is not None]
+    return {
+        "request_id": request_id,
+        "tokens": sum(r["tokens"] for r in rows),
+        "prefill_chunks": sum(r["prefill_chunks"] for r in rows),
+        "cached_tokens": sum(r["cached_tokens"] for r in rows),
+        "spec_proposed": sum(r["spec_proposed"] for r in rows),
+        "spec_accepted": sum(r["spec_accepted"] for r in rows),
+        # Worst row's queue wait, best row's TTFT: the spread between
+        # them is the packing/admission story for a multi-row request.
+        "queue_ms": max(queue) if queue else None,
+        "ttft_ms": min(ttft) if ttft else None,
+        "finish_reasons": sorted({r["finish_reason"] for r in rows}),
+        "rows": rows,
+    }
+
+
 def _to_v2_outputs(out: Any) -> list[dict]:
     if isinstance(out, dict):
         items = list(out.items())
@@ -612,7 +807,9 @@ def _to_v2_outputs(out: Any) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None):
+def make_gen_engine(
+    predictor, config: ServerConfig, channel=None, metrics=None, recorder=None
+):
     """Construct the GenerationEngine for a causal-LM predictor.
 
     ONE construction site for leader and followers: lockstep replay needs
@@ -673,6 +870,12 @@ def make_gen_engine(predictor, config: ServerConfig, channel=None, metrics=None)
         on_prefill_batch=metrics.observe_prefill_batch if metrics else None,
         on_admission_wait=metrics.observe_admission_wait if metrics else None,
         on_ttft=metrics.observe_ttft if metrics else None,
+        on_itl=metrics.observe_itl if metrics else None,
+        on_request_tokens=metrics.observe_request_tokens if metrics else None,
+        on_tick=metrics.observe_tick if metrics else None,
+        # Leader-side only: the scheduler (and so the journal) runs on
+        # the leader; follower processes replay device ops blind.
+        recorder=recorder,
     )
 
 
@@ -707,6 +910,11 @@ def build_server(
 
         engine = MultihostEngine(engine, transport)
         channel = engine.channel
+    recorder = None
+    if config.tpu.observability.trace_ring > 0:
+        from .flight_recorder import FlightRecorder
+
+        recorder = FlightRecorder(config.tpu.observability.trace_ring)
     gen_engine = None
     if predictor.causal_lm is not None:
         # On a multi-host unit the scheduler runs leader-side only; every
@@ -714,7 +922,8 @@ def build_server(
         # replay it in lockstep (their GenerationEngine is built in
         # main()'s follower path and driven by follower_loop).
         gen_engine = make_gen_engine(
-            predictor, config, channel=channel, metrics=metrics
+            predictor, config, channel=channel, metrics=metrics,
+            recorder=recorder,
         )
     server = TpuInferenceServer(
         engine,
@@ -724,6 +933,7 @@ def build_server(
         max_batch_delay_ms=config.tpu.max_batch_delay_ms,
         gen_engine=gen_engine,
         max_inflight_batches=config.tpu.max_inflight_batches,
+        recorder=recorder,
     )
     server.startup(warmup=warmup)
     return server
@@ -871,8 +1081,25 @@ def main(argv: list[str] | None = None) -> None:
         help="persistent XLA compile cache (SURVEY §7 hard part 3); "
         "empty string disables",
     )
+    ap.add_argument(
+        "--trace-ring",
+        type=int,
+        default=0,
+        help="engine flight-recorder ring size (ticks/events/requests "
+        "kept in memory, served at /debug/engine and /debug/trace); "
+        "0 disables recording entirely (the default — zero overhead)",
+    )
+    ap.add_argument(
+        "--log-format",
+        default="text",
+        choices=["text", "json"],
+        help="json: one JSON object per log line carrying request_id, so "
+        "per-request completion lines are machine-parseable",
+    )
     args = ap.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    from ..utils.logging import configure as configure_logging
+
+    configure_logging(json_format=args.log_format == "json")
 
     from ..parallel.distributed import maybe_initialize_distributed
     from ..utils.compile_cache import enable_persistent_compile_cache
@@ -912,6 +1139,7 @@ def main(argv: list[str] | None = None) -> None:
                     "ngramMax": args.speculative_ngram_max,
                     "adaptive": bool(args.speculative_adaptive),
                 },
+                "observability": {"traceRing": args.trace_ring},
             }
         ),
     )

@@ -154,6 +154,12 @@ class _Slot:
     history: np.ndarray | None = None  # int64 [capacity]; valid: [:hist_len]
     hist_len: int = 0
     draft: "object | None" = None  # speculative.DraftState
+    # Request tracing (flight_recorder.RequestTrace | None): per-request
+    # timing the HTTP layer returns under ``"debug": true`` and logs on
+    # completion.  None (direct engine callers, warmup) = no bookkeeping.
+    request_id: str = ""
+    trace: "object | None" = None
+    t_last_token: float = 0.0  # previous token's wall (inter-token latency)
 
 
 @dataclass(eq=False)  # identity semantics: list membership/removal must
@@ -193,6 +199,8 @@ class _Request:
     seed: int | None = None  # None: engine-assigned (boot-nonce fold_in)
     on_token: Callable[[int], None] | None = None  # streaming callback
     t_submit: float = 0.0  # perf_counter at submit (admission-wait / TTFT)
+    request_id: str = ""  # inbound X-Request-Id / traceparent (or generated)
+    trace: "object | None" = None  # flight_recorder.RequestTrace | None
 
 
 class GenerationEngine:
@@ -227,6 +235,10 @@ class GenerationEngine:
         on_prefill_batch: Callable[[int], None] | None = None,
         on_admission_wait: Callable[[float], None] | None = None,
         on_ttft: Callable[[float], None] | None = None,
+        on_itl: Callable[[float], None] | None = None,
+        on_request_tokens: Callable[[int], None] | None = None,
+        on_tick: Callable[[str, float], None] | None = None,
+        recorder=None,  # flight_recorder.FlightRecorder | None
     ):
         import jax
         import jax.numpy as jnp
@@ -318,6 +330,25 @@ class GenerationEngine:
         self._on_prefill_batch = on_prefill_batch
         self._on_admission_wait = on_admission_wait
         self._on_ttft = on_ttft
+        # Per-request cadence metrics + engine flight recorder.  recorder
+        # None (the default) keeps the scheduler loop byte-for-byte: every
+        # hook below is guarded, nothing is allocated per tick.
+        self._on_itl = on_itl
+        self._on_request_tokens = on_request_tokens
+        self._on_tick = on_tick
+        self._recorder = recorder
+        # JAX dispatch is async: a prefill/seed call returns before the
+        # device finishes, and the wait would otherwise be absorbed into
+        # the NEXT decode tick's wall — the exact mis-attribution the
+        # flight recorder exists to prevent.  With the RECORDER on,
+        # non-decode ticks block on their outputs before the wall is
+        # read (decode/verify/packed already sync via their np.asarray
+        # result reads).  Gated on the recorder ONLY — on_tick (the
+        # always-wired tpumlops_tick_seconds metric) must not arm device
+        # syncs in the default deployment, or traceRing=0 would no
+        # longer be the byte-for-byte unobserved engine loop; without
+        # the recorder, non-decode tick-metric walls are dispatch-only.
+        self._sync_ticks = recorder is not None
         if prefix_enabled:
             from .prefix_cache import RadixPrefixCache
 
@@ -865,6 +896,7 @@ class GenerationEngine:
         for prog in self._pending:
             # A chunked admission in flight is in neither the queue nor a
             # slot; fail it LOUDLY or its client awaits forever.
+            self._abort_trace(prog.req.trace, "shutdown")
             if not prog.req.future.done():
                 _safe_fail(
                     prog.req.future,
@@ -878,6 +910,7 @@ class GenerationEngine:
         self._seq_state = None
         for slot in self._slots:
             if slot is not None and not slot.future.done():
+                self._abort_trace(slot.trace, "shutdown")
                 slot.future.cancel()
         while True:
             try:
@@ -889,6 +922,7 @@ class GenerationEngine:
                 # bare CancelledError — callers can distinguish "the
                 # server is going away, retry elsewhere" from a client-
                 # side cancel.
+                self._abort_trace(req.trace, "shutdown")
                 _safe_fail(
                     req.future,
                     EngineShutdown(
@@ -896,6 +930,16 @@ class GenerationEngine:
                         "another replica"
                     ),
                 )
+
+    def _abort_trace(self, trace, reason: str) -> None:
+        """Finish a request trace off the normal token path (shutdown /
+        engine failure) so its span still closes in the recorder."""
+        if trace is None:
+            return
+        trace.finish(reason)
+        if self._recorder is not None:
+            self._recorder.event(trace.request_id, "finish", slot=trace.slot)
+            self._recorder.complete(trace)
 
     # -- client API ----------------------------------------------------------
 
@@ -965,6 +1009,8 @@ class GenerationEngine:
         top_p: float = 1.0,
         seed: int | None = None,
         on_token: Callable[[int], None] | None = None,
+        request_id: str = "",
+        trace=None,  # flight_recorder.RequestTrace | None
     ) -> Future:
         prompt = self.validate(
             prompt_ids, max_new_tokens, temperature, top_k, top_p, seed
@@ -972,6 +1018,14 @@ class GenerationEngine:
         fut: Future = Future()
         # None means "use the engine default"; 0 is a legitimate eos token.
         eos = self._eos_default if eos_id is None else eos_id
+        t_submit = time.perf_counter()
+        if trace is not None:
+            trace.t_submit = t_submit
+            trace.prompt_tokens = int(prompt.size)
+            if not trace.request_id:
+                trace.request_id = request_id
+            if self._recorder is not None:
+                self._recorder.event(trace.request_id, "enqueued")
         self._queue.put(
             _Request(
                 prompt,
@@ -983,7 +1037,9 @@ class GenerationEngine:
                 top_p=float(top_p),
                 seed=seed,
                 on_token=on_token,
-                t_submit=time.perf_counter(),
+                t_submit=t_submit,
+                request_id=request_id,
+                trace=trace,
             )
         )
         return fut
@@ -1028,6 +1084,16 @@ class GenerationEngine:
         )
         if not self._in_warmup:
             self.prefill_forwards += 1
+            if self._sync_ticks:
+                first = int(first)  # sync: the wall must cover device time
+            self._record_tick(
+                "prefill", t0, time.perf_counter() - t0,
+                active_slots=sum(s is not None for s in self._slots),
+                batch_fill=1, tokens=1,
+            )
+        if req.trace is not None:
+            req.trace.slot = slot_idx
+            req.trace.prefill_chunks += 1  # fused: the whole prompt at once
         slot = _Slot(
             future=req.future,
             remaining=req.max_new_tokens,
@@ -1036,16 +1102,58 @@ class GenerationEngine:
             on_token=req.on_token,
             prompt_len=L,
             t_start=t0,
+            request_id=req.request_id,
+            trace=req.trace,
             **self._spec_slot_state(req),
         )
         self._slots[slot_idx] = slot
         self._note_ttft(req)
         self._record_token(slot_idx, int(first))
 
+    def _sync_seq_state(self) -> None:
+        """Journaling only: wait for the in-flight scratch-cache op so
+        the tick wall about to be recorded covers the device time, not
+        just the async dispatch (see ``_sync_ticks``)."""
+        if self._sync_ticks and self._seq_state is not None:
+            import jax
+
+            jax.block_until_ready(self._seq_state[1])
+
+    def _record_tick(
+        self, kind: str, t0: float, wall_s: float, *,
+        active_slots: int = 0, batch_fill: int = 0, tokens: int = 0,
+        spec_accepted: int = 0,
+    ) -> None:
+        """Journal one engine device dispatch (tick-kind metric + flight
+        recorder).  Callers skip warmup themselves; both sinks are
+        optional and the default (both None) costs one branch."""
+        if self._on_tick is not None:
+            self._on_tick(kind, wall_s)
+        if self._recorder is not None:
+            self._recorder.tick(
+                kind, t0, wall_s,
+                active_slots=active_slots,
+                queue_depth=self._queue.qsize(),
+                batch_fill=batch_fill,
+                tokens=tokens,
+                spec_accepted=spec_accepted,
+            )
+
+    def _trace_event(self, trace, name: str, slot: int = -1) -> None:
+        if (
+            self._recorder is not None
+            and trace is not None
+            and not self._in_warmup
+        ):
+            self._recorder.event(trace.request_id, name, slot=slot)
+
     def _note_ttft(self, req: _Request) -> None:
         """First token produced for ``req``: record submit->token wall."""
         if self._in_warmup or req.t_submit <= 0.0:
             return
+        if req.trace is not None:
+            req.trace.t_first = time.perf_counter()
+            self._trace_event(req.trace, "first_token", slot=req.trace.slot)
         if self._on_ttft is not None:
             self._on_ttft(time.perf_counter() - req.t_submit)
 
@@ -1053,6 +1161,9 @@ class GenerationEngine:
         """``req`` left the submission queue and its admission began."""
         if self._in_warmup or req.t_submit <= 0.0:
             return
+        if req.trace is not None:
+            req.trace.t_admit = time.perf_counter()
+            self._trace_event(req.trace, "admission")
         if self._on_admission_wait is not None:
             self._on_admission_wait(time.perf_counter() - req.t_submit)
 
@@ -1182,6 +1293,8 @@ class GenerationEngine:
         cached_tokens, cached_kv = 0, []
         if self._prefix_cache is not None and not self._in_warmup:
             cached_tokens, cached_kv = self._prefix_cache.lookup(req.prompt)
+        if req.trace is not None:
+            req.trace.cached_tokens = cached_tokens
         return _PrefillProgress(
             req=req,
             chunks=self._split_chunks(req.prompt[cached_tokens:]),
@@ -1434,6 +1547,7 @@ class GenerationEngine:
             if prog.cached_tokens and not prog.seeded:
                 # Cached-prefix hit: seed the radix K/V straight into the
                 # reserved cache row; those tokens never re-prefill.
+                ts = time.perf_counter()
                 self._dispatch_seed_slot(
                     prog.cached_kv, prog.slot, prog.cached_tokens
                 )
@@ -1441,8 +1555,19 @@ class GenerationEngine:
                 prog.cached_kv = []
                 self.prefix_hits += 1
                 self.prefix_cached_tokens += prog.cached_tokens
-                if self._on_prefix_hit is not None and not self._in_warmup:
-                    self._on_prefix_hit(prog.cached_tokens)
+                if not self._in_warmup:
+                    if self._on_prefix_hit is not None:
+                        self._on_prefix_hit(prog.cached_tokens)
+                    if self._sync_ticks:
+                        import jax
+
+                        jax.block_until_ready(self._cache_k)
+                    self._record_tick(
+                        "seed", ts, time.perf_counter() - ts,
+                        active_slots=sum(s is not None for s in self._slots),
+                        batch_fill=1,
+                    )
+                    self._trace_event(prog.req.trace, "seed", slot=prog.slot)
             else:
                 chunk_progs.append(prog)
         if not chunk_progs:
@@ -1480,7 +1605,22 @@ class GenerationEngine:
             self.prefill_forwards += 1
             if self._on_prefill_batch is not None:
                 self._on_prefill_batch(n)
+            finals = sum(
+                1 for prog in chunk_progs
+                if prog.next_idx == len(prog.chunks) - 1
+            )
+            self._record_tick(
+                "packed-prefill", t0, time.perf_counter() - t0,
+                active_slots=sum(s is not None for s in self._slots),
+                batch_fill=n, tokens=finals,
+            )
         for i, prog in enumerate(chunk_progs):
+            if prog.req.trace is not None:
+                prog.req.trace.slot = prog.slot
+                prog.req.trace.prefill_chunks += 1
+                self._trace_event(
+                    prog.req.trace, "prefill_chunk", slot=prog.slot
+                )
             self._maybe_cache_chunk_slot(prog)
             prog.next_idx += 1
             if prog.next_idx < len(prog.chunks):
@@ -1498,6 +1638,8 @@ class GenerationEngine:
                 on_token=req.on_token,
                 prompt_len=int(req.prompt.size),
                 t_start=t0,
+                request_id=req.request_id,
+                trace=req.trace,
                 **self._spec_slot_state(req),
             )
             self._note_ttft(req)
@@ -1666,19 +1808,38 @@ class GenerationEngine:
         if prog.cached_tokens and not prog.seeded:
             # Cached-prefix hit: one seed op copies the radix-cached K/V
             # into a fresh sequence cache — those tokens never re-prefill.
+            ts = time.perf_counter()
             self._dispatch_seed(prog.cached_kv, prog.cached_tokens)
             prog.seeded = True
             prog.cached_kv = []  # host copies handed off; free the refs
             self.prefix_hits += 1
             self.prefix_cached_tokens += prog.cached_tokens
-            if self._on_prefix_hit is not None and not self._in_warmup:
-                self._on_prefix_hit(prog.cached_tokens)
+            if not self._in_warmup:
+                if self._on_prefix_hit is not None:
+                    self._on_prefix_hit(prog.cached_tokens)
+                self._sync_seq_state()
+                self._record_tick(
+                    "seed", ts, time.perf_counter() - ts,
+                    active_slots=sum(s is not None for s in self._slots),
+                    batch_fill=1,
+                )
+                self._trace_event(prog.req.trace, "seed")
             return  # suffix chunks start next tick (decode cadence kept)
         ids = prog.chunks[prog.next_idx]
+        ts = time.perf_counter()
         self._dispatch_chunk(ids, fresh=prog.next_idx == 0 and not prog.seeded)
         if not self._in_warmup:
             self.prefill_chunks_dispatched += 1
             self.prefill_forwards += 1
+            self._sync_seq_state()
+            self._record_tick(
+                "prefill", ts, time.perf_counter() - ts,
+                active_slots=sum(s is not None for s in self._slots),
+                batch_fill=1,
+            )
+        if prog.req.trace is not None:
+            prog.req.trace.prefill_chunks += 1
+            self._trace_event(prog.req.trace, "prefill_chunk")
         self._maybe_cache_chunk(prog)
         prog.next_idx += 1
         if prog.next_idx < len(prog.chunks):
@@ -1695,6 +1856,16 @@ class GenerationEngine:
             slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p,
             last_idx=(L - 1) - prog.cached_tokens - C * (len(prog.chunks) - 1),
         )
+        if not self._in_warmup:
+            if self._sync_ticks:
+                first = int(first)  # sync: the wall must cover device time
+            self._record_tick(
+                "prefill", t0, time.perf_counter() - t0,
+                active_slots=sum(s is not None for s in self._slots),
+                batch_fill=1, tokens=1,
+            )
+        if req.trace is not None:
+            req.trace.slot = slot_idx
         self._slots[slot_idx] = _Slot(
             future=req.future,
             remaining=req.max_new_tokens,
@@ -1703,6 +1874,8 @@ class GenerationEngine:
             on_token=req.on_token,
             prompt_len=L,
             t_start=t0,
+            request_id=req.request_id,
+            trace=req.trace,
             **self._spec_slot_state(req),
         )
         self._note_ttft(req)
@@ -1718,6 +1891,7 @@ class GenerationEngine:
         if slot.future.cancelled():
             # Client gone (stream disconnect / shutdown): free the slot
             # instead of decoding tokens nobody will read.
+            self._finish_trace(slot, "cancelled")
             self._slots[slot_idx] = None
             return
         slot.generated.append(token)
@@ -1726,6 +1900,12 @@ class GenerationEngine:
             slot.hist_len += 1
         slot.remaining -= 1
         if not self._in_warmup:
+            now = time.perf_counter()
+            if slot.t_last_token > 0.0 and self._on_itl is not None:
+                self._on_itl(now - slot.t_last_token)
+            slot.t_last_token = now
+            if slot.trace is not None:
+                slot.trace.note_token(now)
             self.tokens_generated += 1
             if self._on_tokens is not None:
                 self._on_tokens(1)
@@ -1733,13 +1913,41 @@ class GenerationEngine:
                 try:
                     slot.on_token(token)
                 except Exception:
-                    _log.exception("on_token callback failed")
+                    # ONE line, then disarm: a broken streaming client
+                    # would otherwise log a full stack per token at
+                    # decode rate for the rest of the request.
+                    _log.exception(
+                        "on_token callback failed; disabling streaming "
+                        "callback for this request"
+                    )
+                    slot.on_token = None
         done = slot.remaining <= 0 or (
             slot.eos_id is not None and token == slot.eos_id
         )
         if done:
+            reason = (
+                "eos"
+                if slot.eos_id is not None and token == slot.eos_id
+                else "length"
+            )
+            self._finish_trace(slot, reason)
             _safe_resolve(slot.future, np.asarray(slot.generated, np.int32))
             self._slots[slot_idx] = None
+
+    def _finish_trace(self, slot: _Slot, reason: str) -> None:
+        """Close a slot's request trace: finish reason, completion event,
+        per-request token-count histogram, and hand the trace to the
+        flight recorder's completed-request ring."""
+        if self._in_warmup:
+            return
+        if self._on_request_tokens is not None:
+            self._on_request_tokens(len(slot.generated))
+        if slot.trace is None:
+            return
+        slot.trace.finish(reason)
+        self._trace_event(slot.trace, "finish", slot=slot.trace.slot)
+        if self._recorder is not None:
+            self._recorder.complete(slot.trace)
 
     def _step(self) -> None:
         """One batched decode tick over every occupied slot.
@@ -1773,24 +1981,33 @@ class GenerationEngine:
         t0 = time.perf_counter()
         self._dispatch_step(active_np, window, sampling)
         toks = np.asarray(self._tokens)[:, 0]
-        self._note_tick(active_np, t0)
+        self._note_tick(active_np, t0, tokens=int(active_np.sum()))
         for i, was_active in enumerate(active_np):
             if was_active and self._slots[i] is not None:
                 self._record_token(i, int(toks[i]))
                 if not self._in_warmup:
                     self.decode_tokens += 1
 
-    def _note_tick(self, active_np, t0: float) -> None:
+    def _note_tick(
+        self, active_np, t0: float, kind: str = "decode",
+        tokens: int = 0, spec_accepted: int = 0,
+    ) -> None:
         if self._in_warmup:
             return
         self.decode_forwards += 1
+        wall = time.perf_counter() - t0
+        self._record_tick(
+            kind, t0, wall,
+            active_slots=int(active_np.sum()),
+            tokens=tokens, spec_accepted=spec_accepted,
+        )
         if self._on_step is not None:
             # queue depth counts QUEUED-BUT-UNADMITTED requests only; the
             # in-flight admission count rides separately so saturation
             # and admission-latency alerts stop conflating the two.
             self._on_step(
                 int(active_np.sum()),
-                time.perf_counter() - t0,
+                wall,
                 self._queue.qsize(),
                 len(self._pending),
             )
@@ -1846,7 +2063,12 @@ class GenerationEngine:
         greedy, accepted = self._dispatch_verify(
             toks, active_np, draft_len, window
         )
-        self._note_tick(active_np, t0)
+        acc_total = int(np.asarray(accepted)[active_np].sum())
+        self._note_tick(
+            active_np, t0, kind="verify",
+            tokens=int(active_np.sum()) + acc_total,
+            spec_accepted=acc_total,
+        )
         if not self._in_warmup:
             self.spec_verify_ticks += 1
         for i, was_active in enumerate(active_np):
@@ -1859,6 +2081,9 @@ class GenerationEngine:
             if n_prop and not self._in_warmup:
                 self.spec_proposed_tokens += n_prop
                 self.spec_accepted_tokens += n_acc
+                if slot.trace is not None:
+                    slot.trace.spec_proposed += n_prop
+                    slot.trace.spec_accepted += n_acc
                 if self._on_spec is not None:
                     self._on_spec(n_prop, n_acc)
             # Emit the accepted draft prefix plus the bonus token; stop
@@ -2092,6 +2317,7 @@ class GenerationEngine:
         buffers restore service for subsequent requests."""
         for i, slot in enumerate(self._slots):
             if slot is not None and not slot.future.done():
+                self._abort_trace(slot.trace, "error")
                 _safe_fail(
                     slot.future,
                     RuntimeError("generation step failed; see server log"),
@@ -2105,6 +2331,7 @@ class GenerationEngine:
             # zeroed K/V would stream corrupted completions as 200s.
             for prog in self._pending:
                 if not prog.req.future.done():
+                    self._abort_trace(prog.req.trace, "error")
                     _safe_fail(
                         prog.req.future,
                         RuntimeError(

@@ -203,6 +203,41 @@ class ServerMetrics:
             buckets=_LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # Per-request latency decomposition (with ttft_seconds): ITL is
+        # the steady-state token cadence a streaming client feels —
+        # decode_step_seconds measures the device tick, ITL measures the
+        # request (a tick serves many slots; a slot skips ticks while
+        # its admission peer prefills).
+        self.itl_seconds = Histogram(
+            "tpumlops_itl_seconds",
+            "Inter-token latency: wall between consecutive tokens of one "
+            "request (first token excluded — that is TTFT)",
+            ident_labels,
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.request_tokens = Histogram(
+            "tpumlops_request_tokens",
+            "Tokens generated per finished request (includes cancelled "
+            "requests' partial output)",
+            ident_labels,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+            registry=self.registry,
+        )
+        # Engine tick wall by kind: the aggregate view of the flight
+        # recorder's per-tick journal (server/flight_recorder.py) — a
+        # decode-cadence regression shows up as the decode kind's
+        # distribution shifting while packed-prefill's fattens.
+        self.tick_seconds = Histogram(
+            "tpumlops_tick_seconds",
+            "Engine tick wall time by kind "
+            "(decode/verify/prefill/packed-prefill/seed); prefill/seed "
+            "walls are dispatch-only unless the flight recorder is on "
+            "(traceRing > 0), which syncs them to cover device time",
+            ident_labels + ["kind"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
         # Self-speculative decoding (server/speculative.py): proposed vs
         # accepted draft tokens, plus per-verify distributions.  The
         # counters give the exact acceptance rate over any window
@@ -311,6 +346,15 @@ class ServerMetrics:
 
     def observe_ttft(self, seconds: float):
         self.ttft_seconds.labels(**self.identity).observe(seconds)
+
+    def observe_itl(self, seconds: float):
+        self.itl_seconds.labels(**self.identity).observe(seconds)
+
+    def observe_request_tokens(self, n: int):
+        self.request_tokens.labels(**self.identity).observe(n)
+
+    def observe_tick(self, kind: str, seconds: float):
+        self.tick_seconds.labels(**self.identity, kind=kind).observe(seconds)
 
     def observe_speculative(self, proposed: int, accepted: int):
         self.spec_proposed_tokens.labels(**self.identity).inc(proposed)

@@ -324,6 +324,36 @@ class SpeculativeSpec:
                 )
 
 
+@dataclass(frozen=True)
+class ObservabilitySpec:
+    """``spec.tpu.observability``: engine flight-recorder sizing.
+
+    ``trace_ring`` is the bounded in-memory journal's capacity (one ring
+    each for engine ticks, request lifecycle events, and completed
+    request traces; served at ``/debug/engine`` and ``/debug/trace``).
+    0 — the default — creates no recorder at all, so the engine loop
+    stays byte-for-byte unobserved.
+    """
+
+    trace_ring: int = 0
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "ObservabilitySpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec, frozenset({"traceRing"}), "spec.tpu.observability"
+        )
+        return cls(trace_ring=int(spec.get("traceRing", 0)))
+
+    def __post_init__(self):
+        if self.trace_ring < 0:
+            # Reject at reconcile time, not as a pod CrashLoopBackOff.
+            raise ValueError(
+                "observability.traceRing must be >= 0, got "
+                f"{self.trace_ring}"
+            )
+
+
 def _parse_quantize(value) -> str:
     """Reject bad quantize values at reconcile time — a typo'd CR field must
     surface in status, not as a pod CrashLoopBackOff at argparse."""
@@ -381,6 +411,9 @@ class TpuSpec:
     # Self-speculative n-gram decoding: batched multi-token verify
     # amortizes the per-tick HBM weight stream over accepted drafts.
     speculative: SpeculativeSpec = field(default_factory=SpeculativeSpec)
+    # Engine flight recorder (per-tick journal + request traces at
+    # /debug/engine and /debug/trace); traceRing 0 = off, zero overhead.
+    observability: ObservabilitySpec = field(default_factory=ObservabilitySpec)
     # Warm the FULL batch x seq-length compile grid at startup instead of
     # the edges (batch 1 / max per length).  Costs |batch buckets| x
     # |length buckets| cold compiles; buys zero first-hit compile stalls
@@ -398,7 +431,8 @@ class TpuSpec:
                     "maxBatchSize", "maxBatchDelayMs", "maxSlots",
                     "maxInflightBatches", "compileCacheDir", "quantize",
                     "prefillChunk", "prefillBatch", "prefillTokenBudget",
-                    "prefixCache", "speculative", "warmupFullGrid",
+                    "prefixCache", "speculative", "observability",
+                    "warmupFullGrid",
                 }
             ),
             "spec.tpu",
@@ -441,6 +475,9 @@ class TpuSpec:
             ),
             prefix_cache=prefix_cache,
             speculative=SpeculativeSpec.from_spec(spec.get("speculative")),
+            observability=ObservabilitySpec.from_spec(
+                spec.get("observability")
+            ),
             warmup_full_grid=bool(spec.get("warmupFullGrid", False)),
         )
 

@@ -5,10 +5,17 @@ The reference configures global INFO logging at import time
 ``f"{name}-{namespace}"`` (``:38-41``), prefixing messages with
 ``[namespace/name]``.  We keep the per-resource logger convention but make
 the prefix part of the logger itself.
+
+``configure(json_format=True)`` (the ``--log-format json`` CLI flag on the
+server and operator entrypoints) switches every line to one JSON object
+carrying ``request_id`` when the record has one — the per-request
+completion lines the server emits become machine-parseable without
+regexing the ``[ns/name]`` prefix convention away.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 
 
@@ -23,7 +30,42 @@ def model_logger(name: str, namespace: str) -> logging.LoggerAdapter:
     return _PrefixAdapter(base, {"resource": f"[{namespace}/{name}]"})
 
 
-def configure(level: int = logging.INFO) -> None:
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; ``request_id`` rides along when present
+    (loggers pass it via ``extra={"request_id": ...}``)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        from datetime import datetime, timezone
+
+        # UTC with millisecond precision and an explicit offset: whole
+        # local seconds can't order two completion lines from one burst,
+        # and offset-less stamps from pods in different TZ configs don't
+        # merge.
+        ts = datetime.fromtimestamp(
+            record.created, timezone.utc
+        ).isoformat(timespec="milliseconds")
+        out = {
+            "ts": ts,
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        request_id = getattr(record, "request_id", None)
+        if request_id:
+            out["request_id"] = str(request_id)
+        if record.exc_info:
+            out["exc_info"] = self.formatException(record.exc_info)
+        # default=str: a log call with a non-serializable extra must
+        # degrade to its repr, never throw inside the logging machinery.
+        return json.dumps(out, default=str)
+
+
+def configure(level: int = logging.INFO, json_format: bool = False) -> None:
+    if json_format:
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonFormatter())
+        logging.basicConfig(level=level, handlers=[handler], force=True)
+        return
     logging.basicConfig(
         level=level,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

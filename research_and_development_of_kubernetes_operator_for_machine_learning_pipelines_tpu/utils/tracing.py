@@ -52,8 +52,27 @@ class Tracer:
                 self._stats[name].observe(dt)
 
     def stats(self) -> dict[str, SpanStats]:
+        """Point-in-time snapshot.  The values are COPIES taken under the
+        lock: handing out the live mutable ``SpanStats`` let ``report()``
+        read torn counts mid-``observe`` (count bumped, total_s not yet)."""
         with self._lock:
-            return dict(self._stats)
+            return {
+                name: SpanStats(s.count, s.total_s, s.max_s)
+                for name, s in self._stats.items()
+            }
+
+    def as_dict(self) -> dict[str, dict]:
+        """JSON-ready stats (the ``/debug/spans`` payload shape on both
+        the server and the operator's metrics listener)."""
+        return {
+            name: {
+                "count": s.count,
+                "total_s": round(s.total_s, 6),
+                "mean_ms": round(s.mean_s * 1e3, 3),
+                "max_ms": round(s.max_s * 1e3, 3),
+            }
+            for name, s in sorted(self.stats().items())
+        }
 
     def report(self) -> str:
         lines = []

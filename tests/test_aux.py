@@ -28,6 +28,92 @@ def test_tracer_records_spans():
     assert "reconcile: n=2" in tr.report()
 
 
+def test_tracer_stats_is_a_snapshot_not_a_live_view():
+    """``stats()`` must copy the SpanStats under the lock: sharing the
+    live mutable values let ``report()`` read torn counts mid-observe
+    (count bumped on one thread, total_s not yet)."""
+    tr = Tracer()
+    with tr.span("x"):
+        pass
+    snap = tr.stats()["x"]
+    count0, total0 = snap.count, snap.total_s
+    with tr.span("x"):
+        pass
+    assert snap.count == count0
+    assert snap.total_s == total0
+    assert tr.stats()["x"].count == count0 + 1
+
+
+def test_tracer_as_dict_is_json_ready():
+    import json
+
+    tr = Tracer()
+    with tr.span("gate"):
+        pass
+    d = json.loads(json.dumps(tr.as_dict()))
+    assert d["gate"]["count"] == 1
+    assert set(d["gate"]) == {"count", "total_s", "mean_ms", "max_ms"}
+
+
+def test_json_log_format_carries_request_id():
+    import io
+    import json
+    import logging
+
+    from tpumlops.utils.logging import JsonFormatter
+
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(JsonFormatter())
+    log = logging.getLogger("tpumlops.test.jsonfmt")
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    log.propagate = False
+    try:
+        log.info("generate done tokens=%d", 7, extra={"request_id": "rid-9"})
+        log.warning("no id attached")
+    finally:
+        log.removeHandler(handler)
+    lines = [json.loads(ln) for ln in stream.getvalue().splitlines()]
+    assert lines[0]["message"] == "generate done tokens=7"
+    assert lines[0]["request_id"] == "rid-9"
+    assert lines[0]["level"] == "INFO"
+    assert lines[0]["logger"] == "tpumlops.test.jsonfmt"
+    assert "request_id" not in lines[1]
+
+
+def test_operator_metrics_listener_serves_debug_spans():
+    """The operator's --metrics-port listener serves /metrics AND
+    /debug/spans (the GLOBAL_TRACER stats, same shape as the server)."""
+    import json
+    import urllib.request
+
+    from tpumlops.operator.telemetry import OperatorTelemetry
+    from tpumlops.utils.tracing import GLOBAL_TRACER
+
+    telemetry = OperatorTelemetry()
+    telemetry.set_resource_count(3)
+    httpd = telemetry.serve(0, addr="127.0.0.1")  # port 0: OS-assigned
+    port = httpd.server_address[1]
+    try:
+        with GLOBAL_TRACER.span("operator-listener-probe"):
+            pass
+        base = f"http://127.0.0.1:{port}"
+        metrics = urllib.request.urlopen(base + "/metrics", timeout=5).read()
+        assert b"tpumlops_operator_resources 3.0" in metrics
+        spans = json.loads(
+            urllib.request.urlopen(base + "/debug/spans", timeout=5).read()
+        )["spans"]
+        assert spans["operator-listener-probe"]["count"] >= 1
+        try:
+            urllib.request.urlopen(base + "/nope", timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        httpd.shutdown()
+
+
 def test_checkpoint_roundtrip(tmp_path):
     tree = {
         "layer": {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.ones((4,))},

@@ -1,0 +1,110 @@
+"""Metric-identity contract (SURVEY §7 hard part 4).
+
+The promotion gate's PromQL — and every dashboard, alert, and the
+canary-judge queries built on it — reads these exact family names and
+label sets.  prometheus_client would happily accept a rename and the
+gate would then read 0 through its ``or on() vector(0)`` fallback, which
+is the worst failure mode: green dashboards over a blind gate.  This
+test snapshots the full inventory of both registries so an accidental
+rename (or label drop) fails HERE; ``make verify`` runs it as the
+``metrics-contract`` step alongside ``bench-contract``.
+
+Names below are prometheus_client *family* names (``describe()``):
+Counters declared with a ``_total`` suffix appear stripped here and
+re-gain ``_total`` in the exposition; Counters declared without one
+(e.g. ``tpumlops_prefix_cache_hits``) gain ``_total`` only at export.
+
+Intentional renames are fine — update the snapshot AND the PromQL that
+reads the series (operator/judge.py, docs/OBSERVABILITY.md) in the same
+commit.
+"""
+
+from prometheus_client.metrics import MetricWrapperBase
+
+from tpumlops.operator.telemetry import OperatorTelemetry
+from tpumlops.server.metrics import ServerMetrics
+
+_IDENT = ("deployment_name", "predictor_name", "namespace")
+
+EXPECTED_SERVER = {
+    "seldon_api_executor_client_requests_seconds": ("histogram", _IDENT),
+    "seldon_api_executor_server_requests_seconds": (
+        "histogram", _IDENT + ("code", "service")),
+    "tpumlops_admission_wait_ms": ("histogram", _IDENT),
+    "tpumlops_batch_run_seconds": ("histogram", _IDENT),
+    "tpumlops_batch_size": ("histogram", _IDENT),
+    "tpumlops_compilations": ("counter", _IDENT),
+    "tpumlops_decode_batch_size": ("histogram", _IDENT),
+    "tpumlops_decode_step_seconds": ("histogram", _IDENT),
+    "tpumlops_engine_active_slots": ("gauge", _IDENT),
+    "tpumlops_engine_admitting": ("gauge", _IDENT),
+    "tpumlops_engine_queue_depth": ("gauge", _IDENT),
+    "tpumlops_feedback_reward_total": ("gauge", _IDENT),
+    "tpumlops_generated_tokens": ("counter", _IDENT),
+    "tpumlops_itl_seconds": ("histogram", _IDENT),
+    "tpumlops_model_ready": ("gauge", _IDENT),
+    "tpumlops_pipeline_wait_seconds": ("histogram", _IDENT),
+    "tpumlops_prefill_batch_fill": ("histogram", _IDENT),
+    "tpumlops_prefix_cache_cached_tokens": ("counter", _IDENT),
+    "tpumlops_prefix_cache_evictions": ("counter", _IDENT),
+    "tpumlops_prefix_cache_hits": ("counter", _IDENT),
+    "tpumlops_queue_seconds": ("histogram", _IDENT),
+    "tpumlops_request_tokens": ("histogram", _IDENT),
+    "tpumlops_spec_acceptance_rate": ("histogram", _IDENT),
+    "tpumlops_spec_accepted_len": ("histogram", _IDENT),
+    "tpumlops_spec_accepted_tokens": ("counter", _IDENT),
+    "tpumlops_spec_proposed_tokens": ("counter", _IDENT),
+    "tpumlops_tick_seconds": ("histogram", _IDENT + ("kind",)),
+    "tpumlops_ttft_seconds": ("histogram", _IDENT),
+}
+
+_OP_IDENT = ("namespace", "name")
+
+EXPECTED_OPERATOR = {
+    "tpumlops_operator_events": ("counter", _OP_IDENT + ("reason",)),
+    "tpumlops_operator_phase": ("gauge", _OP_IDENT + ("phase",)),
+    "tpumlops_operator_promotions": ("counter", _OP_IDENT + ("outcome",)),
+    "tpumlops_operator_reconcile": ("counter", _OP_IDENT + ("result",)),
+    "tpumlops_operator_reconcile_seconds": ("histogram", _OP_IDENT),
+    "tpumlops_operator_resources": ("gauge", ()),
+    "tpumlops_operator_step_component_seconds": (
+        "histogram", _OP_IDENT + ("component",)),
+    "tpumlops_operator_traffic_percent": ("gauge", _OP_IDENT),
+}
+
+
+def _inventory(obj) -> dict:
+    out = {}
+    for attr in vars(obj).values():
+        if isinstance(attr, MetricWrapperBase):
+            fam = attr.describe()[0]
+            out[fam.name] = (fam.type, tuple(attr._labelnames))
+    return out
+
+
+def test_server_metric_families_are_pinned():
+    metrics = ServerMetrics(
+        deployment_name="d", predictor_name="p", namespace="n"
+    )
+    assert _inventory(metrics) == EXPECTED_SERVER
+
+
+def test_operator_metric_families_are_pinned():
+    assert _inventory(OperatorTelemetry()) == EXPECTED_OPERATOR
+
+
+def test_gate_series_present_in_exposition():
+    """The two families the gate's PromQL reads directly
+    (mlflow_operator.py:367,:375) must appear in the exposition with
+    their identity labels even before any traffic."""
+    metrics = ServerMetrics(
+        deployment_name="d", predictor_name="p", namespace="n"
+    )
+    metrics.observe_request(0.01, code=200)
+    text = metrics.exposition().decode()
+    assert (
+        'seldon_api_executor_client_requests_seconds_count{'
+        'deployment_name="d",namespace="n",predictor_name="p"}' in text
+    )
+    assert "seldon_api_executor_server_requests_seconds_count{" in text
+    assert 'code="200"' in text

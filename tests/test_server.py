@@ -616,6 +616,153 @@ def test_generate_streaming_rejects_multi_prompt(llm_server):
     assert "one prompt" in resp.json()["error"]
 
 
+def test_request_id_echo_and_traceparent(iris_server):
+    """Request identity contract: X-Request-Id in -> echoed verbatim;
+    W3C traceparent in -> its 32-hex trace id becomes the request id;
+    neither in -> the server mints one.  Errors carry the echo too."""
+    handle, sk, X, y = iris_server
+    body = {
+        "inputs": [
+            {
+                "name": "x",
+                "shape": [1, 4],
+                "datatype": "FP32",
+                "data": [float(v) for v in X[0]],
+            }
+        ]
+    }
+    url = handle.base + "/v2/models/iris/infer"
+    resp = httpx.post(
+        url, json=body, headers={"X-Request-Id": "my-id-42"}, timeout=30
+    )
+    assert resp.headers["X-Request-Id"] == "my-id-42"
+    trace_id = "0af7651916cd43dd8448eb211c80319c"
+    resp = httpx.post(
+        url,
+        json=body,
+        headers={"traceparent": f"00-{trace_id}-b7ad6b7169203331-01"},
+        timeout=30,
+    )
+    assert resp.headers["X-Request-Id"] == trace_id
+    resp = httpx.post(url, json=body, timeout=30)
+    assert len(resp.headers["X-Request-Id"]) == 32  # server-minted uuid4
+    bad = httpx.post(
+        url, json={"inputs": []}, headers={"X-Request-Id": "err-7"}, timeout=30
+    )
+    assert bad.status_code == 400
+    assert bad.headers["X-Request-Id"] == "err-7"
+    # Router-level 404s are RAISED HTTPExceptions, not returned
+    # responses — they carry the echo too (misrouted requests are the
+    # ones a client most needs to correlate).
+    lost = httpx.get(
+        handle.base + "/no/such/path",
+        headers={"X-Request-Id": "lost-1"},
+        timeout=30,
+    )
+    assert lost.status_code == 404
+    assert lost.headers["X-Request-Id"] == "lost-1"
+    # An id that sanitizes to nothing falls through to a minted one
+    # (httpx refuses to send control chars, so this level is unit-only).
+    from tpumlops.server.app import request_id_from_headers
+
+    assert len(request_id_from_headers({"X-Request-Id": "\x01\x02"})) == 32
+    assert request_id_from_headers({"X-Request-Id": "ok-1"}) == "ok-1"
+
+
+def test_debug_spans_endpoint(iris_server):
+    """GLOBAL_TRACER stats readable off the data plane."""
+    from tpumlops.utils.tracing import GLOBAL_TRACER
+
+    handle, *_ = iris_server
+    with GLOBAL_TRACER.span("test-span-probe"):
+        pass
+    resp = httpx.get(handle.base + "/debug/spans", timeout=10)
+    assert resp.status_code == 200
+    spans = resp.json()["spans"]
+    assert spans["test-span-probe"]["count"] >= 1
+    assert set(spans["test-span-probe"]) == {
+        "count", "total_s", "mean_ms", "max_ms"
+    }
+
+
+def _metric_total(text: str, family: str) -> float:
+    """Sum every sample of ``family`` in a Prometheus exposition."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(family) and line[len(family)] in "{ ":
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+@pytest.mark.slow
+def test_generate_debug_timing_block_agrees_with_metrics(llm_server):
+    """``"debug": true`` returns the per-request timing block, and its
+    token / cached-token / speculative totals agree with the Prometheus
+    counters that same request incremented."""
+    before = httpx.get(llm_server.base + "/metrics", timeout=10).text
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 7, "debug": True},
+        headers={"X-Request-Id": "debug-req-1"},
+        timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    assert resp.headers["X-Request-Id"] == "debug-req-1"
+    after = httpx.get(llm_server.base + "/metrics", timeout=10).text
+    timing = resp.json()["timing"]
+    assert timing["request_id"] == "debug-req-1"
+
+    def delta(family):
+        return _metric_total(after, family) - _metric_total(before, family)
+
+    assert timing["tokens"] == 7
+    assert timing["tokens"] == delta("tpumlops_generated_tokens_total")
+    assert timing["cached_tokens"] == delta(
+        "tpumlops_prefix_cache_cached_tokens_total"
+    )
+    assert timing["spec_accepted"] == delta(
+        "tpumlops_spec_accepted_tokens_total"
+    )
+    assert delta("tpumlops_request_tokens_count") == 1
+    assert delta("tpumlops_request_tokens_sum") == 7
+    # 7 tokens = 1 from prefill + 6 decode ticks -> 6 inter-token gaps.
+    assert delta("tpumlops_itl_seconds_count") == 6
+    assert delta("tpumlops_tick_seconds_count") >= 6  # decode + prefill
+    assert 'kind="decode"' in after and 'kind="prefill"' in after
+    assert timing["finish_reasons"] == ["length"]
+    assert timing["queue_ms"] is not None and timing["queue_ms"] >= 0
+    assert timing["ttft_ms"] is not None and timing["ttft_ms"] >= 0
+    assert timing["rows"][0]["prompt_tokens"] == 3
+    # Without the flag the block is absent (and typo'd knobs still 400).
+    plain = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 2},
+        timeout=60,
+    )
+    assert "timing" not in plain.json()
+
+
+@pytest.mark.slow
+def test_generate_multi_row_debug_totals(llm_server):
+    """Row sub-ids derive from the request id; totals sum across rows."""
+    resp = httpx.post(
+        llm_server.base + "/v2/models/llm/generate",
+        json={
+            "prompt_ids": [[5, 9, 2], [7, 1, 4, 8]],
+            "max_new_tokens": 3,
+            "debug": True,
+        },
+        headers={"X-Request-Id": "multi-1"},
+        timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    timing = resp.json()["timing"]
+    assert timing["tokens"] == 6
+    assert [r["request_id"] for r in timing["rows"]] == [
+        "multi-1/0", "multi-1/1"
+    ]
+
+
 def test_debug_profile_endpoint(iris_server):
     handle, *_ = iris_server
     resp = httpx.post(
