@@ -64,6 +64,15 @@ def main(argv: list[str] | None = None) -> None:
         choices=["text", "json"],
         help="json: one JSON object per log line (machine-parseable)",
     )
+    ap.add_argument(
+        "--rollout-ring",
+        type=int,
+        default=0,
+        help="per-CR rollout journal capacity (gate decisions + phase "
+        "transitions, served at /debug/rollouts and "
+        "/debug/rollouts/trace on the metrics listener); 0 disables — "
+        "no recorder object is constructed at all",
+    )
     args = ap.parse_args(argv)
 
     from ..utils.logging import configure as configure_logging
@@ -78,6 +87,7 @@ def main(argv: list[str] | None = None) -> None:
     from ..clients.mlflow_rest import MlflowRestClient
     from ..clients.prom_http import PrometheusSource
     from .leader import LeaderElector
+    from .rollout_recorder import RolloutRecorder
     from .runtime import CrWatcher, DeploymentWatcher, OperatorRuntime
     from .telemetry import OperatorTelemetry
 
@@ -87,8 +97,13 @@ def main(argv: list[str] | None = None) -> None:
     kube = KubeRestClient(base_url=args.kube_url)
     registry = MlflowRestClient()
     telemetry = OperatorTelemetry()
+    recorder = (
+        RolloutRecorder(capacity=args.rollout_ring)
+        if args.rollout_ring > 0
+        else None
+    )
     if args.metrics_port:
-        telemetry.serve(args.metrics_port)
+        telemetry.serve(args.metrics_port, recorder=recorder)
 
     sources: dict[str, PrometheusSource] = {}
 
@@ -118,6 +133,7 @@ def main(argv: list[str] | None = None) -> None:
                 namespace=args.namespace,
                 sync_interval_s=args.sync_interval,
                 telemetry=telemetry,
+                recorder=recorder,
                 max_concurrent_reconciles=args.concurrent_reconciles,
             )
             # Watchers start HERE, synchronously, so teardown can never

@@ -397,12 +397,24 @@ def build_deployment(
         predictors.append(make(previous_version, old_model_uri, traffic_prev))
     predictors.append(make(current_version, new_model_uri, traffic_current))
 
+    # Rollout context as annotations: `kubectl get sdep -o yaml` then
+    # explains the split without chasing the owning MlflowModel's status
+    # (the spec.predictors weights say WHAT, these say WHICH rollout).
+    annotations = {
+        "tpumlops.dev/current-version": str(current_version),
+        "tpumlops.dev/traffic-current": str(traffic_current),
+    }
+    if previous_version is not None and traffic_prev > 0:
+        annotations["tpumlops.dev/previous-version"] = str(previous_version)
+        annotations["tpumlops.dev/traffic-prev"] = str(traffic_prev)
+
     return {
         "apiVersion": SELDON_API_VERSION,
         "kind": "SeldonDeployment",
         "metadata": {
             "name": name,
             "namespace": namespace,
+            "annotations": annotations,
             "ownerReferences": owner_reference(name, owner_uid),
         },
         "spec": {

@@ -18,12 +18,18 @@ Hardening beyond the reference (opt-in via ``GateThresholds``, see SURVEY
   the window, so a 2-request fluke can't drive a promotion;
 - ``error_rate_floor``: absolute slack so a zero-error baseline doesn't
   deadlock the relative check on the canary's first error.
+
+Observability: alongside the boolean and the prose reasons, the decision
+carries a signed **margin** per check (budget − observed) so the rollout
+journal, ``status.lastGate``, and ``tpumlops_operator_gate_margin`` can
+say *how far* a canary is from promoting, not just that it isn't.
 """
 
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Mapping
 
 from ..clients.base import ModelMetrics
 from ..utils.config import GateThresholds
@@ -40,6 +46,15 @@ class GateDecision:
     # in the reconciler) never parse the human-readable reason strings —
     # rewording a message must not change behavior.
     missing_on: frozenset[str] = frozenset()
+    # Signed headroom per check, budget − observed (so >= 0 promotes and
+    # exact boundary equality is margin 0.0): keys "latency_p95",
+    # "error_rate", "latency_avg".  EMPTY — not zero — when the gate
+    # refused before the budget comparisons ran (metrics missing or
+    # below minSampleCount): an absent margin must never read as "right
+    # at the boundary".  This is what the rollout journal, status
+    # history, and tpumlops_operator_gate_margin{check} export instead
+    # of leaving headroom derivable only from the prose reasons.
+    margins: Mapping[str, float] = field(default_factory=dict)
 
     def __bool__(self) -> bool:
         return self.promote
@@ -94,24 +109,33 @@ def should_promote(
                 log.warning(r)
             return GateDecision(False, tuple(reasons))
 
+    # Budgets per check; margin = budget − observed.  A negative margin
+    # IS the refusal (margin < 0 ⇔ the reference's new > budget, so the
+    # boundary stays inclusive: margin 0.0 promotes).
+    err_budget = old.error_rate * (1 + t.error_rate)
+    if t.error_rate_floor > 0:
+        err_budget = max(err_budget, t.error_rate_floor)
+    margins = {
+        "latency_p95": old.latency_p95 * (1 + t.latency_p95) - new.latency_p95,
+        "error_rate": err_budget - new.error_rate,
+        "latency_avg": old.latency_avg * (1 + t.latency_avg) - new.latency_avg,
+    }
+
     # p95 latency (reference :440-444)
-    if new.latency_p95 > old.latency_p95 * (1 + t.latency_p95):
+    if margins["latency_p95"] < 0:
         reasons.append(
             f"p95 latency {new.latency_p95:.4f}s exceeds "
             f"{old.latency_p95:.4f}s * {1 + t.latency_p95:.2f}"
         )
 
     # error rate (reference :447-451), with optional absolute floor
-    err_budget = old.error_rate * (1 + t.error_rate)
-    if t.error_rate_floor > 0:
-        err_budget = max(err_budget, t.error_rate_floor)
-    if new.error_rate > err_budget:
+    if margins["error_rate"] < 0:
         reasons.append(
             f"error rate {new.error_rate:.4f} exceeds budget {err_budget:.4f}"
         )
 
     # mean latency (reference :454-458)
-    if new.latency_avg > old.latency_avg * (1 + t.latency_avg):
+    if margins["latency_avg"] < 0:
         reasons.append(
             f"mean latency {new.latency_avg:.4f}s exceeds "
             f"{old.latency_avg:.4f}s * {1 + t.latency_avg:.2f}"
@@ -120,6 +144,6 @@ def should_promote(
     if reasons:
         for r in reasons:
             log.warning(r)
-        return GateDecision(False, tuple(reasons))
+        return GateDecision(False, tuple(reasons), margins=margins)
     log.info("promotion gate passed: canary within all thresholds")
-    return GateDecision(True)
+    return GateDecision(True, margins=margins)

@@ -14,9 +14,12 @@ the runtime can interleave many resources on one thread.
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import logging
 import time
 from dataclasses import dataclass, field
+from typing import Any
 
 from ..clients.base import (
     AliasNotFound,
@@ -38,8 +41,14 @@ from ..utils.config import OperatorConfig
 from ..utils.logging import model_logger
 from .builder import build_deployment
 from .judge import should_promote
+from .rollout_recorder import GateRecord, TransitionRecord
 from .state import Phase, PromotionState
 from .uri import artifact_uri
+
+# One structured JSON decision line per gate evaluation (the control
+# plane's analogue of the server's ``tpumlops.request`` completion line):
+# CR identity + decision + margins, machine-parseable in both log modes.
+_gate_log = logging.getLogger("tpumlops.gate")
 
 
 class _OpTimer:
@@ -71,6 +80,10 @@ class ReconcileOutcome:
     # manifest_apply, gate_read, registry) — the overhead breakdown the
     # time-to-100% bench and operator telemetry report (VERDICT r2 #10).
     timings: dict = field(default_factory=dict)
+    # The step's GateRecord when this step evaluated the promotion gate
+    # (None otherwise); OperatorTelemetry reads it for the
+    # tpumlops_operator_gate_* series.
+    gate: Any = None
 
 
 class Reconciler:
@@ -92,6 +105,7 @@ class Reconciler:
         logger: logging.Logger | logging.LoggerAdapter | None = None,
         metrics_factory=None,  # Callable[[str], MetricsSource]; honors spec.prometheusUrl
         warmup=None,  # Callable[(deployment, predictor, namespace, n)]; synthetic traffic
+        recorder=None,  # RolloutRecorder | None; per-CR gate/phase journal
     ):
         self.name = name
         self.namespace = namespace
@@ -116,6 +130,15 @@ class Reconciler:
         # registered model restarts version numbering with new sources).
         self._source_cache: dict[tuple[str, str], str] = {}
         self._timings: dict[str, float] = {}
+        self.recorder = recorder
+        # Gate/phase records produced by the current step, flushed to the
+        # recorder (with the step's full op-timer breakdown) in reconcile().
+        self._pending_records: list = []
+        # Stuck-canary event rate limiter: the (traffic, reasons) of the
+        # last PromotionHold Warning actually emitted, and how many
+        # identical refusals have been suppressed since.
+        self._last_hold: tuple | None = None
+        self._hold_suppressed = 0
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -143,13 +166,33 @@ class Reconciler:
     def reconcile(self, obj: dict) -> ReconcileOutcome:
         """One reconcile step for the given CR object (spec+status+metadata)."""
         self._timings = {}
+        self._pending_records = []
+        # Per-CR log identity: metadata.generation on every line of this
+        # step (the control-plane analogue of the server's request_id).
+        if hasattr(self.log, "set_generation"):
+            self.log.set_generation(
+                (obj.get("metadata") or {}).get("generation")
+            )
         outcome = self._reconcile_inner(obj)
         outcome.timings = self._timings
+        # Flush the step's journal records.  Gate records get the step's
+        # COMPLETE op-timer breakdown here (the status.history copy was
+        # written mid-step, before its own status_patch could be timed).
+        for rec in self._pending_records:
+            if isinstance(rec, GateRecord):
+                rec = dataclasses.replace(rec, timings=dict(self._timings))
+                outcome.gate = rec
+            if self.recorder is not None:
+                self.recorder.record(self.namespace, self.name, rec)
         return outcome
 
     def _reconcile_inner(self, obj: dict) -> ReconcileOutcome:
         # Prior conditions feed lastTransitionTime stability (state.py).
         self._prior_conditions = (obj.get("status") or {}).get("conditions")
+        prior_status = obj.get("status") or {}
+        self._had_journal_keys = bool(
+            prior_status.get("lastGate") or prior_status.get("history")
+        )
         state = PromotionState.from_status(obj.get("status"))
         events: list[Event] = []
         try:
@@ -191,6 +234,7 @@ class Reconciler:
             and state.phase in (Phase.FAILED, Phase.ROLLED_BACK)
         ):
             self._ensure_deployment(obj, config, state)
+            state = self._shed_disabled_journal(config, state)
             return ReconcileOutcome(state, config.monitoring_interval_s, events)
 
         # 3. New version detected (reference :97-149).
@@ -205,7 +249,23 @@ class Reconciler:
         #    monitoring the alias.
         if state.phase in (Phase.STABLE, Phase.FAILED, Phase.ROLLED_BACK):
             self._ensure_deployment(obj, config, state)
+            state = self._shed_disabled_journal(config, state)
         return ReconcileOutcome(state, config.monitoring_interval_s, events)
+
+    def _shed_disabled_journal(
+        self, config: OperatorConfig, state: PromotionState
+    ) -> PromotionState:
+        """historyLimit back at 0 on a quiescent CR: the journal-writing
+        paths won't run again until the next rollout, so clear the stale
+        status.lastGate/history here (one extra patch, then steady state
+        is patch-free again)."""
+        if config.observability.history_limit > 0 or (
+            state.last_gate is None and not state.history
+        ):
+            return state
+        state = state.with_(last_gate=None, history=())
+        self._patch_status(state)
+        return state
 
     # -- handlers ------------------------------------------------------------
 
@@ -237,7 +297,11 @@ class Reconciler:
     ) -> ReconcileOutcome:
         """Reference :64-93: error status, tear down, Warning event."""
         new_state = state.alias_missing(config.model_alias)
-        if state != new_state:
+        changed = state != new_state
+        # Strip stale journal keys if historyLimit went back to 0 — an
+        # ERROR-parked CR never reaches the other shedding sites.
+        new_state = self._journal(config, new_state)
+        if changed:
             self._patch_status(new_state)
             self._delete_deployment()
             ev = Event(
@@ -248,7 +312,118 @@ class Reconciler:
             events.append(ev)
             self.kube.emit_event(self.cr_ref, ev)
             self.log.error(f"Alias '{config.model_alias}' does not exist.")
+        elif state != new_state:
+            # Journal-only cleanup: patch, but don't re-announce the
+            # missing alias.
+            self._patch_status(new_state)
         return ReconcileOutcome(new_state, config.monitoring_interval_s, events)
+
+    # -- rollout journal -----------------------------------------------------
+
+    def _journal(self, config: OperatorConfig, state: PromotionState, *records):
+        """Queue journal records for the recorder flush and — when
+        ``spec.observability.historyLimit`` > 0 — fold them into the
+        state's status journal.  Returns the state to persist."""
+        recs = [r for r in records if r is not None]
+        self._pending_records.extend(recs)
+        limit = config.observability.history_limit
+        if limit <= 0:
+            # Journal disabled: strip keys left over from when it was
+            # enabled so the upcoming patch clears them.
+            if state.last_gate is not None or state.history:
+                return state.with_(last_gate=None, history=())
+            return state
+        if not recs:
+            return state
+        history = (state.history + tuple(r.as_dict() for r in recs))[-limit:]
+        kw: dict = {"history": tuple(history)}
+        for r in reversed(recs):
+            if isinstance(r, GateRecord):
+                kw["last_gate"] = r.compact()
+                break
+        return state.with_(**kw)
+
+    def _gate_record(
+        self,
+        config: OperatorConfig,
+        state: PromotionState,
+        decision,
+        new_m,
+        old_m,
+        traffic_after: int,
+        attempt: int,
+    ) -> GateRecord:
+        """Everything the judge saw and decided, as one journal record.
+        The timings snapshot here is what has accrued so far this step
+        (registry + gate_read + any manifest apply); the recorder copy
+        is re-stamped with the complete breakdown at step end."""
+        return GateRecord(
+            ts=self.clock.now(),
+            wall=time.time(),
+            new_version=state.current_version,
+            old_version=state.previous_version,
+            traffic_before=state.traffic_current,
+            traffic_after=traffic_after,
+            attempt=attempt,
+            promote=bool(decision.promote),
+            reasons=tuple(decision.reasons),
+            missing_on=tuple(sorted(decision.missing_on)),
+            margins=dict(decision.margins),
+            new_metrics=new_m.as_dict(),
+            old_metrics=old_m.as_dict(),
+            thresholds=dataclasses.asdict(config.thresholds),
+            timings=dict(self._timings),
+            suppressed_events=self._hold_suppressed,
+        )
+
+    def _transition(
+        self,
+        from_phase: Phase,
+        to_phase: Phase,
+        reason: str,
+        new_version: str | None,
+        old_version: str | None,
+        traffic: int,
+    ) -> TransitionRecord:
+        return TransitionRecord(
+            ts=self.clock.now(),
+            wall=time.time(),
+            from_phase=from_phase.value,
+            to_phase=to_phase.value,
+            reason=reason,
+            new_version=new_version,
+            old_version=old_version,
+            traffic=traffic,
+        )
+
+    def _log_decision(self, config: OperatorConfig, rec: GateRecord) -> None:
+        payload = {
+            "event": "gate_decision",
+            "namespace": self.namespace,
+            "name": self.name,
+            "model": config.model_name,
+            "newVersion": rec.new_version,
+            "oldVersion": rec.old_version,
+            "result": rec.result,
+            "refusal": rec.refusal,
+            "attempt": rec.attempt,
+            "trafficBefore": rec.traffic_before,
+            "trafficAfter": rec.traffic_after,
+            "margins": dict(rec.margins),
+            "reasons": list(rec.reasons),
+            "suppressedEvents": rec.suppressed_events,
+        }
+        _gate_log.info(
+            "%s",
+            json.dumps(payload, default=str),
+            extra={"cr_namespace": self.namespace, "cr_name": self.name},
+        )
+
+    def _reset_hold_dedupe(self) -> None:
+        self._last_hold = None
+        self._hold_suppressed = 0
+
+    # -- handlers (continued) ------------------------------------------------
 
     def _on_new_version(
         self,
@@ -259,10 +434,23 @@ class Reconciler:
         events: list[Event],
     ) -> ReconcileOutcome:
         new_state = state.new_version(mv.version, config.canary.initial_traffic)
+        self._reset_hold_dedupe()
         # Apply + persist BEFORE emitting: if the apply fails persistently,
         # status is unchanged and the next reconcile retries this branch —
         # emitting first would duplicate the event on every retry.
         applied = self._apply_for_state(obj, config, new_state, source_of_current=mv)
+        new_state = self._journal(
+            config,
+            new_state,
+            self._transition(
+                state.phase,
+                new_state.phase,
+                "NewModelVersionDetected",
+                mv.version,
+                new_state.previous_version,
+                new_state.traffic_current,
+            ),
+        )
         self._patch_status(new_state)
         ev = Event(
             "Normal",
@@ -308,10 +496,26 @@ class Reconciler:
         )
 
         decision = should_promote(new_m, old_m, config.thresholds, self.log)
+        attempt_no = state.attempt + 1  # 1-based: this evaluation's number
         if decision:
+            self._reset_hold_dedupe()
             new_state = state.promoted_step(canary.step)
+            rec = self._gate_record(
+                config, state, decision, new_m, old_m,
+                new_state.traffic_current, attempt_no,
+            )
             applied = self._apply_for_state(obj, config, new_state)
+            records = [rec]
+            if new_state.phase == Phase.STABLE:
+                records.append(
+                    self._transition(
+                        Phase.CANARY, Phase.STABLE, "PromotionComplete",
+                        new_state.current_version, state.previous_version, 100,
+                    )
+                )
+            new_state = self._journal(config, new_state, *records)
             self._patch_status(new_state)
+            self._log_decision(config, rec)
             if new_state.phase == Phase.STABLE:
                 ev = Event(
                     "Normal",
@@ -365,7 +569,44 @@ class Reconciler:
 
         new_state = state.gate_failed()
         if new_state.attempt < canary.max_attempts:
+            # Stuck-canary event rate limiting: an unchanged refusal at
+            # the same traffic level emits ONE Warning event, not one
+            # per poll — the suppressed count rides the journal.  The
+            # key is the refusal SHAPE (which checks fail / which model
+            # is traffic-less), never the reason strings: those embed
+            # live metric readings that jitter every poll, which would
+            # defeat the dedupe exactly when it matters.
+            hold_key = (
+                state.traffic_current,
+                tuple(sorted(decision.missing_on)),
+                bool(decision.margins),  # min_sample vs threshold class
+                tuple(
+                    sorted(
+                        k for k, v in decision.margins.items() if v < 0
+                    )
+                ),
+            )
+            if hold_key != self._last_hold:
+                self._last_hold = hold_key
+                self._hold_suppressed = 0
+                hold_ev = Event(
+                    "Warning",
+                    "PromotionHold",
+                    f"Gate refused promotion at {state.traffic_current}% "
+                    f"(attempt {new_state.attempt}/{canary.max_attempts}): "
+                    + "; ".join(decision.reasons),
+                )
+                events.append(hold_ev)
+                self.kube.emit_event(self.cr_ref, hold_ev)
+            else:
+                self._hold_suppressed += 1
+            rec = self._gate_record(
+                config, state, decision, new_m, old_m,
+                state.traffic_current, attempt_no,
+            )
+            new_state = self._journal(config, new_state, rec)
             self._patch_status(new_state)
+            self._log_decision(config, rec)
             self.log.info(
                 f"Attempt {new_state.attempt}/{canary.max_attempts}: metrics do not "
                 f"meet conditions, retrying after {canary.attempt_delay_s} seconds."
@@ -373,6 +614,11 @@ class Reconciler:
             return ReconcileOutcome(new_state, canary.attempt_delay_s, events)
 
         # Max attempts exhausted (reference :341-349).
+        rec = self._gate_record(
+            config, state, decision, new_m, old_m,
+            state.traffic_current, attempt_no,
+        )
+        self._reset_hold_dedupe()
         fail_ev = Event(
             "Warning",
             "PromotionFailed",
@@ -387,7 +633,17 @@ class Reconciler:
             # The rollback the reference left as a TODO (:345).
             new_state = new_state.rolled_back()
             applied = self._apply_for_state(obj, config, new_state)
+            new_state = self._journal(
+                config,
+                new_state,
+                rec,
+                self._transition(
+                    Phase.CANARY, Phase.ROLLED_BACK, "RollbackComplete",
+                    new_state.held_version, new_state.current_version, 100,
+                ),
+            )
             self._patch_status(new_state)
+            self._log_decision(config, rec)
             rb_ev = Event(
                 "Normal",
                 "RollbackComplete",
@@ -402,7 +658,18 @@ class Reconciler:
             )
 
         new_state = new_state.halt_failed()
+        new_state = self._journal(
+            config,
+            new_state,
+            rec,
+            self._transition(
+                Phase.CANARY, Phase.FAILED, "PromotionFailed",
+                new_state.current_version, new_state.previous_version,
+                new_state.traffic_current,
+            ),
+        )
         self._patch_status(new_state)
+        self._log_decision(config, rec)
         return ReconcileOutcome(new_state, config.monitoring_interval_s, events)
 
     # -- deployment application ---------------------------------------------
@@ -639,10 +906,22 @@ class Reconciler:
     def _patch_status(self, state: PromotionState) -> None:
         import datetime
 
+        # Wall clock, NOT self.clock: the injected Clock is monotonic in
+        # production (SystemClock = time.monotonic), and a
+        # lastTransitionTime of "1970-01-03T…" is garbage to kubectl and
+        # anything sorting conditions.  Transition stability still comes
+        # from the prior-conditions comparison, so FakeClock tests are
+        # unaffected.
         now_iso = datetime.datetime.fromtimestamp(
-            self.clock.now(), datetime.timezone.utc
+            time.time(), datetime.timezone.utc
         ).strftime("%Y-%m-%dT%H:%M:%SZ")
         status = state.to_status()
+        # Journal keys are omitted when empty (byte-for-byte default), so
+        # a CR whose historyLimit went back to 0 needs explicit nulls once
+        # to clear what the merge-patch would otherwise leave behind.
+        if getattr(self, "_had_journal_keys", False):
+            status.setdefault("lastGate", None)
+            status.setdefault("history", None)
         status["conditions"] = state.conditions(
             getattr(self, "_prior_conditions", None), now_iso
         )

@@ -77,6 +77,7 @@ class OperatorRuntime:
         metrics_factory=None,
         warmup=None,
         telemetry=None,
+        recorder=None,
         max_concurrent_reconciles: int = 1,
     ):
         if metrics is None and metrics_factory is None:
@@ -90,6 +91,7 @@ class OperatorRuntime:
         self.metrics_factory = metrics_factory
         self.warmup = warmup
         self.telemetry = telemetry  # OperatorTelemetry | None (SURVEY §5)
+        self.recorder = recorder  # RolloutRecorder | None (gate journal)
         self.clock = clock or SystemClock()
         self.namespace = namespace
         self.sync_interval_s = sync_interval_s
@@ -147,6 +149,7 @@ class OperatorRuntime:
                             clock=self.clock,
                             metrics_factory=self.metrics_factory,
                             warmup=self.warmup,
+                            recorder=self.recorder,
                         ),
                         due_at=self.clock.now(),  # reconcile promptly
                     )
@@ -161,6 +164,8 @@ class OperatorRuntime:
                         _log.exception("teardown of %s/%s failed", ns, name)
                     if self.telemetry is not None:
                         self.telemetry.forget(ns, name)
+                    if self.recorder is not None:
+                        self.recorder.forget(ns, name)
 
     def notify(
         self,

@@ -55,6 +55,14 @@ class PromotionState:
     attempt: int = 0  # consecutive gate failures at this traffic level
     held_version: str | None = None  # version blocked after FAILED/ROLLED_BACK
     error: str | None = None
+    # Rollout journal surfaced on status when spec.observability.historyLimit
+    # > 0 (see operator/rollout_recorder.py for the record shapes).  Both
+    # default empty AND are omitted from to_status() when empty, so an
+    # unannotated CR's status stays byte-for-byte what it always was.
+    # ``last_gate`` is the compact block of the most recent gate
+    # evaluation; ``history`` a bounded tuple of full gate/phase records.
+    last_gate: Any = None
+    history: tuple = ()
 
     # -- transitions (pure; each returns a new state) -----------------------
 
@@ -62,10 +70,16 @@ class PromotionState:
         return dataclasses.replace(self, **kw)
 
     def alias_missing(self, alias: str) -> "PromotionState":
-        """Reference ``:64-93``: error status, versions cleared."""
+        """Reference ``:64-93``: error status, versions cleared.
+
+        The rollout journal survives every fresh-state transition (here,
+        ``new_version``, ``rolled_back``): it is this CR's audit trail,
+        not a property of one rollout."""
         return PromotionState(
             phase=Phase.ERROR,
             error=f"Alias '{alias}' does not exist",
+            last_gate=self.last_gate,
+            history=self.history,
         )
 
     def new_version(self, version: str, initial_traffic: int) -> "PromotionState":
@@ -89,6 +103,8 @@ class PromotionState:
                 previous_version=None,
                 traffic_current=100,
                 traffic_prev=0,
+                last_gate=self.last_gate,
+                history=self.history,
             )
         if (
             self.previous_version is not None
@@ -106,6 +122,8 @@ class PromotionState:
                 previous_version=None,
                 traffic_current=100,
                 traffic_prev=0,
+                last_gate=self.last_gate,
+                history=self.history,
             )
         return PromotionState(
             phase=Phase.CANARY,
@@ -114,6 +132,8 @@ class PromotionState:
             traffic_current=initial_traffic,
             traffic_prev=100 - initial_traffic,
             attempt=0,
+            last_gate=self.last_gate,
+            history=self.history,
         )
 
     def promoted_step(self, step: int) -> "PromotionState":
@@ -146,6 +166,8 @@ class PromotionState:
             traffic_current=100,
             traffic_prev=0,
             held_version=self.current_version,
+            last_gate=self.last_gate,
+            history=self.history,
         )
 
     # -- serialization ------------------------------------------------------
@@ -233,7 +255,7 @@ class PromotionState:
         return out
 
     def to_status(self) -> dict[str, Any]:
-        return {
+        status = {
             "phase": self.phase.value,
             "currentModelVersion": self.current_version,
             "previousModelVersion": self.previous_version,
@@ -243,6 +265,13 @@ class PromotionState:
             "heldVersion": self.held_version,
             "error": self.error,
         }
+        # Omitted — not null — when empty: historyLimit 0 (the default)
+        # must keep status patches byte-identical to pre-journal behavior.
+        if self.last_gate is not None:
+            status["lastGate"] = self.last_gate
+        if self.history:
+            status["history"] = list(self.history)
+        return status
 
     @classmethod
     def from_status(cls, status: Mapping[str, Any] | None) -> "PromotionState":
@@ -278,4 +307,6 @@ class PromotionState:
             attempt=int(status.get("attempt") or 0),
             held_version=status.get("heldVersion"),
             error=status.get("error"),
+            last_gate=status.get("lastGate"),
+            history=tuple(status.get("history") or ()),
         )

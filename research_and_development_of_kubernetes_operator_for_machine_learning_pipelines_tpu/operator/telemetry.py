@@ -17,13 +17,25 @@ the control plane the same observability its data plane already has:
 - ``tpumlops_operator_promotions_total{...,outcome}`` — completed /
   failed / rolled-back rollouts (from the same events the reference posts
   to Kubernetes, ``mlflow_operator.py:344,:361``);
-- ``tpumlops_operator_resources`` — CRs currently managed.
+- ``tpumlops_operator_resources`` — CRs currently managed;
+- ``tpumlops_operator_gate_margin{check}`` — signed headroom (budget −
+  observed) of the last gate evaluation per check: how far the canary
+  is from promoting, not just that it isn't;
+- ``tpumlops_operator_gate_evaluations_total{result}`` — gate decisions
+  by class (``promote`` / ``threshold`` / ``missing_metrics`` /
+  ``min_sample``);
+- ``tpumlops_operator_gate_attempt`` — this evaluation's attempt number
+  at the current traffic level (resets on each promote step);
+- ``tpumlops_operator_rollout_duration_seconds`` — NEW_VERSION→terminal
+  wall time per rollout (the north-star time-to-100% as a histogram).
 
 Wired into ``OperatorRuntime`` (zero-cost when not configured) and served
 by ``python -m <package>.operator --metrics-port``.
 """
 
 from __future__ import annotations
+
+import time
 
 from prometheus_client import (
     CollectorRegistry,
@@ -33,16 +45,26 @@ from prometheus_client import (
     generate_latest,
 )
 
+from .rollout_recorder import GATE_CHECKS
 from .state import Phase
 
 _STEP_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0)
+# A rollout spans canary step intervals, not reconcile steps: seconds to
+# hours.
+_ROLLOUT_BUCKETS = (1.0, 5.0, 15.0, 60.0, 120.0, 300.0, 600.0, 1800.0,
+                    3600.0, 7200.0)
 
 # Event reasons that terminate a rollout, mapped to a promotion outcome.
-_TERMINAL_REASONS = {
-    "PromotionComplete": "completed",
-    "PromotionFailed": "failed",
-    "RolledBack": "rolled_back",
-}
+# Ordered by precedence: a rolled-back step emits PromotionFailed AND
+# RollbackComplete in the same outcome and must count once, as
+# rolled_back — not once per reason (pre-journal versions keyed this on
+# a "RolledBack" reason nothing ever emitted, so rolled_back rollouts
+# were miscounted as failed).
+_TERMINAL_REASONS = (
+    ("RollbackComplete", "rolled_back"),
+    ("PromotionComplete", "completed"),
+    ("PromotionFailed", "failed"),
+)
 
 
 class OperatorTelemetry:
@@ -102,6 +124,38 @@ class OperatorTelemetry:
             "MlflowModel resources currently managed",
             registry=self.registry,
         )
+        # Promotion-gate decision series (fed from ReconcileOutcome.gate;
+        # no samples appear until a CR actually runs a canary gate).
+        self.gate_margin = Gauge(
+            "tpumlops_operator_gate_margin",
+            "Signed headroom (budget - observed) of the last gate "
+            "evaluation, per check; >= 0 promotes",
+            ident + ["check"],
+            registry=self.registry,
+        )
+        self.gate_evaluations = Counter(
+            "tpumlops_operator_gate_evaluations_total",
+            "Gate evaluations by decision class",
+            ident + ["result"],
+            registry=self.registry,
+        )
+        self.gate_attempt = Gauge(
+            "tpumlops_operator_gate_attempt",
+            "Attempt number of the last gate evaluation at the current "
+            "traffic level (1-based; resets each promote step)",
+            ident,
+            registry=self.registry,
+        )
+        self.rollout_seconds = Histogram(
+            "tpumlops_operator_rollout_duration_seconds",
+            "Wall time from NEW_VERSION detection to a terminal phase "
+            "(promoted / failed / rolled back)",
+            ident,
+            buckets=_ROLLOUT_BUCKETS,
+            registry=self.registry,
+        )
+        # Canary start times for rollout_duration (keyed per CR).
+        self._rollout_t0: dict[tuple[str, str], float] = {}
         # Every labeled series this object has minted, keyed by CR, so
         # forget() can prune with the public remove() API only (no reaching
         # into prometheus_client internals).
@@ -128,13 +182,49 @@ class OperatorTelemetry:
                 1.0 if state.phase == phase else 0.0
             )
         self._child(self.traffic, namespace, name).set(state.traffic_current)
+        reasons = {event.reason for event in outcome.events}
         for event in outcome.events:
             self._child(self.events, namespace, name, event.reason).inc()
-            outcome_label = _TERMINAL_REASONS.get(event.reason)
-            if outcome_label:
+        for reason, outcome_label in _TERMINAL_REASONS:
+            if reason in reasons:
                 self._child(
                     self.promotions, namespace, name, outcome_label
                 ).inc()
+                break
+        gate = getattr(outcome, "gate", None)
+        if gate is not None:
+            self._child(
+                self.gate_evaluations, namespace, name,
+                gate.refusal or "promote",
+            ).inc()
+            self._child(self.gate_attempt, namespace, name).set(gate.attempt)
+            if gate.margins:
+                for check, margin in gate.margins.items():
+                    self._child(
+                        self.gate_margin, namespace, name, check
+                    ).set(margin)
+            else:
+                # The latest evaluation ran NO budget comparisons
+                # (metrics missing / below min samples): drop the
+                # per-check children rather than keep exporting the
+                # previous evaluation's headroom as if it were current.
+                for check in GATE_CHECKS:
+                    try:
+                        self.gate_margin.remove(namespace, name, check)
+                    except KeyError:
+                        pass
+        # Rollout duration: arm on canary start, observe on terminal.
+        key = (namespace, name)
+        if "NewModelVersionDetected" in reasons and state.phase == Phase.CANARY:
+            self._rollout_t0[key] = time.monotonic()
+        if reasons & {"PromotionComplete", "RollbackComplete"} or (
+            "PromotionFailed" in reasons and state.phase == Phase.FAILED
+        ):
+            t0 = self._rollout_t0.pop(key, None)
+            if t0 is not None:
+                self._child(self.rollout_seconds, namespace, name).observe(
+                    time.monotonic() - t0
+                )
 
     def record_failure(self, namespace: str, name: str, seconds: float):
         self._child(self.reconciles, namespace, name, "error").inc()
@@ -152,19 +242,27 @@ class OperatorTelemetry:
                 metric.remove(*values)
             except KeyError:
                 pass
+        self._rollout_t0.pop((namespace, name), None)
 
     def exposition(self) -> bytes:
         return generate_latest(self.registry)
 
-    def serve(self, port: int, addr: str = "0.0.0.0"):
-        """Expose /metrics AND /debug/spans on a daemon-thread listener.
+    def serve(self, port: int, addr: str = "0.0.0.0", recorder=None):
+        """Expose /metrics, /debug/spans, and (with a RolloutRecorder
+        attached) /debug/rollouts + /debug/rollouts/trace on a
+        daemon-thread listener.
 
         /debug/spans serves the ``utils/tracing.py`` GLOBAL_TRACER stats
         (reconcile-step span timings) as JSON — the same payload shape
-        the data-plane server exposes, so one tool reads both planes."""
+        the data-plane server exposes, so one tool reads both planes.
+        /debug/rollouts is the live per-CR gate/phase journal;
+        /debug/rollouts/trace?format=chrome renders it as Chrome
+        trace-event JSON (Perfetto), mirroring the server's
+        /debug/engine + /debug/trace pair."""
         import json
         import threading
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlparse
 
         from ..utils.tracing import GLOBAL_TRACER
 
@@ -172,7 +270,8 @@ class OperatorTelemetry:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib handler contract)
-                path = self.path.split("?", 1)[0]
+                parsed = urlparse(self.path)
+                path = parsed.path
                 if path == "/metrics":
                     body = telemetry.exposition()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -180,6 +279,25 @@ class OperatorTelemetry:
                     body = json.dumps(
                         {"spans": GLOBAL_TRACER.as_dict()}
                     ).encode()
+                    ctype = "application/json"
+                elif path == "/debug/rollouts":
+                    if recorder is None:
+                        self.send_error(404, "rollout recorder disabled")
+                        return
+                    body = json.dumps(recorder.snapshot()).encode()
+                    ctype = "application/json"
+                elif path == "/debug/rollouts/trace":
+                    if recorder is None:
+                        self.send_error(404, "rollout recorder disabled")
+                        return
+                    fmt = parse_qs(parsed.query).get("format", ["chrome"])[0]
+                    if fmt == "chrome":
+                        body = json.dumps(recorder.chrome_trace()).encode()
+                    elif fmt == "json":
+                        body = json.dumps(recorder.snapshot()).encode()
+                    else:
+                        self.send_error(400, f"unknown format {fmt!r}")
+                        return
                     ctype = "application/json"
                 else:
                     self.send_error(404)

@@ -354,6 +354,38 @@ class ObservabilitySpec:
             )
 
 
+@dataclass(frozen=True)
+class RolloutObservability:
+    """``spec.observability``: rollout decision-journal surfacing on the CR.
+
+    ``history_limit`` bounds ``status.history`` — the per-CR journal of
+    gate evaluations and phase transitions the reconciler appends so
+    ``kubectl get -o yaml`` alone explains a stalled canary.  0 — the
+    default — writes neither ``status.history`` nor ``status.lastGate``,
+    keeping status patches byte-for-byte what they were.  The cap of 64
+    exists because status lives in etcd (~1.5 MB object limit): a full
+    gate record with two raw metric readings is ~1 KB.
+    """
+
+    history_limit: int = 0
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "RolloutObservability":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec, frozenset({"historyLimit"}), "spec.observability"
+        )
+        return cls(history_limit=int(spec.get("historyLimit", 0)))
+
+    def __post_init__(self):
+        if not (0 <= self.history_limit <= 64):
+            # Reject at reconcile time so it lands in CR status.
+            raise ValueError(
+                "observability.historyLimit must be in [0, 64], got "
+                f"{self.history_limit}"
+            )
+
+
 def _parse_quantize(value) -> str:
     """Reject bad quantize values at reconcile time — a typo'd CR field must
     surface in status, not as a pod CrashLoopBackOff at argparse."""
@@ -524,6 +556,12 @@ class OperatorConfig:
     canary: CanaryPolicy = field(default_factory=CanaryPolicy)
     tpu: TpuSpec = field(default_factory=TpuSpec)
     server_image: str = "tpumlops/jax-server:latest"
+    # Rollout journal surfacing on CR status (status.lastGate/history);
+    # distinct from spec.tpu.observability, which sizes the data plane's
+    # engine flight recorder.
+    observability: RolloutObservability = field(
+        default_factory=RolloutObservability
+    )
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
@@ -570,4 +608,7 @@ class OperatorConfig:
             canary=CanaryPolicy.from_spec(spec.get("canary")),
             tpu=tpu,
             server_image=str(spec.get("serverImage", "tpumlops/jax-server:latest")),
+            observability=RolloutObservability.from_spec(
+                spec.get("observability")
+            ),
         )

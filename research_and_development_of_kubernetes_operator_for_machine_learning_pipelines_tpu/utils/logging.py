@@ -20,14 +20,47 @@ import logging
 
 
 class _PrefixAdapter(logging.LoggerAdapter):
+    """Per-CR adapter: the ``[ns/name]`` text prefix (with the observed
+    ``metadata.generation`` when the reconciler has one), and namespace /
+    name / generation as record attributes so ``--log-format json``
+    carries them as structured fields — the operator's counterpart of
+    the server's per-request ``request_id`` convention."""
+
     def process(self, msg, kwargs):
-        return f"{self.extra['resource']} {msg}", kwargs
+        # Record attributes are cr_-prefixed because bare "name" is a
+        # reserved LogRecord attribute (logging rejects it in extra);
+        # JsonFormatter renders them back as namespace/name/generation.
+        extra = dict(kwargs.get("extra") or {})
+        extra.setdefault("cr_namespace", self.extra["namespace"])
+        extra.setdefault("cr_name", self.extra["name"])
+        generation = self.extra.get("generation")
+        prefix = self.extra["resource"]
+        if generation is not None:
+            extra.setdefault("cr_generation", generation)
+            prefix = (
+                f"[{self.extra['namespace']}/{self.extra['name']}"
+                f" gen={generation}]"
+            )
+        kwargs["extra"] = extra
+        return f"{prefix} {msg}", kwargs
+
+    def set_generation(self, generation) -> None:
+        """Stamp the CR generation the current reconcile step observed."""
+        self.extra["generation"] = generation
 
 
 def model_logger(name: str, namespace: str) -> logging.LoggerAdapter:
     """Per-resource logger with the reference's ``[ns/name]`` message prefix."""
     base = logging.getLogger(f"tpumlops.{namespace}.{name}")
-    return _PrefixAdapter(base, {"resource": f"[{namespace}/{name}]"})
+    return _PrefixAdapter(
+        base,
+        {
+            "resource": f"[{namespace}/{name}]",
+            "namespace": namespace,
+            "name": name,
+            "generation": None,
+        },
+    )
 
 
 class JsonFormatter(logging.Formatter):
@@ -53,6 +86,18 @@ class JsonFormatter(logging.Formatter):
         request_id = getattr(record, "request_id", None)
         if request_id:
             out["request_id"] = str(request_id)
+        # CR identity (the operator's analogue of request_id): attached
+        # by the per-CR _PrefixAdapter, one set per reconcile log line.
+        # cr_-prefixed on the record (bare "name" is reserved there),
+        # clean names in the JSON output.
+        for attr, key in (
+            ("cr_namespace", "namespace"),
+            ("cr_name", "name"),
+            ("cr_generation", "generation"),
+        ):
+            value = getattr(record, attr, None)
+            if value is not None:
+                out[key] = value
         if record.exc_info:
             out["exc_info"] = self.formatException(record.exc_info)
         # default=str: a log call with a non-serializable extra must

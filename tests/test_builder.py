@@ -142,3 +142,22 @@ def test_set_traffic_rewrites_weights():
     assert [p["traffic"] for p in sd2["spec"]["predictors"]] == [80, 20]
     # original untouched
     assert [p["traffic"] for p in sd["spec"]["predictors"]] == [90, 10]
+
+
+def test_manifest_annotations_carry_rollout_context():
+    """`kubectl get sdep -o yaml` explains the split without chasing the
+    owning CR: version(s) and traffic ride as annotations."""
+    sd = two_version_manifest()
+    ann = sd["metadata"]["annotations"]
+    assert ann["tpumlops.dev/current-version"] == "2"
+    assert ann["tpumlops.dev/traffic-current"] == "10"
+    assert ann["tpumlops.dev/previous-version"] == "1"
+    assert ann["tpumlops.dev/traffic-prev"] == "90"
+    # Single-predictor manifests carry no previous-* keys.
+    solo = build_deployment(
+        name="iris", namespace="models", owner_uid="u", config=cfg(),
+        current_version="1", new_model_uri="s3://x", traffic_current=100,
+    )
+    ann = solo["metadata"]["annotations"]
+    assert "tpumlops.dev/previous-version" not in ann
+    assert ann["tpumlops.dev/traffic-current"] == "100"

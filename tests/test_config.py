@@ -181,3 +181,22 @@ def test_operator_config_speculative_round_trip():
     # Defaults: disabled, inert.
     assert OperatorConfig.from_spec(minimal_spec()).tpu.speculative.enabled \
         is False
+
+
+def test_rollout_observability_history_limit():
+    # Default: journal disabled -> status stays byte-for-byte.
+    assert OperatorConfig.from_spec(minimal_spec()).observability \
+        .history_limit == 0
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(observability={"historyLimit": 16})
+    )
+    assert cfg.observability.history_limit == 16
+    # Bounded: status lives in etcd (~1.5 MB/object), records carry two
+    # raw metric readings each.
+    with pytest.raises(ValueError, match="historyLimit"):
+        OperatorConfig.from_spec(minimal_spec(observability={"historyLimit": 65}))
+    with pytest.raises(ValueError, match="historyLimit"):
+        OperatorConfig.from_spec(minimal_spec(observability={"historyLimit": -1}))
+    # Typo'd knobs are named back, not silently defaulted.
+    with pytest.raises(ValueError, match="historyLimi"):
+        OperatorConfig.from_spec(minimal_spec(observability={"historyLimi": 8}))

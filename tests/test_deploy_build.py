@@ -154,6 +154,35 @@ def test_image_names_line_up_with_manifests_and_builder():
     )
 
 
+def test_crd_printer_columns_surface_rollout_state():
+    """`kubectl get mlflowm` must answer "where is my rollout" without
+    -o yaml: phase, live split, canary version, and the newest gate
+    decision (populated when spec.observability.historyLimit > 0)."""
+    import yaml
+
+    crd = yaml.safe_load((PKG / "deploy" / "crd.yaml").read_text())
+    version = crd["spec"]["versions"][0]
+    columns = {
+        c["name"]: c["jsonPath"] for c in version["additionalPrinterColumns"]
+    }
+    assert columns["Phase"] == ".status.phase"
+    assert columns["Traffic"] == ".status.trafficCurrent"
+    assert columns["New-Version"] == ".status.currentModelVersion"
+    assert columns["Last-Gate"] == ".status.lastGate.result"
+    # The journal knob and the status fields the columns read must exist
+    # in the schema.
+    schema = version["schema"]["openAPIV3Schema"]["properties"]
+    assert (
+        schema["spec"]["properties"]["observability"]["properties"][
+            "historyLimit"
+        ]["default"]
+        == 0
+    )
+    status = schema["status"]["properties"]
+    assert status["lastGate"]["x-kubernetes-preserve-unknown-fields"] is True
+    assert status["history"]["items"]["x-kubernetes-preserve-unknown-fields"] is True
+
+
 def test_makefile_targets_present():
     mk = (REPO / "Makefile").read_text()
     for target in ("images:", "operator-image:", "server-image:",

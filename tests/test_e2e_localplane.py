@@ -249,6 +249,174 @@ def test_rollback_on_slo_breach_with_live_metrics(servers):
         router.stop()
 
 
+def test_rollout_journal_reconstructs_promote_and_rollback(servers):
+    """Acceptance drive for the rollout flight recorder: one CR goes
+    refuse→promote (v2 on live metrics) and then through a rollback (v3
+    on a dead port), and the FULL decision sequence — raw metrics,
+    thresholds, margins, reasons, traffic levels — is reconstructed from
+    ``status.history`` and ``GET /debug/rollouts`` alone, with
+    ``/debug/rollouts/trace?format=chrome`` validating as Chrome
+    trace-event JSON."""
+    import json as _json
+    import urllib.request
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.rollout_recorder import (
+        RolloutRecorder,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.telemetry import (
+        OperatorTelemetry,
+    )
+
+    dead = free_port()
+    ports = dict(servers)
+    ports["v3"] = dead
+    router = RouterProcess(port=free_port(), backends={}, namespace="models").start()
+    sync = RouterSync(router.admin, lambda pred: ("127.0.0.1", ports[pred]))
+    kube = SyncingKube(sync)
+    registry = FakeRegistry()
+    registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias("iris", "prod", "1")
+    recorder = RolloutRecorder(capacity=256)
+    telemetry = OperatorTelemetry()
+    rt = OperatorRuntime(
+        kube,
+        registry,
+        metrics=RouterMetricsSource(router.admin),
+        clock=SystemClock(),
+        sync_interval_s=0.05,
+        telemetry=telemetry,
+        recorder=recorder,
+    )
+    metrics_port = free_port()
+    httpd = telemetry.serve(metrics_port, addr="127.0.0.1", recorder=recorder)
+    spec = base_spec(
+        observability={"historyLimit": 64},
+        canary={
+            "step": 25,
+            "stepInterval": 0.2,
+            "attemptDelay": 0.1,
+            "maxAttempts": 4,
+            "initialTraffic": 25,
+            "metricsWindow": 2,
+            "rollbackOnFailure": True,
+        },
+    )
+    try:
+        kube.create(cr_ref(), {"spec": spec})
+        threading.Thread(target=rt.serve, daemon=True).start()
+        wait_for(
+            lambda: get_status(kube).get("phase") == "Stable",
+            what="initial Stable phase",
+        )
+        with TrafficGenerator(router.port) as gen:
+            wait_for(lambda: gen.sent > 50, what="baseline traffic")
+            registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+            registry.set_alias("iris", "prod", "2")
+            wait_for(
+                lambda: get_status(kube).get("phase") == "Stable"
+                and get_status(kube).get("currentModelVersion") == "2",
+                timeout=120.0,
+                what="promotion of v2",
+            )
+            registry.register("iris", "3", "mlflow-artifacts:/1/ccc/artifacts/model")
+            registry.set_alias("iris", "prod", "3")
+            wait_for(
+                lambda: get_status(kube).get("phase") == "RolledBack",
+                timeout=120.0,
+                what="rollback of v3",
+            )
+
+        # -- reconstruction from status.history alone -------------------
+        status = get_status(kube)
+        history = status["history"]
+        gates = [r for r in history if r["kind"] == "gate"]
+        v2 = [g for g in gates if g["newVersion"] == "2"]
+        # The fresh canary's first attempts refuse (no traffic in the
+        # metrics window yet / below minSampleCount), then the staircase
+        # promotes 25 -> 50 -> 75 -> 100 on live router histograms.
+        assert any(g["result"] == "refuse" for g in v2), [
+            g["result"] for g in v2
+        ]
+        promoted = [g for g in v2 if g["result"] == "promote"]
+        assert [g["trafficAfter"] for g in promoted] == [50, 75, 100]
+        done = [g for g in promoted if g["trafficAfter"] == 100][0]
+        # Full evidence on the record: the raw metrics the gate judged,
+        # the thresholds in force, and non-negative margins.
+        assert done["newMetrics"]["request_count"] > 0
+        assert done["oldMetrics"]["latency_95th"] is not None
+        assert done["thresholds"]["min_sample_count"] == 3
+        assert all(v >= 0 for v in done["margins"].values())
+        # v3's rollback journey: every evaluation refused, the terminal
+        # transition is the rollback, and lastGate shows the final refusal.
+        v3 = [g for g in gates if g["newVersion"] == "3"]
+        assert v3 and all(g["result"] == "refuse" for g in v3)
+        breaches = [g for g in v3 if g["refusal"] == "threshold"]
+        assert breaches, [g["refusal"] for g in v3]
+        # The dead backend 502s: the error-rate budget is blown and the
+        # margin says by how much.
+        assert any(g["margins"]["error_rate"] < 0 for g in breaches)
+        assert any(
+            "error rate" in r for g in breaches for r in g["reasons"]
+        )
+        assert history[-1]["kind"] == "phase"
+        assert history[-1]["reason"] == "RollbackComplete"
+        assert status["lastGate"]["result"] == "refuse"
+        # Repeated identical refusals were deduped into one PromotionHold
+        # Warning per (level, reason) with the rest counted in-journal.
+        reasons = kube.event_reasons()
+        assert reasons.count("PromotionHold") <= len(
+            {(g["trafficBefore"], tuple(g["reasons"])) for g in gates}
+        )
+
+        # -- reconstruction from /debug/rollouts alone ------------------
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}{path}", timeout=5
+            ).read()
+
+        live = _json.loads(get("/debug/rollouts"))
+        records = live["rollouts"]["models/iris"]["records"]
+        live_gates = [r for r in records if r["kind"] == "gate"]
+        assert [
+            g["trafficAfter"]
+            for g in live_gates
+            if g["newVersion"] == "2" and g["result"] == "promote"
+        ] == [50, 75, 100]
+        assert {r["reason"] for r in records if r["kind"] == "phase"} >= {
+            "NewModelVersionDetected",
+            "PromotionComplete",
+            "RollbackComplete",
+        }
+        # Recorder-side records also carry the step's op-timer breakdown.
+        assert "gate_read" in live_gates[-1]["timings"]
+
+        trace = _json.loads(get("/debug/rollouts/trace?format=chrome"))
+        assert set(trace) == {"traceEvents", "displayTimeUnit"}
+        assert trace["traceEvents"]
+        for ev in trace["traceEvents"]:
+            assert {"name", "ph", "pid", "tid"} <= set(ev)
+            if ev["ph"] != "M":
+                assert isinstance(ev["ts"], int) and ev["ts"] >= 0
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+        levels = {
+            e["args"]["level"]
+            for e in trace["traceEvents"]
+            if e.get("cat") == "traffic"
+        }
+        assert {25, 50, 75, 100} <= levels
+
+        # The gate metrics series materialized on the same listener.
+        expo = get("/metrics").decode()
+        assert 'tpumlops_operator_gate_margin{check="error_rate"' in expo
+        assert 'result="promote"' in expo
+        assert "tpumlops_operator_rollout_duration_seconds_count" in expo
+    finally:
+        httpd.shutdown()
+        rt.stop()
+        router.stop()
+
+
 def test_operator_restart_mid_rollout_resumes_from_status(servers):
     """Kill the operator halfway through a canary and start a FRESH
     runtime (new Reconciler objects, no in-memory state) over the same

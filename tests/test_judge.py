@@ -64,6 +64,79 @@ def test_min_sample_count_gating():
     assert should_promote(m(count=60), m(count=1000), t).promote
 
 
+# -- gate margins (signed headroom, budget - observed) ----------------------
+
+
+def test_boundary_equality_promotes_with_zero_margins():
+    """new == old * (1 + tol) on every check: promote, margin exactly 0."""
+    import pytest
+
+    old = m(p95=0.1, err=0.01, avg=0.05)
+    new = m(p95=0.1 * 1.05, err=0.01 * 1.02, avg=0.05 * 1.05)
+    d = should_promote(new, old)
+    assert d.promote
+    assert d.margins["latency_p95"] == pytest.approx(0.0, abs=1e-12)
+    assert d.margins["error_rate"] == pytest.approx(0.0, abs=1e-12)
+    assert d.margins["latency_avg"] == pytest.approx(0.0, abs=1e-12)
+
+
+def test_margin_values_pinned_on_promote():
+    import pytest
+
+    d = should_promote(m(), m())  # p95 0.1, err 0.01, avg 0.05, defaults
+    assert d.promote
+    assert d.margins["latency_p95"] == pytest.approx(0.1 * 1.05 - 0.1)
+    assert d.margins["error_rate"] == pytest.approx(0.01 * 1.02 - 0.01)
+    assert d.margins["latency_avg"] == pytest.approx(0.05 * 1.05 - 0.05)
+
+
+def test_margin_signs_pinned_per_refusal_class():
+    import pytest
+
+    # p95 regression only: that margin negative, the others positive.
+    d = should_promote(m(p95=0.2), m(p95=0.1))
+    assert not d.promote
+    assert d.margins["latency_p95"] == pytest.approx(0.105 - 0.2)
+    assert d.margins["error_rate"] > 0 and d.margins["latency_avg"] > 0
+
+    d = should_promote(m(err=0.05), m(err=0.01))
+    assert not d.promote
+    assert d.margins["error_rate"] == pytest.approx(0.0102 - 0.05)
+    assert d.margins["latency_p95"] > 0 and d.margins["latency_avg"] > 0
+
+    d = should_promote(m(avg=0.2), m(avg=0.05))
+    assert not d.promote
+    assert d.margins["latency_avg"] == pytest.approx(0.0525 - 0.2)
+    assert d.margins["latency_p95"] > 0 and d.margins["error_rate"] > 0
+
+
+def test_error_floor_raises_the_margin_budget():
+    import pytest
+
+    t = GateThresholds(error_rate_floor=0.01)
+    d = should_promote(m(err=0.005), m(err=0.0), t)
+    assert d.promote
+    # Budget is the floor (0.01), not old * 1.02 = 0.
+    assert d.margins["error_rate"] == pytest.approx(0.01 - 0.005)
+
+
+def test_margins_absent_not_zero_when_metrics_missing():
+    """A refusal that never reached the budget comparisons must report NO
+    margins — an absent margin is not "exactly at the boundary"."""
+    d = should_promote(ModelMetrics(), m())
+    assert not d.promote and d.missing_on == frozenset({"new"})
+    assert d.margins == {}
+    d = should_promote(m(), ModelMetrics())
+    assert d.margins == {}
+
+
+def test_margins_absent_not_zero_below_min_sample():
+    t = GateThresholds(min_sample_count=50)
+    d = should_promote(m(count=10), m(count=1000), t)
+    assert not d.promote and d.missing_on == frozenset()
+    assert d.margins == {}
+
+
 def test_missing_on_is_typed_not_string_matched():
     """Warm-up targeting reads GateDecision.missing_on, never the
     human-readable reasons (VERDICT round 1, weak #2)."""

@@ -62,11 +62,16 @@ _OP_IDENT = ("namespace", "name")
 
 EXPECTED_OPERATOR = {
     "tpumlops_operator_events": ("counter", _OP_IDENT + ("reason",)),
+    "tpumlops_operator_gate_attempt": ("gauge", _OP_IDENT),
+    "tpumlops_operator_gate_evaluations": (
+        "counter", _OP_IDENT + ("result",)),
+    "tpumlops_operator_gate_margin": ("gauge", _OP_IDENT + ("check",)),
     "tpumlops_operator_phase": ("gauge", _OP_IDENT + ("phase",)),
     "tpumlops_operator_promotions": ("counter", _OP_IDENT + ("outcome",)),
     "tpumlops_operator_reconcile": ("counter", _OP_IDENT + ("result",)),
     "tpumlops_operator_reconcile_seconds": ("histogram", _OP_IDENT),
     "tpumlops_operator_resources": ("gauge", ()),
+    "tpumlops_operator_rollout_duration_seconds": ("histogram", _OP_IDENT),
     "tpumlops_operator_step_component_seconds": (
         "histogram", _OP_IDENT + ("component",)),
     "tpumlops_operator_traffic_percent": ("gauge", _OP_IDENT),

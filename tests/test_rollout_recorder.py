@@ -1,0 +1,631 @@
+"""Rollout flight recorder: gate-decision audit trail from the judge's
+margins to CR status, /debug/rollouts, and the operator metrics.
+
+Covers the three surfacing paths (status.lastGate/history, the
+RolloutRecorder rings + HTTP endpoints, the tpumlops_operator_gate_*
+series), the stuck-canary Warning-event rate limiter, and the
+byte-identity guarantee: with spec.observability.historyLimit unset the
+status patches the reconciler writes are exactly the pre-journal shape.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpumlops.clients.base import MLFLOWMODEL, ModelMetrics, ObjectRef
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator.reconciler import Reconciler
+from tpumlops.operator.rollout_recorder import RolloutRecorder
+from tpumlops.operator.runtime import OperatorRuntime
+from tpumlops.operator.state import Phase
+from tpumlops.operator.telemetry import OperatorTelemetry
+from tpumlops.utils.clock import FakeClock
+
+NS = "models"
+NAME = "iris"
+
+GOOD = ModelMetrics(
+    latency_p95=0.1, error_rate=0.01, latency_avg=0.05, request_count=500
+)
+BAD = ModelMetrics(
+    latency_p95=0.5, error_rate=0.2, latency_avg=0.4, request_count=500
+)
+
+
+def cr_ref():
+    return ObjectRef(namespace=NS, name=NAME, **MLFLOWMODEL)
+
+
+def make_world(spec_extra=None, recorder=None):
+    kube, registry, metrics, clock = (
+        FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock(),
+    )
+    spec = {"modelName": "iris", "modelAlias": "champion"}
+    spec.update(spec_extra or {})
+    kube.create(
+        cr_ref(),
+        {
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": spec,
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec = Reconciler(
+        NAME, NS, kube, registry, metrics, clock, recorder=recorder
+    )
+    return kube, registry, metrics, clock, rec
+
+
+def reconcile(kube, rec):
+    return rec.reconcile(kube.get(cr_ref()))
+
+
+def start_canary(kube, registry, metrics, rec, new_metrics=GOOD):
+    """v1 stable, then alias moves to v2 and the canary deploys."""
+    reconcile(kube, rec)
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, new_metrics)
+    reconcile(kube, rec)  # canary deployed at 10%
+
+
+def assert_chrome_trace_valid(trace):
+    """Chrome trace-event JSON contract: serializable, every event has
+    the required keys, complete events carry non-negative durations."""
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    for ev in trace["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(ev), ev
+        if ev["ph"] == "M":
+            continue  # metadata events need no timestamp
+        assert isinstance(ev["ts"], int) and ev["ts"] >= 0, ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0, ev
+        if ev["ph"] == "i":
+            assert ev.get("s") in ("t", "p", "g"), ev
+    json.dumps(trace)  # must be valid JSON end to end
+
+
+# -- status.lastGate / status.history ---------------------------------------
+
+
+def test_history_reconstructs_refuse_then_promote_sequence():
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 32}}
+    )
+    start_canary(kube, registry, metrics, rec, new_metrics=BAD)
+    for _ in range(2):  # two identical refusals at 10%
+        out = reconcile(kube, rec)
+        clock.advance(out.requeue_after)
+    metrics.set_metrics(NAME, "v2", NS, GOOD)  # canary recovers
+    for _ in range(20):
+        out = reconcile(kube, rec)
+        if out.state.phase != Phase.CANARY:
+            break
+        clock.advance(out.requeue_after)
+
+    status = kube.get(cr_ref())["status"]
+    history = status["history"]
+    kinds = [r["kind"] for r in history]
+    # NEW_VERSION transitions (v1 initial deploy + v2 canary), the gate
+    # sequence, and the terminal promotion transition.
+    assert kinds[0] == "phase" and kinds[1] == "phase"
+    assert kinds[-1] == "phase" and history[-1]["reason"] == "PromotionComplete"
+    gates = [r for r in history if r["kind"] == "gate"]
+    assert [g["result"] for g in gates[:2]] == ["refuse", "refuse"]
+    assert all(g["result"] == "promote" for g in gates[2:])
+    # Refusals carry the full evidence: raw metrics, thresholds in
+    # force, signed margins, prose reasons — the "why is it stuck at
+    # 10%" answer, straight from kubectl.
+    refusal = gates[0]
+    assert refusal["refusal"] == "threshold"
+    assert refusal["newMetrics"]["latency_95th"] == 0.5
+    assert refusal["oldMetrics"]["latency_95th"] == 0.1
+    assert refusal["thresholds"]["latency_p95"] == 0.05
+    assert refusal["margins"]["latency_p95"] == pytest.approx(0.105 - 0.5)
+    assert any("p95" in r for r in refusal["reasons"])
+    assert (refusal["trafficBefore"], refusal["trafficAfter"]) == (10, 10)
+    assert [g["attempt"] for g in gates[:3]] == [1, 2, 3]
+    # Promotions walk the traffic staircase 10 -> 100.
+    assert [g["trafficAfter"] for g in gates[2:]] == [
+        20, 30, 40, 50, 60, 70, 80, 90, 100
+    ]
+    # lastGate is the compact block of the newest evaluation.
+    assert status["lastGate"]["result"] == "promote"
+    assert status["lastGate"]["trafficAfter"] == 100
+    assert status["lastGate"]["margins"]["latency_p95"] > 0
+
+
+def test_history_bounded_at_limit():
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 3}}
+    )
+    start_canary(kube, registry, metrics, rec)
+    for _ in range(9):
+        out = reconcile(kube, rec)
+        if out.state.phase != Phase.CANARY:
+            break
+        clock.advance(out.requeue_after)
+    history = kube.get(cr_ref())["status"]["history"]
+    assert len(history) == 3  # oldest dropped, newest kept
+    assert history[-1]["reason"] == "PromotionComplete"
+
+
+def test_history_survives_reconciler_restart():
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 32}}
+    )
+    start_canary(kube, registry, metrics, rec)
+    reconcile(kube, rec)  # one promote step -> 20%
+    before = kube.get(cr_ref())["status"]["history"]
+
+    rec2 = Reconciler(NAME, NS, kube, registry, metrics, clock)
+    reconcile(kube, rec2)  # fresh process continues the journal
+    after = kube.get(cr_ref())["status"]["history"]
+    assert after[: len(before)] == before
+    assert len(after) == len(before) + 1
+    assert after[-1]["trafficAfter"] == 30
+
+
+def test_default_status_patches_stay_byte_identical():
+    """historyLimit 0 (the default): no patch the reconciler writes may
+    carry a journal key — kubectl consumers see the pre-PR status shape
+    byte for byte."""
+    kube, registry, metrics, clock, rec = make_world()
+    patches = []
+    real_patch = kube.patch_status
+    kube.patch_status = lambda ref, status: (
+        patches.append(dict(status)), real_patch(ref, status),
+    )[1]
+    start_canary(kube, registry, metrics, rec)
+    for _ in range(12):
+        out = reconcile(kube, rec)
+        if out.state.phase != Phase.CANARY:
+            break
+        clock.advance(out.requeue_after)
+    assert patches
+    expected_keys = {
+        "phase", "currentModelVersion", "previousModelVersion",
+        "trafficCurrent", "trafficPrev", "attempt", "heldVersion",
+        "error", "conditions",
+    }
+    for patch in patches:
+        assert set(patch) == expected_keys, set(patch) ^ expected_keys
+
+
+def test_disabling_history_clears_stale_keys():
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    start_canary(kube, registry, metrics, rec, new_metrics=BAD)
+    reconcile(kube, rec)  # one refusal -> journal written
+    assert kube.get(cr_ref())["status"]["history"]
+
+    obj = kube.get(cr_ref())
+    obj["spec"]["observability"] = {"historyLimit": 0}
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(cr_ref(), obj)
+    reconcile(kube, rec)  # next gate step patches explicit nulls
+    status = kube.get(cr_ref())["status"]
+    assert status["history"] is None and status["lastGate"] is None
+
+
+# -- stuck-canary Warning-event rate limiting --------------------------------
+
+
+def test_unchanged_refusal_emits_one_hold_event():
+    kube, registry, metrics, clock, rec = make_world(
+        {
+            "observability": {"historyLimit": 32},
+            "canary": {"maxAttempts": 10},
+        }
+    )
+    start_canary(kube, registry, metrics, rec, new_metrics=BAD)
+    for _ in range(4):  # same refusal, same traffic level, four polls
+        out = reconcile(kube, rec)
+        clock.advance(out.requeue_after)
+    assert kube.event_reasons().count("PromotionHold") == 1
+    # ...and the journal records how many duplicates were suppressed.
+    gates = [
+        r for r in kube.get(cr_ref())["status"]["history"]
+        if r["kind"] == "gate"
+    ]
+    assert [g["suppressedEvents"] for g in gates] == [0, 1, 2, 3]
+
+    # A DIFFERENT refusal reason is news: it emits again.
+    metrics.set_metrics(
+        NAME, "v2", NS,
+        ModelMetrics(latency_p95=0.9, error_rate=0.01, latency_avg=0.05,
+                     request_count=500),
+    )
+    reconcile(kube, rec)
+    assert kube.event_reasons().count("PromotionHold") == 2
+
+
+def test_hold_dedupe_survives_jittering_metric_readings():
+    """Live metrics jitter every poll; the dedupe keys on the refusal
+    SHAPE (which checks fail at which level), not the reason strings
+    with their interpolated readings — otherwise a threshold-stuck
+    canary would still spam one Warning per poll."""
+    kube, registry, metrics, clock, rec = make_world(
+        {"canary": {"maxAttempts": 10}}
+    )
+    start_canary(kube, registry, metrics, rec, new_metrics=BAD)
+    for p95 in (0.51, 0.502, 0.497, 0.513):  # same breach, new numbers
+        metrics.set_metrics(
+            NAME, "v2", NS,
+            ModelMetrics(latency_p95=p95, error_rate=0.2, latency_avg=0.4,
+                         request_count=500),
+        )
+        out = reconcile(kube, rec)
+        clock.advance(out.requeue_after)
+    assert kube.event_reasons().count("PromotionHold") == 1
+
+
+def test_hold_dedupe_resets_on_promotion():
+    kube, registry, metrics, clock, rec = make_world(
+        {"canary": {"maxAttempts": 10}}
+    )
+    start_canary(kube, registry, metrics, rec, new_metrics=BAD)
+    reconcile(kube, rec)  # hold at 10%
+    metrics.set_metrics(NAME, "v2", NS, GOOD)
+    reconcile(kube, rec)  # promote to 20%
+    metrics.set_metrics(NAME, "v2", NS, BAD)
+    reconcile(kube, rec)  # hold at 20%: same reasons, NEW traffic level
+    assert kube.event_reasons().count("PromotionHold") == 2
+
+
+# -- recorder rings, /debug/rollouts, chrome trace ---------------------------
+
+
+def drive_promote_and_rollback(recorder):
+    """One CR through refuse->promote (v2), then rollback (v3)."""
+    kube, registry, metrics, clock, rec = make_world(
+        {
+            "observability": {"historyLimit": 64},
+            "canary": {"rollbackOnFailure": True, "maxAttempts": 2},
+        },
+        recorder=recorder,
+    )
+    telemetry = OperatorTelemetry()
+
+    def step():
+        out = reconcile(kube, rec)
+        telemetry.record_outcome(NS, NAME, out, 0.01)
+        return out
+
+    step()  # v1 stable
+    registry.register("iris", "2", "mlflow-artifacts:/1/bbb/artifacts/model")
+    registry.set_alias("iris", "champion", "2")
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    metrics.set_metrics(NAME, "v2", NS, BAD)
+    step()  # canary v2 deployed at 10%
+    out = step()  # refusal at 10%
+    clock.advance(out.requeue_after)
+    metrics.set_metrics(NAME, "v2", NS, GOOD)
+    for _ in range(20):
+        out = step()
+        if out.state.phase != Phase.CANARY:
+            break
+        clock.advance(out.requeue_after)
+    assert out.state.phase == Phase.STABLE
+
+    registry.register("iris", "3", "mlflow-artifacts:/1/ccc/artifacts/model")
+    registry.set_alias("iris", "champion", "3")
+    metrics.set_metrics(NAME, "v3", NS, BAD)
+    metrics.set_metrics(NAME, "v2", NS, GOOD)
+    step()  # canary v3 deployed
+    for _ in range(4):
+        out = step()
+        if out.state.phase != Phase.CANARY:
+            break
+        clock.advance(out.requeue_after)
+    assert out.state.phase == Phase.ROLLED_BACK
+    return kube, telemetry
+
+
+def test_recorder_journal_reconstructs_both_rollouts():
+    recorder = RolloutRecorder(capacity=128)
+    drive_promote_and_rollback(recorder)
+
+    snap = recorder.snapshot()
+    records = snap["rollouts"][f"{NS}/{NAME}"]["records"]
+    assert snap["rollouts"][f"{NS}/{NAME}"]["recorded"] == len(records)
+    reasons = [r["reason"] for r in records if r["kind"] == "phase"]
+    assert reasons.count("NewModelVersionDetected") == 3  # v1, v2, v3
+    assert "PromotionComplete" in reasons
+    assert "RollbackComplete" in reasons
+    gates = [r for r in records if r["kind"] == "gate"]
+    # v2's journey: one threshold refusal then the staircase to 100.
+    v2 = [g for g in gates if g["newVersion"] == "2"]
+    assert v2[0]["result"] == "refuse" and v2[0]["refusal"] == "threshold"
+    assert [g["trafficAfter"] for g in v2 if g["result"] == "promote"] == [
+        20, 30, 40, 50, 60, 70, 80, 90, 100
+    ]
+    # v3's journey: refusals with negative margins, never a promote.
+    v3 = [g for g in gates if g["newVersion"] == "3"]
+    assert v3 and all(g["result"] == "refuse" for g in v3)
+    assert all(g["margins"]["latency_p95"] < 0 for g in v3)
+    # Recorder-side gate records carry the step's FULL op-timer
+    # breakdown (status_patch included — the status copy can't time the
+    # patch that writes it).
+    assert "status_patch" in v2[-1]["timings"]
+    assert "gate_read" in v2[-1]["timings"]
+
+
+def test_chrome_trace_validates_and_shows_traffic_staircase():
+    recorder = RolloutRecorder(capacity=128)
+    drive_promote_and_rollback(recorder)
+    trace = recorder.chrome_trace()
+    assert_chrome_trace_valid(trace)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert f"{NS}/{NAME}" in {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert {"gate promote", "gate refuse"} <= names
+    levels = {
+        e["args"]["level"]
+        for e in trace["traceEvents"]
+        if e.get("cat") == "traffic"
+    }
+    assert {10, 50, 100} <= levels
+    # Gate instants carry the margins.
+    gate_instants = [
+        e for e in trace["traceEvents"] if e.get("cat") == "gate"
+    ]
+    assert any(
+        e["args"]["margins"].get("latency_p95", 1) < 0 for e in gate_instants
+    )
+
+
+def test_debug_rollouts_http_endpoints():
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.localplane import (
+        free_port,
+    )
+
+    recorder = RolloutRecorder(capacity=128)
+    kube, telemetry = drive_promote_and_rollback(recorder)
+    port = free_port()
+    httpd = telemetry.serve(port, addr="127.0.0.1", recorder=recorder)
+    try:
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            )
+
+        live = json.loads(get("/debug/rollouts").read())
+        assert f"{NS}/{NAME}" in live["rollouts"]
+        assert live["rollouts"][f"{NS}/{NAME}"]["records"]
+
+        trace = json.loads(get("/debug/rollouts/trace?format=chrome").read())
+        assert_chrome_trace_valid(trace)
+        raw = json.loads(get("/debug/rollouts/trace?format=json").read())
+        assert raw == live
+
+        # The metrics listener still serves its original endpoints.
+        assert b"tpumlops_operator_gate_margin" in get("/metrics").read()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            get("/debug/rollouts/trace?format=pdf")
+        assert err.value.code == 400
+    finally:
+        httpd.shutdown()
+
+    # Without a recorder the endpoints 404 (the default operator).
+    port2 = free_port()
+    httpd2 = OperatorTelemetry().serve(port2, addr="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/debug/rollouts", timeout=5
+            )
+        assert err.value.code == 404
+    finally:
+        httpd2.shutdown()
+
+
+# -- prometheus series + decision log line -----------------------------------
+
+
+def test_gate_series_and_promotion_outcomes():
+    recorder = RolloutRecorder(capacity=128)
+    _, telemetry = drive_promote_and_rollback(recorder)
+    text = telemetry.exposition().decode()
+    assert (
+        'tpumlops_operator_gate_evaluations_total{name="iris",'
+        'namespace="models",result="promote"} 9.0' in text
+    )
+    assert 'result="threshold"' in text
+    assert (
+        'tpumlops_operator_gate_margin{check="latency_p95",name="iris",'
+        'namespace="models"}' in text
+    )
+    assert 'tpumlops_operator_gate_attempt{name="iris",namespace="models"}' in text
+    # One completed rollout (v2), one rolled back (v3) — the rolled-back
+    # one counts ONCE, as rolled_back (not double-counted as failed).
+    assert (
+        'tpumlops_operator_promotions_total{name="iris",'
+        'namespace="models",outcome="completed"} 1.0' in text
+    )
+    assert (
+        'tpumlops_operator_promotions_total{name="iris",'
+        'namespace="models",outcome="rolled_back"} 1.0' in text
+    )
+    assert 'outcome="failed"' not in text
+    # Two armed rollouts reached a terminal phase -> two observations.
+    assert (
+        'tpumlops_operator_rollout_duration_seconds_count{name="iris",'
+        'namespace="models"} 2.0' in text
+    )
+
+
+def test_min_sample_refusal_classified_without_margins():
+    kube, registry, metrics, clock, rec = make_world(
+        {"thresholds": {"minSampleCount": 1000}}
+    )
+    start_canary(kube, registry, metrics, rec)
+    out = reconcile(kube, rec)
+    assert out.gate is not None
+    assert out.gate.refusal == "min_sample"
+    assert out.gate.margins == {}
+    telemetry = OperatorTelemetry()
+    telemetry.record_outcome(NS, NAME, out, 0.01)
+    text = telemetry.exposition().decode()
+    assert 'result="min_sample"' in text
+    assert "tpumlops_operator_gate_margin{" not in text  # absent, not zero
+
+
+def test_margin_gauges_cleared_when_metrics_go_missing():
+    """An evaluation that ran no budget comparisons must not leave the
+    previous evaluation's headroom on the gauge — absent, not stale."""
+    kube, registry, metrics, clock, rec = make_world()
+    telemetry = OperatorTelemetry()
+    start_canary(kube, registry, metrics, rec)
+    out = reconcile(kube, rec)  # promote: margins set
+    telemetry.record_outcome(NS, NAME, out, 0.01)
+    assert "tpumlops_operator_gate_margin{" in telemetry.exposition().decode()
+
+    metrics.set_metrics(NAME, "v2", NS, ModelMetrics())  # traffic vanishes
+    out = reconcile(kube, rec)
+    assert out.gate.refusal == "missing_metrics"
+    telemetry.record_outcome(NS, NAME, out, 0.01)
+    text = telemetry.exposition().decode()
+    assert "tpumlops_operator_gate_margin{" not in text
+    assert 'result="missing_metrics"' in text
+
+
+def test_stale_journal_sheds_on_quiescent_cr():
+    """historyLimit back to 0 while the CR sits in STABLE: the next
+    steady-state reconcile clears the leftover keys (no rollout needed)."""
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    start_canary(kube, registry, metrics, rec)
+    for _ in range(10):
+        out = reconcile(kube, rec)
+        if out.state.phase != Phase.CANARY:
+            break
+        clock.advance(out.requeue_after)
+    assert kube.get(cr_ref())["status"]["history"]  # journal written
+
+    obj = kube.get(cr_ref())
+    obj["spec"]["observability"] = {"historyLimit": 0}
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(cr_ref(), obj)
+    reconcile(kube, rec)  # steady-state STABLE step
+    status = kube.get(cr_ref())["status"]
+    assert status["history"] is None and status["lastGate"] is None
+
+
+def test_stale_journal_sheds_in_error_phase():
+    """Same cleanup for a CR parked in ERROR (alias missing): journal
+    clears without re-announcing AliasNotFound."""
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    start_canary(kube, registry, metrics, rec)
+    reconcile(kube, rec)  # one promote step: journal written
+    registry.drop_alias("iris", "champion")
+    reconcile(kube, rec)  # -> ERROR, journal preserved
+    status = kube.get(cr_ref())["status"]
+    assert status["phase"] == "Error" and status["history"]
+
+    obj = kube.get(cr_ref())
+    obj["spec"]["observability"] = {"historyLimit": 0}
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(cr_ref(), obj)
+    reconcile(kube, rec)  # ERROR-parked step clears the journal...
+    status = kube.get(cr_ref())["status"]
+    assert status["history"] is None and status["lastGate"] is None
+    # ...without duplicating the alias-missing Warning.
+    assert kube.event_reasons().count("AliasNotFound") == 1
+
+
+def test_record_time_is_wall_clock_not_monotonic():
+    """status times must be calendar time a human can correlate — the
+    injected Clock is monotonic in production (1970-relative if naively
+    rendered)."""
+    import datetime
+
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    start_canary(kube, registry, metrics, rec)  # FakeClock at t=0
+    last_gate = None
+    for _ in range(3):
+        reconcile(kube, rec)
+        last_gate = kube.get(cr_ref())["status"]["lastGate"]
+    year = datetime.datetime.strptime(
+        last_gate["time"], "%Y-%m-%dT%H:%M:%SZ"
+    ).year
+    assert year >= 2024, last_gate["time"]
+
+
+def test_one_structured_json_decision_line_per_evaluation(caplog):
+    import logging
+
+    kube, registry, metrics, clock, rec = make_world()
+    start_canary(kube, registry, metrics, rec, new_metrics=BAD)
+    with caplog.at_level(logging.INFO, logger="tpumlops.gate"):
+        reconcile(kube, rec)  # refusal
+        metrics.set_metrics(NAME, "v2", NS, GOOD)
+        reconcile(kube, rec)  # promote
+    lines = [
+        r for r in caplog.records if r.name == "tpumlops.gate"
+    ]
+    assert len(lines) == 2
+    refuse = json.loads(lines[0].getMessage())
+    assert refuse["event"] == "gate_decision"
+    assert (refuse["namespace"], refuse["name"]) == (NS, NAME)
+    assert refuse["result"] == "refuse" and refuse["refusal"] == "threshold"
+    assert refuse["margins"]["latency_p95"] < 0
+    promote = json.loads(lines[1].getMessage())
+    assert promote["result"] == "promote" and promote["trafficAfter"] == 20
+    # CR identity rides the record for --log-format json.
+    assert lines[0].cr_namespace == NS and lines[0].cr_name == NAME
+
+
+def test_per_cr_logger_carries_generation_in_json_mode(caplog):
+    import logging
+
+    from tpumlops.utils.logging import JsonFormatter, model_logger
+
+    log = model_logger("iris", "models")
+    log.set_generation(7)
+    with caplog.at_level(logging.INFO, logger="tpumlops.models.iris"):
+        log.info("reconcile step")
+    record = caplog.records[-1]
+    rendered = json.loads(JsonFormatter().format(record))
+    assert rendered["namespace"] == "models"
+    assert rendered["name"] == "iris"
+    assert rendered["generation"] == 7
+    assert "[models/iris gen=7]" in rendered["message"]
+
+
+# -- runtime wiring ----------------------------------------------------------
+
+
+def test_runtime_threads_recorder_and_forgets_on_delete():
+    recorder = RolloutRecorder(capacity=16)
+    kube, registry, metrics, clock = (
+        FakeKube(), FakeRegistry(), FakeMetrics(), FakeClock(),
+    )
+    kube.create(
+        cr_ref(),
+        {
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": {"modelName": "iris", "modelAlias": "champion"},
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/a/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rt = OperatorRuntime(kube, registry, metrics, clock, recorder=recorder)
+    rt.step()  # initial deploy -> NewModelVersionDetected transition
+    assert recorder.snapshot()["rollouts"][f"{NS}/{NAME}"]["records"]
+    kube.delete(cr_ref())
+    rt.step()
+    assert recorder.snapshot()["rollouts"] == {}
