@@ -16,6 +16,7 @@ from .base import (
     AliasNotFound,
     ApiError,
     Conflict,
+    EngineMetrics,
     KubeClient,
     MetricsSource,
     ModelMetrics,
@@ -30,6 +31,7 @@ __all__ = [
     "AliasNotFound",
     "ApiError",
     "Conflict",
+    "EngineMetrics",
     "KubeClient",
     "MetricsSource",
     "ModelMetrics",

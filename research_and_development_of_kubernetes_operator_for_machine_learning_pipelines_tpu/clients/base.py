@@ -109,6 +109,31 @@ class ModelMetrics:
 
 
 @dataclass(frozen=True)
+class EngineMetrics:
+    """One predictor's engine-saturation signals over a window.
+
+    The replica autoscaler's inputs (``operator/autoscaler.py``): queue
+    depth summed across the predictor's replicas (instant gauge), and
+    the p95 of admission wait / TTFT over the window.  ``None`` means
+    the signal is unavailable (no such series, Prometheus unreachable,
+    or no traffic in the window) — the autoscaler must treat that as
+    "hold", never as zero load, or a metrics blackout would drain the
+    fleet to minReplicas under full load.
+    """
+
+    queue_depth: float | None = None
+    admission_wait_p95_ms: float | None = None
+    ttft_p95_s: float | None = None
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "queue_depth": self.queue_depth,
+            "admission_wait_p95_ms": self.admission_wait_p95_ms,
+            "ttft_p95_s": self.ttft_p95_s,
+        }
+
+
+@dataclass(frozen=True)
 class WatchEvent:
     """One event off a Kubernetes watch stream.
 

@@ -23,6 +23,7 @@ from typing import Any, Callable, Mapping
 from .base import (
     AliasNotFound,
     Conflict,
+    EngineMetrics,
     Event,
     ModelMetrics,
     ModelVersion,
@@ -261,6 +262,14 @@ class FakeMetrics:
     def __init__(self):
         self._readings: dict[tuple[str, str, str], Callable[[int], ModelMetrics]] = {}
         self.query_log: list[tuple[str, str, str]] = []
+        # Engine-saturation readings for the replica autoscaler
+        # (mirrors PrometheusSource.engine_metrics).  Unknown predictors
+        # return the all-None shape = signal unavailable, which the
+        # autoscaler treats as "hold".
+        self._engine: dict[
+            tuple[str, str, str], Callable[[int], EngineMetrics]
+        ] = {}
+        self.engine_query_log: list[tuple[str, str, str]] = []
 
     def set_metrics(
         self, deployment: str, predictor: str, namespace: str, metrics: ModelMetrics
@@ -290,4 +299,33 @@ class FakeMetrics:
         fn = self._readings.get((deployment_name, predictor_name, namespace))
         if fn is None:
             return ModelMetrics()  # no traffic: latency/error metrics all None
+        return fn(window_s)
+
+    def set_engine_metrics(
+        self, deployment: str, predictor: str, namespace: str, metrics: EngineMetrics
+    ) -> None:
+        self._engine[(deployment, predictor, namespace)] = lambda _w: metrics
+
+    def set_engine_series(
+        self,
+        deployment: str,
+        predictor: str,
+        namespace: str,
+        fn: Callable[[int], EngineMetrics],
+    ) -> None:
+        self._engine[(deployment, predictor, namespace)] = fn
+
+    def engine_metrics(
+        self,
+        deployment_name: str,
+        predictor_name: str,
+        namespace: str,
+        window_s: int = 60,
+    ) -> EngineMetrics:
+        self.engine_query_log.append(
+            (deployment_name, predictor_name, namespace)
+        )
+        fn = self._engine.get((deployment_name, predictor_name, namespace))
+        if fn is None:
+            return EngineMetrics()  # unavailable: autoscaler holds
         return fn(window_s)

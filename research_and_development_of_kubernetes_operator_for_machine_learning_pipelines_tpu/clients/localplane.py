@@ -24,9 +24,9 @@ import threading
 import time
 import urllib.request
 
-from .base import SELDONDEPLOYMENT
+from .base import SELDONDEPLOYMENT, EngineMetrics, ModelMetrics
 from .fakes import FakeKube
-from .router import RouterSync
+from .router import RouterSync, parse_prometheus_text
 
 __all__ = [
     "free_port",
@@ -36,6 +36,8 @@ __all__ = [
     "TrafficGenerator",
     "train_iris_pair",
     "relaxed_gate_spec",
+    "LocalReplicaSet",
+    "ReplicaSetMetrics",
 ]
 
 
@@ -76,6 +78,7 @@ def start_model_server(
     namespace: str = "models",
     tpu=None,
     ready_timeout_s: float = 180.0,
+    warmup: bool = True,
 ) -> ModelServerHandle:
     """Run a real inference server (aiohttp) on a daemon thread; raises
     TimeoutError if it never becomes ready."""
@@ -92,7 +95,7 @@ def start_model_server(
     )
     if tpu is not None:
         cfg_kwargs["tpu"] = tpu
-    server = build_server(ServerConfig(**cfg_kwargs))
+    server = build_server(ServerConfig(**cfg_kwargs), warmup=warmup)
     loop = asyncio.new_event_loop()
     handle = ModelServerHandle(server, loop, port)
     boot_error: list[BaseException] = []
@@ -166,6 +169,189 @@ class SyncingKube(FakeKube):
         obj = super().replace(ref, body)
         self._push(ref, obj)
         return obj
+
+
+class LocalReplicaSet:
+    """The Deployment-controller role for the local plane: make predictor
+    ``replicas`` REAL.
+
+    In-cluster, a predictor's ``replicas`` count materializes as pods via
+    Seldon/Kubernetes; here each replica is a live inference server on a
+    local port.  ``sync_manifest`` diffs an applied SeldonDeployment
+    against the running set: scale-up starts servers, scale-down (and
+    predictor removal) runs the LOSSLESS drain protocol — the port is
+    unlisted from :meth:`ports` first, ``POST /admin/drain`` finishes
+    every in-flight sequence, and only then does the server stop — so
+    the autoscaler's e2e can prove no request is ever dropped across a
+    topology change.
+    """
+
+    def __init__(
+        self,
+        model_uris: dict,  # predictor name -> artifact uri
+        model_name: str,
+        namespace: str = "models",
+        deployment_name: str | None = None,
+        tpu=None,  # TpuSpec for every replica server
+        drain_grace_s: float = 30.0,
+        stop_linger_s: float = 0.5,
+        warmup: bool = True,  # False: replicas boot fast, compile lazily
+    ):
+        self.model_uris = dict(model_uris)
+        self.model_name = model_name
+        self.namespace = namespace
+        self.deployment_name = deployment_name or model_name
+        self.tpu = tpu
+        self.drain_grace_s = drain_grace_s
+        # Post-drain linger before the socket closes: clients that
+        # snapshotted the port list just before it was unlisted get
+        # their request answered (shed or served), never a connection
+        # refusal — the local analogue of the --drain-s endpoint-removal
+        # lag in production.
+        self.stop_linger_s = stop_linger_s
+        self.warmup = warmup
+        self._lock = threading.RLock()
+        self._replicas: dict[str, list[ModelServerHandle]] = {}
+        # Every drain's final /admin/drain response, for the e2e's
+        # zero-lost-requests proof.
+        self.drain_reports: list[dict] = []
+        self.scale_log: list[tuple[str, int]] = []  # (predictor, replicas)
+
+    def ports(self) -> list[int]:
+        """Live (non-draining) replica ports, all predictors."""
+        with self._lock:
+            return [
+                h.port for handles in self._replicas.values() for h in handles
+            ]
+
+    def replica_count(self, predictor: str | None = None) -> int:
+        with self._lock:
+            if predictor is not None:
+                return len(self._replicas.get(predictor, []))
+            return sum(len(v) for v in self._replicas.values())
+
+    def sync_manifest(self, manifest: dict) -> None:
+        spec = manifest.get("spec") or {}
+        desired = {
+            p.get("name"): int(p.get("replicas", 1))
+            for p in spec.get("predictors") or []
+        }
+        with self._lock:
+            current = {k: list(v) for k, v in self._replicas.items()}
+        # Scale up / create first (capacity before teardown), then drain
+        # down — the same order a rolling controller uses.
+        for pred, n in desired.items():
+            have = len(current.get(pred, []))
+            for _ in range(have, n):
+                self._start(pred)
+            if n != have:
+                self.scale_log.append((pred, n))
+        for pred, handles in current.items():
+            keep = desired.get(pred, 0)
+            for handle in handles[keep:]:
+                self._drain_stop(pred, handle)
+
+    def _start(self, predictor: str) -> None:
+        uri = self.model_uris[predictor]
+        handle = start_model_server(
+            uri,
+            predictor,
+            free_port(),
+            model_name=self.model_name,
+            deployment_name=self.deployment_name,
+            namespace=self.namespace,
+            tpu=self.tpu,
+            warmup=self.warmup,
+        )
+        with self._lock:
+            self._replicas.setdefault(predictor, []).append(handle)
+
+    def _drain_stop(self, predictor: str, handle: ModelServerHandle) -> None:
+        # Unlist BEFORE draining: new traffic must stop targeting this
+        # replica while its in-flight tail finishes.
+        with self._lock:
+            handles = self._replicas.get(predictor, [])
+            if handle in handles:
+                handles.remove(handle)
+            if not handles:
+                self._replicas.pop(predictor, None)
+        report: dict = {"predictor": predictor, "port": handle.port}
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{handle.port}/admin/drain",
+                data=json.dumps({"grace_s": self.drain_grace_s}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.drain_grace_s + 10
+            ) as resp:
+                report.update(json.loads(resp.read()))
+        except Exception as e:  # drain endpoint gone/failed: record it
+            report["error"] = str(e)
+        self.drain_reports.append(report)
+        if self.stop_linger_s > 0:
+            time.sleep(self.stop_linger_s)
+        handle.stop()
+
+    def stop_all(self) -> None:
+        with self._lock:
+            handles = [
+                h for hs in self._replicas.values() for h in hs
+            ]
+            self._replicas.clear()
+        for h in handles:
+            h.stop()
+
+
+class ReplicaSetMetrics:
+    """Engine-saturation source over live local replicas.
+
+    The in-cluster shape is Prometheus scraping every replica pod and the
+    autoscaler's PromQL summing ``tpumlops_engine_queue_depth`` across
+    them (``PrometheusSource.engine_metrics``); here we scrape each
+    replica's ``/metrics`` directly and do the same sum.  A replica that
+    fails to answer is skipped; no replicas answering returns the
+    all-None shape, which the autoscaler treats as "hold".
+    ``model_metrics`` returns the no-traffic shape — the promotion gate
+    is not part of the scaling loop this source serves.
+    """
+
+    _FAMILY = "tpumlops_engine_queue_depth"
+
+    def __init__(self, ports, timeout: float = 2.0):
+        self._ports = ports  # Callable[[], list[int]]
+        self._timeout = timeout
+
+    def model_metrics(
+        self, deployment_name, predictor_name, namespace, window_s=60
+    ) -> ModelMetrics:
+        return ModelMetrics()
+
+    def engine_metrics(
+        self, deployment_name, predictor_name, namespace, window_s=60
+    ) -> EngineMetrics:
+        ident = {
+            ("deployment_name", deployment_name),
+            ("predictor_name", predictor_name),
+            ("namespace", namespace),
+        }
+        total: float | None = None
+        for port in list(self._ports()):
+            try:
+                text = (
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=self._timeout,
+                    )
+                    .read()
+                    .decode()
+                )
+            except Exception:
+                continue  # replica mid-boot/mid-drain: partial sum
+            for (name, labels), value in parse_prometheus_text(text).items():
+                if name == self._FAMILY and ident <= labels:
+                    total = (total or 0.0) + value
+        return EngineMetrics(queue_depth=total)
 
 
 class TrafficGenerator:

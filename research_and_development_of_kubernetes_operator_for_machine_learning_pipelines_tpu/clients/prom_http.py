@@ -13,7 +13,7 @@ import logging
 
 import httpx
 
-from .base import ModelMetrics
+from .base import EngineMetrics, ModelMetrics
 
 _log = logging.getLogger(__name__)
 
@@ -115,4 +115,46 @@ class PrometheusSource:
             # min_sample_count hardening treats as not-enough-samples (safe).
             request_count=lat_count if lat_count is not None else 0.0,
             feedback_request_count=feedback,
+        )
+
+    def engine_metrics(
+        self,
+        deployment_name: str,
+        predictor_name: str,
+        namespace: str,
+        window_s: int = 60,
+    ) -> EngineMetrics:
+        """Engine-saturation signals for the replica autoscaler.
+
+        Queue depth is summed over the predictor's replicas — each
+        replica exports its own ``tpumlops_engine_queue_depth`` gauge
+        under the same identity labels, so the sum is the predictor's
+        total backlog.  No ``vector(0)`` fallback anywhere: a failed or
+        empty query must surface as None (signal unavailable), never as
+        0 — the autoscaler treats blindness as "hold", and a Prometheus
+        blackout reading as "no load" would drain the fleet to
+        minReplicas under full traffic.
+        """
+        sel = (
+            f'deployment_name="{deployment_name}", '
+            f'predictor_name="{predictor_name}", namespace="{namespace}"'
+        )
+        w = f"{window_s}s"
+        queue_depth = self._query(
+            f"sum(tpumlops_engine_queue_depth{{{sel}}})"
+        )
+        wait_p95 = self._query(
+            "histogram_quantile(0.95, sum(rate("
+            f"tpumlops_admission_wait_ms_bucket{{{sel}}}[{w}]"
+            ")) by (le))"
+        )
+        ttft_p95 = self._query(
+            "histogram_quantile(0.95, sum(rate("
+            f"tpumlops_ttft_seconds_bucket{{{sel}}}[{w}]"
+            ")) by (le))"
+        )
+        return EngineMetrics(
+            queue_depth=queue_depth,
+            admission_wait_p95_ms=wait_p95,
+            ttft_p95_s=ttft_p95,
         )

@@ -90,8 +90,45 @@ class RouterAdmin:
     def get_weights(self) -> dict[str, int]:
         return json.loads(self._req("/router/weights"))
 
-    def set_weights(self, weights: dict[str, int]) -> None:
-        self._req("/router/weights", "PUT", weights)
+    def _req_retry(
+        self,
+        path: str,
+        method: str,
+        body: dict | None,
+        retries: int,
+        backoff_s: float,
+        sleep=time.sleep,
+    ):
+        """Bounded retry on TRANSIENT transport errors only.
+
+        An HTTPError means the router is up and answered (a real 4xx/5xx
+        the caller must see); connection refused/reset/timeout means it
+        is restarting — exactly the window a scale event's weight flip
+        used to race and lose, leaving the split stale until the next
+        reconcile.  Exponential backoff, ``retries`` re-attempts, then
+        the last error propagates."""
+        for attempt in range(retries + 1):
+            try:
+                return self._req(path, method, body)
+            except urllib.error.HTTPError:
+                raise  # the router answered; not a transient
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if attempt == retries:
+                    raise
+                sleep(backoff_s * (2 ** attempt))
+
+    def set_weights(
+        self,
+        weights: dict[str, int],
+        retries: int = 3,
+        backoff_s: float = 0.05,
+        sleep=time.sleep,
+    ) -> None:
+        """Idempotent (PUT of an absolute weight map), so retrying a
+        flip against a mid-restart router is always safe."""
+        self._req_retry(
+            "/router/weights", "PUT", weights, retries, backoff_s, sleep
+        )
 
     def get_config(self) -> dict:
         return json.loads(self._req("/router/config"))

@@ -1,0 +1,294 @@
+"""SLO-driven replica autoscaler: the topology half of the control plane.
+
+The canary machinery adjusts *which version* gets traffic; until now the
+operator never adjusted *how much capacity* serves it — every predictor
+ran a fixed ``spec.tpu.replicas`` (default 1), so the engine-saturation
+series the data plane exports (``tpumlops_engine_queue_depth``,
+``tpumlops_admission_wait_ms``, ``tpumlops_ttft_seconds``) were observed
+by nothing.  This module closes that loop, InferLine/λScale-style: per
+``MlflowModel``, read the stable predictor's saturation signals, compute
+a desired replica count against ``spec.autoscaling``, and apply it with
+asymmetric hysteresis:
+
+- **fast up** — once demand has persisted ``scaleUpStabilizationSeconds``
+  (0 = immediately), jump straight to the desired count; queued users
+  should not wait one cooldown per replica;
+- **slow down** — step ONE replica at a time, and only after
+  ``scaleDownCooldownSeconds`` since the last scale event in either
+  direction, so a load dip never collapses capacity it will want back;
+- **frozen during a canary** — the reconciler simply never evaluates the
+  autoscaler while a rollout is in flight, so the promotion judge never
+  compares versions across a topology change;
+- **blind = hold** — missing metrics hold the current count; a
+  Prometheus blackout must never read as "no load".
+
+Everything here is a pure function of (spec, current state, observation,
+wall time): the reconciler owns the I/O, status persistence (cooldown
+and stabilization state round-trip through ``status.autoscaler`` so a
+restarted operator keeps its pacing), and manifest application.  Every
+decision that changes or withholds a change becomes a :class:`ScaleRecord`
+in the PR-5 rollout journal (``status.history``, ``/debug/rollouts``,
+``tpumlops_operator_autoscale_*``).
+
+The data-plane half that makes scale-down safe — bounded admission with
+429 shed and the lossless drain protocol — lives in ``server/app.py`` /
+``server/generation.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Any, Mapping
+
+from .rollout_recorder import _iso
+
+# Hold reasons (``ScaleRecord.hold`` / the ``reason`` label on
+# ``tpumlops_operator_autoscale_holds``): why a wanted scale did not run.
+HOLD_METRICS_MISSING = "metrics_missing"
+HOLD_STABILIZATION = "stabilization"
+HOLD_COOLDOWN = "cooldown"
+
+
+@dataclass(frozen=True)
+class ScaleRecord:
+    """One autoscaler decision, with everything it observed.
+
+    Journaled alongside :class:`~.rollout_recorder.GateRecord` /
+    :class:`~.rollout_recorder.TransitionRecord` (``kind: "scale"``), so
+    a replica staircase is reconstructable from ``status.history`` or
+    ``GET /debug/rollouts`` alone.  ``hold`` is ``None`` when the scale
+    was applied; otherwise the typed reason it was withheld."""
+
+    wall: float  # unix epoch seconds at evaluation time
+    from_replicas: int = 0
+    to_replicas: int = 0
+    desired: int = 0  # the un-hysteresis'd target this evaluation wanted
+    reason: str = ""
+    hold: str | None = None
+    version: str | None = None  # predictor version observed
+    observed: Mapping[str, Any] = field(default_factory=dict)
+    targets: Mapping[str, Any] = field(default_factory=dict)
+
+    @property
+    def applied(self) -> bool:
+        return self.hold is None and self.to_replicas != self.from_replicas
+
+    @property
+    def direction(self) -> str:
+        if self.hold is not None or self.to_replicas == self.from_replicas:
+            return "hold"
+        return "up" if self.to_replicas > self.from_replicas else "down"
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "kind": "scale",
+            "ts": self.wall,
+            "time": _iso(self.wall),
+            "from": self.from_replicas,
+            "to": self.to_replicas,
+            "desired": self.desired,
+            "direction": self.direction,
+            "hold": self.hold,
+            "reason": self.reason,
+            "version": self.version,
+            "observed": dict(self.observed),
+            "targets": dict(self.targets),
+        }
+
+
+@dataclass(frozen=True)
+class ScalerState:
+    """Hysteresis state, round-tripped through ``status.autoscaler``.
+
+    Wall-clock (unix epoch) timestamps on purpose: this state survives
+    operator restarts via CR status, and the injected reconcile Clock is
+    monotonic in production — a persisted monotonic reading would reset
+    to ~0 on every restart and break cooldown arithmetic (the same
+    lesson the rollout journal learned in the tracing PR)."""
+
+    last_scale_wall: float = 0.0  # last applied scale, either direction
+    above_since_wall: float | None = None  # demand > current since (or None)
+
+    def to_status(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"lastScaleTime": self.last_scale_wall}
+        if self.above_since_wall is not None:
+            out["scaleUpPendingSince"] = self.above_since_wall
+        return out
+
+    @classmethod
+    def from_status(cls, status: Mapping[str, Any] | None) -> "ScalerState":
+        if not status:
+            return cls()
+        above = status.get("scaleUpPendingSince")
+        return cls(
+            last_scale_wall=float(status.get("lastScaleTime") or 0.0),
+            above_since_wall=float(above) if above is not None else None,
+        )
+
+
+@dataclass(frozen=True)
+class ScaleDecision:
+    """What to run now, plus the state and journal record to persist."""
+
+    replicas: int
+    state: ScalerState
+    record: ScaleRecord | None = None  # None = nothing worth journaling
+
+
+def clamp_replicas(value: int, spec) -> int:
+    return max(spec.min_replicas, min(spec.max_replicas, int(value)))
+
+
+def desired_replicas(spec, current: int, observed) -> tuple[int, str]:
+    """The un-hysteresis'd replica target for one observation.
+
+    Queue depth is the primary signal (``ceil(total / target-per-
+    replica)``); a TTFT p95 above budget adds one replica on top even
+    when the queue looks fine — latency pressure without a backlog is
+    what long prompts under packed prefill look like.  Returns
+    ``(desired, reason)`` with the reason naming the binding signal.
+    """
+    wanted = spec.min_replicas
+    reason = "idle"
+    qd_target = spec.target_queue_depth_per_replica
+    if qd_target > 0 and observed.queue_depth is not None:
+        by_queue = math.ceil(observed.queue_depth / qd_target)
+        if by_queue > wanted:
+            wanted = by_queue
+            reason = (
+                f"queue depth {observed.queue_depth:g} / target "
+                f"{qd_target:g} per replica"
+            )
+    ttft_target = spec.target_ttft_seconds
+    if (
+        ttft_target > 0
+        and observed.ttft_p95_s is not None
+        and observed.ttft_p95_s > ttft_target
+        and current + 1 > wanted
+    ):
+        wanted = current + 1
+        reason = (
+            f"ttft p95 {observed.ttft_p95_s:.3f}s > target "
+            f"{ttft_target:g}s"
+        )
+    return clamp_replicas(wanted, spec), reason
+
+
+def decide(
+    spec,
+    current: int,
+    state: ScalerState,
+    observed,
+    now_wall: float,
+) -> ScaleDecision:
+    """One autoscaler evaluation (pure; the reconciler applies it).
+
+    ``spec`` is a :class:`~..utils.config.AutoscalingSpec`, ``observed``
+    an :class:`~..clients.base.EngineMetrics` or ``None`` (source has no
+    engine-metrics capability / query failed entirely).
+    """
+
+    def rec(to: int, desired: int, reason: str, hold: str | None):
+        return ScaleRecord(
+            wall=now_wall,
+            from_replicas=current,
+            to_replicas=to,
+            desired=desired,
+            reason=reason,
+            hold=hold,
+            observed=observed.as_dict() if observed is not None else {},
+            targets={
+                "queueDepthPerReplica": spec.target_queue_depth_per_replica,
+                "ttftSeconds": spec.target_ttft_seconds,
+                "minReplicas": spec.min_replicas,
+                "maxReplicas": spec.max_replicas,
+            },
+        )
+
+    blind = observed is None or (
+        observed.queue_depth is None and observed.ttft_p95_s is None
+    )
+    if blind:
+        # Hold at current strength; also stop any pending scale-up clock
+        # — stale demand must re-prove itself once metrics return.
+        new_state = replace(state, above_since_wall=None)
+        return ScaleDecision(
+            replicas=current,
+            state=new_state,
+            record=rec(
+                current, current,
+                "engine metrics unavailable", HOLD_METRICS_MISSING,
+            ),
+        )
+
+    desired, why = desired_replicas(spec, current, observed)
+
+    # Scale-DOWN needs positive evidence of idleness.  With a queue
+    # target configured, that evidence is the queue gauge itself — a
+    # healthy TTFT cannot stand in for it (TTFT samples only admitted
+    # requests; under shed the backlog pressure is exactly what TTFT
+    # doesn't see).  A TTFT-only config needs a present TTFT reading —
+    # and since the rate-window quantile is also None at zero traffic,
+    # such a config holds its count through full idle (configure the
+    # queue target to shrink).  A partially-answering source may still
+    # justify GROWING; under-observing never shrinks the fleet.
+    if desired < current:
+        if spec.target_queue_depth_per_replica > 0:
+            down_evidence = observed.queue_depth is not None
+        else:
+            down_evidence = observed.ttft_p95_s is not None
+        if not down_evidence:
+            return ScaleDecision(
+                replicas=current,
+                state=replace(state, above_since_wall=None),
+                record=rec(
+                    current, desired,
+                    "idle-evidence signal unavailable; holding scale-down",
+                    HOLD_METRICS_MISSING,
+                ),
+            )
+
+    if desired > current:
+        since = (
+            state.above_since_wall
+            if state.above_since_wall is not None
+            else now_wall
+        )
+        pending = replace(state, above_since_wall=since)
+        if now_wall - since < spec.scale_up_stabilization_s:
+            return ScaleDecision(
+                replicas=current,
+                state=pending,
+                record=rec(current, desired, why, HOLD_STABILIZATION),
+            )
+        # Fast up: jump straight to the stabilized demand.
+        return ScaleDecision(
+            replicas=desired,
+            state=ScalerState(
+                last_scale_wall=now_wall, above_since_wall=None
+            ),
+            record=rec(desired, desired, why, None),
+        )
+
+    # Demand at or below current: any pending scale-up is off.
+    state = replace(state, above_since_wall=None)
+    if desired < current:
+        since_last = now_wall - state.last_scale_wall
+        if since_last < spec.scale_down_cooldown_s:
+            return ScaleDecision(
+                replicas=current,
+                state=state,
+                record=rec(current, desired, why, HOLD_COOLDOWN),
+            )
+        # Slow down: one replica per cooldown window, never straight to
+        # the floor — the load that justified the fleet usually comes
+        # back faster than a replica boots.
+        to = current - 1
+        return ScaleDecision(
+            replicas=to,
+            state=ScalerState(last_scale_wall=now_wall),
+            record=rec(to, desired, why, None),
+        )
+
+    return ScaleDecision(replicas=current, state=state, record=None)

@@ -43,9 +43,16 @@ def owner_reference(name: str, uid: str) -> list[dict[str, Any]]:
 
 
 def _seldon_predictor(
-    version: str, model_uri: str, traffic: int, config: OperatorConfig
+    version: str,
+    model_uri: str,
+    traffic: int,
+    config: OperatorConfig,
+    replicas: int | None = None,
 ) -> dict[str, Any]:
-    """Reference-parity predictor (``mlflow_operator.py:195-222``)."""
+    """Reference-parity predictor (``mlflow_operator.py:195-222``).
+
+    ``replicas`` is the autoscaler's override (None — the default — keeps
+    the reference's fixed 1, byte-for-byte)."""
     return {
         "graph": {
             "name": f"classifier-{version}",
@@ -55,7 +62,7 @@ def _seldon_predictor(
             "children": [],
         },
         "name": f"v{version}",
-        "replicas": 1,
+        "replicas": 1 if replicas is None else int(replicas),
         "traffic": traffic,
     }
 
@@ -146,6 +153,17 @@ def _tpu_pod_spec(
             "failureThreshold": 60,
         },
     }
+    # Admission-control / drain flags are appended ONLY when non-default:
+    # unlike the always-emitted knobs above, these arrived after PR 7 and
+    # an unannotated CR's manifest must stay byte-for-byte identical.
+    if tpu.admission_queue_budget > 0:
+        container["args"] += [
+            "--admission-queue-budget", str(tpu.admission_queue_budget),
+        ]
+    if tpu.drain_grace_s != 20.0:
+        container["args"] += [
+            "--drain-grace-seconds", str(tpu.drain_grace_s),
+        ]
     if info.hosts > 1:
         unit = worker_unit_name(deployment_name, version)
         container["env"] += [
@@ -171,6 +189,14 @@ def _tpu_pod_spec(
     if config.minio_secret:
         container["envFrom"] = [{"secretRef": {"name": config.minio_secret}}]
     pod: dict[str, Any] = {}
+    if tpu.drain_grace_s != 20.0:
+        # The drain is only lossless if kubelet lets it finish: pod
+        # termination grace must cover the endpoint-removal lag (3s
+        # --drain-s default) + the in-flight drain window + margin, or
+        # Kubernetes' default 30s grace SIGKILLs the server mid-drain
+        # and drops exactly the requests the protocol exists to save.
+        # Emitted only alongside the non-default flag (byte-identity).
+        pod["terminationGracePeriodSeconds"] = int(tpu.drain_grace_s) + 15
     if tpu.compile_cache_dir:
         # Node-local persistent XLA cache (SURVEY §7 hard part 3): hostPath
         # outlives the pod, so a rescheduled canary — or the *other* version's
@@ -212,6 +238,7 @@ def _tpu_predictor(
     config: OperatorConfig,
     deployment_name: str,
     namespace: str,
+    replicas: int | None = None,
 ) -> dict[str, Any]:
     """First-party TPU predictor: our JAX server on a v5e node pool.
 
@@ -234,8 +261,11 @@ def _tpu_predictor(
         "name": f"v{version}",
         # data-parallel copies of the predictor — DP in SURVEY §2.3's
         # inventory (single-host only; multi-host units reject replicas>1
-        # at config parse)
-        "replicas": config.tpu.replicas,
+        # at config parse).  ``replicas`` is the autoscaler's live count
+        # (None = spec.tpu.replicas, byte-for-byte the fixed topology).
+        "replicas": (
+            config.tpu.replicas if replicas is None else int(replicas)
+        ),
         "traffic": traffic,
     }
     if info.hosts > 1:
@@ -375,21 +405,33 @@ def build_deployment(
     previous_version: str | None = None,
     old_model_uri: str | None = None,
     traffic_prev: int = 0,
+    replicas: int | None = None,
 ) -> dict[str, Any]:
     """Build the (Seldon-shaped) deployment manifest for a rollout state.
 
     Predictor order matches the reference: previous first, current second
     (``mlflow_operator.py:181-222``); at 100% only the current predictor
     remains (``:354-358``).
+
+    ``replicas`` is the autoscaler-controlled count (``status.replicas``);
+    it applies to EVERY predictor in the manifest — during a canary the
+    topology is frozen, so old and new versions must serve at the same
+    replica count or the promotion judge would compare a loaded predictor
+    against an idle one.  None (autoscaling off) keeps the spec-declared
+    topology byte-for-byte.
     """
     if previous_version is not None and old_model_uri is None:
         raise ValueError("old_model_uri required when previous_version is set")
 
     if config.backend == "tpu":
-        make = lambda v, uri, t: _tpu_predictor(v, uri, t, config, name, namespace)
+        make = lambda v, uri, t: _tpu_predictor(
+            v, uri, t, config, name, namespace, replicas=replicas
+        )
         protocol = "v2"
     else:
-        make = lambda v, uri, t: _seldon_predictor(v, uri, t, config)
+        make = lambda v, uri, t: _seldon_predictor(
+            v, uri, t, config, replicas=replicas
+        )
         protocol = "kfserving"  # reference :235
 
     predictors: list[dict[str, Any]] = []
@@ -407,6 +449,11 @@ def build_deployment(
     if previous_version is not None and traffic_prev > 0:
         annotations["tpumlops.dev/previous-version"] = str(previous_version)
         annotations["tpumlops.dev/traffic-prev"] = str(traffic_prev)
+    if replicas is not None:
+        # Autoscaler context (absent = fixed topology, byte-for-byte):
+        # `kubectl get sdep -o yaml` explains the replica count without
+        # chasing the owning MlflowModel's status.
+        annotations["tpumlops.dev/replicas"] = str(replicas)
 
     return {
         "apiVersion": SELDON_API_VERSION,

@@ -84,6 +84,10 @@ class ReconcileOutcome:
     # (None otherwise); OperatorTelemetry reads it for the
     # tpumlops_operator_gate_* series.
     gate: Any = None
+    # The step's ScaleRecord when this step evaluated the autoscaler
+    # (None otherwise — including every step with autoscaling disabled);
+    # OperatorTelemetry reads it for tpumlops_operator_autoscale_*.
+    scale: Any = None
 
 
 class Reconciler:
@@ -106,6 +110,7 @@ class Reconciler:
         metrics_factory=None,  # Callable[[str], MetricsSource]; honors spec.prometheusUrl
         warmup=None,  # Callable[(deployment, predictor, namespace, n)]; synthetic traffic
         recorder=None,  # RolloutRecorder | None; per-CR gate/phase journal
+        wall=None,  # Callable[[], float]; unix-epoch seconds (tests inject)
     ):
         self.name = name
         self.namespace = namespace
@@ -139,6 +144,17 @@ class Reconciler:
         # identical refusals have been suppressed since.
         self._last_hold: tuple | None = None
         self._hold_suppressed = 0
+        # Autoscaler wiring.  ``wall`` is unix-epoch time (NOT the
+        # injected Clock, which is monotonic in production): cooldown /
+        # stabilization anchors persist in CR status across operator
+        # restarts, where a monotonic reading would reset to ~0.
+        self._wall = wall or time.time
+        # Journal rate limiter for autoscaler holds: the (hold, desired,
+        # current) shape of the last hold record journaled — an
+        # unchanged "cooldown" hold must not append one record per poll.
+        self._last_scale_hold: tuple | None = None
+        # The step's ScaleRecord (telemetry feed), set by _autoscale_step.
+        self._scale_record = None
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -167,6 +183,7 @@ class Reconciler:
         """One reconcile step for the given CR object (spec+status+metadata)."""
         self._timings = {}
         self._pending_records = []
+        self._scale_record = None
         # Per-CR log identity: metadata.generation on every line of this
         # step (the control-plane analogue of the server's request_id).
         if hasattr(self.log, "set_generation"):
@@ -175,6 +192,7 @@ class Reconciler:
             )
         outcome = self._reconcile_inner(obj)
         outcome.timings = self._timings
+        outcome.scale = self._scale_record
         # Flush the step's journal records.  Gate records get the step's
         # COMPLETE op-timer breakdown here (the status.history copy was
         # written mid-step, before its own status_patch could be timed).
@@ -192,6 +210,12 @@ class Reconciler:
         prior_status = obj.get("status") or {}
         self._had_journal_keys = bool(
             prior_status.get("lastGate") or prior_status.get("history")
+        )
+        # Same explicit-null contract for the autoscaler keys: a CR whose
+        # autoscaling was just disabled needs one patch clearing them.
+        self._had_scaler_keys = (
+            prior_status.get("replicas") is not None
+            or prior_status.get("autoscaler") is not None
         )
         state = PromotionState.from_status(obj.get("status"))
         events: list[Event] = []
@@ -235,6 +259,7 @@ class Reconciler:
         ):
             self._ensure_deployment(obj, config, state)
             state = self._shed_disabled_journal(config, state)
+            state = self._autoscale_step(obj, config, state, events)
             return ReconcileOutcome(state, config.monitoring_interval_s, events)
 
         # 3. New version detected (reference :97-149).
@@ -246,10 +271,14 @@ class Reconciler:
             return self._on_canary_step(obj, config, state, events)
 
         # 5. Steady state: self-heal the deployment if it vanished, keep
-        #    monitoring the alias.
+        #    monitoring the alias, and size the topology to the load.
+        #    The autoscaler runs ONLY here (and on the held-version
+        #    branch above) — never mid-CANARY, so the promotion judge
+        #    never compares versions across a topology change.
         if state.phase in (Phase.STABLE, Phase.FAILED, Phase.ROLLED_BACK):
             self._ensure_deployment(obj, config, state)
             state = self._shed_disabled_journal(config, state)
+            state = self._autoscale_step(obj, config, state, events)
         return ReconcileOutcome(state, config.monitoring_interval_s, events)
 
     def _shed_disabled_journal(
@@ -266,6 +295,145 @@ class Reconciler:
         state = state.with_(last_gate=None, history=())
         self._patch_status(state)
         return state
+
+    # -- replica autoscaling (operator/autoscaler.py) ------------------------
+
+    def _autoscale_step(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        events: list[Event],
+    ) -> PromotionState:
+        """One autoscaler evaluation on a steady-state (non-canary) CR.
+
+        Reads the current version's engine-saturation signals, computes
+        the desired replica count with asymmetric hysteresis (pure logic
+        in ``operator/autoscaler.py``), applies topology changes through
+        the normal manifest path, and journals every decision as a
+        ``ScaleRecord`` beside the gate/phase records.
+        """
+        from . import autoscaler as _scaling
+
+        auto = config.autoscaling
+        if not auto.enabled:
+            if state.replicas is None and state.scaler is None:
+                return state
+            # Autoscaling switched off: hand the topology back to
+            # spec.tpu.replicas and clear the status keys (explicit
+            # nulls via _had_scaler_keys).
+            state = state.with_(replicas=None, scaler=None)
+            self._apply_for_state(obj, config, state)
+            self._patch_status(state)
+            self.log.info(
+                "autoscaling disabled; replicas back to spec topology"
+            )
+            return state
+        if state.current_version is None:
+            return state
+
+        current = state.replicas
+        if current is None:
+            # First evaluation after enabling: adopt the spec topology,
+            # clamped into the autoscaler's band.
+            current = _scaling.clamp_replicas(config.tpu.replicas, auto)
+        observed = None
+        source = self._metrics_source(config)
+        fetch = getattr(source, "engine_metrics", None)
+        if fetch is not None:
+            try:
+                with self._op_timer("scale_read"):
+                    observed = fetch(
+                        self.name,
+                        f"v{state.current_version}",
+                        self.namespace,
+                        config.canary.metrics_window_s,
+                    )
+            except Exception as e:
+                # Blind = hold (decide() treats None as metrics-missing);
+                # a Prometheus blip must never read as "no load".
+                self.log.warning(f"engine metrics read failed: {e}")
+                observed = None
+
+        decision = _scaling.decide(
+            auto,
+            current,
+            _scaling.ScalerState.from_status(state.scaler),
+            observed,
+            self._wall(),
+        )
+        record = decision.record
+        if record is not None:
+            record = dataclasses.replace(
+                record, version=state.current_version
+            )
+        self._scale_record = record
+
+        first_take = state.replicas is None
+        changed = decision.replicas != current
+        new_state = state.with_(
+            replicas=decision.replicas, scaler=decision.state.to_status()
+        )
+
+        if changed or first_take:
+            self._last_scale_hold = None
+            applied_rec = record if changed else None
+            if first_take and config.tpu.replicas != decision.replicas:
+                # Enabling autoscaling CHANGED the running topology (the
+                # spec count was clamped into the band, or the demand
+                # moved it immediately): journal the real from-count and
+                # arm the cooldown — an unrecorded multi-replica jump
+                # would be invisible in status.history and a follow-up
+                # step-down could fire with no scale event on record.
+                base = record if record is not None else _scaling.ScaleRecord(
+                    wall=self._wall(),
+                    desired=decision.replicas,
+                    reason="spec topology adopted into the autoscaling band",
+                )
+                applied_rec = dataclasses.replace(
+                    base,
+                    from_replicas=config.tpu.replicas,
+                    to_replicas=decision.replicas,
+                    hold=None,
+                    version=state.current_version,
+                )
+                self._scale_record = applied_rec
+                new_state = new_state.with_(
+                    scaler=dataclasses.replace(
+                        decision.state, last_scale_wall=self._wall()
+                    ).to_status()
+                )
+            self._apply_for_state(obj, config, new_state)
+            new_state = self._journal(config, new_state, applied_rec)
+            self._patch_status(new_state)
+            if applied_rec is not None and applied_rec.applied:
+                ev = Event(
+                    "Normal",
+                    "ScaledUp"
+                    if applied_rec.to_replicas > applied_rec.from_replicas
+                    else "ScaledDown",
+                    f"Scaled replicas {applied_rec.from_replicas} -> "
+                    f"{applied_rec.to_replicas} ({applied_rec.reason}).",
+                )
+                events.append(ev)
+                self.kube.emit_event(self.cr_ref, ev)
+                self.log.info(ev.message)
+            return new_state
+
+        # No topology change.  Journal a hold only when its shape is new
+        # (an unchanged "cooldown" hold must not append one record per
+        # poll), and patch only when something durable moved (the
+        # stabilization clock arming/landing, or the journal growing).
+        hold_rec = None
+        if record is not None and record.hold is not None:
+            hold_key = (record.hold, record.desired, current)
+            if hold_key != self._last_scale_hold:
+                self._last_scale_hold = hold_key
+                hold_rec = record
+        new_state = self._journal(config, new_state, hold_rec)
+        if new_state != state:
+            self._patch_status(new_state)
+        return new_state
 
     # -- handlers ------------------------------------------------------------
 
@@ -435,6 +603,7 @@ class Reconciler:
     ) -> ReconcileOutcome:
         new_state = state.new_version(mv.version, config.canary.initial_traffic)
         self._reset_hold_dedupe()
+        self._last_scale_hold = None  # frozen rollout: fresh dedupe after
         # Apply + persist BEFORE emitting: if the apply fails persistently,
         # status is unchanged and the next reconcile retries this branch —
         # emitting first would duplicate the event on every retry.
@@ -460,6 +629,13 @@ class Reconciler:
         events.append(ev)
         self.kube.emit_event(self.cr_ref, ev)
         self.log.info(f"New model version detected: {mv.version}")
+
+        # Fresh STABLE deploy (no canary): the autoscaler takes the
+        # topology under control immediately, so a minReplicas floor
+        # above spec.tpu.replicas applies on first deploy rather than
+        # one monitoring interval later.
+        if new_state.phase == Phase.STABLE:
+            new_state = self._autoscale_step(obj, config, new_state, events)
 
         # Canary: go straight to the first gate check (the reference enters
         # its metrics loop immediately after the initial apply, :296-310).
@@ -711,6 +887,10 @@ class Reconciler:
             previous_version=state.previous_version if state.traffic_prev > 0 else None,
             old_model_uri=old_uri,
             traffic_prev=state.traffic_prev,
+            # Autoscaler-controlled count (None = spec topology).  Applies
+            # to every predictor: mid-canary the topology is frozen, so
+            # both versions serve at the same replica count.
+            replicas=state.replicas,
         )
 
     def _apply_for_state(
@@ -922,6 +1102,9 @@ class Reconciler:
         if getattr(self, "_had_journal_keys", False):
             status.setdefault("lastGate", None)
             status.setdefault("history", None)
+        if getattr(self, "_had_scaler_keys", False):
+            status.setdefault("replicas", None)
+            status.setdefault("autoscaler", None)
         status["conditions"] = state.conditions(
             getattr(self, "_prior_conditions", None), now_iso
         )

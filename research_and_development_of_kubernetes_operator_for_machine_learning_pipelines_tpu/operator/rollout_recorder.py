@@ -12,7 +12,9 @@ Every gate evaluation now produces a structured :class:`GateRecord`
 (raw new/old metrics, thresholds, per-check signed margins from
 ``judge.should_promote``, decision + reasons, traffic before/after,
 attempt count, op-timer breakdown) and every rollout phase change a
-:class:`TransitionRecord`.  They surface three ways:
+:class:`TransitionRecord`; the replica autoscaler journals its decisions
+beside them as :class:`~.autoscaler.ScaleRecord` (``kind: "scale"``).
+They surface three ways:
 
 - ``status.lastGate`` / ``status.history`` on the CR itself (opt-in via
   ``spec.observability.historyLimit``; 0 — the default — writes neither
@@ -293,6 +295,29 @@ class RolloutRecorder:
                                 "attempt": r.get("attempt"),
                                 "margins": r.get("margins") or {},
                                 "reasons": r.get("reasons") or [],
+                            },
+                        }
+                    )
+                elif r.get("kind") == "scale":
+                    # Autoscaler decision (operator/autoscaler.py
+                    # ScaleRecord): applied scalings and typed holds.
+                    out.append(
+                        {
+                            "name": (
+                                f"scale {r.get('from')} -> {r.get('to')}"
+                                if r.get("hold") is None
+                                else f"scale hold ({r.get('hold')})"
+                            ),
+                            "cat": "scale",
+                            "ph": "i",
+                            "s": "t",
+                            "ts": ts,
+                            "pid": 1,
+                            "tid": tid,
+                            "args": {
+                                "desired": r.get("desired"),
+                                "reason": r.get("reason"),
+                                "observed": r.get("observed") or {},
                             },
                         }
                     )

@@ -63,6 +63,15 @@ class PromotionState:
     # evaluation; ``history`` a bounded tuple of full gate/phase records.
     last_gate: Any = None
     history: tuple = ()
+    # Replica autoscaling (spec.autoscaling, operator/autoscaler.py).
+    # ``replicas`` is the autoscaler-controlled predictor replica count
+    # (None = autoscaling off, spec.tpu.replicas rules — and both keys
+    # are omitted from to_status(), keeping an unannotated CR's status
+    # byte-for-byte).  ``scaler`` is the hysteresis state dict
+    # (ScalerState.to_status()): wall-clock cooldown/stabilization
+    # anchors that must survive operator restarts.
+    replicas: int | None = None
+    scaler: Any = None
 
     # -- transitions (pure; each returns a new state) -----------------------
 
@@ -80,6 +89,8 @@ class PromotionState:
             error=f"Alias '{alias}' does not exist",
             last_gate=self.last_gate,
             history=self.history,
+            replicas=self.replicas,
+            scaler=self.scaler,
         )
 
     def new_version(self, version: str, initial_traffic: int) -> "PromotionState":
@@ -105,6 +116,8 @@ class PromotionState:
                 traffic_prev=0,
                 last_gate=self.last_gate,
                 history=self.history,
+                replicas=self.replicas,
+                scaler=self.scaler,
             )
         if (
             self.previous_version is not None
@@ -124,6 +137,8 @@ class PromotionState:
                 traffic_prev=0,
                 last_gate=self.last_gate,
                 history=self.history,
+                replicas=self.replicas,
+                scaler=self.scaler,
             )
         return PromotionState(
             phase=Phase.CANARY,
@@ -134,6 +149,12 @@ class PromotionState:
             attempt=0,
             last_gate=self.last_gate,
             history=self.history,
+            # The scaled topology rides into (and through) the rollout
+            # FROZEN: the autoscaler never evaluates mid-canary, so both
+            # predictors serve at the same replica count and the judge
+            # compares like with like.
+            replicas=self.replicas,
+            scaler=self.scaler,
         )
 
     def promoted_step(self, step: int) -> "PromotionState":
@@ -168,6 +189,8 @@ class PromotionState:
             held_version=self.current_version,
             last_gate=self.last_gate,
             history=self.history,
+            replicas=self.replicas,
+            scaler=self.scaler,
         )
 
     # -- serialization ------------------------------------------------------
@@ -271,6 +294,12 @@ class PromotionState:
             status["lastGate"] = self.last_gate
         if self.history:
             status["history"] = list(self.history)
+        # Same contract for the autoscaler keys: absent unless autoscaling
+        # has taken control of the replica count.
+        if self.replicas is not None:
+            status["replicas"] = self.replicas
+        if self.scaler is not None:
+            status["autoscaler"] = dict(self.scaler)
         return status
 
     @classmethod
@@ -309,4 +338,10 @@ class PromotionState:
             error=status.get("error"),
             last_gate=status.get("lastGate"),
             history=tuple(status.get("history") or ()),
+            replicas=(
+                int(status["replicas"])
+                if status.get("replicas") is not None
+                else None
+            ),
+            scaler=status.get("autoscaler"),
         )

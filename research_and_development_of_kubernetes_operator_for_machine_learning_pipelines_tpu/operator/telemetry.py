@@ -146,6 +146,37 @@ class OperatorTelemetry:
             ident,
             registry=self.registry,
         )
+        # Replica-autoscaler decision series (fed from
+        # ReconcileOutcome.scale and the post-step state; no samples
+        # until a CR enables spec.autoscaling).
+        self.autoscale_replicas = Gauge(
+            "tpumlops_operator_autoscale_replicas",
+            "Autoscaler-controlled replica count of the current version "
+            "(absent while spec.autoscaling is disabled)",
+            ident,
+            registry=self.registry,
+        )
+        self.autoscale_desired = Gauge(
+            "tpumlops_operator_autoscale_desired_replicas",
+            "Replica count the last autoscaler evaluation wanted before "
+            "hysteresis (> replicas = scale-up pending stabilization; "
+            "< replicas = scale-down pending cooldown)",
+            ident,
+            registry=self.registry,
+        )
+        self.autoscale_events = Counter(
+            "tpumlops_operator_autoscale_events_total",
+            "Applied replica scalings by direction",
+            ident + ["direction"],
+            registry=self.registry,
+        )
+        self.autoscale_holds = Counter(
+            "tpumlops_operator_autoscale_holds_total",
+            "Autoscaler evaluations held back, by reason (cooldown / "
+            "stabilization / metrics_missing)",
+            ident + ["reason"],
+            registry=self.registry,
+        )
         self.rollout_seconds = Histogram(
             "tpumlops_operator_rollout_duration_seconds",
             "Wall time from NEW_VERSION detection to a terminal phase "
@@ -213,6 +244,30 @@ class OperatorTelemetry:
                         self.gate_margin.remove(namespace, name, check)
                     except KeyError:
                         pass
+        scale = getattr(outcome, "scale", None)
+        if state.replicas is not None:
+            self._child(self.autoscale_replicas, namespace, name).set(
+                state.replicas
+            )
+        elif (namespace, name) in self._series:
+            # Autoscaling just disabled: stop exporting a stale count.
+            for metric in (self.autoscale_replicas, self.autoscale_desired):
+                try:
+                    metric.remove(namespace, name)
+                except KeyError:
+                    pass
+        if scale is not None:
+            self._child(self.autoscale_desired, namespace, name).set(
+                scale.desired
+            )
+            if scale.applied:
+                self._child(
+                    self.autoscale_events, namespace, name, scale.direction
+                ).inc()
+            elif scale.hold is not None:
+                self._child(
+                    self.autoscale_holds, namespace, name, scale.hold
+                ).inc()
         # Rollout duration: arm on canary start, observe on terminal.
         key = (namespace, name)
         if "NewModelVersionDetected" in reasons and state.phase == Phase.CANARY:

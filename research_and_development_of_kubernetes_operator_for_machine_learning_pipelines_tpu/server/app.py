@@ -35,6 +35,7 @@ from aiohttp import web
 from ..utils.config import ServerConfig, TpuSpec
 from .batching import DynamicBatcher
 from .engine import InferenceEngine
+from .generation import EngineOverloaded
 from .loader import load_predictor
 from .metrics import ServerMetrics
 
@@ -138,11 +139,23 @@ class TpuInferenceServer:
         gen_engine=None,
         max_inflight_batches: int = 2,
         recorder=None,
+        drain_grace_s: float = 20.0,
     ):
         self.engine = engine
         self.metrics = metrics
         self.model_name = model_name
-        self.ready = False
+        # Single source of truth for the serving lifecycle: loading ->
+        # ready -> draining -> shutdown.  /readyz, /v2/health/ready (the
+        # manifest's readiness-probe path — same handler), the drain
+        # protocol, and the SIGTERM path all read/write THIS field; there
+        # is no second "ready" boolean anywhere to fall out of sync.
+        self.lifecycle = "loading"
+        self.drain_grace_s = float(drain_grace_s)
+        # Set by the SIGTERM path: the process is irrevocably exiting,
+        # so a drain can no longer be cancelled (an unauthenticated
+        # cancel re-opening admissions on a dying pod would route fresh
+        # traffic straight into the teardown's EngineShutdown).
+        self.terminating = False
         self.gen_engine = gen_engine  # GenerationEngine for causal-LM flavors
         self.recorder = recorder  # flight_recorder.FlightRecorder | None
         import threading
@@ -162,17 +175,80 @@ class TpuInferenceServer:
 
     # -- lifecycle -----------------------------------------------------------
 
+    @property
+    def ready(self) -> bool:
+        """Back-compat view of the lifecycle (probes read this)."""
+        return self.lifecycle == "ready"
+
+    @ready.setter
+    def ready(self, value: bool) -> None:
+        # Legacy writers (SIGTERM path, tests) flip a boolean; map it
+        # onto the lifecycle without ever resurrecting a shutdown server.
+        if value:
+            self.lifecycle = "ready"
+        elif self.lifecycle == "ready":
+            self.lifecycle = "draining"
+
     def startup(self, warmup: bool = True) -> None:
         if warmup:
             self.engine.warmup()
         if self.gen_engine is not None:
             self.gen_engine.start(warmup=warmup)
         self.batcher.start()
-        self.ready = True
+        self.lifecycle = "ready"
         self.metrics.ready.labels(**self.metrics.identity).set(1)
 
+    def begin_drain(self) -> None:
+        """Enter the lossless-drain state: readiness flips (kubelet and
+        balancers stop routing here), the generation engine sheds NEW
+        submissions with 429 + Retry-After, and everything already
+        admitted — queued, mid-prefill, decoding, streaming — runs to
+        completion.
+
+        Idempotent, and deliberately NOT guarded on lifecycle ==
+        "draining": the SIGTERM path flips ``ready = False`` first (the
+        endpoint-removal lag keeps ADMITTING while NotReady), which
+        already reads as "draining" — an early-return there would skip
+        arming the engine and the drain would never shed or complete.
+        Only a shut-down server is past draining."""
+        if self.lifecycle == "shutdown":
+            return
+        self.lifecycle = "draining"
+        self.metrics.ready.labels(**self.metrics.identity).set(0)
+        if self.gen_engine is not None:
+            self.gen_engine.begin_drain()
+
+    def cancel_drain(self) -> bool:
+        """Reverse a drain (``POST /admin/drain {"cancel": true}``): the
+        engine admits again and readiness returns.  The escape hatch
+        that keeps the unauthenticated drain endpoint from being a
+        one-way kill switch — a stray or mistaken drain is repairable
+        without a pod restart.  Refused (False) once the process is
+        terminating (SIGTERM already committed to exit) or shut down."""
+        if self.terminating:
+            return False
+        if self.lifecycle != "draining":
+            return self.lifecycle == "ready"
+        if self.gen_engine is not None:
+            self.gen_engine.cancel_drain()
+        self.lifecycle = "ready"
+        self.metrics.ready.labels(**self.metrics.identity).set(1)
+        return True
+
+    async def wait_drained(self, grace_s: float | None = None) -> bool:
+        """Await in-flight completion (bounded by ``grace_s``); True when
+        the engine owes no sequence another token."""
+        grace = self.drain_grace_s if grace_s is None else float(grace_s)
+        deadline = time.monotonic() + max(0.0, grace)
+        while True:
+            if self.gen_engine is None or self.gen_engine.drained():
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(0.05)
+
     def shutdown(self) -> None:
-        self.ready = False
+        self.lifecycle = "shutdown"
         self.batcher.stop()
         if self.gen_engine is not None:
             self.gen_engine.shutdown()
@@ -418,6 +494,14 @@ class TpuInferenceServer:
                     code = codebox["code"]
             from .flight_recorder import RequestTrace
 
+            # Admission control: reserve the WHOLE request's estimated
+            # tokens up front, so it is admitted whole or shed whole —
+            # a 429 must never leave earlier siblings generating into
+            # abandoned futures.  Raises EngineOverloaded (-> 429 below)
+            # before anything is enqueued.
+            self.gen_engine.reserve_admission(
+                sum(int(p.size) + max_new for p in prompts)
+            )
             traces = [
                 RequestTrace(
                     request_id=rid if len(prompts) == 1 else f"{rid}/{i}"
@@ -430,6 +514,7 @@ class TpuInferenceServer:
                     **{**sampling, "seed": row_seed(i)},
                     request_id=traces[i].request_id,
                     trace=traces[i],
+                    est_reserved=True,
                 )
                 for i, p in enumerate(prompts)
             ]
@@ -454,6 +539,21 @@ class TpuInferenceServer:
             if debug:
                 payload["timing"] = summary
             return web.json_response(payload)
+        except EngineOverloaded as e:
+            # Shed contract: 429 + Retry-After, body naming the typed
+            # reason ("budget" under load, "draining" during scale-down
+            # / shutdown).  Nothing reached the engine — clients retry
+            # verbatim on another replica.
+            code = 429
+            return web.json_response(
+                {
+                    "error": str(e),
+                    "reason": e.reason,
+                    "retry_after_s": e.retry_after_s,
+                },
+                status=429,
+                headers={"Retry-After": str(e.retry_after_s)},
+            )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             code = 400
             return web.json_response({"error": str(e)}, status=400)
@@ -680,11 +780,66 @@ class TpuInferenceServer:
         return web.json_response({"spans": GLOBAL_TRACER.as_dict()})
 
     async def handle_live(self, request: web.Request) -> web.Response:
-        return web.json_response({"live": True})
+        # Live through loading AND draining: kubelet must not kill a pod
+        # that is busy finishing its in-flight request tail.
+        return web.json_response(
+            {"live": self.lifecycle != "shutdown", "lifecycle": self.lifecycle},
+            status=200 if self.lifecycle != "shutdown" else 503,
+        )
 
     async def handle_ready(self, request: web.Request) -> web.Response:
-        status = 200 if self.ready else 503
-        return web.json_response({"ready": self.ready}, status=status)
+        """The lifecycle endpoint (``/readyz``; ``/v2/health/ready`` is
+        the same handler, which is what the builder's readiness-probe
+        stanza points at): 200 only in the ``ready`` state — loading,
+        draining, and shutdown all 503 so balancers route elsewhere —
+        with the state named in the body either way."""
+        status = 200 if self.lifecycle == "ready" else 503
+        body = {"ready": self.lifecycle == "ready", "lifecycle": self.lifecycle}
+        if self.lifecycle == "draining" and self.gen_engine is not None:
+            body["inFlight"] = self.gen_engine.inflight()
+        return web.json_response(body, status=status)
+
+    async def handle_admin_drain(self, request: web.Request) -> web.Response:
+        """``POST /admin/drain``: the lossless scale-down protocol.
+
+        Stops admissions (new /generate requests shed 429 + Retry-After),
+        flips ``/readyz`` to draining, then waits — bounded by
+        ``grace_s`` (default ``--drain-grace-seconds``) — for every
+        admitted sequence, SSE streams included, to finish.  Returns the
+        final state; the caller (autoscaler teardown, preStop hook, an
+        operator's kubectl) deletes the pod only after ``drained`` is
+        true.  SIGTERM runs the same protocol.
+        """
+        try:
+            body = await request.json() if request.can_read_body else {}
+            if not isinstance(body, dict):
+                raise ValueError("drain body must be a JSON object")
+            grace = float(body.get("grace_s", self.drain_grace_s))
+            if not (0.0 <= grace <= 3600.0):
+                raise ValueError(
+                    f"grace_s must be in [0, 3600], got {grace}"
+                )
+            cancel = bool(body.get("cancel", False))
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if cancel:
+            restored = self.cancel_drain()
+            return web.json_response(
+                {"lifecycle": self.lifecycle, "cancelled": restored},
+                status=200 if restored else 409,
+            )
+        self.begin_drain()
+        drained = await self.wait_drained(grace)
+        inflight = (
+            self.gen_engine.inflight() if self.gen_engine is not None else 0
+        )
+        return web.json_response(
+            {
+                "lifecycle": self.lifecycle,
+                "drained": drained,
+                "inFlight": inflight,
+            }
+        )
 
     async def handle_model_metadata(self, request: web.Request) -> web.Response:
         p = self.engine.predictor
@@ -708,6 +863,12 @@ class TpuInferenceServer:
         name = self.model_name
         app.router.add_get("/v2/health/live", self.handle_live)
         app.router.add_get("/v2/health/ready", self.handle_ready)
+        # Canonical lifecycle endpoint — same handler as the V2 ready
+        # route above, so the manifest probe and the drain protocol read
+        # one truth.
+        app.router.add_get("/readyz", self.handle_ready)
+        app.router.add_get("/livez", self.handle_live)
+        app.router.add_post("/admin/drain", self.handle_admin_drain)
         app.router.add_get(f"/v2/models/{name}", self.handle_model_metadata)
         app.router.add_get(f"/v2/models/{name}/ready", self.handle_ready)
         app.router.add_post(f"/v2/models/{name}/infer", self.handle_v2_infer)
@@ -876,6 +1037,10 @@ def make_gen_engine(
         # Leader-side only: the scheduler (and so the journal) runs on
         # the leader; follower processes replay device ops blind.
         recorder=recorder,
+        # Admission control (leader-side: followers never take
+        # submissions): shed past the queued-token budget, 429 upstream.
+        admission_queue_budget=config.tpu.admission_queue_budget,
+        on_shed=metrics.inc_shed if metrics else None,
     )
 
 
@@ -934,6 +1099,7 @@ def build_server(
         gen_engine=gen_engine,
         max_inflight_batches=config.tpu.max_inflight_batches,
         recorder=recorder,
+        drain_grace_s=config.tpu.drain_grace_s,
     )
     server.startup(warmup=warmup)
     return server
@@ -988,7 +1154,25 @@ def main(argv: list[str] | None = None) -> None:
         type=float,
         default=3.0,
         help="seconds to keep serving (NotReady) after SIGTERM before "
-        "teardown, so rolling steps don't 503 their request tail",
+        "the in-flight drain begins, so rolling steps don't 503 the "
+        "request tail still being routed here",
+    )
+    ap.add_argument(
+        "--admission-queue-budget",
+        type=int,
+        default=0,
+        help="estimated-token bound (prompt + max_new) on queued-but-"
+        "unadmitted generation work; beyond it /generate sheds with "
+        "429 + Retry-After (tpumlops_engine_shed_total counts them). "
+        "0 = unbounded (the pre-admission-control behavior)",
+    )
+    ap.add_argument(
+        "--drain-grace-seconds",
+        type=float,
+        default=20.0,
+        help="lossless-drain window: seconds SIGTERM / POST /admin/drain "
+        "waits for in-flight sequences (SSE streams included) to finish "
+        "after admissions stop, before teardown",
     )
     ap.add_argument(
         "--prefill-chunk",
@@ -1140,6 +1324,8 @@ def main(argv: list[str] | None = None) -> None:
                     "adaptive": bool(args.speculative_adaptive),
                 },
                 "observability": {"traceRing": args.trace_ring},
+                "admissionQueueBudget": args.admission_queue_budget,
+                "drainGraceSeconds": args.drain_grace_seconds,
             }
         ),
     )
@@ -1210,19 +1396,34 @@ def main(argv: list[str] | None = None) -> None:
             except (NotImplementedError, RuntimeError):  # non-main thread
                 pass
         await stop.wait()
-        # Drain before teardown.  The work here is done by the SLEEP:
-        # Kubernetes removes a Terminating pod from endpoints while we keep
-        # serving the tail of in-flight/raced requests — without the window
-        # every rolling canary step 503s that tail, which the gate reads as
-        # an error-rate spike on whichever version was being replaced.
-        # Flipping readiness is supplementary (it answers kubelet probes and
-        # any readiness-polling balancer during LONG drains; the default
-        # probe needs minutes of failures to act within a 3s window).
+        # Lossless drain before teardown, in two phases.
+        #
+        # Phase 1 (--drain-s): keep ADMITTING while NotReady.  Kubernetes
+        # removes a Terminating pod from endpoints asynchronously, so for
+        # a short window traffic is still routed here; without accepting
+        # that tail every rolling canary step 503s it, which the gate
+        # reads as an error-rate spike on whichever version was being
+        # replaced.
         server.ready = False
         _log.info(
-            "termination signal; draining %.1fs before shutdown", args.drain_s
+            "termination signal; endpoint lag %.1fs before drain",
+            args.drain_s,
         )
         await asyncio.sleep(max(0.0, args.drain_s))
+        # Phase 2 (--drain-grace-seconds): stop admissions — new
+        # /generate requests shed 429 + Retry-After so clients go to
+        # another replica — and wait for every admitted sequence (SSE
+        # streams included) to finish.  Scale-down and rollout teardown
+        # never drop a request.
+        server.terminating = True  # a committed exit: cancel refused
+        server.begin_drain()
+        drained = await server.wait_drained(args.drain_grace_seconds)
+        if not drained and server.gen_engine is not None:
+            _log.warning(
+                "drain grace %.1fs expired with %d sequence(s) in flight",
+                args.drain_grace_seconds,
+                server.gen_engine.inflight(),
+            )
         await runner.cleanup()  # fires on_shutdown -> server.shutdown()
 
     try:

@@ -63,6 +63,27 @@ class EngineShutdown(RuntimeError):
     ``CancelledError`` (or a hang on a future nobody will resolve)."""
 
 
+class EngineOverloaded(RuntimeError):
+    """Admission shed: the request was refused at the door.
+
+    Raised SYNCHRONOUSLY from :meth:`GenerationEngine.submit` /
+    :meth:`GenerationEngine.reserve_admission` — nothing was enqueued —
+    either because accepting the request would push the estimated tokens
+    (prompt + max_new) of queued-but-unadmitted work past the admission
+    budget, or because the engine is draining for shutdown/scale-down.
+    The HTTP layer maps it to ``429`` with a ``Retry-After`` header so
+    clients (and the router) retry on another replica; shed is the
+    loss-free pressure valve that keeps admitted requests' TTFT bounded
+    while the autoscaler boots more capacity.
+    """
+
+    def __init__(self, message: str, reason: str = "budget",
+                 retry_after_s: int = 1):
+        super().__init__(message)
+        self.reason = reason  # "budget" | "draining"
+        self.retry_after_s = int(retry_after_s)
+
+
 def _safe_resolve(fut: Future, value) -> None:
     """set_result tolerating a concurrent client-side cancel (TOCTOU: the
     cancelled() check and set_result are not atomic across threads)."""
@@ -201,6 +222,10 @@ class _Request:
     t_submit: float = 0.0  # perf_counter at submit (admission-wait / TTFT)
     request_id: str = ""  # inbound X-Request-Id / traceparent (or generated)
     trace: "object | None" = None  # flight_recorder.RequestTrace | None
+    # Estimated tokens (prompt + max_new) this request holds against the
+    # admission budget while queued; released exactly once at dequeue
+    # (0 = nothing reserved, e.g. budget disabled).
+    est_tokens: int = 0
 
 
 class GenerationEngine:
@@ -239,6 +264,8 @@ class GenerationEngine:
         on_request_tokens: Callable[[int], None] | None = None,
         on_tick: Callable[[str, float], None] | None = None,
         recorder=None,  # flight_recorder.FlightRecorder | None
+        admission_queue_budget: int = 0,
+        on_shed: Callable[[str], None] | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -692,6 +719,23 @@ class GenerationEngine:
         self._queue: queue.Queue[_Request | None] = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Admission control (the data-plane half of the autoscaling
+        # subsystem): a token-denominated bound on queued-but-unadmitted
+        # work.  0 (default) = unbounded, the old admission behavior
+        # byte-for-byte — submit still takes the lock, but only to read
+        # a flag that is always False.
+        self._admission_budget = int(admission_queue_budget or 0)
+        if self._admission_budget < 0:
+            raise ValueError(
+                "admission_queue_budget must be >= 0, got "
+                f"{admission_queue_budget}"
+            )
+        self._adm_lock = threading.Lock()
+        self._queued_est_tokens = 0
+        self._inflight_reqs = 0  # submitted futures not yet done
+        self._draining = False
+        self._on_shed = on_shed
+        self.shed_total = 0  # sheds by any reason (bench/metrics mirror)
         self.tokens_generated = 0
         # Prefix-cache observability (also read by bench.py's shared-prefix
         # scenario and the Prometheus hookups in app.make_gen_engine).
@@ -917,6 +961,8 @@ class GenerationEngine:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
+            if req is not None:
+                self._release_queued(req)
             if req is not None and not req.future.done():
                 # Queued-but-unadmitted: a clear EngineShutdown beats a
                 # bare CancelledError — callers can distinguish "the
@@ -940,6 +986,98 @@ class GenerationEngine:
         if self._recorder is not None:
             self._recorder.event(trace.request_id, "finish", slot=trace.slot)
             self._recorder.complete(trace)
+
+    # -- admission control / drain (client-facing) ---------------------------
+
+    def reserve_admission(self, est_tokens: int) -> None:
+        """Reserve queue room for ``est_tokens`` or shed.
+
+        Raises :class:`EngineOverloaded` when the engine is draining, or
+        when the reservation would push queued-but-unadmitted estimated
+        tokens past the admission budget; otherwise the tokens are
+        counted (released exactly once when the scheduler dequeues the
+        carrying request).  Callers batching several prompts into one
+        HTTP request reserve the TOTAL up front, so a request is
+        admitted whole or shed whole — never half-admitted with
+        siblings generating into abandoned futures.
+        """
+        with self._adm_lock:
+            if self._draining:
+                self._note_shed("draining")
+                raise EngineOverloaded(
+                    "engine is draining; retry on another replica",
+                    reason="draining",
+                    retry_after_s=1,
+                )
+            budget = self._admission_budget
+            # The budget bounds the BACKLOG, not request size: with the
+            # queue empty, any request validate() allowed is admitted —
+            # otherwise a single request whose estimate alone exceeds
+            # the budget would shed identically on every replica, a
+            # deterministic fleet-wide 429 outage for work the engine
+            # could run directly.
+            if (
+                budget
+                and self._queued_est_tokens > 0
+                and self._queued_est_tokens + est_tokens > budget
+            ):
+                self._note_shed("budget")
+                raise EngineOverloaded(
+                    f"admission queue full: {self._queued_est_tokens} "
+                    f"estimated tokens queued + {est_tokens} requested "
+                    f"> budget {budget}; retry on another replica",
+                    reason="budget",
+                    retry_after_s=1,
+                )
+            self._queued_est_tokens += est_tokens
+
+    def _note_shed(self, reason: str) -> None:
+        # _adm_lock held: counter mutations stay consistent with the
+        # decision that produced them.
+        self.shed_total += 1
+        if self._on_shed is not None:
+            self._on_shed(reason)
+
+    def _release_queued(self, req: _Request) -> None:
+        """Return a dequeued request's reservation (idempotent)."""
+        if req.est_tokens:
+            with self._adm_lock:
+                self._queued_est_tokens -= req.est_tokens
+            req.est_tokens = 0
+
+    def begin_drain(self) -> None:
+        """Stop admissions: every later submit sheds with 429-mapped
+        :class:`EngineOverloaded`; already-queued and in-flight
+        sequences run to completion (that is what makes the drain
+        lossless).  The scheduler loop keeps ticking until
+        :meth:`shutdown`."""
+        with self._adm_lock:
+            self._draining = True
+
+    def cancel_drain(self) -> None:
+        """Reopen admissions (an operator cancelled the drain); nothing
+        in flight was disturbed, so this is just the flag."""
+        with self._adm_lock:
+            self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def inflight(self) -> int:
+        """Submitted sequences whose futures are not yet done.
+
+        Counted at the future boundary, NOT by summing queue + pending +
+        slots: a request being moved between those structures on the
+        scheduler thread would transiently vanish from a structural sum,
+        and a drain waiter hitting that gap would tear the server down
+        with work in flight — the one request the drain exists to save.
+        """
+        with self._adm_lock:
+            return self._inflight_reqs
+
+    def drained(self) -> bool:
+        return self._draining and self.inflight() == 0
 
     # -- client API ----------------------------------------------------------
 
@@ -1011,10 +1149,18 @@ class GenerationEngine:
         on_token: Callable[[int], None] | None = None,
         request_id: str = "",
         trace=None,  # flight_recorder.RequestTrace | None
+        est_reserved: bool = False,
     ) -> Future:
         prompt = self.validate(
             prompt_ids, max_new_tokens, temperature, top_k, top_p, seed
         )
+        # Admission control: shed BEFORE anything is enqueued (429 at
+        # the door, never a half-admitted request).  est_reserved=True
+        # means the caller already took the whole multi-prompt request's
+        # reservation through reserve_admission.
+        est = int(prompt.size) + int(max_new_tokens)
+        if not est_reserved:
+            self.reserve_admission(est)
         fut: Future = Future()
         # None means "use the engine default"; 0 is a legitimate eos token.
         eos = self._eos_default if eos_id is None else eos_id
@@ -1026,6 +1172,9 @@ class GenerationEngine:
                 trace.request_id = request_id
             if self._recorder is not None:
                 self._recorder.event(trace.request_id, "enqueued")
+        with self._adm_lock:
+            self._inflight_reqs += 1
+        fut.add_done_callback(self._note_request_done)
         self._queue.put(
             _Request(
                 prompt,
@@ -1040,9 +1189,19 @@ class GenerationEngine:
                 t_submit=t_submit,
                 request_id=request_id,
                 trace=trace,
+                # Always the reservation size: every submit reserved
+                # (itself or via the caller's batch reserve_admission),
+                # and the dequeue-side release must mirror it exactly.
+                est_tokens=est,
             )
         )
         return fut
+
+    def _note_request_done(self, _fut: Future) -> None:
+        # Fires exactly once per submitted future (result, exception, or
+        # cancel) — the drain waiter's in-flight count lives here.
+        with self._adm_lock:
+            self._inflight_reqs -= 1
 
     def generate(
         self,
@@ -2237,6 +2396,8 @@ class GenerationEngine:
                 req = self._queue.get(block=idle, timeout=1.0)
             except queue.Empty:
                 break
+            if req is not None:
+                self._release_queued(req)  # left the admission queue
             if req is None or self._stop.is_set():
                 # A real request dequeued during shutdown is in neither
                 # the queue nor a slot — fail it here or its client
@@ -2277,6 +2438,8 @@ class GenerationEngine:
                 req = self._queue.get(block=idle and not popped, timeout=1.0)
             except queue.Empty:
                 break
+            if req is not None:
+                self._release_queued(req)  # left the admission queue
             if req is None or self._stop.is_set():
                 if req is not None and not req.future.done():
                     _safe_fail(

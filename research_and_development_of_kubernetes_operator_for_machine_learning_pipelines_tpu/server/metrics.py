@@ -272,6 +272,18 @@ class ServerMetrics:
             buckets=(0.0, 0.25, 0.5, 0.75, 0.999, 1.0),
             registry=self.registry,
         )
+        # Admission control (server/generation.py admission_queue_budget
+        # + the drain protocol): requests refused at the door with
+        # 429 + Retry-After.  reason="budget" = queued estimated tokens
+        # over budget; reason="draining" = scale-down / shutdown drain
+        # in progress.  The autoscaler watches this family to confirm
+        # shed (not silence) is what a saturated replica produces.
+        self.shed = Counter(
+            "tpumlops_engine_shed",
+            "Generation requests shed at admission (HTTP 429)",
+            ident_labels + ["reason"],
+            registry=self.registry,
+        )
         self.ready = Gauge(
             "tpumlops_model_ready",
             "1 once the model is loaded and warmed",
@@ -337,6 +349,9 @@ class ServerMetrics:
         self.engine_active_slots.labels(**self.identity).set(active_slots)
         self.engine_queue_depth.labels(**self.identity).set(queue_depth)
         self.engine_admitting.labels(**self.identity).set(admitting)
+
+    def inc_shed(self, reason: str):
+        self.shed.labels(**self.identity, reason=reason).inc()
 
     def observe_prefill_batch(self, fill: int):
         self.prefill_batch_fill.labels(**self.identity).observe(fill)

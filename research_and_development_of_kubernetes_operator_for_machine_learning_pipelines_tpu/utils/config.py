@@ -205,6 +205,34 @@ def _parse_prefill_chunk(value) -> int | None:
     return chunk
 
 
+def _parse_admission_budget(value) -> int:
+    """``spec.tpu.admissionQueueBudget``: estimated-token bound on
+    queued-but-unadmitted generation work (0 = unbounded, the old
+    behavior byte-for-byte); beyond it the server sheds with 429."""
+    budget = int(value) if value is not None else 0
+    if budget < 0:
+        raise ValueError(
+            f"spec.tpu.admissionQueueBudget must be >= 0, got {value!r}"
+        )
+    return budget
+
+
+def _parse_drain_grace(value) -> float:
+    """``spec.tpu.drainGraceSeconds``: in-flight completion bound of the
+    lossless drain protocol (SIGTERM / POST /admin/drain).
+
+    Default 20: with the 3s endpoint-removal lag it fits inside
+    Kubernetes' DEFAULT 30s terminationGracePeriodSeconds with margin —
+    a default-config drain must never be SIGKILLed mid-flight.  Larger
+    values make the builder emit a matching pod grace override."""
+    grace = float(value) if value is not None else 20.0
+    if grace < 0:
+        raise ValueError(
+            f"spec.tpu.drainGraceSeconds must be >= 0, got {value!r}"
+        )
+    return grace
+
+
 @dataclass(frozen=True)
 class PrefixCacheSpec:
     """``spec.tpu.prefixCache``: radix-tree prompt-prefix KV reuse.
@@ -355,6 +383,104 @@ class ObservabilitySpec:
 
 
 @dataclass(frozen=True)
+class AutoscalingSpec:
+    """``spec.autoscaling``: SLO-driven horizontal replica scaling.
+
+    The autoscaler (``operator/autoscaler.py``) reads the stable
+    predictor's engine saturation signals — queue depth, admission wait,
+    TTFT p95 — from the CR's Prometheus and sizes ``replicas`` between
+    ``min_replicas`` and ``max_replicas``:
+
+    - ``target_queue_depth_per_replica``: desired replicas =
+      ceil(total queue depth / target) — the primary saturation signal;
+    - ``target_ttft_seconds``: a TTFT p95 above this adds one replica
+      even when the queue target is met (latency pressure without a
+      visible backlog, e.g. long prompts);
+    - asymmetric hysteresis: scale-up jumps straight to the desired
+      count once the demand has persisted ``scale_up_stabilization_s``
+      (0 = immediately); scale-down steps ONE replica at a time and only
+      after ``scale_down_cooldown_s`` since the last scale event in
+      either direction.
+
+    Disabled (the default) keeps manifests, status patches, and engine
+    admission behavior byte-for-byte what they were.
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_queue_depth_per_replica: float = 0.0  # <= 0: signal unused
+    target_ttft_seconds: float = 0.0  # <= 0: signal unused
+    scale_up_stabilization_s: float = 0.0
+    scale_down_cooldown_s: float = 300.0
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "AutoscalingSpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec,
+            frozenset(
+                {
+                    "enabled", "minReplicas", "maxReplicas",
+                    "targetQueueDepthPerReplica", "targetTTFTSeconds",
+                    "scaleUpStabilizationSeconds",
+                    "scaleDownCooldownSeconds",
+                }
+            ),
+            "spec.autoscaling",
+        )
+        return cls(
+            enabled=bool(spec.get("enabled", False)),
+            min_replicas=int(spec.get("minReplicas", 1)),
+            max_replicas=int(spec.get("maxReplicas", 1)),
+            target_queue_depth_per_replica=float(
+                spec.get("targetQueueDepthPerReplica", 0.0)
+            ),
+            target_ttft_seconds=float(spec.get("targetTTFTSeconds", 0.0)),
+            scale_up_stabilization_s=float(
+                spec.get("scaleUpStabilizationSeconds", 0.0)
+            ),
+            scale_down_cooldown_s=float(
+                spec.get("scaleDownCooldownSeconds", 300.0)
+            ),
+        )
+
+    def __post_init__(self):
+        # Contradictory specs are rejected at reconcile time so they land
+        # in CR status, not as an autoscaler oscillating or parked.
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"autoscaling.minReplicas must be >= 1, got "
+                f"{self.min_replicas}"
+            )
+        if self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f"autoscaling.minReplicas {self.min_replicas} > "
+                f"maxReplicas {self.max_replicas}"
+            )
+        if self.scale_up_stabilization_s < 0:
+            raise ValueError(
+                "autoscaling.scaleUpStabilizationSeconds must be >= 0, "
+                f"got {self.scale_up_stabilization_s}"
+            )
+        if self.scale_down_cooldown_s < 0:
+            raise ValueError(
+                "autoscaling.scaleDownCooldownSeconds must be >= 0, got "
+                f"{self.scale_down_cooldown_s}"
+            )
+        if (
+            self.enabled
+            and self.target_queue_depth_per_replica <= 0
+            and self.target_ttft_seconds <= 0
+        ):
+            raise ValueError(
+                "autoscaling.enabled requires a scaling target: set "
+                "targetQueueDepthPerReplica > 0 and/or "
+                "targetTTFTSeconds > 0"
+            )
+
+
+@dataclass(frozen=True)
 class RolloutObservability:
     """``spec.observability``: rollout decision-journal surfacing on the CR.
 
@@ -451,6 +577,20 @@ class TpuSpec:
     # |length buckets| cold compiles; buys zero first-hit compile stalls
     # even with a cold persistent cache.
     warmup_full_grid: bool = False
+    # Server-side admission control: shed /generate submissions with
+    # 429 + Retry-After once the estimated tokens (prompt + max_new) of
+    # queued-but-unadmitted work would exceed this budget.  0 (default)
+    # = unbounded queue, byte-for-byte the old admission behavior.
+    # Sheds keep p99 TTFT bounded under overload and give the replica
+    # autoscaler a loss-free pressure valve while new replicas boot.
+    admission_queue_budget: int = 0
+    # Lossless-drain window: on SIGTERM / POST /admin/drain the server
+    # stops admissions (new requests shed 429), flips /readyz, and waits
+    # up to this many seconds for in-flight sequences to finish before
+    # teardown — scale-down and rollout teardown never drop a request.
+    # 20 (not 30): + the 3s endpoint lag it fits Kubernetes' default
+    # 30s termination grace; larger values emit a pod grace override.
+    drain_grace_s: float = 20.0
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
@@ -464,7 +604,8 @@ class TpuSpec:
                     "maxInflightBatches", "compileCacheDir", "quantize",
                     "prefillChunk", "prefillBatch", "prefillTokenBudget",
                     "prefixCache", "speculative", "observability",
-                    "warmupFullGrid",
+                    "warmupFullGrid", "admissionQueueBudget",
+                    "drainGraceSeconds",
                 }
             ),
             "spec.tpu",
@@ -511,6 +652,10 @@ class TpuSpec:
                 spec.get("observability")
             ),
             warmup_full_grid=bool(spec.get("warmupFullGrid", False)),
+            admission_queue_budget=_parse_admission_budget(
+                spec.get("admissionQueueBudget")
+            ),
+            drain_grace_s=_parse_drain_grace(spec.get("drainGraceSeconds")),
         )
 
     @property
@@ -562,6 +707,9 @@ class OperatorConfig:
     observability: RolloutObservability = field(
         default_factory=RolloutObservability
     )
+    # SLO-driven replica autoscaling (operator/autoscaler.py); disabled
+    # default = manifests and status byte-for-byte unchanged.
+    autoscaling: AutoscalingSpec = field(default_factory=AutoscalingSpec)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
@@ -573,6 +721,7 @@ class OperatorConfig:
         if backend not in ("seldon", "tpu"):
             raise ValueError(f"spec.backend must be 'seldon' or 'tpu', got {backend!r}")
         tpu = TpuSpec.from_spec(spec.get("tpu"))
+        autoscaling = AutoscalingSpec.from_spec(spec.get("autoscaling"))
         if backend == "tpu":
             info = TPU_TOPOLOGIES.get(tpu.topology)
             if info is None:
@@ -594,6 +743,17 @@ class OperatorConfig:
                     "unit per predictor version; scale out with more "
                     "MlflowModel CRs or a larger slice"
                 )
+            if info.hosts > 1 and autoscaling.max_replicas > 1:
+                # Same constraint the builder enforces for replicas > 1:
+                # a multi-host unit is one StatefulSet per predictor, so
+                # the autoscaler cannot fan it out either.
+                raise ValueError(
+                    f"autoscaling.maxReplicas={autoscaling.max_replicas} "
+                    f"with multi-host topology {tpu.topology!r} is not "
+                    "supported: one worker unit per predictor version; "
+                    "scale out with more MlflowModel CRs or a larger "
+                    "slice"
+                )
         return cls(
             model_name=str(model_name),
             model_alias=str(model_alias),
@@ -611,4 +771,5 @@ class OperatorConfig:
             observability=RolloutObservability.from_spec(
                 spec.get("observability")
             ),
+            autoscaling=autoscaling,
         )

@@ -1,0 +1,478 @@
+"""Replica autoscaler: pure decision logic (operator/autoscaler.py) and
+the reconciler integration — scale records in the journal, frozen
+topology during a canary, byte-identical status/manifests when disabled.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpumlops.clients.base import EngineMetrics, ObjectRef, MLFLOWMODEL
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator.autoscaler import (
+    HOLD_COOLDOWN,
+    HOLD_METRICS_MISSING,
+    HOLD_STABILIZATION,
+    ScalerState,
+    decide,
+)
+from tpumlops.operator.reconciler import Reconciler
+from tpumlops.operator.state import Phase, PromotionState
+from tpumlops.utils.clock import FakeClock
+from tpumlops.utils.config import AutoscalingSpec
+
+
+def spec(**kw) -> AutoscalingSpec:
+    base = dict(
+        enabled=True,
+        min_replicas=1,
+        max_replicas=8,
+        target_queue_depth_per_replica=4.0,
+        scale_up_stabilization_s=0.0,
+        scale_down_cooldown_s=60.0,
+    )
+    base.update(kw)
+    return AutoscalingSpec(**base)
+
+
+def metrics(qd=None, ttft=None, wait=None) -> EngineMetrics:
+    return EngineMetrics(
+        queue_depth=qd, admission_wait_p95_ms=wait, ttft_p95_s=ttft
+    )
+
+
+# ---------------------------------------------------------------------------
+# decide(): pure hysteresis logic
+# ---------------------------------------------------------------------------
+
+
+def test_scale_up_jumps_straight_to_demand():
+    """Fast up: 17 queued at 4-per-replica wants ceil(17/4)=5; one
+    decision goes 1 -> 5, not one replica per evaluation."""
+    d = decide(spec(), 1, ScalerState(), metrics(qd=17), now_wall=1000.0)
+    assert d.replicas == 5
+    assert d.record is not None and d.record.applied
+    assert d.record.direction == "up"
+    assert d.record.as_dict()["kind"] == "scale"
+    assert d.state.last_scale_wall == 1000.0
+
+
+def test_scale_up_clamped_to_max():
+    d = decide(spec(max_replicas=3), 1, ScalerState(), metrics(qd=100), 0.0)
+    assert d.replicas == 3
+
+
+def test_scale_up_waits_out_stabilization_window():
+    s = spec(scale_up_stabilization_s=30.0)
+    d1 = decide(s, 1, ScalerState(), metrics(qd=20), now_wall=100.0)
+    assert d1.replicas == 1 and d1.record.hold == HOLD_STABILIZATION
+    assert d1.state.above_since_wall == 100.0
+    # Still early: hold, clock keeps its original anchor.
+    d2 = decide(s, 1, d1.state, metrics(qd=20), now_wall=120.0)
+    assert d2.replicas == 1 and d2.state.above_since_wall == 100.0
+    # Window served: jump to demand.
+    d3 = decide(s, 1, d2.state, metrics(qd=20), now_wall=131.0)
+    assert d3.replicas == 5 and d3.record.applied
+
+
+def test_demand_dip_resets_stabilization_clock():
+    s = spec(scale_up_stabilization_s=30.0)
+    d1 = decide(s, 1, ScalerState(), metrics(qd=20), now_wall=100.0)
+    d2 = decide(s, 1, d1.state, metrics(qd=0), now_wall=110.0)  # dip
+    assert d2.state.above_since_wall is None
+    d3 = decide(s, 1, d2.state, metrics(qd=20), now_wall=120.0)
+    assert d3.state.above_since_wall == 120.0  # re-armed, not inherited
+
+
+def test_scale_down_steps_one_and_respects_cooldown():
+    s = spec(scale_down_cooldown_s=60.0)
+    st = ScalerState(last_scale_wall=1000.0)
+    # Inside cooldown: hold.
+    d1 = decide(s, 5, st, metrics(qd=0), now_wall=1030.0)
+    assert d1.replicas == 5 and d1.record.hold == HOLD_COOLDOWN
+    # Cooldown served: ONE step down even though demand says 1.
+    d2 = decide(s, 5, d1.state, metrics(qd=0), now_wall=1061.0)
+    assert d2.replicas == 4 and d2.record.applied
+    assert d2.record.direction == "down" and d2.record.desired == 1
+    # The step re-arms the cooldown.
+    d3 = decide(s, 4, d2.state, metrics(qd=0), now_wall=1062.0)
+    assert d3.replicas == 4 and d3.record.hold == HOLD_COOLDOWN
+
+
+def test_scale_up_resets_down_cooldown():
+    """A scale-up is a scale event: the next scale-down must wait a full
+    cooldown from it (load that just arrived tends to come back)."""
+    s = spec(scale_down_cooldown_s=60.0)
+    up = decide(s, 1, ScalerState(), metrics(qd=20), now_wall=500.0)
+    assert up.replicas == 5
+    d = decide(s, 5, up.state, metrics(qd=0), now_wall=540.0)
+    assert d.replicas == 5 and d.record.hold == HOLD_COOLDOWN
+
+
+def test_ttft_pressure_adds_a_replica_without_backlog():
+    """TTFT p95 over budget scales up by one even at zero queue depth
+    (latency pressure without a visible backlog)."""
+    s = spec(target_ttft_seconds=1.0)
+    d = decide(s, 2, ScalerState(), metrics(qd=0, ttft=2.5), now_wall=0.0)
+    assert d.replicas == 3
+    assert "ttft" in d.record.reason
+
+
+def test_blind_metrics_hold_never_scale_down():
+    """A metrics blackout must hold the fleet, not read as 'no load' and
+    drain it to minReplicas under full traffic."""
+    for observed in (None, metrics()):  # no source / all-None reading
+        d = decide(spec(), 5, ScalerState(), observed, now_wall=10_000.0)
+        assert d.replicas == 5
+        assert d.record.hold == HOLD_METRICS_MISSING
+
+
+def test_steady_state_produces_no_record():
+    d = decide(spec(), 2, ScalerState(), metrics(qd=6), now_wall=0.0)
+    assert d.replicas == 2 and d.record is None
+
+
+def test_scaler_state_round_trips_through_status():
+    st = ScalerState(last_scale_wall=123.5, above_since_wall=120.0)
+    assert ScalerState.from_status(st.to_status()) == st
+    idle = ScalerState(last_scale_wall=9.0)
+    assert ScalerState.from_status(idle.to_status()) == idle
+    assert ScalerState.from_status(None) == ScalerState()
+
+
+# ---------------------------------------------------------------------------
+# Reconciler integration
+# ---------------------------------------------------------------------------
+
+
+CR = ObjectRef(namespace="ns", name="m", **MLFLOWMODEL)
+
+
+def make_world(spec_extra=None, wall_box=None):
+    kube = FakeKube()
+    registry = FakeRegistry()
+    registry.register("iris", "1", "s3://b/1")
+    registry.set_alias("iris", "champion", "1")
+    fake_metrics = FakeMetrics()
+    clock = FakeClock()
+    wall_box = wall_box if wall_box is not None else [1_000_000.0]
+    rec = Reconciler(
+        "m",
+        "ns",
+        kube,
+        registry,
+        metrics=fake_metrics,
+        clock=clock,
+        wall=lambda: wall_box[0],
+    )
+    cr_spec = {"modelName": "iris", "modelAlias": "champion"}
+    cr_spec.update(spec_extra or {})
+    kube.create(CR, {"spec": cr_spec})
+    return kube, registry, fake_metrics, clock, rec, wall_box
+
+
+AUTOSCALE = {
+    "enabled": True,
+    "minReplicas": 1,
+    "maxReplicas": 4,
+    "targetQueueDepthPerReplica": 2,
+    "scaleUpStabilizationSeconds": 0,
+    "scaleDownCooldownSeconds": 60,
+}
+
+
+def reconcile(kube, rec):
+    return rec.reconcile(kube.get(CR))
+
+
+def deployed_replicas(kube):
+    from tpumlops.clients.base import SELDONDEPLOYMENT
+
+    sd = kube.get(ObjectRef(namespace="ns", name="m", **SELDONDEPLOYMENT))
+    return {
+        p["name"]: p["replicas"] for p in sd["spec"]["predictors"]
+    }, (sd["metadata"].get("annotations") or {})
+
+
+def test_disabled_autoscaling_is_byte_identical():
+    """No spec.autoscaling: no status keys, no annotation, predictor
+    replicas from spec.tpu — the pre-autoscaler output exactly."""
+    kube, registry, fm, clock, rec, wall = make_world()
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.STABLE
+    assert out.scale is None
+    status = kube.get(CR)["status"]
+    assert "replicas" not in status and "autoscaler" not in status
+    preds, annotations = deployed_replicas(kube)
+    assert preds == {"v1": 1}
+    assert "tpumlops.dev/replicas" not in annotations
+    # Steady-state reconciles stay patch-free and scale-free.
+    out2 = reconcile(kube, rec)
+    assert out2.scale is None
+
+
+def test_scale_up_applies_manifest_status_journal_and_event():
+    kube, registry, fm, clock, rec, wall = make_world(
+        {"autoscaling": AUTOSCALE, "observability": {"historyLimit": 16}}
+    )
+    out = reconcile(kube, rec)  # v1 -> Stable; first take adopts 1 replica
+    assert out.state.replicas == 1
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=7))
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 4  # ceil(7/2) = 4, fast up
+    assert out.scale is not None and out.scale.applied
+    preds, annotations = deployed_replicas(kube)
+    assert preds == {"v1": 4}
+    assert annotations["tpumlops.dev/replicas"] == "4"
+    status = kube.get(CR)["status"]
+    assert status["replicas"] == 4
+    assert status["autoscaler"]["lastScaleTime"] == wall[0]
+    scale_recs = [r for r in status["history"] if r["kind"] == "scale"]
+    assert scale_recs and scale_recs[-1]["to"] == 4
+    assert scale_recs[-1]["observed"]["queue_depth"] == 7
+    assert "ScaledUp" in kube.event_reasons()
+
+
+def test_scale_down_cooldown_then_single_steps_with_journal():
+    kube, registry, fm, clock, rec, wall = make_world(
+        {"autoscaling": AUTOSCALE, "observability": {"historyLimit": 32}}
+    )
+    reconcile(kube, rec)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=8))
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 4
+    # Load stops: inside cooldown, held (journaled once, not per poll).
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=0))
+    wall[0] += 10
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 4
+    assert out.scale.hold == HOLD_COOLDOWN
+    out = reconcile(kube, rec)  # identical hold: journal must not grow
+    holds = [
+        r
+        for r in kube.get(CR)["status"]["history"]
+        if r["kind"] == "scale" and r["hold"] == HOLD_COOLDOWN
+    ]
+    assert len(holds) == 1
+    # Cooldown served: one step down per window, 4 -> 3 -> 2 -> 1.
+    for expect in (3, 2, 1):
+        wall[0] += 61
+        out = reconcile(kube, rec)
+        assert out.state.replicas == expect
+    assert kube.event_reasons().count("ScaledDown") == 3
+    preds, _ = deployed_replicas(kube)
+    assert preds == {"v1": 1}
+
+
+def test_autoscaler_frozen_during_canary_and_resumes_after():
+    kube, registry, fm, clock, rec, wall = make_world(
+        {
+            "autoscaling": AUTOSCALE,
+            "canary": {"maxAttempts": 2, "initialTraffic": 50, "step": 50},
+        }
+    )
+    reconcile(kube, rec)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=8))
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 4
+    # New version: canary starts; the scaled topology rides in frozen.
+    registry.register("iris", "2", "s3://b/2")
+    registry.set_alias("iris", "champion", "2")
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.CANARY
+    assert out.state.replicas == 4
+    preds, _ = deployed_replicas(kube)
+    assert preds == {"v1": 4, "v2": 4}  # both versions at the same count
+    # Mid-canary reconciles never evaluate the autoscaler, whatever the
+    # queue says.
+    fm.set_engine_metrics("m", "v2", "ns", EngineMetrics(queue_depth=100))
+    fm.engine_query_log.clear()
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.CANARY
+    assert out.scale is None and fm.engine_query_log == []
+    assert out.state.replicas == 4
+    # Promote to stable (healthy metrics on both), then scaling resumes.
+    from tpumlops.clients.base import ModelMetrics
+
+    good = ModelMetrics(
+        latency_p95=0.1, error_rate=0.0, latency_avg=0.05, request_count=100
+    )
+    fm.set_metrics("m", "v1", "ns", good)
+    fm.set_metrics("m", "v2", "ns", good)
+    for _ in range(4):
+        out = reconcile(kube, rec)
+        if out.state.phase == Phase.STABLE:
+            break
+    assert out.state.phase == Phase.STABLE
+    fm.set_engine_metrics("m", "v2", "ns", EngineMetrics(queue_depth=0))
+    wall[0] += 120
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 3  # scale-down resumed post-rollout
+
+
+def test_metrics_blackout_holds_and_is_counted():
+    kube, registry, fm, clock, rec, wall = make_world(
+        {"autoscaling": AUTOSCALE}
+    )
+    reconcile(kube, rec)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=8))
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 4
+    # Blackout: all-None reading. Hold at 4 forever, never drift down.
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics())
+    wall[0] += 3600
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 4
+    assert out.scale.hold == HOLD_METRICS_MISSING
+
+
+def test_disabling_autoscaling_clears_status_and_reverts_manifest():
+    kube, registry, fm, clock, rec, wall = make_world(
+        {"autoscaling": AUTOSCALE}
+    )
+    reconcile(kube, rec)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=8))
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 4
+    # Flip the spec off (FakeKube.replace preserves status).
+    obj = kube.get(CR)
+    obj["spec"] = {"modelName": "iris", "modelAlias": "champion"}
+    kube.replace(CR, obj)
+    out = reconcile(kube, rec)
+    assert out.state.replicas is None
+    status = kube.get(CR)["status"]
+    assert status.get("replicas") is None  # explicit null cleared it
+    assert status.get("autoscaler") is None
+    preds, annotations = deployed_replicas(kube)
+    assert preds == {"v1": 1}
+    assert "tpumlops.dev/replicas" not in annotations
+
+
+def test_restart_resumes_cooldown_from_status():
+    """A fresh Reconciler (operator restart) must keep honoring the
+    persisted cooldown anchor instead of scaling down immediately."""
+    kube, registry, fm, clock, rec, wall = make_world(
+        {"autoscaling": AUTOSCALE}
+    )
+    reconcile(kube, rec)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=8))
+    reconcile(kube, rec)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=0))
+    # New operator instance, 10 wall-seconds later: inside cooldown.
+    wall[0] += 10
+    rec2 = Reconciler(
+        "m", "ns", kube, registry, metrics=fm, clock=FakeClock(),
+        wall=lambda: wall[0],
+    )
+    out = reconcile(kube, rec2)
+    assert out.state.replicas == 4
+    assert out.scale.hold == HOLD_COOLDOWN
+    wall[0] += 61
+    out = reconcile(kube, rec2)
+    assert out.state.replicas == 3
+
+
+def test_min_replicas_floor_adopted_on_enable():
+    """Enabling with minReplicas above the spec topology immediately
+    raises the floor (capacity guarantees are part of the SLO)."""
+    auto = dict(AUTOSCALE, minReplicas=2)
+    kube, registry, fm, clock, rec, wall = make_world({"autoscaling": auto})
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 2
+    preds, _ = deployed_replicas(kube)
+    assert preds == {"v1": 2}
+
+
+def test_telemetry_autoscale_series():
+    from tpumlops.operator.telemetry import OperatorTelemetry
+
+    kube, registry, fm, clock, rec, wall = make_world(
+        {"autoscaling": AUTOSCALE}
+    )
+    tel = OperatorTelemetry()
+    out = reconcile(kube, rec)
+    tel.record_outcome("ns", "m", out, 0.01)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=8))
+    out = reconcile(kube, rec)
+    tel.record_outcome("ns", "m", out, 0.01)
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=0))
+    out = reconcile(kube, rec)  # cooldown hold
+    tel.record_outcome("ns", "m", out, 0.01)
+    expo = tel.exposition().decode()
+    assert (
+        'tpumlops_operator_autoscale_replicas{name="m",namespace="ns"} 4.0'
+        in expo
+    )
+    assert (
+        'tpumlops_operator_autoscale_events_total{direction="up",'
+        'name="m",namespace="ns"} 1.0' in expo
+    )
+    assert (
+        'tpumlops_operator_autoscale_holds_total{name="m",'
+        'namespace="ns",reason="cooldown"} 1.0' in expo
+    )
+
+
+def test_partial_blackout_holds_scale_down_but_allows_scale_up():
+    """Queue depth (the primary signal) unavailable while TTFT answers:
+    TTFT may justify GROWING, never shrinking — an unobservable backlog
+    must not read as an empty one."""
+    s = spec(target_ttft_seconds=1.0)
+    # TTFT healthy, queue signal dark: would compute desired=min — held.
+    d = decide(
+        s, 5, ScalerState(), metrics(qd=None, ttft=0.2), now_wall=10_000.0
+    )
+    assert d.replicas == 5
+    assert d.record.hold == HOLD_METRICS_MISSING
+    # TTFT breach with the queue signal dark still scales UP.
+    d = decide(
+        s, 5, ScalerState(), metrics(qd=None, ttft=3.0), now_wall=10_000.0
+    )
+    assert d.replicas == 6 and d.record.applied
+
+
+def test_ttft_only_config_holds_scale_down_when_ttft_dark():
+    """TTFT-only autoscaling (no queue target — explicitly legal): a
+    dark TTFT series is the ONLY configured signal; scale-down must
+    hold, whatever the (unused) queue gauge says."""
+    s = spec(target_queue_depth_per_replica=0.0, target_ttft_seconds=1.0)
+    d = decide(
+        s, 4, ScalerState(), metrics(qd=0, ttft=None), now_wall=10_000.0
+    )
+    assert d.replicas == 4
+    assert d.record.hold == HOLD_METRICS_MISSING
+    # TTFT observable and healthy: the step-down proceeds.
+    d = decide(
+        s, 4, ScalerState(), metrics(qd=0, ttft=0.2), now_wall=10_000.0
+    )
+    assert d.replicas == 3 and d.record.applied
+
+
+def test_enabling_autoscaling_journals_the_adoption_jump():
+    """spec.tpu.replicas outside the autoscaling band: the first
+    evaluation clamps the running topology into it — that IS a scale
+    event and must be journaled (from the REAL spec count) and armed
+    with the cooldown, not applied silently."""
+    kube, registry, fm, clock, rec, wall = make_world(
+        {
+            "tpu": {"replicas": 4},
+            "autoscaling": dict(AUTOSCALE, maxReplicas=2),
+            "observability": {"historyLimit": 16},
+        }
+    )
+    out = reconcile(kube, rec)
+    assert out.state.phase == Phase.STABLE
+    assert out.state.replicas == 2
+    scales = [
+        r
+        for r in kube.get(CR)["status"]["history"]
+        if r["kind"] == "scale"
+    ]
+    assert scales and scales[-1]["from"] == 4 and scales[-1]["to"] == 2
+    assert "ScaledDown" in kube.event_reasons()
+    # The jump armed the cooldown: the next step-down waits it out.
+    assert kube.get(CR)["status"]["autoscaler"]["lastScaleTime"] == wall[0]
+    fm.set_engine_metrics("m", "v1", "ns", EngineMetrics(queue_depth=0))
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 2
+    assert out.scale.hold == HOLD_COOLDOWN

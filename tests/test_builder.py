@@ -161,3 +161,94 @@ def test_manifest_annotations_carry_rollout_context():
     ann = solo["metadata"]["annotations"]
     assert "tpumlops.dev/previous-version" not in ann
     assert ann["tpumlops.dev/traffic-current"] == "100"
+
+
+def test_replicas_override_applies_to_every_predictor():
+    """The autoscaler's count rides build_deployment(replicas=N): every
+    predictor (old AND new — the canary topology is frozen at one
+    count) plus the explaining annotation."""
+    sd = build_deployment(
+        name="iris",
+        namespace="models",
+        owner_uid="uid-123",
+        config=cfg(),
+        current_version="2",
+        new_model_uri="s3://mlflow/1/bbb/artifacts/model",
+        traffic_current=10,
+        previous_version="1",
+        old_model_uri="s3://mlflow/1/aaa/artifacts/model",
+        traffic_prev=90,
+        replicas=3,
+    )
+    assert [p["replicas"] for p in sd["spec"]["predictors"]] == [3, 3]
+    assert sd["metadata"]["annotations"]["tpumlops.dev/replicas"] == "3"
+    # TPU backend honors the same override.
+    tpu_cfg = cfg(backend="tpu", tpu={"meshShape": {"tp": 8}})
+    sd = build_deployment(
+        name="iris", namespace="models", owner_uid="u", config=tpu_cfg,
+        current_version="1", new_model_uri="s3://m", traffic_current=100,
+        replicas=2,
+    )
+    assert sd["spec"]["predictors"][0]["replicas"] == 2
+
+
+def test_no_replicas_override_is_byte_identical():
+    """replicas=None (autoscaling off) must reproduce the fixed
+    topology exactly: seldon predictors at 1, tpu at spec.tpu.replicas,
+    and NO autoscaler annotation."""
+    sd = two_version_manifest()
+    assert [p["replicas"] for p in sd["spec"]["predictors"]] == [1, 1]
+    assert "tpumlops.dev/replicas" not in sd["metadata"]["annotations"]
+    tpu_cfg = cfg(
+        backend="tpu", tpu={"meshShape": {"tp": 8}, "replicas": 2}
+    )
+    sd = build_deployment(
+        name="iris", namespace="models", owner_uid="u", config=tpu_cfg,
+        current_version="1", new_model_uri="s3://m", traffic_current=100,
+    )
+    assert sd["spec"]["predictors"][0]["replicas"] == 2
+    assert "tpumlops.dev/replicas" not in sd["metadata"]["annotations"]
+
+
+def test_admission_and_drain_flags_emitted_only_when_set():
+    """The new serving flags arrived after the always-emitted block:
+    default values must add NOTHING to the args (an unannotated CR's
+    manifest stays byte-for-byte), non-defaults append the flags."""
+    def args_of(tpu_extra):
+        tpu_cfg = cfg(backend="tpu", tpu={"meshShape": {"tp": 8}, **tpu_extra})
+        sd = build_deployment(
+            name="iris", namespace="models", owner_uid="u", config=tpu_cfg,
+            current_version="1", new_model_uri="s3://m", traffic_current=100,
+        )
+        spec = sd["spec"]["predictors"][0]["componentSpecs"][0]["spec"]
+        return spec["containers"][0]["args"]
+
+    default = args_of({})
+    assert "--admission-queue-budget" not in default
+    assert "--drain-grace-seconds" not in default
+    tuned = args_of(
+        {"admissionQueueBudget": 8192, "drainGraceSeconds": 12.5}
+    )
+    assert tuned[: len(default)] == default  # pure suffix, order stable
+    assert tuned[len(default):] == [
+        "--admission-queue-budget", "8192",
+        "--drain-grace-seconds", "12.5",
+    ]
+
+
+def _pod_spec_of(tpu_extra):
+    tpu_cfg = cfg(backend="tpu", tpu={"meshShape": {"tp": 8}, **tpu_extra})
+    sd = build_deployment(
+        name="iris", namespace="models", owner_uid="u", config=tpu_cfg,
+        current_version="1", new_model_uri="s3://m", traffic_current=100,
+    )
+    return sd["spec"]["predictors"][0]["componentSpecs"][0]["spec"]
+
+
+def test_drain_grace_extends_pod_termination_grace():
+    """A non-default drain window must stretch terminationGracePeriodSeconds
+    past it, or kubelet's default 30s SIGKILLs the server mid-drain and
+    drops exactly the requests the lossless-drain protocol saves."""
+    assert "terminationGracePeriodSeconds" not in _pod_spec_of({})
+    spec = _pod_spec_of({"drainGraceSeconds": 120})
+    assert spec["terminationGracePeriodSeconds"] >= 120 + 3  # + --drain-s lag

@@ -298,3 +298,52 @@ def test_runtime_requires_metrics_at_startup():
 
     with pytest.raises(ValueError, match="metrics"):
         OperatorRuntime(FakeKube(), FakeRegistry())
+
+
+def test_prometheus_engine_metrics_queries_and_none_semantics():
+    """The autoscaler's PromQL: queue depth summed across replicas,
+    admission-wait / TTFT p95 over the window — and NO vector(0)
+    fallback anywhere (a failed query must read as None/hold, never as
+    "no load")."""
+    queries = []
+
+    def handler(request):
+        q = request.url.params["query"]
+        queries.append(q)
+        value = "7"
+        if "admission_wait" in q:
+            value = "42.5"
+        if "ttft" in q:
+            value = "1.25"
+        return httpx.Response(
+            200,
+            json={"data": {"result": [{"value": [0, value]}]},
+                  "status": "success"},
+        )
+
+    src = PrometheusSource.__new__(PrometheusSource)
+    src._http = httpx.Client(
+        base_url="http://prom", transport=httpx.MockTransport(handler)
+    )
+    em = src.engine_metrics("iris", "v2", "models", 30)
+    assert len(queries) == 3
+    assert queries[0].startswith("sum(tpumlops_engine_queue_depth{")
+    assert 'deployment_name="iris"' in queries[0]
+    assert "histogram_quantile(0.95" in queries[1]
+    assert "tpumlops_admission_wait_ms_bucket" in queries[1]
+    assert "[30s]" in queries[1]
+    assert "tpumlops_ttft_seconds_bucket" in queries[2]
+    assert all("vector(0)" not in q for q in queries)
+    assert em.queue_depth == 7.0
+    assert em.admission_wait_p95_ms == 42.5
+    assert em.ttft_p95_s == 1.25
+
+    def empty(request):
+        return httpx.Response(200, json={"data": {"result": []}})
+
+    src._http = httpx.Client(
+        base_url="http://prom", transport=httpx.MockTransport(empty)
+    )
+    em = src.engine_metrics("iris", "v2", "models")
+    assert em.queue_depth is None  # unavailable, NOT zero load
+    assert em.ttft_p95_s is None

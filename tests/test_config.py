@@ -200,3 +200,104 @@ def test_rollout_observability_history_limit():
     # Typo'd knobs are named back, not silently defaulted.
     with pytest.raises(ValueError, match="historyLimi"):
         OperatorConfig.from_spec(minimal_spec(observability={"historyLimi": 8}))
+
+
+def test_autoscaling_spec_parsing_and_defaults():
+    # Default: disabled, inert — an unannotated CR is byte-for-byte.
+    cfg = OperatorConfig.from_spec(minimal_spec())
+    assert cfg.autoscaling.enabled is False
+    assert cfg.autoscaling.min_replicas == 1
+    assert cfg.autoscaling.max_replicas == 1
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            autoscaling={
+                "enabled": True,
+                "minReplicas": 2,
+                "maxReplicas": 6,
+                "targetQueueDepthPerReplica": 4,
+                "targetTTFTSeconds": 1.5,
+                "scaleUpStabilizationSeconds": 10,
+                "scaleDownCooldownSeconds": 120,
+            }
+        )
+    )
+    a = cfg.autoscaling
+    assert (a.enabled, a.min_replicas, a.max_replicas) == (True, 2, 6)
+    assert a.target_queue_depth_per_replica == 4.0
+    assert a.target_ttft_seconds == 1.5
+    assert a.scale_up_stabilization_s == 10.0
+    assert a.scale_down_cooldown_s == 120.0
+
+
+def test_autoscaling_contradictory_specs_rejected():
+    """Contradictory autoscaling specs fail at reconcile time with a
+    typed error naming the field — not as an oscillating or parked
+    controller."""
+    with pytest.raises(ValueError, match="minReplicas"):
+        OperatorConfig.from_spec(
+            minimal_spec(
+                autoscaling={"minReplicas": 3, "maxReplicas": 2}
+            )
+        )
+    with pytest.raises(ValueError, match="minReplicas"):
+        OperatorConfig.from_spec(minimal_spec(autoscaling={"minReplicas": 0}))
+    # Enabled with no scaling target: nothing to steer by.
+    with pytest.raises(ValueError, match="target"):
+        OperatorConfig.from_spec(
+            minimal_spec(autoscaling={"enabled": True, "maxReplicas": 4})
+        )
+    with pytest.raises(ValueError, match="scaleDownCooldownSeconds"):
+        OperatorConfig.from_spec(
+            minimal_spec(autoscaling={"scaleDownCooldownSeconds": -1})
+        )
+    # Typo'd keys are named back, not silently defaulted.
+    with pytest.raises(ValueError, match="maxReplica"):
+        OperatorConfig.from_spec(minimal_spec(autoscaling={"maxReplica": 3}))
+
+
+def test_autoscaling_multihost_rejected_like_replicas():
+    """maxReplicas > 1 on a multi-host unit is the same impossibility as
+    replicas > 1 there (one StatefulSet per predictor) — reject at
+    reconcile time with the same guidance."""
+    with pytest.raises(ValueError, match="maxReplicas"):
+        OperatorConfig.from_spec(
+            minimal_spec(
+                backend="tpu",
+                tpu={"tpuTopology": "v5e-16", "meshShape": {"tp": 16}},
+                autoscaling={
+                    "enabled": True,
+                    "maxReplicas": 3,
+                    "targetQueueDepthPerReplica": 4,
+                },
+            )
+        )
+    # Single-host topologies scale fine.
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            backend="tpu",
+            tpu={"tpuTopology": "v5e-8", "meshShape": {"tp": 8}},
+            autoscaling={
+                "enabled": True,
+                "maxReplicas": 3,
+                "targetQueueDepthPerReplica": 4,
+            },
+        )
+    )
+    assert cfg.autoscaling.max_replicas == 3
+
+
+def test_tpu_admission_and_drain_knobs():
+    from tpumlops.utils.config import TpuSpec
+
+    d = TpuSpec.from_spec({})
+    assert d.admission_queue_budget == 0  # unbounded = old behavior
+    assert d.drain_grace_s == 20.0  # + 3s lag fits k8s' 30s pod grace
+    s = TpuSpec.from_spec(
+        {"admissionQueueBudget": 4096, "drainGraceSeconds": 5}
+    )
+    assert s.admission_queue_budget == 4096
+    assert s.drain_grace_s == 5.0
+    with pytest.raises(ValueError, match="admissionQueueBudget"):
+        TpuSpec.from_spec({"admissionQueueBudget": -1})
+    with pytest.raises(ValueError, match="drainGraceSeconds"):
+        TpuSpec.from_spec({"drainGraceSeconds": -0.5})

@@ -819,3 +819,269 @@ def test_generation_canary_on_live_metrics(llm_models):
         router.stop()
         for h in handles:
             h.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO-driven replica autoscaling: the full loop against LIVE servers.
+# Load ramp -> replicas climb min -> N -> load stops -> cooldown-gated
+# scale-down with lossless drains -> every submitted request either
+# completed (200) or was shed (429); none dropped — reconstructed from
+# status.history / /debug/rollouts scale records alone.
+# ---------------------------------------------------------------------------
+
+
+class _ScaleLoad:
+    """Round-robin /generate load over the LIVE replica ports, tallying
+    every attempt: 200 = completed, 429 = shed (client retries land on
+    the next replica naturally), anything else = LOST (the thing the
+    drain protocol must make impossible)."""
+
+    def __init__(self, ports_fn, model: str, workers: int):
+        self.ports_fn = ports_fn
+        self.model = model
+        self.workers = workers
+        self.completed = 0
+        self.shed = 0
+        self.lost: list[str] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def _loop(self, idx: int):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        body = _json.dumps(
+            {"prompt_ids": [5, 9, 2, 7], "max_new_tokens": 16}
+        ).encode()
+        i = idx
+        while not self._stop.is_set():
+            ports = self.ports_fn()
+            if not ports:
+                time.sleep(0.05)
+                continue
+            port = ports[i % len(ports)]
+            i += 1
+            url = f"http://127.0.0.1:{port}/v2/models/{self.model}/generate"
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+                with self._lock:
+                    self.completed += 1
+            except urllib.error.HTTPError as e:
+                with self._lock:
+                    if e.code == 429:
+                        self.shed += 1  # contract: retry elsewhere
+                    else:
+                        self.lost.append(f"{port}: HTTP {e.code}")
+            except Exception as e:
+                with self._lock:
+                    self.lost.append(f"{port}: {type(e).__name__}: {e}")
+
+    def start(self):
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,), daemon=True)
+            for i in range(self.workers)
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=90)
+
+
+def test_autoscaler_full_loop_scale_up_drain_down_zero_lost(llm_models):
+    import json as _json
+    import urllib.request
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.base import (
+        ObjectRef,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.localplane import (
+        LocalReplicaSet,
+        ReplicaSetMetrics,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.rollout_recorder import (
+        RolloutRecorder,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.telemetry import (
+        OperatorTelemetry,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.config import (
+        TpuSpec,
+    )
+
+    replica_set = LocalReplicaSet(
+        model_uris={"v1": llm_models["1"]},
+        model_name="llmscale",
+        namespace="models",
+        tpu=TpuSpec.from_spec(
+            {"meshShape": {"tp": 1}, "maxBatchSize": 2, "maxSlots": 2}
+        ),
+        drain_grace_s=60.0,
+        # Replicas boot without warmup: compiles land lazily under the
+        # very load that triggered the scale-up (and inflate TTFT/queue,
+        # which is exactly the saturation the autoscaler should see).
+        warmup=False,
+    )
+    kube = SyncingKube(replica_set)
+    registry = FakeRegistry()
+    registry.register(
+        "llmscale", "1", "mlflow-artifacts:/1/aaa/artifacts/model"
+    )
+    registry.set_alias("llmscale", "prod", "1")
+    recorder = RolloutRecorder(capacity=256)
+    telemetry = OperatorTelemetry()
+    rt = OperatorRuntime(
+        kube,
+        registry,
+        metrics=ReplicaSetMetrics(replica_set.ports),
+        clock=SystemClock(),
+        sync_interval_s=0.05,
+        telemetry=telemetry,
+        recorder=recorder,
+    )
+    metrics_port = free_port()
+    httpd = telemetry.serve(metrics_port, addr="127.0.0.1", recorder=recorder)
+    ref = ObjectRef(namespace="models", name="llmscale", **CR)
+    spec = {
+        "modelName": "llmscale",
+        "modelAlias": "prod",
+        "monitoringInterval": 0.15,
+        "observability": {"historyLimit": 64},
+        "autoscaling": {
+            "enabled": True,
+            "minReplicas": 1,
+            "maxReplicas": 3,
+            "targetQueueDepthPerReplica": 1.5,
+            "scaleUpStabilizationSeconds": 0,
+            "scaleDownCooldownSeconds": 4,
+        },
+    }
+
+    def status():
+        return kube.get(ref).get("status") or {}
+
+    heavy = light = None
+    try:
+        kube.create(ref, {"spec": spec})
+        threading.Thread(target=rt.serve, daemon=True).start()
+
+        # v1 Stable on ONE live replica (the autoscaler's floor).
+        wait_for(
+            lambda: status().get("phase") == "Stable"
+            and replica_set.replica_count("v1") == 1,
+            timeout=180.0,
+            what="initial Stable at 1 replica",
+        )
+        assert status().get("replicas") == 1
+
+        # Load ramp: 10 concurrent streams onto 2 decode slots — queue
+        # depth climbs, the autoscaler reads it off the live /metrics
+        # and jumps to the demand (fast up).
+        heavy = _ScaleLoad(
+            replica_set.ports, "llmscale", workers=10
+        ).start()
+        wait_for(
+            lambda: status().get("replicas") == 3
+            and replica_set.replica_count("v1") == 3,
+            timeout=180.0,
+            what="scale-up to maxReplicas under load",
+        )
+        heavy.stop()
+
+        # Light trickle keeps requests in flight ACROSS the scale-downs
+        # — the drains must finish them, not drop them.
+        light = _ScaleLoad(
+            replica_set.ports, "llmscale", workers=1
+        ).start()
+        wait_for(
+            lambda: status().get("replicas") == 1
+            and replica_set.replica_count("v1") == 1,
+            timeout=180.0,
+            what="cooldown-gated scale-down back to minReplicas",
+        )
+        time.sleep(0.5)  # let the trickle cross the final topology
+        light.stop()
+
+        # -- zero lost requests ----------------------------------------
+        for load, name in ((heavy, "heavy"), (light, "light")):
+            assert load.lost == [], (name, load.lost[:5])
+        # Real traffic flowed through every phase (the exact volume
+        # depends on how fast the box compiles/decodes; losslessness —
+        # the contract — is the empty `lost` lists above).
+        assert heavy.completed > 0
+        assert light.completed > 0
+        assert heavy.completed + light.completed > 15
+        # Every drain was lossless and reported empty before teardown.
+        assert len(replica_set.drain_reports) == 2  # 3 -> 2 -> 1
+        for report in replica_set.drain_reports:
+            assert report.get("drained") is True, report
+            assert report.get("inFlight") == 0, report
+            assert "error" not in report, report
+
+        # -- reconstruction from status.history alone ------------------
+        history = status()["history"]
+        scales = [r for r in history if r["kind"] == "scale"]
+        applied = [s for s in scales if s["hold"] is None]
+        ups = [s for s in applied if s["direction"] == "up"]
+        downs = [s for s in applied if s["direction"] == "down"]
+        # The climb: one fast-up jump driven by queue depth.
+        assert ups and ups[0]["from"] == 1 and ups[0]["to"] >= 2
+        assert "queue depth" in ups[0]["reason"]
+        assert ups[0]["observed"]["queue_depth"] > 0
+        assert max(s["to"] for s in ups) == 3
+        # The descent: single steps, cooldown-gated, ending at the floor.
+        assert [s["to"] for s in downs][-2:] == [2, 1]
+        assert all(s["from"] - s["to"] == 1 for s in downs)
+        assert applied[-1]["to"] == 1
+        # Cooldown holds were journaled (deduped, not one per poll).
+        holds = [s for s in scales if s["hold"] == "cooldown"]
+        assert holds, [s["hold"] for s in scales]
+        # The record sequence alone tells the whole story in order:
+        # up(s) first, then the descent.
+        first_down = applied.index(downs[0])
+        assert all(s["direction"] == "up" for s in applied[:first_down])
+
+        # -- reconstruction from /debug/rollouts alone -----------------
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}{path}", timeout=5
+            ).read()
+
+        live = _json.loads(get("/debug/rollouts"))
+        records = live["rollouts"]["models/llmscale"]["records"]
+        live_scales = [r for r in records if r["kind"] == "scale"]
+        assert [
+            (s["from"], s["to"])
+            for s in live_scales
+            if s["hold"] is None
+        ] == [(s["from"], s["to"]) for s in applied]
+        trace = _json.loads(get("/debug/rollouts/trace?format=chrome"))
+        assert {
+            e["name"]
+            for e in trace["traceEvents"]
+            if e.get("cat") == "scale"
+        } >= {"scale 2 -> 1", "scale hold (cooldown)"}
+
+        # The autoscale metric families materialized on the listener.
+        expo = get("/metrics").decode()
+        assert 'tpumlops_operator_autoscale_events_total{direction="up"' in expo
+        assert (
+            'tpumlops_operator_autoscale_replicas{name="llmscale"' in expo
+        )
+    finally:
+        for load in (heavy, light):
+            if load is not None:
+                load.stop()
+        httpd.shutdown()
+        rt.stop()
+        replica_set.stop_all()

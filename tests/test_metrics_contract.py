@@ -39,6 +39,10 @@ EXPECTED_SERVER = {
     "tpumlops_engine_active_slots": ("gauge", _IDENT),
     "tpumlops_engine_admitting": ("gauge", _IDENT),
     "tpumlops_engine_queue_depth": ("gauge", _IDENT),
+    # Admission control: sheds by typed reason ("budget" | "draining");
+    # exported as tpumlops_engine_shed_total.  The autoscaler's alert
+    # surface for "replica refusing load".
+    "tpumlops_engine_shed": ("counter", _IDENT + ("reason",)),
     "tpumlops_feedback_reward_total": ("gauge", _IDENT),
     "tpumlops_generated_tokens": ("counter", _IDENT),
     "tpumlops_itl_seconds": ("histogram", _IDENT),
@@ -61,6 +65,14 @@ EXPECTED_SERVER = {
 _OP_IDENT = ("namespace", "name")
 
 EXPECTED_OPERATOR = {
+    # Replica autoscaler (operator/autoscaler.py): controlled + wanted
+    # counts, applied scalings by direction, holds by typed reason.
+    "tpumlops_operator_autoscale_desired_replicas": ("gauge", _OP_IDENT),
+    "tpumlops_operator_autoscale_events": (
+        "counter", _OP_IDENT + ("direction",)),
+    "tpumlops_operator_autoscale_holds": (
+        "counter", _OP_IDENT + ("reason",)),
+    "tpumlops_operator_autoscale_replicas": ("gauge", _OP_IDENT),
     "tpumlops_operator_events": ("counter", _OP_IDENT + ("reason",)),
     "tpumlops_operator_gate_attempt": ("gauge", _OP_IDENT),
     "tpumlops_operator_gate_evaluations": (

@@ -396,3 +396,96 @@ def test_zero_weight_everywhere_is_503(binary):
     finally:
         router.stop()
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# RouterAdmin.set_weights retry: weight flips vs a mid-restart router
+# ---------------------------------------------------------------------------
+
+
+def _flaky_admin(world, injector_target):
+    """Route the admin's transport through a chaos FaultInjector so the
+    scheduled fault types (ConnectionError / URLError / HTTPError) hit
+    ``_req`` exactly where a restarting router would."""
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.chaos import (
+        FaultInjector,
+    )
+
+    admin = world.admin
+    real_req = admin._req
+
+    class _Transport:
+        def req(self, path, method="GET", body=None):
+            return real_req(path, method, body)
+
+    injector = FaultInjector(_Transport())
+    admin._req = injector.req
+    injector_target.append((admin, real_req))
+    return injector
+
+
+def test_set_weights_retries_transient_connection_errors(world):
+    """A weight flip racing a router restart must retry, not leave the
+    split stale until the next reconcile (scale events flip weights
+    exactly when routers are being shuffled)."""
+    restore = []
+    injector = _flaky_admin(world, restore)
+    try:
+        injector.inject_fail(
+            "req", ConnectionError("router restarting"), times=2
+        )
+        sleeps = []
+        world.admin.set_weights(
+            {"v1": 70, "v2": 30}, sleep=sleeps.append
+        )
+        assert injector.faults_fired == 2
+        # Exponential backoff between attempts, one sleep per retry.
+        assert len(sleeps) == 2 and sleeps[1] > sleeps[0]
+    finally:
+        admin, real = restore[0]
+        admin._req = real
+    assert world.admin.get_weights() == {"v1": 70, "v2": 30}
+
+
+def test_set_weights_retry_budget_is_bounded(world):
+    import urllib.error as _ue
+
+    restore = []
+    injector = _flaky_admin(world, restore)
+    try:
+        injector.inject_fail(
+            "req", _ue.URLError(OSError("connection refused")), times=10
+        )
+        with pytest.raises(_ue.URLError):
+            world.admin.set_weights(
+                {"v1": 10, "v2": 90}, retries=2, sleep=lambda s: None
+            )
+        # 1 initial + 2 retries, then the error propagates.
+        assert injector.faults_fired == 3
+    finally:
+        admin, real = restore[0]
+        admin._req = real
+
+
+def test_set_weights_does_not_retry_http_errors(world):
+    """An HTTPError means the router is UP and answered: a real 4xx must
+    surface immediately (retrying a rejected payload can never fix it)."""
+    import io
+    import urllib.error as _ue
+
+    restore = []
+    injector = _flaky_admin(world, restore)
+    try:
+        injector.inject_fail(
+            "req",
+            _ue.HTTPError("http://x", 400, "bad weights", {}, io.BytesIO()),
+            times=1,
+        )
+        slept = []
+        with pytest.raises(_ue.HTTPError):
+            world.admin.set_weights({"v1": 50, "v2": 50}, sleep=slept.append)
+        assert injector.faults_fired == 1
+        assert slept == []  # no backoff burned on a non-transient
+    finally:
+        admin, real = restore[0]
+        admin._req = real

@@ -120,3 +120,387 @@ def test_warmup_full_grid_covers_interior_buckets():
     for b in (1, 2, 4):
         for s in (16, 32, 64):
             assert (b, s) in traced, (b, s)
+
+
+# ---------------------------------------------------------------------------
+# Admission control + lossless drain (the data-plane half of autoscaling):
+# 429 shed contract, shed-never-reaches-the-engine, SSE across a drain.
+# ---------------------------------------------------------------------------
+
+import asyncio
+import json
+import threading
+import time
+
+import httpx
+
+from tpumlops.server.generation import EngineOverloaded
+from tpumlops.utils.config import ServerConfig, TpuSpec
+
+
+class _HttpHandle:
+    """Run a built server's aiohttp app on a daemon thread (the
+    test_server.py harness, trimmed)."""
+
+    def __init__(self, server, port: int):
+        from aiohttp import web
+
+        self.server = server
+        self.base = f"http://127.0.0.1:{port}"
+        self._loop = asyncio.new_event_loop()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+            runner = web.AppRunner(server.build_app())
+            self._loop.run_until_complete(runner.setup())
+            self._loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start()
+            )
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        for _ in range(200):
+            try:
+                httpx.get(self.base + "/v2/health/live", timeout=0.5)
+                return
+            except Exception:
+                time.sleep(0.05)
+        raise RuntimeError("server did not come up")
+
+    def stop(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self.server.shutdown()
+
+
+def _build_llm_server(tmp_path, budget: int = 0):
+    import jax
+
+    from tpumlops.models import llama
+    from tpumlops.server.app import build_server
+    from tpumlops.server.loader import save_native_model
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    art = tmp_path / "llm"
+    save_native_model(
+        art,
+        "llama-generate",
+        llama.init(jax.random.key(3), cfg),
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+    )
+    return build_server(
+        ServerConfig(
+            model_name="llm",
+            model_uri=str(art),
+            predictor_name="v1",
+            deployment_name="llm",
+            namespace="models",
+            tpu=TpuSpec.from_spec(
+                {
+                    "meshShape": {"tp": 1},
+                    "maxBatchSize": 2,
+                    "maxSlots": 2,
+                    "admissionQueueBudget": budget,
+                    "drainGraceSeconds": 30,
+                }
+            ),
+        ),
+        # Lazy compiles are fine here (admission control and the drain
+        # protocol are scheduling behavior, not numerics) and warmup is
+        # the bulk of the fixture's wall time.
+        warmup=False,
+    )
+
+
+_SHED_PORT = [19650]
+
+
+@pytest.fixture(scope="module")
+def shed_server(tmp_path_factory):
+    server = _build_llm_server(
+        tmp_path_factory.mktemp("shed"), budget=64
+    )
+    _SHED_PORT[0] += 1
+    handle = _HttpHandle(server, _SHED_PORT[0])
+    yield handle
+    handle.stop()
+
+
+def _metric(handle, family: str, labels: str = "") -> float:
+    text = httpx.get(handle.base + "/metrics", timeout=10).text
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(family) and labels in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _saturate(eng):
+    """Fill both slots and leave one request queued — the busy shape the
+    budget bounds (the backlog, never request size).  Slot occupants are
+    admitted ONE AT A TIME (two queued at once would already exceed the
+    tiny budget and shed each other).  Returns the futures so the
+    caller can wait the fixture clean."""
+    slot_futs = []
+    for _ in range(2):
+        slot_futs.append(eng.submit([5, 9, 2, 7], 56))
+        deadline = time.monotonic() + 60
+        while eng._queue.qsize() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)  # admitted into a slot
+        assert eng._queue.qsize() == 0
+    queued = eng.submit([5, 9, 2, 7], 56)  # est 60 of 64 budget queued
+    return slot_futs + [queued]
+
+
+def test_shed_429_body_and_retry_after_contract(shed_server):
+    """With the admission queue already holding work near the budget, a
+    request that would push it over sheds with the pinned contract:
+    HTTP 429, JSON body naming the typed reason and retry_after_s, and
+    a Retry-After header that matches it."""
+    eng = shed_server.server.gen_engine
+    futs = _saturate(eng)
+    try:
+        resp = httpx.post(
+            shed_server.base + "/v2/models/llm/generate",
+            # est 4+56=60: queued 60 + 60 > budget 64 -> shed.
+            json={"prompt_ids": [5, 9, 2, 7], "max_new_tokens": 56},
+            timeout=30,
+        )
+        assert resp.status_code == 429, resp.text
+        body = resp.json()
+        assert body["reason"] == "budget"
+        assert body["retry_after_s"] >= 1
+        assert resp.headers["Retry-After"] == str(body["retry_after_s"])
+        assert "budget" in body["error"]
+        # Shed requests never reach the engine: the queue still holds
+        # exactly the one pre-shed request, in-flight is exactly the
+        # three admitted sequences, and the counter says why.
+        assert eng._queue.qsize() == 1
+        assert eng.inflight() == 3
+        assert _metric(
+            shed_server, "tpumlops_engine_shed_total", 'reason="budget"'
+        ) >= 1.0
+    finally:
+        for f in futs:
+            f.result(timeout=120)
+    # Engine idle again: the same request now serves 200.
+    ok = httpx.post(
+        shed_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 4},
+        timeout=60,
+    )
+    assert ok.status_code == 200, ok.text
+
+
+def test_oversized_single_request_admits_on_idle_engine(shed_server):
+    """The budget bounds the BACKLOG, not request size: a request whose
+    estimate alone exceeds the budget must ADMIT when the queue is
+    empty — shedding it would 429 identically on every replica, a
+    deterministic fleet-wide outage for servable work."""
+    eng = shed_server.server.gen_engine
+    deadline = time.monotonic() + 60
+    while eng.inflight() > 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    resp = httpx.post(
+        shed_server.base + "/v2/models/llm/generate",
+        # Two prompts, est 120 total > budget 64 — but the queue is
+        # empty, so it runs.
+        json={"prompt_ids": [[5, 9, 2, 7], [1, 2, 3, 4]],
+              "max_new_tokens": 56},
+        timeout=120,
+    )
+    assert resp.status_code == 200, resp.text
+    assert len(resp.json()["outputs"]) == 2
+
+
+def test_shed_is_atomic_for_multi_prompt_requests(shed_server):
+    """The whole-request reservation: a shed multi-prompt request must
+    not leave earlier siblings admitted (generating into abandoned
+    futures)."""
+    eng = shed_server.server.gen_engine
+    futs = _saturate(eng)
+    before = eng.shed_total
+    try:
+        resp = httpx.post(
+            shed_server.base + "/v2/models/llm/generate",
+            json={
+                "inputs": [
+                    {
+                        "name": "prompt_ids",
+                        "shape": [3, 4],
+                        "datatype": "INT64",
+                        "data": [5, 9, 2, 7] * 3,
+                    }
+                ],
+                "parameters": {"max_new_tokens": 40},
+            },
+            timeout=30,
+        )
+        assert resp.status_code == 429
+        assert eng.shed_total == before + 1  # ONE shed, whole request
+        assert eng.inflight() == 3  # no sibling joined the saturators
+    finally:
+        for f in futs:
+            f.result(timeout=120)
+
+
+def test_ready_flip_then_begin_drain_still_arms_engine(shed_server):
+    """The SIGTERM path flips ``ready = False`` (endpoint-removal lag)
+    BEFORE calling begin_drain(); begin_drain must still arm the engine
+    — an early-return on lifecycle == "draining" would leave the drain
+    admitting forever and wait_drained() spinning out its full grace."""
+    server = shed_server.server
+    eng = server.gen_engine
+    try:
+        server.ready = False  # phase 1: NotReady, still admitting
+        assert server.lifecycle == "draining"
+        assert not eng.draining
+        server.begin_drain()  # phase 2 must NOT be a no-op
+        assert eng.draining
+        assert eng.drained()  # idle fixture: drain completes instantly
+        # Once SIGTERM commits the exit, cancel is refused — a client
+        # must not re-open admissions on a dying pod.
+        server.terminating = True
+        assert server.cancel_drain() is False
+        assert server.lifecycle == "draining" and eng.draining
+    finally:
+        server.terminating = False
+        assert server.cancel_drain() is True
+        assert server.lifecycle == "ready" and not eng.draining
+
+
+def test_engine_level_shed_when_queue_over_budget():
+    """Direct engine contract: queued-but-unadmitted work past the
+    budget sheds synchronously; the queue and counters prove nothing
+    entered."""
+    import jax
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(0), cfg)
+    engine = GenerationEngine(
+        params, cfg, max_slots=1, admission_queue_budget=100
+    )
+    engine.start(warmup=False)
+    try:
+        # Slot 1 admits (leaves the queue); the next two queue 60 est
+        # tokens each: the second pushes 120 > 100 and sheds.
+        f1 = engine.submit([5, 9, 2, 7], 40)
+        deadline = time.monotonic() + 30
+        while engine._queue.qsize() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)  # wait for admission to drain the queue
+        f2 = engine.submit([5, 9, 2, 7], 56)  # queued: est 60 <= 100
+        with pytest.raises(EngineOverloaded) as err:
+            engine.submit([5, 9, 2, 7], 56)  # 60 + 60 > 100
+        assert err.value.reason == "budget"
+        assert err.value.retry_after_s >= 1
+        assert engine.shed_total == 1
+        assert engine._queue.qsize() == 1  # only f2's request is queued
+        import numpy as np
+
+        assert np.asarray(f1.result(timeout=60)).size == 40
+        assert np.asarray(f2.result(timeout=60)).size == 56
+    finally:
+        engine.shutdown()
+
+
+def test_sse_stream_survives_drain_and_new_requests_shed(tmp_path):
+    """The lossless-drain contract end to end: an SSE stream in flight
+    when /admin/drain lands keeps streaming to completion; new requests
+    shed 429 reason="draining"; /readyz flips to draining then the
+    drain reports zero in-flight."""
+    server = _build_llm_server(tmp_path, budget=0)
+    _SHED_PORT[0] += 1
+    handle = _HttpHandle(server, _SHED_PORT[0])
+    try:
+        drain_result = {}
+
+        def drain_midflight():
+            drain_result.update(
+                httpx.post(
+                    handle.base + "/admin/drain",
+                    json={"grace_s": 60},
+                    timeout=90,
+                ).json()
+            )
+
+        tokens = []
+        final = {}
+        with httpx.stream(
+            "POST",
+            handle.base + "/v2/models/llm/generate",
+            json={"prompt_ids": [5, 9, 2], "max_new_tokens": 24,
+                  "stream": True},
+            timeout=120,
+        ) as resp:
+            assert resp.status_code == 200
+            drainer = None
+            for line in resp.iter_lines():
+                if not line.startswith("data: "):
+                    continue
+                payload = json.loads(line[len("data: "):])
+                if payload.get("done"):
+                    final = payload
+                    break
+                tokens.append(payload["token"])
+                if len(tokens) == 2 and drainer is None:
+                    # Drain lands mid-stream, grace far longer than the
+                    # remaining generation.
+                    drainer = threading.Thread(target=drain_midflight)
+                    drainer.start()
+                    # Readiness flips promptly while the stream lives.
+                    deadline = time.monotonic() + 10
+                    while time.monotonic() < deadline:
+                        r = httpx.get(handle.base + "/readyz", timeout=5)
+                        if r.status_code == 503:
+                            break
+                        time.sleep(0.02)
+                    assert r.status_code == 503
+                    assert r.json()["lifecycle"] == "draining"
+                    # New work is shed, not dropped.
+                    shed = httpx.post(
+                        handle.base + "/v2/models/llm/generate",
+                        json={"prompt_ids": [5], "max_new_tokens": 2},
+                        timeout=30,
+                    )
+                    assert shed.status_code == 429
+                    assert shed.json()["reason"] == "draining"
+                    assert "Retry-After" in shed.headers
+        # The in-flight stream survived the drain to full completion.
+        assert "error" not in final, final
+        assert len(final["output_ids"]) == 24
+        assert len(tokens) == 24
+        if drainer is not None:
+            drainer.join(timeout=90)
+        assert drain_result.get("drained") is True
+        assert drain_result.get("inFlight") == 0
+        assert drain_result.get("lifecycle") == "draining"
+        # The drain is reversible (cancel): a stray or mistaken drain
+        # must not be a one-way kill switch on an unauthenticated
+        # endpoint.
+        undo = httpx.post(
+            handle.base + "/admin/drain", json={"cancel": True},
+            timeout=10,
+        )
+        assert undo.status_code == 200 and undo.json()["cancelled"]
+        assert httpx.get(handle.base + "/readyz", timeout=5).status_code \
+            == 200
+        ok = httpx.post(
+            handle.base + "/v2/models/llm/generate",
+            json={"prompt_ids": [5, 9, 2], "max_new_tokens": 2},
+            timeout=60,
+        )
+        assert ok.status_code == 200, ok.text
+    finally:
+        handle.stop()

@@ -254,3 +254,47 @@ def test_failed_frozen_split_is_still_available():
     assert _cond(c, "Available")["status"] == "True"
     assert _cond(c, "Degraded")["status"] == "True"
     assert _cond(c, "Degraded")["reason"] == "PromotionFailed"
+
+
+def test_autoscaler_fields_round_trip_and_default_omission():
+    from tpumlops.operator.state import Phase, PromotionState
+
+    plain = PromotionState(
+        phase=Phase.STABLE, current_version="1", traffic_current=100
+    )
+    status = plain.to_status()
+    # Autoscaling off: status byte-for-byte pre-autoscaler.
+    assert "replicas" not in status and "autoscaler" not in status
+    assert PromotionState.from_status(status) == plain
+
+    scaled = plain.with_(
+        replicas=3, scaler={"lastScaleTime": 123.0}
+    )
+    status = scaled.to_status()
+    assert status["replicas"] == 3
+    assert status["autoscaler"] == {"lastScaleTime": 123.0}
+    assert PromotionState.from_status(status) == scaled
+
+
+def test_autoscaler_fields_survive_every_transition():
+    """The scaled topology is the CR's capacity state, not a property of
+    one rollout: it must ride through new-version (canary entry),
+    promotion, rollback, and even the alias-missing teardown, so the
+    restored deployment comes back at strength."""
+    from tpumlops.operator.state import Phase, PromotionState
+
+    s = PromotionState(
+        phase=Phase.STABLE, current_version="1", traffic_current=100,
+        replicas=4, scaler={"lastScaleTime": 9.0},
+    )
+    canary = s.new_version("2", 10)
+    assert canary.phase == Phase.CANARY
+    assert canary.replicas == 4 and canary.scaler == {"lastScaleTime": 9.0}
+    stable = canary.promoted_step(90)
+    assert stable.phase == Phase.STABLE and stable.replicas == 4
+    rb = canary.rolled_back()
+    assert rb.replicas == 4 and rb.scaler == {"lastScaleTime": 9.0}
+    err = s.alias_missing("prod")
+    assert err.replicas == 4
+    fresh = err.new_version("3", 10)  # self-heal: back at strength
+    assert fresh.replicas == 4
