@@ -1169,6 +1169,38 @@ def _decode_hbm_bytes(params, cfg, slots: int, window: int, kv_quant: bool) -> i
     return quantized_bytes(params) + kv
 
 
+def _device_cost_keys(
+    params, cfg, slots: int, tok_per_s: float, kv_quant: bool = False
+) -> dict:
+    """The ``mfu`` / ``hbm_peak_bytes`` pair every serving scenario's
+    compact output carries (server/device_telemetry.py cost model):
+    ``mfu`` is model-forward tokens/s x 2 FLOPs/matmul-param against the
+    device peak (the weight-stream term; attention adds a few percent at
+    these shapes), ``hbm_peak_bytes`` the analytic ledger total (weights
+    + KV cache + sampling state) for the scenario's engine geometry.  On
+    the CPU dev tunnel mfu is honestly tiny; on chip it is the roofline
+    position the scenario's headline number sits at."""
+    from tpumlops.server.device_telemetry import (
+        LlamaCostModel,
+        build_hbm_ledger,
+        detect_peaks,
+        param_device_count,
+    )
+
+    peaks = detect_peaks().scaled(param_device_count(params))
+    cost = LlamaCostModel.for_model(params, cfg, kv_quant=kv_quant)
+    ledger = build_hbm_ledger(params, cfg, slots, kv_quant=kv_quant)
+    mfu = min(
+        1.0,
+        max(0.0, float(tok_per_s)) * 2.0 * cost.matmul_params
+        / peaks.flops_per_s,
+    )
+    return {
+        "mfu": float(f"{mfu:.3g}"),
+        "hbm_peak_bytes": ledger.device_total(),
+    }
+
+
 def bench_prefix_cache() -> dict:
     """Shared-prefix serving scenario: radix prefix KV cache
     (server/prefix_cache.py) at a small llama shape.
@@ -1265,6 +1297,7 @@ def bench_prefix_cache() -> dict:
         "cached_tokens_per_warm_hit": cached // hits,
         "hits": hits,
         "evictions": evictions,
+        **_device_cost_keys(params, cfg, 4, prompt_tokens / warm_ttft),
         "note": (
             "engine-loop TTFT rides the dev tunnel's ~65 ms/dispatch; the "
             "chunk-call drop (cold 5 -> warm 1 per admission) is the "
@@ -1420,6 +1453,7 @@ def bench_speculative() -> dict:
         "speedup_vs_plain_random": round(
             plain["random"]["wall_s"] / rnd["wall_s"], 2
         ),
+        **_device_cost_keys(params, cfg, 4, rep["tok_per_s"]),
         "plain": plain,
         "speculative": spec,
         "note": (
@@ -1499,12 +1533,14 @@ def bench_packed_prefill() -> dict:
                 return cb
 
             futs = []
+            t_burst = time.perf_counter()
             for i, p in enumerate(prompts):
                 t_sub[i] = time.perf_counter()
                 futs.append(engine.submit(p, NEW, on_token=on_token_for(i)))
             outs = [
                 np.asarray(f.result(timeout=600)).tolist() for f in futs
             ]
+            wall = time.perf_counter() - t_burst
             assert all(ev.wait(timeout=600) for ev in done)
             calls = engine.prefill_forwards - f0
         finally:
@@ -1513,6 +1549,7 @@ def bench_packed_prefill() -> dict:
         return {
             "ttft_p50_ms": round(p[50], 1),
             "ttft_p99_ms": round(p[99], 1),
+            "wall_s": wall,
             "chunk_calls": calls,
             "batch_fill_mean": (
                 round(sum(fills) / len(fills), 2) if fills else None
@@ -1557,6 +1594,10 @@ def bench_packed_prefill() -> dict:
         ),
         "batch_fill_mean": packed["batch_fill_mean"],
         "token_agreement": agreement,
+        **_device_cost_keys(
+            params, cfg, N_REQ,
+            N_REQ * (PROMPT + NEW) / packed["wall_s"],
+        ),
         "note": (
             "engine-loop TTFT rides the dev tunnel's ~65 ms/dispatch; "
             "the weight-streaming prefill call count (serial "
@@ -1666,10 +1707,125 @@ def bench_observability() -> dict:
         "ring_requests": snap["traces_recorded"],
         "trace_events": trace_events,
         "token_agreement": round(agree, 3),
+        **_device_cost_keys(params, cfg, SLOTS, on["tok_per_s"]),
         "note": (
             "recorder work is host-side ring appends between device "
             "dispatches; decode_step_ms (pure dispatch wall) isolates "
             "the device from the journaling cost"
+        ),
+    }
+
+
+def bench_device_telemetry() -> dict:
+    """Device telemetry layer (server/device_telemetry.py): the same
+    continuous-batching run with telemetry absent (the default — no
+    ledger, no cost model, no wrapped jits) vs fully on.
+
+    Three claims gated here: (1) tok/s with telemetry on is within noise
+    of off — the per-tick cost is a handful of float multiplies plus the
+    thread-local set/unset around each dispatch; (2) the analytic HBM
+    ledger agrees with ``device.memory_stats()`` within 10% where the
+    platform reports it (the CPU dev environment reports None — the
+    check is live on TPU); (3) per-tick MFU / bandwidth utilization land
+    in (0, 1] for the decode and prefill tick kinds.  Outputs agree
+    token-for-token: observation must not perturb scheduling."""
+    jax = _setup_jax()
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama
+    from tpumlops.server.device_telemetry import DeviceTelemetry
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=4000, hidden_size=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, intermediate_size=704, max_seq=256,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    N_REQ, PROMPT, NEW, SLOTS = 8, 32, 64, 4
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=PROMPT).tolist()
+        for _ in range(N_REQ)
+    ]
+
+    def run(telemetry):
+        engine = GenerationEngine(
+            params, cfg, max_slots=SLOTS, dtype=jnp.bfloat16,
+            telemetry=telemetry,
+        )
+        engine.start(warmup=True)
+        try:
+            t0 = time.perf_counter()
+            futs = [engine.submit(p, NEW) for p in prompts]
+            outs = [np.asarray(f.result(timeout=600)).tolist() for f in futs]
+            wall = time.perf_counter() - t0
+        finally:
+            engine.shutdown()
+        return {
+            "wall_s": wall,
+            "tok_per_s": N_REQ * NEW / wall,
+            "outputs": outs,
+        }
+
+    off = run(None)
+    telemetry = DeviceTelemetry()
+    on = run(telemetry)
+    snap = telemetry.snapshot()
+    hbm = snap["hbm"]
+    util = snap["utilization"]
+    agree = float(
+        np.mean(
+            [
+                x == y
+                for a, b in zip(off["outputs"], on["outputs"])
+                for x, y in zip(a, b)
+            ]
+        )
+    )
+    # Utilization contract: decode and prefill tick kinds produced
+    # ratios in (0, 1].  HARD assertions — a cost-model regression
+    # (negative bytes, >1 MFU) must fail the bench.
+    for kind in ("decode", "prefill"):
+        assert kind in util, util
+        assert 0.0 < util[kind]["mfu"] <= 1.0, (kind, util[kind])
+        assert 0.0 < util[kind]["hbm_bw_util"] <= 1.0, (kind, util[kind])
+    # Ledger-vs-measured: live only where memory_stats() reports.
+    if hbm.get("ledger_vs_measured_pct") is not None:
+        assert abs(hbm["ledger_vs_measured_pct"]) <= 10.0, hbm
+    overhead_pct = 100.0 * (1.0 - on["tok_per_s"] / off["tok_per_s"])
+    return {
+        "requests": N_REQ,
+        "new_tokens_per_request": NEW,
+        "slots": SLOTS,
+        "tok_per_s_off": round(off["tok_per_s"], 1),
+        "tok_per_s_on": round(on["tok_per_s"], 1),
+        # Negative = the telemetry run was faster (run-to-run noise on a
+        # shared host; the contract is "within noise of 0").
+        "overhead_pct": round(overhead_pct, 2),
+        "hbm_ledger_total_bytes": hbm["device_total_bytes"],
+        "ledger_vs_measured_pct": hbm.get("ledger_vs_measured_pct"),
+        "kv_bytes_per_row": hbm["kv_bytes_per_row"],
+        "max_cache_rows": hbm["max_cache_rows"],
+        "decode_mfu": util["decode"]["mfu"],
+        "decode_hbm_bw_util": util["decode"]["hbm_bw_util"],
+        "prefill_mfu": util["prefill"]["mfu"],
+        "warmup_compiles": snap["compile"]["warmup"].get("compiles", 0),
+        "warmup_compile_s": round(
+            snap["compile"]["warmup"].get("seconds", 0.0), 2
+        ),
+        "token_agreement": round(agree, 3),
+        **_device_cost_keys(params, cfg, SLOTS, on["tok_per_s"]),
+        "note": (
+            "telemetry work is host-side arithmetic between device "
+            "dispatches; ledger_vs_measured is None off-TPU "
+            "(memory_stats unavailable) and the 10%-agreement gate "
+            "arms itself where the platform reports"
         ),
     }
 
@@ -1739,6 +1895,7 @@ def bench_admission_control() -> dict:
                 return cb
 
             futs, shed = [], 0
+            t_burst = time.perf_counter()
             for i, p in enumerate(prompts):
                 t_sub[i] = time.perf_counter()
                 try:
@@ -1749,6 +1906,7 @@ def bench_admission_control() -> dict:
                     shed += 1
                     done[i].set()
             outs = [f.result(timeout=600) for _, f in futs]
+            wall = time.perf_counter() - t_burst
             assert all(ev.wait(timeout=600) for ev in done)
             admitted_ttft = [
                 ttfts[i] * 1000 for i, _ in futs if ttfts[i] is not None
@@ -1762,6 +1920,7 @@ def bench_admission_control() -> dict:
             "completed_ok": len(outs),
             "ttft_p50_ms": round(p[50], 1),
             "ttft_p99_ms": round(p[99], 1),
+            "wall_s": wall,
         }
 
     unbounded = run(0)
@@ -1789,6 +1948,10 @@ def bench_admission_control() -> dict:
         "admitted_ttft_p50_ms_bounded": bounded["ttft_p50_ms"],
         "ttft_p99_improvement": round(
             unbounded["ttft_p99_ms"] / max(1e-9, bounded["ttft_p99_ms"]), 2
+        ),
+        **_device_cost_keys(
+            params, cfg, SLOTS,
+            bounded["completed_ok"] * NEW / bounded["wall_s"],
         ),
         "note": (
             "2x-capacity burst; bounded mode converts the overload tail "
@@ -2198,6 +2361,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("packed_prefill_serving", "bench_packed_prefill"),
     ("admission_control_serving", "bench_admission_control"),
     ("observability_serving", "bench_observability"),
+    ("device_telemetry_serving", "bench_device_telemetry"),
     ("llama_1p35b_decode", "bench_llama_decode"),
     ("serve_path_http", "bench_serve_path"),
     ("llama_7b_decode", "bench_llama_7b_decode"),
@@ -2214,28 +2378,38 @@ SCENARIO_SCHEMAS: dict = {
         "serial_ttft_p50_ms", "serial_ttft_p99_ms", "serial_chunk_calls",
         "packed_ttft_p50_ms", "packed_ttft_p99_ms", "packed_chunk_calls",
         "ttft_p50_speedup", "chunk_call_reduction", "batch_fill_mean",
-        "token_agreement",
+        "token_agreement", "mfu", "hbm_peak_bytes",
     ),
     "prefix_cache_serving": (
         "cold_ttft_ms", "warm_ttft_ms", "ttft_speedup",
         "chunks_cold", "chunks_warm", "hits", "evictions",
+        "mfu", "hbm_peak_bytes",
     ),
     "speculative_serving": (
         "rep_forwards_per_token", "rep_acceptance_rate",
         "rnd_forwards_per_token", "plain_forwards_per_token",
-        "speedup_vs_plain_repetitive",
+        "speedup_vs_plain_repetitive", "mfu", "hbm_peak_bytes",
     ),
     "observability_serving": (
         "tok_per_s_off", "tok_per_s_on", "overhead_pct",
         "decode_step_ms_off", "decode_step_ms_on",
         "ring_ticks", "trace_events", "token_agreement",
+        "mfu", "hbm_peak_bytes",
+    ),
+    "device_telemetry_serving": (
+        "tok_per_s_off", "tok_per_s_on", "overhead_pct",
+        "hbm_ledger_total_bytes", "ledger_vs_measured_pct",
+        "kv_bytes_per_row", "max_cache_rows",
+        "decode_mfu", "decode_hbm_bw_util", "prefill_mfu",
+        "warmup_compiles", "warmup_compile_s", "token_agreement",
+        "mfu", "hbm_peak_bytes",
     ),
     "admission_control_serving": (
         "requests", "slots", "budget_tokens", "shed", "shed_rate",
         "completed_ok",
         "admitted_ttft_p99_ms_unbounded", "admitted_ttft_p99_ms_bounded",
         "admitted_ttft_p50_ms_unbounded", "admitted_ttft_p50_ms_bounded",
-        "ttft_p99_improvement",
+        "ttft_p99_improvement", "mfu", "hbm_peak_bytes",
     ),
 }
 
@@ -2310,19 +2484,26 @@ _COMPACT_KEYS = {
     "llama_1p35b_decode": (
         "device_tok_per_s", "slots", "bw_util_at_best"),
     "prefix_cache_serving": (
-        "cold_ttft_ms", "warm_ttft_ms", "chunks_cold", "chunks_warm"),
+        "cold_ttft_ms", "warm_ttft_ms", "chunks_cold", "chunks_warm",
+        "mfu", "hbm_peak_bytes"),
     "speculative_serving": (
         "rep_forwards_per_token", "plain_forwards_per_token",
-        "rep_acceptance_rate", "speedup_vs_plain_repetitive"),
+        "rep_acceptance_rate", "speedup_vs_plain_repetitive",
+        "mfu", "hbm_peak_bytes"),
     "packed_prefill_serving": (
         "serial_ttft_p50_ms", "packed_ttft_p50_ms",
         "serial_chunk_calls", "packed_chunk_calls",
-        "chunk_call_reduction"),
+        "chunk_call_reduction", "mfu", "hbm_peak_bytes"),
     "observability_serving": (
-        "tok_per_s_off", "tok_per_s_on", "overhead_pct"),
+        "tok_per_s_off", "tok_per_s_on", "overhead_pct",
+        "mfu", "hbm_peak_bytes"),
+    "device_telemetry_serving": (
+        "overhead_pct", "decode_mfu", "ledger_vs_measured_pct",
+        "mfu", "hbm_peak_bytes"),
     "admission_control_serving": (
         "shed_rate", "admitted_ttft_p99_ms_unbounded",
-        "admitted_ttft_p99_ms_bounded", "ttft_p99_improvement"),
+        "admitted_ttft_p99_ms_bounded", "ttft_p99_improvement",
+        "mfu", "hbm_peak_bytes"),
     "serve_path_http": (
         "server_queue_mean_ms", "server_device_run_mean_ms",
         "server_pipeline_wait_mean_ms", "server_observed_mean_ms",

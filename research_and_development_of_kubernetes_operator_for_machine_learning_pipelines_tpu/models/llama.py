@@ -150,6 +150,24 @@ class LlamaConfig:
         return cls(**defaults)
 
 
+def matmul_param_count(cfg: LlamaConfig) -> int:
+    """Weight-matrix elements one token-position multiplies through in a
+    forward pass: q/k/v/o projections, the SwiGLU MLP triple, and the
+    untied LM head (embedding lookups move bytes, not FLOPs).  The
+    device-telemetry cost model's dominant term — 2 FLOPs per element
+    per position — kept HERE so it can never drift from the layer
+    geometry it describes."""
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    per_layer = (
+        h * nh * hd          # q
+        + 2 * h * nkv * hd   # k, v
+        + nh * hd * h        # o
+        + 3 * h * i          # gate, up, down
+    )
+    return cfg.num_layers * per_layer + h * v
+
+
 class KVCache(NamedTuple):
     """Static-shape KV cache: (layers, batch, max_seq, kv_heads, head_dim).
 

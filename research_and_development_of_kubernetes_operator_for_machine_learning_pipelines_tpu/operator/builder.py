@@ -164,6 +164,11 @@ def _tpu_pod_spec(
         container["args"] += [
             "--drain-grace-seconds", str(tpu.drain_grace_s),
         ]
+    if tpu.observability.device_telemetry:
+        # Appended only when enabled (same byte-identity contract as the
+        # admission/drain flags): an unannotated CR's manifest must stay
+        # byte-for-byte what it was before the device telemetry layer.
+        container["args"] += ["--device-telemetry", "1"]
     if info.hosts > 1:
         unit = worker_unit_name(deployment_name, version)
         container["env"] += [

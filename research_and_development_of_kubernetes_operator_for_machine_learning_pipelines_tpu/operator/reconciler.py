@@ -37,7 +37,11 @@ from ..clients.base import (
     SELDONDEPLOYMENT,
 )
 from ..utils.clock import Clock, SystemClock
-from ..utils.config import OperatorConfig
+from ..utils.config import (
+    OperatorConfig,
+    TPU_HBM_GIB_PER_CHIP,
+    TPU_TOPOLOGIES,
+)
 from ..utils.logging import model_logger
 from .builder import build_deployment
 from .judge import should_promote
@@ -49,6 +53,32 @@ from .uri import artifact_uri
 # plane's analogue of the server's ``tpumlops.request`` completion line):
 # CR identity + decision + margins, machine-parseable in both log modes.
 _gate_log = logging.getLogger("tpumlops.gate")
+
+
+def _capacity_summary(config: OperatorConfig) -> "dict | None":
+    """``status.capacity``: what the operator scheduled, in device terms
+    — topology, chips, HBM — so the CR itself answers "how much hardware
+    does this model hold" (the server's ledger answers how it is spent).
+    None unless ``spec.tpu.observability.deviceTelemetry`` on a ``tpu``
+    backend: the disabled status patch stays byte-for-byte."""
+    if config.backend != "tpu" or not config.tpu.observability.device_telemetry:
+        return None
+    info = TPU_TOPOLOGIES.get(config.tpu.topology)
+    if info is None:
+        return None
+    hbm_per_chip = TPU_HBM_GIB_PER_CHIP.get(info.accelerator)
+    out = {
+        "topology": config.tpu.topology,
+        "chips": info.chips,
+        "hosts": info.hosts,
+        "meshShape": dict(config.tpu.mesh_shape),
+        "quantize": config.tpu.quantize,
+        "deviceTelemetry": True,
+    }
+    if hbm_per_chip is not None:
+        out["hbmGiBPerChip"] = hbm_per_chip
+        out["hbmGiBTotal"] = hbm_per_chip * info.chips
+    return out
 
 
 class _OpTimer:
@@ -191,6 +221,11 @@ class Reconciler:
                 (obj.get("metadata") or {}).get("generation")
             )
         outcome = self._reconcile_inner(obj)
+        # Capacity-summary sync runs on EVERY path (ERROR-parked and
+        # held CRs included — the journal keys have per-branch shedding,
+        # capacity is cheaper to sync centrally): one patch when the
+        # spec-derived summary differs from what status carries.
+        self._sync_capacity_status(outcome.state)
         outcome.timings = self._timings
         outcome.scale = self._scale_record
         # Flush the step's journal records.  Gate records get the step's
@@ -217,12 +252,25 @@ class Reconciler:
             prior_status.get("replicas") is not None
             or prior_status.get("autoscaler") is not None
         )
+        # Device-telemetry capacity summary: recomputed from spec each
+        # step (no state round-trip needed); the explicit-null contract
+        # mirrors the journal/scaler keys so disabling clears it once.
+        self._had_capacity_key = prior_status.get("capacity") is not None
+        self._prior_capacity = prior_status.get("capacity")
+        self._capacity_status = None
+        # Unknown until the spec parses: a config-error step must leave
+        # status.capacity untouched (neither refreshed nor nulled) — the
+        # summary still reflects the last VALID spec, and a transient
+        # typo in an unrelated field must not wipe it.
+        self._capacity_known = False
         state = PromotionState.from_status(obj.get("status"))
         events: list[Event] = []
         try:
             config = OperatorConfig.from_spec(obj.get("spec") or {})
         except ValueError as e:
             return self._on_config_error(state, str(e), events)
+        self._capacity_status = _capacity_summary(config)
+        self._capacity_known = True
 
         # 1. Resolve alias -> version (reference :57-62).
         try:
@@ -280,6 +328,22 @@ class Reconciler:
             state = self._shed_disabled_journal(config, state)
             state = self._autoscale_step(obj, config, state, events)
         return ReconcileOutcome(state, config.monitoring_interval_s, events)
+
+    def _sync_capacity_status(self, state: PromotionState) -> None:
+        """Quiescent-CR capacity sync: transitions carry the key on their
+        own patches, but a STABLE CR whose deviceTelemetry was just
+        toggled (or whose topology spec changed) would otherwise never
+        see status.capacity appear/refresh/clear — one patch, then
+        steady state is patch-free again."""
+        if not getattr(self, "_capacity_known", False):
+            return  # config never parsed this step: leave status alone
+        cap = self._capacity_status
+        prior = getattr(self, "_prior_capacity", None)
+        if cap == prior:
+            return
+        if cap is None and not getattr(self, "_had_capacity_key", False):
+            return
+        self._patch_status(state)
 
     def _shed_disabled_journal(
         self, config: OperatorConfig, state: PromotionState
@@ -1105,6 +1169,15 @@ class Reconciler:
         if getattr(self, "_had_scaler_keys", False):
             status.setdefault("replicas", None)
             status.setdefault("autoscaler", None)
+        if getattr(self, "_capacity_known", False):
+            cap = self._capacity_status
+            if cap is not None:
+                status["capacity"] = cap
+            elif getattr(self, "_had_capacity_key", False):
+                status.setdefault("capacity", None)
+            # Any patch carries the current summary (or its explicit
+            # null), so the end-of-step sync knows nothing is left to do.
+            self._prior_capacity = cap
         status["conditions"] = state.conditions(
             getattr(self, "_prior_conditions", None), now_iso
         )

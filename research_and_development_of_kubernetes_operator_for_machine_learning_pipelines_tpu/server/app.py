@@ -140,6 +140,7 @@ class TpuInferenceServer:
         max_inflight_batches: int = 2,
         recorder=None,
         drain_grace_s: float = 20.0,
+        telemetry=None,
     ):
         self.engine = engine
         self.metrics = metrics
@@ -158,6 +159,7 @@ class TpuInferenceServer:
         self.terminating = False
         self.gen_engine = gen_engine  # GenerationEngine for causal-LM flavors
         self.recorder = recorder  # flight_recorder.FlightRecorder | None
+        self.telemetry = telemetry  # device_telemetry.DeviceTelemetry | None
         import threading
 
         self._profile_lock = threading.Lock()
@@ -249,6 +251,12 @@ class TpuInferenceServer:
 
     def shutdown(self) -> None:
         self.lifecycle = "shutdown"
+        if self.telemetry is not None:
+            # Stop the process-global compile listeners attributing into
+            # this (now retired) server's observatory and metrics.
+            from ..utils.compile_cache import detach_observatory
+
+            detach_observatory(self.telemetry.observatory)
         self.batcher.stop()
         if self.gen_engine is not None:
             self.gen_engine.shutdown()
@@ -772,6 +780,21 @@ class TpuInferenceServer:
             status=400,
         )
 
+    async def handle_debug_device(self, request: web.Request) -> web.Response:
+        """Device telemetry snapshot: HBM ledger vs measured memory,
+        per-tick-kind utilization, compile observatory (spec.tpu.
+        observability.deviceTelemetry; 404 names the knob when off)."""
+        if self.telemetry is None:
+            return web.json_response(
+                {
+                    "error": "device telemetry disabled; set "
+                    "spec.tpu.observability.deviceTelemetry "
+                    "(--device-telemetry 1)"
+                },
+                status=404,
+            )
+        return await self._debug_json(self.telemetry.snapshot)
+
     async def handle_debug_spans(self, request: web.Request) -> web.Response:
         """GLOBAL_TRACER span stats (count/mean/max per name) — the
         control-plane tracer finally readable off the data plane too."""
@@ -881,6 +904,7 @@ class TpuInferenceServer:
         app.router.add_get("/debug/engine", self.handle_debug_engine)
         app.router.add_get("/debug/trace", self.handle_debug_trace)
         app.router.add_get("/debug/spans", self.handle_debug_spans)
+        app.router.add_get("/debug/device", self.handle_debug_device)
 
         async def on_shutdown(_app):
             self.shutdown()
@@ -969,7 +993,8 @@ def _to_v2_outputs(out: Any) -> list[dict]:
 
 
 def make_gen_engine(
-    predictor, config: ServerConfig, channel=None, metrics=None, recorder=None
+    predictor, config: ServerConfig, channel=None, metrics=None,
+    recorder=None, telemetry=None,
 ):
     """Construct the GenerationEngine for a causal-LM predictor.
 
@@ -1041,6 +1066,9 @@ def make_gen_engine(
         # submissions): shed past the queued-token budget, 429 upstream.
         admission_queue_budget=config.tpu.admission_queue_budget,
         on_shed=metrics.inc_shed if metrics else None,
+        # Leader-side only, like the recorder: the ledger/observatory
+        # describe the scheduling process; followers replay blind.
+        telemetry=telemetry,
     )
 
 
@@ -1055,6 +1083,13 @@ def build_server(
     Single-host units pass None and run the engine directly.
     """
     mesh_shape = dict(config.tpu.mesh_shape)
+    telemetry = None
+    if config.tpu.observability.device_telemetry:
+        from .device_telemetry import DeviceTelemetry
+
+        # Before load_predictor so even the loader-phase compiles (the
+        # streamed quantizer) land in the observatory's journal.
+        telemetry = DeviceTelemetry()
     predictor = load_predictor(
         config.model_uri, mesh_shape=mesh_shape, quantize=config.tpu.quantize
     )
@@ -1062,7 +1097,10 @@ def build_server(
         deployment_name=config.deployment_name or config.model_name,
         predictor_name=config.predictor_name,
         namespace=config.namespace,
+        device_telemetry=telemetry is not None,
     )
+    if telemetry is not None:
+        telemetry.bind_metrics(metrics)
     engine = InferenceEngine(
         predictor,
         max_batch_size=config.tpu.max_batch_size,
@@ -1088,7 +1126,7 @@ def build_server(
         # main()'s follower path and driven by follower_loop).
         gen_engine = make_gen_engine(
             predictor, config, channel=channel, metrics=metrics,
-            recorder=recorder,
+            recorder=recorder, telemetry=telemetry,
         )
     server = TpuInferenceServer(
         engine,
@@ -1100,6 +1138,7 @@ def build_server(
         max_inflight_batches=config.tpu.max_inflight_batches,
         recorder=recorder,
         drain_grace_s=config.tpu.drain_grace_s,
+        telemetry=telemetry,
     )
     server.startup(warmup=warmup)
     return server
@@ -1274,6 +1313,16 @@ def main(argv: list[str] | None = None) -> None:
         "0 disables recording entirely (the default — zero overhead)",
     )
     ap.add_argument(
+        "--device-telemetry",
+        type=int,
+        default=0,
+        help="1 enables the device telemetry layer: analytic HBM ledger "
+        "(GET /debug/device, tpumlops_device_hbm_bytes), per-op compile "
+        "observatory (tpumlops_compile_*), and per-tick MFU/HBM-bandwidth "
+        "utilization gauges + recorder fields; 0 (default) constructs "
+        "none of it",
+    )
+    ap.add_argument(
         "--log-format",
         default="text",
         choices=["text", "json"],
@@ -1323,7 +1372,10 @@ def main(argv: list[str] | None = None) -> None:
                     "ngramMax": args.speculative_ngram_max,
                     "adaptive": bool(args.speculative_adaptive),
                 },
-                "observability": {"traceRing": args.trace_ring},
+                "observability": {
+                    "traceRing": args.trace_ring,
+                    "deviceTelemetry": bool(args.device_telemetry),
+                },
                 "admissionQueueBudget": args.admission_queue_budget,
                 "drainGraceSeconds": args.drain_grace_seconds,
             }

@@ -1,0 +1,643 @@
+"""Device telemetry: HBM ledger, compile observatory, cost model + MFU.
+
+Every perf PR so far justified itself with hand-derived "weight streams
+per token" arithmetic; this module makes the hardware story a measured,
+served surface instead of a code comment.  Three parts:
+
+- **HBM ledger** — an analytic byte ledger of what the serving process
+  holds on device (weight tree by dtype, KV cache incl. the int8kv
+  layout's scale planes, per-slot sampling state) cross-checked against
+  ``device.memory_stats()`` where the platform provides it.  Served at
+  ``GET /debug/device``, exported as ``tpumlops_device_hbm_bytes
+  {component}``, and stamped into the model-capacity startup log line
+  (``server/loader.py`` emits that line even with telemetry off).
+- **Compile observatory** — wraps every engine jit dispatch so each XLA
+  compilation is attributed to the op that triggered it (decode buckets,
+  verify variants, prefill B_p buckets, seed ops), with wall time and
+  persistent-cache hit/miss from ``utils/compile_cache``'s jax
+  monitoring hooks.  One structured ``tpumlops.compile`` log line per
+  compilation; ``tpumlops_compile_seconds_total{op}`` and
+  ``tpumlops_compile_cache_{hits,misses}_total`` series; a warning when
+  the warmup sweep exceeds the readiness budget (cold-start is a
+  first-class serving cost — "Breaking the Ice", PAPERS.md).
+- **Cost model + utilization** — analytic per-program FLOPs / HBM-bytes
+  estimates for the llama serving programs, joined with flight-recorder
+  tick walls into per-tick-kind MFU and HBM-bandwidth utilization.  The
+  ENGINE path is analytic by design: its programs are jit-dispatched
+  with donated buffers, so there is no compiled object in hand and an
+  AOT re-lower just to ask XLA's opinion would double every compile.
+  :func:`cost_from_analysis` is the adapter for contexts that DO hold a
+  ``Compiled`` (scripts, notebooks, AOT tooling — ``lower().compile()
+  .cost_analysis()``), and the test suite uses it to cross-check the
+  analytic numbers against XLA's own count.  Exposed surfaces:
+  ``mfu`` / ``hbm_bw_util`` fields on recorder ticks, Perfetto counter
+  tracks in ``/debug/trace``, and ``tpumlops_device_{mfu,hbm_bw_util}
+  {kind}`` gauges.
+
+Error bars (documented in docs/OBSERVABILITY.md): the analytic FLOPs
+count is exact for the matmul tree and counts the attention einsums at
+the full padded window, so MFU is a lower bound on "useful" utilization
+by at most the padding fraction; HBM bytes assume each weight byte and
+each attended cache byte streams exactly once (XLA re-reads under
+fusion-decline pathologies, so bw_util can read > 1 of the *model*
+while still < 1 of the wire — values are clamped to (0, 1]).
+
+``spec.tpu.observability.deviceTelemetry`` (CRD -> config -> builder
+``--device-telemetry`` -> server CLI) gates the whole layer; off — the
+default — constructs nothing and every payload stays byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+_log = logging.getLogger("tpumlops.device_telemetry")
+_compile_log = logging.getLogger("tpumlops.compile")
+
+# Warmup sweep budget before a warning fires: the builder's readiness
+# probe window is initialDelay 10 + period 5 x failureThreshold 60 =
+# 310 s; a sweep past ~300 s risks the kubelet killing the pod
+# mid-compile (SURVEY §7 hard part 3).
+READINESS_BUDGET_S = 300.0
+
+
+# ---------------------------------------------------------------------------
+# Device facts (peaks the utilization ratios divide by)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DevicePeaks:
+    """Peak rates the utilization ratios are read against.
+
+    ``chips`` is how many chips the numbers cover: the cost model and
+    ledger count the WHOLE (possibly sharded) model, so the peaks must
+    cover the whole device set holding it — a tp=8 mesh divides by 8x
+    the per-chip roofline, or every ratio reads 8x high and clamps."""
+
+    kind: str  # jax device_kind (or the assumed stand-in)
+    flops_per_s: float  # dense peak for the serving dtype family
+    hbm_bytes_per_s: float
+    hbm_bytes: int  # HBM capacity
+    source: str  # "detected" | "assumed"
+    chips: int = 1
+
+    def scaled(self, chips: int) -> "DevicePeaks":
+        import dataclasses
+
+        n = max(1, int(chips))
+        return dataclasses.replace(
+            self,
+            flops_per_s=self.flops_per_s * n,
+            hbm_bytes_per_s=self.hbm_bytes_per_s * n,
+            hbm_bytes=self.hbm_bytes * n,
+            chips=n,
+        )
+
+
+def param_device_count(params) -> int:
+    """Devices the param tree is actually sharded over (1 for the
+    default unsharded tree, even when more devices are visible)."""
+    try:
+        import jax
+
+        leaf = jax.tree.leaves(params)[0]
+        return max(1, len(leaf.sharding.device_set))
+    except Exception:
+        return 1
+
+
+# v5e: 197 bf16 TFLOP/s, 819 GB/s, 16 GiB HBM (bench.py's constants of
+# record).  Matching is by device_kind substring; unknown kinds (the CPU
+# dev environment) fall back to the v5e row marked "assumed" so ratios
+# stay computable — tiny on CPU, honest on the target part.
+_KNOWN_DEVICES = {
+    "v5 lite": ("tpu-v5e", 197e12, 819e9, 16 * 2**30),
+    "v5e": ("tpu-v5e", 197e12, 819e9, 16 * 2**30),
+    "v4": ("tpu-v4", 275e12, 1228e9, 32 * 2**30),
+}
+_ASSUMED = ("tpu-v5e (assumed)", 197e12, 819e9, 16 * 2**30)
+
+
+def detect_peaks() -> DevicePeaks:
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        kind = "unknown"
+    for marker, (name, fl, bw, hbm) in _KNOWN_DEVICES.items():
+        if marker in kind:
+            return DevicePeaks(name, fl, bw, hbm, "detected")
+    name, fl, bw, hbm = _ASSUMED
+    return DevicePeaks(name, fl, bw, hbm, "assumed")
+
+
+def measured_memory() -> dict | None:
+    """``device.memory_stats()`` summed over the ADDRESSABLE devices
+    (TPU/GPU runtimes report it; CPU returns None).  ``devices`` counts
+    how many reported — on a multi-host unit each process sees only its
+    local chips, so the ledger cross-check scales by the addressable
+    fraction (see :meth:`HbmLedger.snapshot`)."""
+    try:
+        import jax
+
+        devs = jax.local_devices()
+    except Exception:
+        return None
+    totals: dict[str, int] = {}
+    reporting = 0
+    for dev in devs:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        reporting += 1
+        for k, v in stats.items():
+            if isinstance(v, (int, float)):
+                totals[k] = totals.get(k, 0) + int(v)
+    if reporting == 0:
+        return None
+    totals["devices"] = reporting
+    return totals
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger
+# ---------------------------------------------------------------------------
+
+
+def weights_bytes_by_dtype(params) -> dict[str, int]:
+    """Parameter bytes grouped by dtype as stored (int8 leaves count
+    1 byte/elem; their f32 scale planes land under float32)."""
+    import jax
+
+    out: dict[str, int] = {}
+    for leaf in jax.tree.leaves(params):
+        name = str(leaf.dtype)
+        out[name] = out.get(name, 0) + int(leaf.size) * leaf.dtype.itemsize
+    return out
+
+
+def kv_cache_bytes_per_row(cfg, kv_quant: bool, dtype_bytes: int = 2) -> int:
+    """Bytes one cache row (slot at full ``max_seq``) holds: k + v across
+    all layers, plus the int8kv layout's per-(pos, head) f32 scales."""
+    elems = cfg.num_layers * cfg.num_kv_heads * cfg.max_seq * cfg.head_dim
+    if kv_quant:
+        # int8 values + f32 scale per head_dim group, for k and v each.
+        return 2 * (elems + (elems // cfg.head_dim) * 4)
+    return 2 * elems * dtype_bytes
+
+
+def sampling_state_bytes(max_slots: int) -> int:
+    """Engine per-slot device state outside the cache: token buffer
+    (int32), PRNG keys (2x uint32), temps/topk/topp (4 B each)."""
+    return max_slots * (4 + 8 + 4 + 4 + 4)
+
+
+@dataclass
+class HbmLedger:
+    """Analytic device-byte ledger, cross-checkable against
+    ``memory_stats()``.  ``components`` are on-device; ``host_components``
+    (the prefix cache's host-RAM budget) ride along for the capacity
+    story but never count toward the device total."""
+
+    components: dict[str, int] = field(default_factory=dict)
+    host_components: dict[str, int] = field(default_factory=dict)
+    kv_bytes_per_row: int = 0
+    max_slots: int = 0
+
+    def device_total(self) -> int:
+        return sum(self.components.values())
+
+    def max_cache_rows(self, hbm_bytes: int) -> int:
+        """Full-capacity KV rows that fit beside the weights — the
+        capacity number the autoscaler/operator plans against."""
+        if self.kv_bytes_per_row <= 0:
+            return 0
+        spare = hbm_bytes - sum(
+            v for k, v in self.components.items() if not k.startswith("kv_")
+        )
+        return max(0, spare // self.kv_bytes_per_row)
+
+    def snapshot(self, peaks: DevicePeaks | None = None) -> dict:
+        peaks = peaks or detect_peaks()
+        measured = measured_memory()
+        out = {
+            "components": dict(self.components),
+            "host_components": dict(self.host_components),
+            "device_total_bytes": self.device_total(),
+            "kv_bytes_per_row": self.kv_bytes_per_row,
+            "max_slots": self.max_slots,
+            "hbm_capacity_bytes": peaks.hbm_bytes,
+            "hbm_source": peaks.source,
+            "max_cache_rows": self.max_cache_rows(peaks.hbm_bytes),
+            "measured": measured,
+        }
+        if measured and measured.get("bytes_in_use"):
+            # Multi-host: this process addresses only its local chips,
+            # which hold addressable/total of the sharded model — scale
+            # the ledger to what THESE chips should hold before
+            # comparing.
+            frac = min(1.0, measured["devices"] / max(1, peaks.chips))
+            expected = self.device_total() * frac
+            out["ledger_vs_measured_pct"] = round(
+                100.0 * (expected - measured["bytes_in_use"])
+                / max(1, measured["bytes_in_use"]),
+                1,
+            )
+        return out
+
+
+def build_hbm_ledger(
+    params,
+    cfg,
+    max_slots: int,
+    kv_quant: bool = False,
+    dtype_bytes: int = 2,
+    prefix_cache_budget_bytes: int = 0,
+) -> HbmLedger:
+    ledger = HbmLedger(
+        kv_bytes_per_row=kv_cache_bytes_per_row(cfg, kv_quant, dtype_bytes),
+        max_slots=int(max_slots),
+    )
+    for dtype, nbytes in weights_bytes_by_dtype(params).items():
+        ledger.components[f"weights_{dtype}"] = nbytes
+    ledger.components["kv_cache"] = ledger.kv_bytes_per_row * int(max_slots)
+    ledger.components["sampling_state"] = sampling_state_bytes(max_slots)
+    if prefix_cache_budget_bytes:
+        ledger.host_components["prefix_cache_budget"] = int(
+            prefix_cache_budget_bytes
+        )
+    return ledger
+
+
+def capacity_log_line(params, cfg, kv_quant: bool) -> str:
+    """The model-capacity startup line ``server/loader.py`` stamps (even
+    with telemetry off): weights by dtype, KV bytes/row, max cache rows.
+    HBM covers the device set the params are sharded over."""
+    peaks = detect_peaks().scaled(param_device_count(params))
+    by_dtype = weights_bytes_by_dtype(params)
+    total = sum(by_dtype.values())
+    per_row = kv_cache_bytes_per_row(cfg, kv_quant)
+    spare = peaks.hbm_bytes - total
+    rows = max(0, spare // per_row) if per_row else 0
+    dtypes = ", ".join(
+        f"{k}={v / 2**20:.1f}MiB" for k, v in sorted(by_dtype.items())
+    )
+    chips = f" x{peaks.chips}" if peaks.chips > 1 else ""
+    return (
+        f"model capacity: weights {total / 2**20:.1f} MiB ({dtypes}), "
+        f"kv {per_row} B/row (max_seq {cfg.max_seq}"
+        f"{', int8kv' if kv_quant else ''}), "
+        f"max cache rows {rows} "
+        f"(hbm {peaks.hbm_bytes / 2**30:.1f} GiB "
+        f"{peaks.source} {peaks.kind}{chips})"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Compile observatory
+# ---------------------------------------------------------------------------
+
+
+class CompileObservatory:
+    """Attributes every XLA compilation to the engine op that triggered
+    it.
+
+    The engine wraps each jitted callable with :meth:`wrap_jit`; the
+    wrapper pins the op name in a thread-local for the duration of the
+    call, and ``utils/compile_cache``'s jax monitoring hooks deliver
+    (compile wall, cache hit/miss) events back through :meth:`on_event`
+    — compiles are synchronous inside the triggering dispatch, so the
+    attribution is exact.  Each compilation logs one structured
+    ``tpumlops.compile`` line (from ``utils/compile_cache``, which asks
+    this observatory for the current op)."""
+
+    MAX_EVENTS = 256
+
+    def __init__(self, readiness_budget_s: float = READINESS_BUDGET_S):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.readiness_budget_s = float(readiness_budget_s)
+        # op -> {"compiles", "seconds", "cache_hits", "cache_misses"}
+        self.ops: dict[str, dict] = {}
+        self.events: list[dict] = []  # newest-last, bounded
+        self._in_warmup = False
+        self.warmup: dict = {}
+        self._on_compile = None  # (op, seconds) -> None (metrics hookup)
+        self._on_cache = None  # (hit: bool) -> None
+
+    # -- wiring ---------------------------------------------------------------
+
+    def install(self) -> None:
+        """Register with utils/compile_cache's monitoring hooks (idempotent
+        there); safe to call before any jit."""
+        from ..utils.compile_cache import install_compile_listeners
+
+        install_compile_listeners(observatory=self)
+
+    def set_metrics_hooks(self, on_compile=None, on_cache=None) -> None:
+        self._on_compile = on_compile
+        self._on_cache = on_cache
+
+    def wrap_jit(self, op: str, fn):
+        """Wrap a jitted callable so compiles inside it attribute to
+        ``op``.  Transparent otherwise — same args, same returns."""
+
+        def wrapped(*args, **kwargs):
+            prev = getattr(self._tls, "op", None)
+            self._tls.op = op
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                self._tls.op = prev
+
+        wrapped.__name__ = f"observed_{op}"
+        return wrapped
+
+    def current_op(self) -> str:
+        return getattr(self._tls, "op", None) or "other"
+
+    # -- event sinks (called from utils/compile_cache's listeners) -----------
+
+    def on_event(self, kind: str, seconds: float = 0.0) -> None:
+        """``kind``: "compile" (with backend wall) or "cache_hit" /
+        "cache_miss" (persistent-cache outcome of the compile request)."""
+        op = self.current_op()
+        with self._lock:
+            rec = self.ops.setdefault(
+                op,
+                {"compiles": 0, "seconds": 0.0,
+                 "cache_hits": 0, "cache_misses": 0},
+            )
+            if kind == "compile":
+                rec["compiles"] += 1
+                rec["seconds"] += seconds
+                self.events.append(
+                    {"op": op, "seconds": round(seconds, 4),
+                     "ts": time.time(), "warmup": self._in_warmup}
+                )
+                del self.events[: -self.MAX_EVENTS]
+                if self._in_warmup:
+                    self.warmup["compiles"] = self.warmup.get("compiles", 0) + 1
+                    self.warmup["seconds"] = (
+                        self.warmup.get("seconds", 0.0) + seconds
+                    )
+            elif kind == "cache_hit":
+                rec["cache_hits"] += 1
+            elif kind == "cache_miss":
+                rec["cache_misses"] += 1
+        if kind == "compile" and self._on_compile is not None:
+            self._on_compile(op, seconds)
+        elif kind in ("cache_hit", "cache_miss") and self._on_cache is not None:
+            self._on_cache(kind == "cache_hit")
+
+    # -- warmup sweep ---------------------------------------------------------
+
+    def begin_warmup(self) -> None:
+        with self._lock:
+            self._in_warmup = True
+            self.warmup = {"compiles": 0, "seconds": 0.0}
+            self._t_warmup = time.perf_counter()
+
+    def end_warmup(self) -> dict:
+        with self._lock:
+            self._in_warmup = False
+            self.warmup["wall_s"] = round(
+                time.perf_counter() - getattr(self, "_t_warmup", 0.0), 2
+            )
+            report = dict(self.warmup)
+        if report["wall_s"] > self.readiness_budget_s:
+            _log.warning(
+                "warmup sweep took %.1fs (> readiness budget %.0fs): "
+                "%d compiles, %.1fs of XLA work — the kubelet may kill "
+                "this pod mid-compile; pre-seed the persistent compile "
+                "cache or raise the readiness window",
+                report["wall_s"], self.readiness_budget_s,
+                report["compiles"], report["seconds"],
+            )
+        else:
+            _compile_log.info(
+                "warmup sweep done compiles=%d compile_s=%.2f wall_s=%.2f",
+                report["compiles"], report["seconds"], report["wall_s"],
+            )
+        return report
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ops": {k: dict(v) for k, v in self.ops.items()},
+                "events": [dict(e) for e in self.events],
+                "warmup": dict(self.warmup),
+                "readiness_budget_s": self.readiness_budget_s,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+# ---------------------------------------------------------------------------
+
+
+def cost_from_analysis(analysis) -> tuple[float, float] | None:
+    """Parse an XLA ``Compiled.cost_analysis()`` payload into
+    ``(flops, hbm_bytes)`` (jax returns a dict, or a 1-list of dicts on
+    older versions).  For callers that hold a compiled object — scripts
+    / AOT tooling / the cross-check test — NOT the engine hot path,
+    which is analytic by design (its programs are jit-dispatched with
+    donated buffers; see the module docstring)."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    flops = float(analysis.get("flops", 0.0))
+    nbytes = float(analysis.get("bytes accessed", 0.0))
+    if flops <= 0.0 and nbytes <= 0.0:
+        return None
+    return flops, nbytes
+
+
+@dataclass(frozen=True)
+class LlamaCostModel:
+    """Analytic per-program FLOPs / HBM-bytes for the llama serving
+    programs.  ``matmul_params`` is the weight-matrix element count (the
+    2-flops-per-param term); ``weight_bytes`` the tree as stored (int8
+    leaves 1 B) — every program streams it once."""
+
+    matmul_params: int
+    weight_bytes: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    kv_elem_bytes: float  # bytes per cache element incl. scale overhead
+
+    @classmethod
+    def for_model(cls, params, cfg, kv_quant: bool = False,
+                  dtype_bytes: int = 2) -> "LlamaCostModel":
+        import jax
+
+        from ..models.llama import matmul_param_count
+
+        wbytes = sum(
+            int(leaf.size) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(params)
+        )
+        hd = cfg.head_dim
+        kv_eb = 1 + 4.0 / hd if kv_quant else float(dtype_bytes)
+        return cls(
+            matmul_params=matmul_param_count(cfg),
+            weight_bytes=wbytes,
+            num_layers=cfg.num_layers,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=hd,
+            kv_elem_bytes=kv_eb,
+        )
+
+    def _kv_bytes(self, rows: int, positions: float) -> float:
+        """k+v cache traffic for ``rows`` rows over ``positions`` each."""
+        return (
+            2.0 * rows * positions * self.num_layers * self.num_kv_heads
+            * self.head_dim * self.kv_elem_bytes
+        )
+
+    def decode(self, rows: int, window: int, s: int = 1
+               ) -> tuple[float, float]:
+        """One decode (``s=1``) or verify (``s`` positions/row) tick over
+        ``rows`` cache rows attending ``window`` positions."""
+        flops = 2.0 * self.matmul_params * rows * s
+        flops += 4.0 * rows * s * window * self.num_heads * self.head_dim
+        nbytes = self.weight_bytes + self._kv_bytes(rows, window)
+        nbytes += self._kv_bytes(rows, s)  # fresh K/V written
+        return flops, nbytes
+
+    def prefill(self, rows: int, chunk: int, attended: float | None = None
+                ) -> tuple[float, float]:
+        """One prefill call: ``rows`` rows of ``chunk`` tokens each,
+        attending ``attended`` mean positions (defaults to the causal
+        mean over the chunk itself)."""
+        if attended is None:
+            attended = chunk / 2.0
+        flops = 2.0 * self.matmul_params * rows * chunk
+        flops += 4.0 * rows * chunk * attended * self.num_heads * self.head_dim
+        nbytes = self.weight_bytes + self._kv_bytes(rows, chunk)
+        nbytes += self._kv_bytes(rows, max(0.0, attended - chunk / 2.0))
+        return flops, nbytes
+
+    def seed(self, tokens: int) -> tuple[float, float]:
+        """Prefix-cache seed: a pure K/V copy — read + write, no flops."""
+        return 0.0, 2.0 * self._kv_bytes(1, tokens)
+
+
+# ---------------------------------------------------------------------------
+# Facade the server wires together
+# ---------------------------------------------------------------------------
+
+
+class DeviceTelemetry:
+    """One object per server process: ledger + observatory + cost model.
+
+    Constructed only when ``spec.tpu.observability.deviceTelemetry`` is
+    on; ``None`` everywhere otherwise, so the disabled path allocates
+    nothing and every existing payload stays byte-for-byte."""
+
+    def __init__(self, metrics=None,
+                 readiness_budget_s: float = READINESS_BUDGET_S):
+        # Per-chip until attach_model scales to the param-holding device
+        # set; _chip_peaks keeps the pristine base so a rebind/re-attach
+        # can never compound the scaling.
+        self._chip_peaks = detect_peaks()
+        self.peaks = self._chip_peaks
+        self.observatory = CompileObservatory(readiness_budget_s)
+        self.observatory.install()
+        self.ledger: HbmLedger | None = None
+        self.cost: LlamaCostModel | None = None
+        self._metrics = None
+        # Last computed utilization per tick kind (the /debug/device
+        # mirror of the gauges).  Written by the engine scheduler
+        # thread, read by the /debug/device executor thread — the lock
+        # covers the first-tick-of-a-new-kind insert racing a snapshot
+        # iteration.
+        self._util_lock = threading.Lock()
+        self.last_util: dict[str, dict] = {}
+        if metrics is not None:
+            self.bind_metrics(metrics)
+
+    def bind_metrics(self, metrics) -> None:
+        """Hook the Prometheus families (present only when the registry
+        was built with ``device_telemetry=True``)."""
+        if getattr(metrics, "device_hbm_bytes", None) is None:
+            return
+        self._metrics = metrics
+        self.observatory.set_metrics_hooks(
+            on_compile=metrics.observe_compile,
+            on_cache=metrics.observe_compile_cache,
+        )
+
+    def attach_model(self, params, cfg, max_slots: int,
+                     kv_quant: bool = False, dtype_bytes: int = 2,
+                     prefix_cache_budget_bytes: int = 0) -> None:
+        """Build the ledger + cost model once the engine geometry is
+        known; exports the per-component HBM gauges.  Peaks scale to the
+        device set actually holding the params (the cost model and
+        ledger count the whole sharded model)."""
+        self.peaks = self._chip_peaks.scaled(param_device_count(params))
+        self.ledger = build_hbm_ledger(
+            params, cfg, max_slots, kv_quant=kv_quant,
+            dtype_bytes=dtype_bytes,
+            prefix_cache_budget_bytes=prefix_cache_budget_bytes,
+        )
+        self.cost = LlamaCostModel.for_model(
+            params, cfg, kv_quant=kv_quant, dtype_bytes=dtype_bytes
+        )
+        if self._metrics is not None:
+            for comp, nbytes in self.ledger.components.items():
+                self._metrics.observe_hbm_component(comp, nbytes)
+            self._metrics.observe_hbm_component(
+                "total", self.ledger.device_total()
+            )
+
+    def tick_util(self, kind: str, wall_s: float, flops: float,
+                  hbm_bytes: float) -> dict:
+        """Join one tick's wall with its program cost: MFU and HBM-BW
+        utilization, clamped to (0, 1] (see the module docstring's error
+        bars).  Returns the dict merged onto the recorder tick."""
+        wall = max(wall_s, 1e-9)
+        mfu = min(1.0, flops / wall / self.peaks.flops_per_s)
+        bw = min(1.0, hbm_bytes / wall / self.peaks.hbm_bytes_per_s)
+        # 3 significant digits, NOT fixed decimals: a CPU dev tick's
+        # 4e-7 MFU must stay > 0 (the in-(0,1] contract), and a real
+        # chip's 0.41 needs no more precision.
+        util = {
+            "mfu": float(f"{mfu:.3g}") if flops > 0 else 0.0,
+            "hbm_bw_util": float(f"{bw:.3g}"),
+        }
+        with self._util_lock:
+            self.last_util[kind] = util
+        if self._metrics is not None:
+            self._metrics.observe_device_util(kind, mfu, bw)
+        return util
+
+    def snapshot(self) -> dict:
+        """The ``GET /debug/device`` payload."""
+        with self._util_lock:
+            utilization = {k: dict(v) for k, v in self.last_util.items()}
+        return {
+            "peaks": {
+                "device": self.peaks.kind,
+                "source": self.peaks.source,
+                "chips": self.peaks.chips,
+                "flops_per_s": self.peaks.flops_per_s,
+                "hbm_bytes_per_s": self.peaks.hbm_bytes_per_s,
+                "hbm_bytes": self.peaks.hbm_bytes,
+            },
+            "hbm": self.ledger.snapshot(self.peaks) if self.ledger else None,
+            "utilization": utilization,
+            "compile": self.observatory.snapshot(),
+        }

@@ -147,6 +147,7 @@ class FlightRecorder:
         batch_fill: int = 0,
         tokens: int = 0,
         spec_accepted: int = 0,
+        util: dict | None = None,
     ) -> None:
         rec = {
             "ts_us": self._us(t0),
@@ -158,6 +159,12 @@ class FlightRecorder:
             "tokens": int(tokens),
             "spec_accepted": int(spec_accepted),
         }
+        if util:
+            # Device telemetry only (spec.tpu.observability.
+            # deviceTelemetry): mfu / hbm_bw_util from the analytic cost
+            # model joined with this tick's wall.  Absent otherwise, so
+            # the telemetry-off tick record stays byte-for-byte.
+            rec.update(util)
         with self._lock:
             self.ticks_recorded += 1
             self._ticks.append(rec)
@@ -280,6 +287,23 @@ class FlightRecorder:
                     },
                 }
             )
+            if "mfu" in t:
+                # Device-telemetry counter tracks: Perfetto renders one
+                # counter per name, one series per args key (tick kind)
+                # — the utilization staircase next to the tick track.
+                # Emitted only for ticks carrying the fields, so the
+                # telemetry-off export stays byte-for-byte.
+                for counter in ("mfu", "hbm_bw_util"):
+                    out.append(
+                        {
+                            "name": counter,
+                            "cat": "utilization",
+                            "ph": "C",
+                            "ts": t["ts_us"],
+                            "pid": 1,
+                            "args": {t["kind"]: t[counter]},
+                        }
+                    )
         for e in events:
             out.append(
                 {

@@ -266,6 +266,7 @@ class GenerationEngine:
         recorder=None,  # flight_recorder.FlightRecorder | None
         admission_queue_budget: int = 0,
         on_shed: Callable[[str], None] | None = None,
+        telemetry=None,  # device_telemetry.DeviceTelemetry | None
     ):
         import jax
         import jax.numpy as jnp
@@ -364,6 +365,10 @@ class GenerationEngine:
         self._on_request_tokens = on_request_tokens
         self._on_tick = on_tick
         self._recorder = recorder
+        # Device telemetry (HBM ledger + compile observatory + per-tick
+        # MFU/bandwidth; spec.tpu.observability.deviceTelemetry).  None
+        # — the default — wraps nothing and computes nothing per tick.
+        self._telemetry = telemetry
         # JAX dispatch is async: a prefill/seed call returns before the
         # device finishes, and the wait would otherwise be absorbed into
         # the NEXT decode tick's wall — the exact mis-attribution the
@@ -375,7 +380,9 @@ class GenerationEngine:
         # syncs in the default deployment, or traceRing=0 would no
         # longer be the byte-for-byte unobserved engine loop; without
         # the recorder, non-decode tick-metric walls are dispatch-only.
-        self._sync_ticks = recorder is not None
+        # Device telemetry also syncs: a dispatch-only prefill wall would
+        # read as an absurd MFU.
+        self._sync_ticks = recorder is not None or telemetry is not None
         if prefix_enabled:
             from .prefix_cache import RadixPrefixCache
 
@@ -694,6 +701,38 @@ class GenerationEngine:
 
         self._read_slot = jax.jit(_read_chunk_slot)
 
+        if telemetry is not None:
+            # Compile observatory: every engine jit dispatch is wrapped so
+            # XLA compilations attribute to the op that triggered them
+            # (decode buckets x verify variants x prefill B_p buckets x
+            # seed ops).  The wrapper is a thread-local set/unset around
+            # the call — no per-dispatch device work.
+            obs = telemetry.observatory
+            self._decode = obs.wrap_jit("decode", self._decode)
+            self._decode_greedy = obs.wrap_jit("decode", self._decode_greedy)
+            self._verify = obs.wrap_jit("verify", self._verify)
+            self._prefill_insert = obs.wrap_jit("prefill", self._prefill_insert)
+            self._prefill_one_chunk = obs.wrap_jit(
+                "prefill", self._prefill_one_chunk
+            )
+            self._insert_only = obs.wrap_jit("prefill", self._insert_only)
+            self._prefill_chunks = obs.wrap_jit(
+                "packed-prefill", self._prefill_chunks
+            )
+            self._seed_chunk = obs.wrap_jit("seed", self._seed_chunk)
+            self._read_chunk = obs.wrap_jit("seed", self._read_chunk)
+            self._seed_slot = obs.wrap_jit("seed", self._seed_slot)
+            self._read_slot = obs.wrap_jit("seed", self._read_slot)
+            prefix_budget = (
+                int(prefix_cache.budget_bytes) if prefix_enabled else 0
+            )
+            telemetry.attach_model(
+                params, cfg, self.max_slots,
+                kv_quant=self._kv_quant,
+                dtype_bytes=jnp.dtype(dtype).itemsize,
+                prefix_cache_budget_bytes=prefix_budget,
+            )
+
         self._slots: list[_Slot | None] = [None] * self.max_slots
         self._pending: list[_PrefillProgress] = []
         # Packed mode: cache rows reserved by in-flight admissions (their
@@ -817,6 +856,11 @@ class GenerationEngine:
 
         t0 = time.perf_counter()
         self._in_warmup = True
+        if self._telemetry is not None:
+            # Compile observatory: the sweep's compiles/seconds roll up
+            # into a warmup report, warned about when they exceed the
+            # readiness budget (the kubelet's probe window).
+            self._telemetry.observatory.begin_warmup()
         try:
             if self._prefix_cache is not None:
                 # Compile the prefix-cache seed (dispatched: followers
@@ -925,6 +969,8 @@ class GenerationEngine:
                     )
         finally:
             self._in_warmup = False
+            if self._telemetry is not None:
+                self._telemetry.observatory.end_warmup()
         # Reset state so warmup tokens never leak into a real response.
         slot = self._slots[0]
         if slot is not None:
@@ -1249,6 +1295,7 @@ class GenerationEngine:
                 "prefill", t0, time.perf_counter() - t0,
                 active_slots=sum(s is not None for s in self._slots),
                 batch_fill=1, tokens=1,
+                cost=self._cost_prefill(1, bucket),
             )
         if req.trace is not None:
             req.trace.slot = slot_idx
@@ -1281,11 +1328,18 @@ class GenerationEngine:
     def _record_tick(
         self, kind: str, t0: float, wall_s: float, *,
         active_slots: int = 0, batch_fill: int = 0, tokens: int = 0,
-        spec_accepted: int = 0,
+        spec_accepted: int = 0, cost=None,
     ) -> None:
         """Journal one engine device dispatch (tick-kind metric + flight
         recorder).  Callers skip warmup themselves; both sinks are
-        optional and the default (both None) costs one branch."""
+        optional and the default (both None) costs one branch.
+
+        ``cost`` is the tick's analytic ``(flops, hbm_bytes)`` (device
+        telemetry only, None otherwise): joined with the wall into MFU /
+        bandwidth utilization — gauges plus extra recorder-tick fields."""
+        util = None
+        if self._telemetry is not None and cost is not None:
+            util = self._telemetry.tick_util(kind, wall_s, *cost)
         if self._on_tick is not None:
             self._on_tick(kind, wall_s)
         if self._recorder is not None:
@@ -1296,7 +1350,26 @@ class GenerationEngine:
                 batch_fill=batch_fill,
                 tokens=tokens,
                 spec_accepted=spec_accepted,
+                util=util,
             )
+
+    def _cost_decode(self, window: int, s: int = 1):
+        """Analytic (flops, bytes) of one decode/verify tick — the
+        program computes EVERY cache row (inactive rows too; the MXU
+        does not care), so the cost counts ``max_slots``."""
+        if self._telemetry is None or self._telemetry.cost is None:
+            return None
+        return self._telemetry.cost.decode(self.max_slots, window, s)
+
+    def _cost_prefill(self, rows: int, chunk: int, attended=None):
+        if self._telemetry is None or self._telemetry.cost is None:
+            return None
+        return self._telemetry.cost.prefill(rows, chunk, attended)
+
+    def _cost_seed(self, tokens: int):
+        if self._telemetry is None or self._telemetry.cost is None:
+            return None
+        return self._telemetry.cost.seed(tokens)
 
     def _trace_event(self, trace, name: str, slot: int = -1) -> None:
         if (
@@ -1725,6 +1798,7 @@ class GenerationEngine:
                         "seed", ts, time.perf_counter() - ts,
                         active_slots=sum(s is not None for s in self._slots),
                         batch_fill=1,
+                        cost=self._cost_seed(prog.cached_tokens),
                     )
                     self._trace_event(prog.req.trace, "seed", slot=prog.slot)
             else:
@@ -1768,10 +1842,17 @@ class GenerationEngine:
                 1 for prog in chunk_progs
                 if prog.next_idx == len(prog.chunks) - 1
             )
+            # The compiled program computes every row of the B_p bucket
+            # (parked pad rows included); the mean attended span is over
+            # the REAL chunks' offsets.
+            attended = (
+                sum(float(offsets[i]) for i in range(n)) / n + C / 2
+            )
             self._record_tick(
                 "packed-prefill", t0, time.perf_counter() - t0,
                 active_slots=sum(s is not None for s in self._slots),
                 batch_fill=n, tokens=finals,
+                cost=self._cost_prefill(bucket, C, attended=attended),
             )
         for i, prog in enumerate(chunk_progs):
             if prog.req.trace is not None:
@@ -1981,20 +2062,24 @@ class GenerationEngine:
                     "seed", ts, time.perf_counter() - ts,
                     active_slots=sum(s is not None for s in self._slots),
                     batch_fill=1,
+                    cost=self._cost_seed(prog.cached_tokens),
                 )
                 self._trace_event(prog.req.trace, "seed")
             return  # suffix chunks start next tick (decode cadence kept)
         ids = prog.chunks[prog.next_idx]
+        offset = prog.cached_tokens + prog.next_idx * self._prefill_chunk_size
         ts = time.perf_counter()
         self._dispatch_chunk(ids, fresh=prog.next_idx == 0 and not prog.seeded)
         if not self._in_warmup:
             self.prefill_chunks_dispatched += 1
             self.prefill_forwards += 1
             self._sync_seq_state()
+            C = self._prefill_chunk_size
             self._record_tick(
                 "prefill", ts, time.perf_counter() - ts,
                 active_slots=sum(s is not None for s in self._slots),
                 batch_fill=1,
+                cost=self._cost_prefill(1, C, attended=offset + C / 2),
             )
         if prog.req.trace is not None:
             prog.req.trace.prefill_chunks += 1
@@ -2140,7 +2225,10 @@ class GenerationEngine:
         t0 = time.perf_counter()
         self._dispatch_step(active_np, window, sampling)
         toks = np.asarray(self._tokens)[:, 0]
-        self._note_tick(active_np, t0, tokens=int(active_np.sum()))
+        self._note_tick(
+            active_np, t0, tokens=int(active_np.sum()),
+            cost=self._cost_decode(window),
+        )
         for i, was_active in enumerate(active_np):
             if was_active and self._slots[i] is not None:
                 self._record_token(i, int(toks[i]))
@@ -2149,7 +2237,7 @@ class GenerationEngine:
 
     def _note_tick(
         self, active_np, t0: float, kind: str = "decode",
-        tokens: int = 0, spec_accepted: int = 0,
+        tokens: int = 0, spec_accepted: int = 0, cost=None,
     ) -> None:
         if self._in_warmup:
             return
@@ -2158,7 +2246,7 @@ class GenerationEngine:
         self._record_tick(
             kind, t0, wall,
             active_slots=int(active_np.sum()),
-            tokens=tokens, spec_accepted=spec_accepted,
+            tokens=tokens, spec_accepted=spec_accepted, cost=cost,
         )
         if self._on_step is not None:
             # queue depth counts QUEUED-BUT-UNADMITTED requests only; the
@@ -2227,6 +2315,7 @@ class GenerationEngine:
             active_np, t0, kind="verify",
             tokens=int(active_np.sum()) + acc_total,
             spec_accepted=acc_total,
+            cost=self._cost_decode(window, s_draft + 1),
         )
         if not self._in_warmup:
             self.spec_verify_ticks += 1

@@ -31,6 +31,10 @@ import numpy as np
 from ..models.registry import Predictor, get_builder
 
 _log = logging.getLogger(__name__)
+# The model-capacity startup line (weights by dtype, KV bytes/row, max
+# cache rows) — its own logger so dashboards/tests grep one name.
+# Emitted for every causal-LM load regardless of deviceTelemetry.
+_capacity_log = logging.getLogger("tpumlops.capacity")
 
 MIRROR_ENV = "TPUMLOPS_ARTIFACT_MIRROR"
 
@@ -281,6 +285,29 @@ def _finish_native(
     if cfg is not None:
         kwargs["cfg"] = cfg
     return get_builder(flavor)(params, **kwargs)
+
+
+def _log_capacity(predictor, quantize: str | None) -> None:
+    """One startup capacity line per causal-LM load: the analytic HBM
+    story (weights bytes by dtype, KV bytes per cache row, max rows the
+    device could hold) a capacity planner needs BEFORE any traffic —
+    emitted even with deviceTelemetry off (the telemetry layer serves
+    the live, cross-checked version at /debug/device)."""
+    lm = getattr(predictor, "causal_lm", None)
+    if not lm:
+        return
+    try:
+        from .device_telemetry import capacity_log_line
+
+        _capacity_log.info(
+            "%s",
+            capacity_log_line(
+                lm["params"], lm["cfg"], kv_quant=quantize == "int8kv"
+            ),
+        )
+    except Exception:
+        # Telemetry must never fail a load.
+        _log.debug("capacity summary failed", exc_info=True)
 
 
 def _find_hf_checkpoint(path: Path) -> Path | None:
@@ -628,7 +655,7 @@ def load_predictor(
             path,
             " (int8 quantized on arrival)" if stream_quant else "",
         )
-        return _finish_native(
+        pred = _finish_native(
             flavor,
             params,
             cfg,
@@ -637,6 +664,8 @@ def load_predictor(
             "none" if stream_quant else quantize,
             raw_config=meta.get("config", {}),
         )
+        _log_capacity(pred, quantize)
+        return pred
 
     hf_dir = _find_hf_checkpoint(path)
     if hf_dir is not None:
@@ -644,10 +673,12 @@ def load_predictor(
             hf_dir
         )
         _log.info("loaded transformers %s model from %s", flavor, hf_dir)
-        return _finish_native(
+        pred = _finish_native(
             flavor, params, cfg, builder_kwargs, mesh_shape, quantize,
             raw_config=raw_config,
         )
+        _log_capacity(pred, quantize)
+        return pred
 
     if quantize and quantize != "none":
         # The JAX-native paths (llama, bert) handled quantize above; what

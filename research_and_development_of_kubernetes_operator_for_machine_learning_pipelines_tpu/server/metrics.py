@@ -36,7 +36,13 @@ _LATENCY_BUCKETS = (
 
 
 class ServerMetrics:
-    def __init__(self, deployment_name: str, predictor_name: str, namespace: str):
+    def __init__(
+        self,
+        deployment_name: str,
+        predictor_name: str,
+        namespace: str,
+        device_telemetry: bool = False,
+    ):
         self.registry = CollectorRegistry()
         self.identity = {
             "deployment_name": deployment_name,
@@ -284,6 +290,58 @@ class ServerMetrics:
             ident_labels + ["reason"],
             registry=self.registry,
         )
+        # Device telemetry layer (server/device_telemetry.py), registered
+        # ONLY when spec.tpu.observability.deviceTelemetry is on: even an
+        # unobserved labeled family adds HELP/TYPE lines to the
+        # exposition, and the disabled contract is byte-for-byte.
+        self.device_hbm_bytes = None
+        self.device_mfu = None
+        self.device_hbm_bw_util = None
+        self.compile_seconds = None
+        self.compile_cache_hits = None
+        self.compile_cache_misses = None
+        if device_telemetry:
+            self.device_hbm_bytes = Gauge(
+                "tpumlops_device_hbm_bytes",
+                "Analytic HBM ledger: bytes held on device by component "
+                "(weights_<dtype>, kv_cache, sampling_state, total)",
+                ident_labels + ["component"],
+                registry=self.registry,
+            )
+            self.device_mfu = Gauge(
+                "tpumlops_device_mfu",
+                "Model FLOPs utilization of the most recent engine tick "
+                "of each kind (analytic cost model / device peak)",
+                ident_labels + ["kind"],
+                registry=self.registry,
+            )
+            self.device_hbm_bw_util = Gauge(
+                "tpumlops_device_hbm_bw_util",
+                "HBM bandwidth utilization of the most recent engine "
+                "tick of each kind (analytic bytes / device peak)",
+                ident_labels + ["kind"],
+                registry=self.registry,
+            )
+            self.compile_seconds = Counter(
+                "tpumlops_compile_seconds",
+                "XLA backend-compile wall seconds attributed to the "
+                "engine op that triggered the compilation",
+                ident_labels + ["op"],
+                registry=self.registry,
+            )
+            self.compile_cache_hits = Counter(
+                "tpumlops_compile_cache_hits",
+                "Persistent compile-cache hits (compile requests served "
+                "by deserializing a cached executable)",
+                ident_labels,
+                registry=self.registry,
+            )
+            self.compile_cache_misses = Counter(
+                "tpumlops_compile_cache_misses",
+                "Persistent compile-cache misses (full XLA compilations)",
+                ident_labels,
+                registry=self.registry,
+            )
         self.ready = Gauge(
             "tpumlops_model_ready",
             "1 once the model is loaded and warmed",
@@ -388,6 +446,30 @@ class ServerMetrics:
 
     def inc_prefix_evictions(self, n: int = 1):
         self.prefix_cache_evictions.labels(**self.identity).inc(n)
+
+    # -- device telemetry (families exist only with deviceTelemetry on) ------
+
+    def observe_hbm_component(self, component: str, nbytes: int):
+        if self.device_hbm_bytes is not None:
+            self.device_hbm_bytes.labels(
+                **self.identity, component=component
+            ).set(nbytes)
+
+    def observe_device_util(self, kind: str, mfu: float, bw_util: float):
+        if self.device_mfu is not None:
+            self.device_mfu.labels(**self.identity, kind=kind).set(mfu)
+            self.device_hbm_bw_util.labels(**self.identity, kind=kind).set(
+                bw_util
+            )
+
+    def observe_compile(self, op: str, seconds: float):
+        if self.compile_seconds is not None:
+            self.compile_seconds.labels(**self.identity, op=op).inc(seconds)
+
+    def observe_compile_cache(self, hit: bool):
+        if self.compile_cache_hits is not None:
+            (self.compile_cache_hits if hit else self.compile_cache_misses
+             ).labels(**self.identity).inc()
 
     def inc_generated_tokens(self, n: int = 1):
         # Separate from observe_decode_step: the first token of every

@@ -30,8 +30,104 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 
 _log = logging.getLogger("tpumlops.compile_cache")
+# One structured line per compilation (see install_compile_listeners).
+_compile_log = logging.getLogger("tpumlops.compile")
+
+# Process-wide compile/cache counters, fed by jax's monitoring events
+# (install_compile_listeners).  "hits"/"misses" are persistent-cache
+# outcomes of compile requests; "persists" counts misses taken while a
+# cache dir was active (with our min-entry floors at zero, every such
+# miss writes an entry); "compiles" counts backend compilations and
+# "compile_seconds" their summed wall.
+COUNTERS = {
+    "hits": 0, "misses": 0, "persists": 0,
+    "compiles": 0, "compile_seconds": 0.0,
+}
+_counters_lock = threading.Lock()
+_listeners_installed = False
+_reset_failure_logged = False
+_observatory = None  # server.device_telemetry.CompileObservatory | None
+
+
+def install_compile_listeners(observatory=None) -> None:
+    """Hook jax's monitoring stream: persistent-cache hit/miss events and
+    backend compile durations feed :data:`COUNTERS`, one structured
+    ``tpumlops.compile`` log line fires per compilation, and — when a
+    :class:`~..server.device_telemetry.CompileObservatory` is supplied —
+    each event is attributed to the engine op that triggered it.
+
+    Idempotent for the listeners (first call wins); the observatory
+    reference is refreshed on every call so a server rebuild re-binds."""
+    global _listeners_installed, _observatory
+    if observatory is not None:
+        _observatory = observatory
+    if _listeners_installed:
+        return
+    try:
+        from jax._src import monitoring
+    except Exception as exc:  # private API moved: counters stay at 0
+        _log.warning("jax monitoring unavailable (%s); compile/cache "
+                     "counters disabled", exc)
+        _listeners_installed = True
+        return
+    monitoring.register_event_listener(_on_jax_event)
+    monitoring.register_event_duration_secs_listener(_on_jax_duration)
+    _listeners_installed = True
+
+
+def detach_observatory(observatory) -> None:
+    """Unbind a CompileObservatory (server shutdown): the jax listeners
+    stay (they are process-global and cheap) but stop attributing into
+    a retired server's observatory — whose metrics hooks would
+    otherwise keep incrementing a dead registry and pin the whole
+    server object graph for the life of the process."""
+    global _observatory
+    if _observatory is observatory:
+        _observatory = None
+
+
+def _on_jax_event(name: str, **kwargs) -> None:
+    if name == "/jax/compilation_cache/cache_hits":
+        kind = "cache_hit"
+        with _counters_lock:
+            COUNTERS["hits"] += 1
+    elif name == "/jax/compilation_cache/cache_misses":
+        kind = "cache_miss"
+        import jax
+
+        with _counters_lock:
+            COUNTERS["misses"] += 1
+            if jax.config.jax_compilation_cache_dir:
+                COUNTERS["persists"] += 1
+    else:
+        return
+    if _observatory is not None:
+        _observatory.on_event(kind)
+
+
+def _on_jax_duration(name: str, duration: float, **kwargs) -> None:
+    if name != "/jax/core/compile/backend_compile_duration":
+        return
+    with _counters_lock:
+        COUNTERS["compiles"] += 1
+        COUNTERS["compile_seconds"] += duration
+        hits, misses = COUNTERS["hits"], COUNTERS["misses"]
+    op = _observatory.current_op() if _observatory is not None else "other"
+    _compile_log.info(
+        "compiled op=%s wall_ms=%.1f cache_hits=%d cache_misses=%d",
+        op, duration * 1000.0, hits, misses,
+        extra={"compile_op": op, "compile_wall_s": duration},
+    )
+    if _observatory is not None:
+        _observatory.on_event("compile", duration)
+
+
+def counters_snapshot() -> dict:
+    with _counters_lock:
+        return dict(COUNTERS)
 
 
 def enable_persistent_compile_cache(
@@ -55,6 +151,11 @@ def enable_persistent_compile_cache(
     """
     import jax
 
+    # Counters + the per-compile tpumlops.compile log line are a
+    # compile-cache feature, not a telemetry-gated one: every server that
+    # configures caching (the CLI default) gets them; DeviceTelemetry
+    # re-binds its observatory for per-op attribution on top.
+    install_compile_listeners()
     if not cache_dir:
         # JAX reads JAX_COMPILATION_CACHE_DIR as this option's import-time
         # default; clear it so "disabled" really disables, even when the
@@ -101,12 +202,25 @@ def _reset_jax_cache_singleton(jax) -> None:
     and never re-reads ``jax_compilation_cache_dir`` afterwards — if any
     jit ran before this helper (or the helper runs twice with different
     dirs), the config update is silently ignored without this reset."""
+    global _reset_failure_logged
     try:
         from jax._src import compilation_cache as _cc
 
         _cc.reset_cache()
-    except Exception:  # private API: absence degrades to the old behavior
-        pass
+    except Exception as exc:  # private API: absence degrades to the old
+        # behavior — but say so ONCE, with the directory that will be
+        # silently ignored if a jit already ran; the old bare ``pass``
+        # made an in-process cache re-point look successful while every
+        # compile kept writing to the previous dir.
+        if not _reset_failure_logged:
+            _reset_failure_logged = True
+            _log.warning(
+                "could not reset jax's persistent-cache singleton "
+                "(%s: %s); if any jit ran before this point, the cache "
+                "dir change to %r is silently ignored",
+                type(exc).__name__, exc,
+                jax.config.jax_compilation_cache_dir,
+            )
 
 
 def cache_entry_count(cache_dir: str) -> int:

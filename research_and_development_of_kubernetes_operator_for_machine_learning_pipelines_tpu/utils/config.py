@@ -56,6 +56,12 @@ class TopologyInfo:
         return (self.accelerator, self.gke_topology, self.chips)[i]
 
 
+# HBM per chip by GKE accelerator name (the operator's capacity-summary
+# fact; the data plane measures its own via device.memory_stats()).
+TPU_HBM_GIB_PER_CHIP: dict[str, int] = {
+    "tpu-v5-lite-podslice": 16,
+}
+
 TPU_TOPOLOGIES: dict[str, TopologyInfo] = {
     "v5e-1": TopologyInfo("tpu-v5-lite-podslice", "1x1", 1),
     "v5e-4": TopologyInfo("tpu-v5-lite-podslice", "2x2", 4),
@@ -354,24 +360,39 @@ class SpeculativeSpec:
 
 @dataclass(frozen=True)
 class ObservabilitySpec:
-    """``spec.tpu.observability``: engine flight-recorder sizing.
+    """``spec.tpu.observability``: engine flight-recorder sizing and the
+    device telemetry layer.
 
     ``trace_ring`` is the bounded in-memory journal's capacity (one ring
     each for engine ticks, request lifecycle events, and completed
     request traces; served at ``/debug/engine`` and ``/debug/trace``).
     0 — the default — creates no recorder at all, so the engine loop
     stays byte-for-byte unobserved.
+
+    ``device_telemetry`` turns on the HBM ledger + compile observatory +
+    per-tick MFU/bandwidth accounting (``server/device_telemetry.py``:
+    ``GET /debug/device``, ``tpumlops_device_*`` /
+    ``tpumlops_compile_*`` series, utilization fields on recorder
+    ticks, and a ``status.capacity`` summary on the CR).  False — the
+    default — constructs none of it: ticks, metric families, status
+    patches, and ``/debug/*`` payloads stay byte-for-byte.
     """
 
     trace_ring: int = 0
+    device_telemetry: bool = False
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "ObservabilitySpec":
         spec = spec or {}
         _reject_unknown_keys(
-            spec, frozenset({"traceRing"}), "spec.tpu.observability"
+            spec,
+            frozenset({"traceRing", "deviceTelemetry"}),
+            "spec.tpu.observability",
         )
-        return cls(trace_ring=int(spec.get("traceRing", 0)))
+        return cls(
+            trace_ring=int(spec.get("traceRing", 0)),
+            device_telemetry=bool(spec.get("deviceTelemetry", False)),
+        )
 
     def __post_init__(self):
         if self.trace_ring < 0:

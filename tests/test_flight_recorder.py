@@ -173,7 +173,11 @@ def traced_llm_server(tmp_path_factory):
                 "meshShape": {"tp": 1},
                 "maxBatchSize": 4,
                 "prefillChunk": 16,
-                "observability": {"traceRing": 512},
+                # deviceTelemetry ON: this fixture doubles as the e2e
+                # for the HBM ledger / per-tick MFU / Perfetto counter
+                # track (speculative gives the verify tick kind).
+                "observability": {"traceRing": 512, "deviceTelemetry": True},
+                "speculative": {"enabled": True},
             }
         ),
     )
@@ -238,6 +242,61 @@ def test_debug_trace_chrome_export_over_http(traced_llm_server):
 
 
 @pytest.mark.slow
+def test_debug_device_and_utilization_over_http(traced_llm_server):
+    """Device telemetry e2e: the analytic HBM ledger agrees with
+    ``device.memory_stats()`` where available, per-tick MFU lands in
+    (0, 1] for the decode / verify / prefill tick kinds, and the
+    Perfetto export carries the utilization counter track."""
+    import httpx
+
+    # All-same-token prompt: the n-gram drafter matches on the first
+    # decode tick, so a verify tick is guaranteed to be journaled.
+    r = httpx.post(
+        traced_llm_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [7] * 8, "max_new_tokens": 24},
+        headers={"X-Request-Id": "devtel-req"},
+        timeout=60,
+    )
+    assert r.status_code == 200, r.text
+
+    dev = httpx.get(
+        traced_llm_server.base + "/debug/device", timeout=10
+    ).json()
+    hbm = dev["hbm"]
+    assert hbm["device_total_bytes"] > 0
+    assert hbm["components"]["kv_cache"] > 0
+    assert any(k.startswith("weights_") for k in hbm["components"])
+    assert hbm["kv_bytes_per_row"] > 0 and hbm["max_cache_rows"] > 0
+    # The cross-check arms itself where the platform reports memory
+    # (TPU/GPU); the CPU dev environment reports None.
+    if hbm.get("ledger_vs_measured_pct") is not None:
+        assert abs(hbm["ledger_vs_measured_pct"]) <= 10.0, hbm
+    assert dev["compile"]["ops"], dev["compile"]
+    assert dev["peaks"]["flops_per_s"] > 0
+
+    snap = httpx.get(
+        traced_llm_server.base + "/debug/engine", timeout=10
+    ).json()
+    by_kind: dict = {}
+    for t in snap["ticks"]:
+        if "mfu" in t:
+            by_kind.setdefault(t["kind"], t)
+    assert {"decode", "verify", "prefill"} <= set(by_kind), sorted(by_kind)
+    for kind, t in by_kind.items():
+        assert 0.0 < t["mfu"] <= 1.0, (kind, t)
+        assert 0.0 < t["hbm_bw_util"] <= 1.0, (kind, t)
+
+    doc = json.loads(
+        httpx.get(
+            traced_llm_server.base + "/debug/trace?format=chrome", timeout=10
+        ).text
+    )
+    _chrome_invariants(doc)
+    counters = {e["name"] for e in doc["traceEvents"] if e["ph"] == "C"}
+    assert {"mfu", "hbm_bw_util"} <= counters
+
+
+@pytest.mark.slow
 def test_debug_trace_404_when_recorder_disabled(tmp_path_factory):
     """The default (traceRing 0) serves 404 with the enabling knob named
     — and the recorder attribute is None, so the engine path carries no
@@ -270,5 +329,10 @@ def test_debug_trace_404_when_recorder_disabled(tmp_path_factory):
             resp = httpx.get(handle.base + path, timeout=10)
             assert resp.status_code == 404
             assert "traceRing" in resp.json()["error"]
+        # Device telemetry is off by default too, with its own knob named.
+        assert server.telemetry is None
+        resp = httpx.get(handle.base + "/debug/device", timeout=10)
+        assert resp.status_code == 404
+        assert "deviceTelemetry" in resp.json()["error"]
     finally:
         handle.stop()

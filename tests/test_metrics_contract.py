@@ -62,6 +62,21 @@ EXPECTED_SERVER = {
     "tpumlops_ttft_seconds": ("histogram", _IDENT),
 }
 
+# Device telemetry layer (spec.tpu.observability.deviceTelemetry): these
+# families exist ONLY when the registry is built with
+# device_telemetry=True — even an unobserved labeled family adds
+# HELP/TYPE lines to the exposition, and the disabled contract is
+# byte-for-byte (pinned below).
+EXPECTED_SERVER_DEVICE = {
+    **EXPECTED_SERVER,
+    "tpumlops_device_hbm_bytes": ("gauge", _IDENT + ("component",)),
+    "tpumlops_device_mfu": ("gauge", _IDENT + ("kind",)),
+    "tpumlops_device_hbm_bw_util": ("gauge", _IDENT + ("kind",)),
+    "tpumlops_compile_seconds": ("counter", _IDENT + ("op",)),
+    "tpumlops_compile_cache_hits": ("counter", _IDENT),
+    "tpumlops_compile_cache_misses": ("counter", _IDENT),
+}
+
 _OP_IDENT = ("namespace", "name")
 
 EXPECTED_OPERATOR = {
@@ -104,6 +119,26 @@ def test_server_metric_families_are_pinned():
         deployment_name="d", predictor_name="p", namespace="n"
     )
     assert _inventory(metrics) == EXPECTED_SERVER
+
+
+def test_server_metric_families_with_device_telemetry():
+    metrics = ServerMetrics(
+        deployment_name="d", predictor_name="p", namespace="n",
+        device_telemetry=True,
+    )
+    assert _inventory(metrics) == EXPECTED_SERVER_DEVICE
+
+
+def test_device_telemetry_families_absent_from_disabled_exposition():
+    """The disabled registry's exposition must not even carry the
+    HELP/TYPE headers of the device families — byte-for-byte means no
+    new lines, not just no new samples."""
+    metrics = ServerMetrics(
+        deployment_name="d", predictor_name="p", namespace="n"
+    )
+    text = metrics.exposition().decode()
+    assert "tpumlops_device_" not in text
+    assert "tpumlops_compile_" not in text
 
 
 def test_operator_metric_families_are_pinned():

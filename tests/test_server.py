@@ -313,6 +313,59 @@ def test_native_bert_artifact_roundtrip(tmp_path):
     assert np.asarray(out).shape == (2, cfg.num_labels)
 
 
+def test_capacity_log_line_on_causal_lm_load(tmp_path, caplog):
+    """Every causal-LM load stamps ONE model-capacity line (weights
+    bytes by dtype, KV bytes/row, max cache rows) — telemetry off or
+    on; the deviceTelemetry layer only adds the live /debug/device
+    view on top of it."""
+    import logging
+
+    import jax
+
+    from tpumlops.models import llama
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(0), cfg)
+    art = tmp_path / "llama-cap"
+    save_native_model(
+        art,
+        "llama-generate",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+    )
+    with caplog.at_level(logging.INFO, logger="tpumlops.capacity"):
+        load_predictor(str(art))
+    lines = [
+        r.getMessage() for r in caplog.records if r.name == "tpumlops.capacity"
+    ]
+    assert len(lines) == 1, lines
+    line = lines[0]
+    assert line.startswith("model capacity: weights ")
+    assert "B/row" in line and "max cache rows" in line
+
+    # Non-causal artifacts emit no capacity line (there is no KV cache
+    # to plan against).
+    from sklearn.linear_model import LogisticRegression
+
+    sk = LogisticRegression(max_iter=50).fit([[0.0], [1.0]], [0, 1])
+    sk_art = tmp_path / "sk-cap"
+    save_sklearn_model(sk_art, sk, "sklearn-linear")
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="tpumlops.capacity"):
+        load_predictor(str(sk_art))
+    assert not [
+        r for r in caplog.records if r.name == "tpumlops.capacity"
+    ]
+
+
 def test_native_artifact_with_tp_mesh(tmp_path):
     import jax
 
