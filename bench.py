@@ -1464,6 +1464,129 @@ def bench_speculative() -> dict:
     }
 
 
+def bench_multistep() -> dict:
+    """Fused multi-step decode through the real engine scheduler
+    (server/generation.py decodeSteps): the same greedy serving run at
+    K in {1, 2, 4, 8} — K=1 is the single-step tick loop byte-for-byte,
+    K>1 dispatches ONE lax.scan program per tick that runs K decode
+    steps with on-device sampling and an EOS latch, and harvests each
+    tick's token block one tick behind (lag-1 async readback).
+
+    The environment-independent number is DECODE DISPATCHES PER TOKEN:
+    every dispatch is one host->device round trip plus (in this
+    environment) the ~65 ms tunnel, and fusing collapses it ~K-fold —
+    at 4 active slots K=1 pays 1/4 dispatch/token and K=4 ~1/16.  The
+    acceptance bar is hard: K=4 must show >= 3x fewer decode dispatches
+    per token than K=1 (padding at request tails eats the last of the
+    4x), with token agreement 1.0 (the f64 bit-identity proof lives in
+    tests/test_multistep.py).  ITL percentiles ride the tunnel but show
+    the cadence shape a streaming client feels (tokens arrive in
+    K-blocks)."""
+    jax = _setup_jax()
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=4000, hidden_size=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, intermediate_size=704, max_seq=256,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    N_REQ, PROMPT, NEW, SLOTS = 4, 32, 64, 4
+    rng = np.random.default_rng(0)
+    # N_REQ == SLOTS so the queue drains at the first admit phase and
+    # fused ticks engage immediately (a queued request suppresses
+    # fusing by design — slots must free at single-step cadence then).
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=PROMPT).tolist()
+        for _ in range(N_REQ)
+    ]
+
+    def run(k: int) -> dict:
+        itls: list[float] = []
+        engine = GenerationEngine(
+            params, cfg, max_slots=SLOTS, dtype=jnp.bfloat16,
+            decode_steps=k, on_itl=itls.append,
+        )
+        engine.start(warmup=True)
+        try:
+            f0 = engine.decode_forwards
+            d0 = dict(engine.dispatches_total)
+            t0 = time.perf_counter()
+            futs = [engine.submit(p, NEW) for p in prompts]
+            outs = [np.asarray(f.result(timeout=600)).tolist() for f in futs]
+            wall = time.perf_counter() - t0
+            forwards = engine.decode_forwards - f0
+            tokens = engine.decode_tokens
+            disp = {
+                op: engine.dispatches_total.get(op, 0) - d0.get(op, 0)
+                for op in engine.dispatches_total
+            }
+        finally:
+            engine.shutdown()
+        p = _percentiles([t * 1000 for t in itls]) if itls else {50: 0.0, 99: 0.0}
+        return {
+            "wall_s": wall,
+            "tok_per_s": round(N_REQ * NEW / wall, 1),
+            "decode_dispatches": forwards,
+            "dispatches_per_token": round(forwards / max(1, tokens), 4),
+            "dispatch_mix": disp,
+            "itl_p50_ms": round(p[50], 2),
+            "itl_p99_ms": round(p[99], 2),
+            "outputs": outs,
+        }
+
+    ladder = {k: run(k) for k in (1, 2, 4, 8)}
+    base = [t for o in ladder[1]["outputs"] for t in o]
+    agreement = {}
+    for k in (2, 4, 8):
+        cur = [t for o in ladder[k]["outputs"] for t in o]
+        agreement[k] = round(
+            float(np.mean([x == y for x, y in zip(base, cur)])), 3
+        )
+        del ladder[k]["outputs"]
+    del ladder[1]["outputs"]
+    # The acceptance bar (ISSUE 10): >= 3x fewer decode dispatches per
+    # token at K=4.  HARD assertion — a fusing regression must fail the
+    # bench, not quietly ship a smaller ratio.
+    assert (
+        ladder[4]["dispatches_per_token"] * 3
+        <= ladder[1]["dispatches_per_token"]
+    ), (ladder[4]["dispatches_per_token"], ladder[1]["dispatches_per_token"])
+    return {
+        "requests": N_REQ,
+        "new_tokens_per_request": NEW,
+        "slots": SLOTS,
+        "k1_dispatches_per_token": ladder[1]["dispatches_per_token"],
+        "k4_dispatches_per_token": ladder[4]["dispatches_per_token"],
+        "dispatch_reduction_k4": round(
+            ladder[1]["dispatches_per_token"]
+            / max(1e-9, ladder[4]["dispatches_per_token"]), 2
+        ),
+        "tok_per_s_k1": ladder[1]["tok_per_s"],
+        "tok_per_s_k4": ladder[4]["tok_per_s"],
+        "itl_p50_ms_k4": ladder[4]["itl_p50_ms"],
+        "itl_p99_ms_k4": ladder[4]["itl_p99_ms"],
+        "token_agreement": min(agreement.values()),
+        "ladder": {str(k): v for k, v in ladder.items()},
+        "agreement_by_k": {str(k): v for k, v in agreement.items()},
+        **_device_cost_keys(params, cfg, SLOTS, ladder[4]["tok_per_s"]),
+        "note": (
+            "engine-loop walls ride the dev tunnel's ~65 ms/dispatch; "
+            "decode dispatches per token is the environment-independent "
+            "number (each dispatch is one host round trip the fused "
+            "scan amortizes K ways)"
+        ),
+    }
+
+
 def bench_packed_prefill() -> dict:
     """Packed multi-admission prefill through the real engine scheduler
     (server/generation.py prefillBatch): N concurrent COLD admissions of
@@ -2358,6 +2481,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("resnet50", "bench_resnet"),
     ("prefix_cache_serving", "bench_prefix_cache"),
     ("speculative_serving", "bench_speculative"),
+    ("multistep_serving", "bench_multistep"),
     ("packed_prefill_serving", "bench_packed_prefill"),
     ("admission_control_serving", "bench_admission_control"),
     ("observability_serving", "bench_observability"),
@@ -2389,6 +2513,13 @@ SCENARIO_SCHEMAS: dict = {
         "rep_forwards_per_token", "rep_acceptance_rate",
         "rnd_forwards_per_token", "plain_forwards_per_token",
         "speedup_vs_plain_repetitive", "mfu", "hbm_peak_bytes",
+    ),
+    "multistep_serving": (
+        "requests", "new_tokens_per_request", "slots",
+        "k1_dispatches_per_token", "k4_dispatches_per_token",
+        "dispatch_reduction_k4", "tok_per_s_k1", "tok_per_s_k4",
+        "itl_p50_ms_k4", "itl_p99_ms_k4", "token_agreement",
+        "mfu", "hbm_peak_bytes",
     ),
     "observability_serving": (
         "tok_per_s_off", "tok_per_s_on", "overhead_pct",
@@ -2490,6 +2621,10 @@ _COMPACT_KEYS = {
         "rep_forwards_per_token", "plain_forwards_per_token",
         "rep_acceptance_rate", "speedup_vs_plain_repetitive",
         "mfu", "hbm_peak_bytes"),
+    "multistep_serving": (
+        "k1_dispatches_per_token", "k4_dispatches_per_token",
+        "dispatch_reduction_k4", "tok_per_s_k1", "tok_per_s_k4",
+        "token_agreement", "mfu", "hbm_peak_bytes"),
     "packed_prefill_serving": (
         "serial_ttft_p50_ms", "packed_ttft_p50_ms",
         "serial_chunk_calls", "packed_chunk_calls",

@@ -874,6 +874,81 @@ def decode_ragged(
     )
 
 
+def decode_multistep(
+    params: dict,
+    token_ids: jax.Array,
+    cache: "RaggedKVCache | QuantRaggedKVCache",
+    cfg: LlamaConfig,
+    active: jax.Array,
+    remaining: jax.Array,
+    eos_ids: jax.Array,
+    steps: int,
+    sample_fn,
+    sample_carry=None,
+    dtype=jnp.bfloat16,
+    window: int | None = None,
+):
+    """``steps`` (K) decode iterations in ONE program: a ``lax.scan``
+    whose body is the existing single-step :func:`decode_ragged` forward
+    plus an on-device sampling chain — each step's sampled token feeds
+    the next step's embedding lookup without a host round trip, so one
+    dispatch (and one blocking readback, which the engine further defers
+    by a tick) serves K tokens per row.
+
+    ``token_ids`` int32 ``[B, 1]`` is each row's pending token (last
+    emitted, not yet fed); ``active`` bool ``[B]``; ``remaining`` int32
+    ``[B]`` is each row's token budget (new tokens it may still emit);
+    ``eos_ids`` int32 ``[B]`` is each row's stop token with ``-1`` for
+    "no EOS" (token ids are non-negative, so -1 never matches).
+
+    ``sample_fn(logits [B, V], carry) -> (carry, next [B])`` is the
+    per-step token rule: greedy passes ``lambda l, c: (c, argmax(l))``
+    with ``sample_carry=None``; sampling passes
+    :func:`~.sampling.sample_chain_step` closed over the per-row
+    temperature/top-k/top-p arrays with ``sample_carry`` = the per-row
+    key batch — the carry threads through the scan so every step splits
+    keys exactly like a step-by-step sampling tick.
+
+    The EOS latch lives INSIDE the scan: a row that samples its EOS (or
+    exhausts ``remaining``) drops out of ``active`` for the rest of the
+    scan, so its lengths stop advancing and its K/V writes park
+    (``decode_ragged``'s ``active`` gate) — over-run work is bounded by
+    K and nothing past EOS is ever committed, so the host needs no K/V
+    truncation, only to ignore token columns at/after ``valid[i]``.
+
+    ``window`` (STATIC) must cover the LAST step's attended positions:
+    callers pass a bucket ``>= max(lengths of active rows) + steps - 1``
+    (the scan cannot grow the window mid-flight — one compiled variant
+    per (steps, window) pair).
+
+    Returns ``(tok_block [B, steps], valid [B], toks [B, 1], cache,
+    active_out, remaining_out, carry_out)``: ``tok_block[i, j]`` is real
+    for ``j < valid[i]`` (frozen last-token copies after), ``valid[i]``
+    counts steps row ``i`` was active for, and the trailing outputs are
+    the device-resident state the engine chains into the NEXT fused
+    dispatch without a host sync (lag-1 readback).
+    """
+    def body(carry, _):
+        toks, cache, act, rem, sc = carry
+        logits, cache = decode_ragged(
+            params, toks, cache, cfg, active=act, dtype=dtype, window=window
+        )
+        sc, nxt = sample_fn(logits[:, -1, :], sc)
+        nxt = jnp.where(act, nxt.astype(jnp.int32), toks[:, 0])
+        emitted = act
+        rem = rem - act.astype(jnp.int32)
+        act = act & (nxt != eos_ids) & (rem > 0)
+        return (nxt[:, None], cache, act, rem, sc), (nxt, emitted)
+
+    carry0 = (token_ids, cache, active, remaining, sample_carry)
+    (toks, cache, active, remaining, sample_carry), (tok_seq, emit_seq) = (
+        lax.scan(body, carry0, None, length=steps)
+    )
+    tok_block = jnp.moveaxis(tok_seq, 0, 1)  # [steps, B] -> [B, steps]
+    valid = jnp.sum(emit_seq.astype(jnp.int32), axis=0)
+    return tok_block, valid, toks, cache, active, remaining, sample_carry
+
+
 def _block_verify_deferred(
     x: jax.Array,
     lp: dict,

@@ -120,3 +120,26 @@ def split_keys(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Advance a batch of per-row PRNG keys: returns (carry, use)."""
     pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
     return pairs[:, 0], pairs[:, 1]
+
+
+def sample_chain_step(
+    logits: jax.Array,
+    keys: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """One on-device sampling step usable as a ``lax.scan`` body stage.
+
+    Advances EVERY row's key chain and draws one token per row — the
+    exact key discipline of the engine's single-step sampling tick
+    (``split_keys`` then :func:`sample_logits` over all rows, greedy
+    rows discarding the draw), so a fused K-step decode scan that calls
+    this once per step reproduces the step-by-step token stream
+    token-for-token, seeded sampling included.
+
+    Returns ``(carry_keys, tokens)``: thread ``carry_keys`` into the
+    next step's call.
+    """
+    carry, use = split_keys(keys)
+    return carry, sample_logits(logits, use, temperature, top_k, top_p)

@@ -156,6 +156,11 @@ def _tpu_pod_spec(
     # Admission-control / drain flags are appended ONLY when non-default:
     # unlike the always-emitted knobs above, these arrived after PR 7 and
     # an unannotated CR's manifest must stay byte-for-byte identical.
+    if tpu.decode_steps != 1:
+        # Appended only when fused decode is on (same byte-identity
+        # contract as the admission/drain flags): an unannotated CR's
+        # manifest must stay byte-for-byte what it was.
+        container["args"] += ["--decode-steps", str(tpu.decode_steps)]
     if tpu.admission_queue_budget > 0:
         container["args"] += [
             "--admission-queue-budget", str(tpu.admission_queue_budget),

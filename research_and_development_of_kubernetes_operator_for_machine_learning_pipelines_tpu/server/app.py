@@ -1048,6 +1048,11 @@ def make_gen_engine(
         on_prefix_evict=metrics.inc_prefix_evictions if metrics else None,
         speculative=speculative,
         on_spec=metrics.observe_speculative if metrics else None,
+        # Fused multi-step decode: same K on leader and followers (this
+        # one construction site) — the compiled (K, window) variants
+        # must agree for lockstep replay.  1 = single-step loop.
+        decode_steps=config.tpu.decode_steps,
+        on_dispatch=metrics.inc_dispatch if metrics else None,
         # Packed multi-admission prefill: same batch geometry on leader
         # and followers (this one construction site) — the compiled B_p
         # bucket variants must agree for lockstep replay.
@@ -1292,6 +1297,15 @@ def main(argv: list[str] | None = None) -> None:
         "verifies and regrows on success; 0: fixed draft length",
     )
     ap.add_argument(
+        "--decode-steps",
+        type=int,
+        default=1,
+        help="decode iterations fused into ONE device dispatch per tick "
+        "(lax.scan with on-device sampling + EOS latch, lag-1 async "
+        "token readback; engages only when no admissions or drafts are "
+        "pending).  1 = the single-step tick loop; max 16",
+    )
+    ap.add_argument(
         "--quantize",
         default="none",
         choices=["none", "int8", "int8kv"],
@@ -1372,6 +1386,7 @@ def main(argv: list[str] | None = None) -> None:
                     "ngramMax": args.speculative_ngram_max,
                     "adaptive": bool(args.speculative_adaptive),
                 },
+                "decodeSteps": args.decode_steps,
                 "observability": {
                     "traceRing": args.trace_ring,
                     "deviceTelemetry": bool(args.device_telemetry),

@@ -7,9 +7,12 @@ to a zero-accept speculative streak.  The recorder keeps the raw
 material for that question in three bounded rings:
 
 - **ticks** — one record per engine device dispatch (kind: ``decode`` /
-  ``verify`` / ``packed-prefill`` / ``prefill`` / ``seed``) with wall
-  time, batch fill, active slots, queue depth, tokens emitted, and
-  accepted speculative drafts;
+  ``verify`` / ``multistep`` / ``packed-prefill`` / ``prefill`` /
+  ``seed``) with wall time, batch fill, active slots, queue depth,
+  tokens emitted, and accepted speculative drafts; fused multi-step
+  ticks additionally carry ``steps`` (K scan iterations per dispatch),
+  and their per-token instants in the request traces are reconstructed
+  across the tick wall, not stacked on the harvest instant;
 - **events** — per-request lifecycle points (``enqueued``, ``admission``,
   ``seed``, ``prefill_chunk``, ``first_token``, ``finish``) with the
   cache row they happened on;
@@ -148,6 +151,7 @@ class FlightRecorder:
         tokens: int = 0,
         spec_accepted: int = 0,
         util: dict | None = None,
+        steps: int = 0,
     ) -> None:
         rec = {
             "ts_us": self._us(t0),
@@ -159,6 +163,11 @@ class FlightRecorder:
             "tokens": int(tokens),
             "spec_accepted": int(spec_accepted),
         }
+        if steps:
+            # Fused multi-step ticks only (K scan iterations under this
+            # one dispatch); absent otherwise so single-step tick
+            # records stay byte-for-byte what they were.
+            rec["steps"] = int(steps)
         if util:
             # Device telemetry only (spec.tpu.observability.
             # deviceTelemetry): mfu / hbm_bw_util from the analytic cost
@@ -283,7 +292,9 @@ class FlightRecorder:
                             "batch_fill",
                             "tokens",
                             "spec_accepted",
+                            "steps",
                         )
+                        if k in t
                     },
                 }
             )

@@ -267,6 +267,8 @@ class GenerationEngine:
         admission_queue_budget: int = 0,
         on_shed: Callable[[str], None] | None = None,
         telemetry=None,  # device_telemetry.DeviceTelemetry | None
+        decode_steps: int = 1,
+        on_dispatch: Callable[[str], None] | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -416,6 +418,25 @@ class GenerationEngine:
                 )
             self._spec = speculative
             self._spec_chain = draft_chain(dt)
+        # Fused multi-step decode (spec.tpu.decodeSteps): K decode
+        # iterations per dispatch as a lax.scan with an on-device
+        # sampling chain and EOS latch, paired with lag-1 asynchronous
+        # token readback (the scheduler dispatches tick N+1 before
+        # blocking on tick N's token block).  1 — the default — keeps
+        # the single-step tick loop byte-for-byte: no fused program is
+        # built, swept, or consulted.
+        self._decode_steps = 1 if decode_steps is None else int(decode_steps)
+        if not (1 <= self._decode_steps <= 16):
+            raise ValueError(
+                f"decode_steps must be in [1, 16], got {decode_steps}"
+            )
+        self._fused = self._decode_steps > 1
+        self._on_dispatch = on_dispatch
+        # Engine device dispatches by tick kind (the amortization series:
+        # a fused K-step tick is ONE dispatch where the plain loop paid
+        # K) — mirrored to tpumlops_engine_dispatches_total{op} via
+        # on_dispatch and read by bench.py's multistep scenario.
+        self.dispatches_total: dict[str, int] = {}
         self._reset_device_state()
 
         def make_cache(k, v, lengths):
@@ -499,6 +520,72 @@ class GenerationEngine:
         self._verify = jax.jit(
             _verify, donate_argnums=(2, 3), static_argnums=(7,)
         )
+
+        def _multistep_sampling(
+            params, toks, k, v, lengths, active, remaining, eos_ids,
+            keys, temps, tks, tps, window, steps,
+        ):
+            # Fused K-step decode, sampling variant: the scan body is the
+            # SAME decode forward as _decode with the on-device sampling
+            # chain advancing every row's key once per step — exactly the
+            # step-by-step key discipline, so seeded sampling is
+            # token-for-token reproducible against K sequential ticks.
+            from ..models.sampling import sample_chain_step
+
+            cache = make_cache(k, v, lengths)
+
+            def sample(logits, carry):
+                return sample_chain_step(logits, carry, temps, tks, tps)
+
+            tok_block, valid, toks2, cache, active2, remaining2, keys2 = (
+                llama.decode_multistep(
+                    params, toks, cache, cfg, active, remaining, eos_ids,
+                    steps, sample, sample_carry=keys, dtype=dtype,
+                    window=window,
+                )
+            )
+            ck, cv = cache_repr(cache)
+            return (
+                tok_block, valid, toks2, ck, cv, cache.lengths,
+                active2, remaining2, keys2,
+            )
+
+        def _multistep_greedy(
+            params, toks, k, v, lengths, active, remaining, eos_ids,
+            window, steps,
+        ):
+            # Greedy variant: plain argmax per step (no sort/softmax/key
+            # work), mirroring _decode_greedy.
+            cache = make_cache(k, v, lengths)
+
+            def sample(logits, carry):
+                return carry, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            tok_block, valid, toks2, cache, active2, remaining2, _ = (
+                llama.decode_multistep(
+                    params, toks, cache, cfg, active, remaining, eos_ids,
+                    steps, sample, sample_carry=None, dtype=dtype,
+                    window=window,
+                )
+            )
+            ck, cv = cache_repr(cache)
+            return (
+                tok_block, valid, toks2, ck, cv, cache.lengths,
+                active2, remaining2,
+            )
+
+        if self._fused:
+            # One compiled variant per (K, window) pair, like _verify's
+            # (S, window) grid; K is fixed per deployment so the warmup
+            # sweep is |window buckets| x 2 variants.
+            self._multistep = jax.jit(
+                _multistep_sampling, donate_argnums=(2, 3),
+                static_argnums=(12, 13),
+            )
+            self._multistep_greedy = jax.jit(
+                _multistep_greedy, donate_argnums=(2, 3),
+                static_argnums=(8, 9),
+            )
 
         def _prefill_insert(
             params, ids, k, v, lengths, toks, slot, actual_len,
@@ -711,6 +798,11 @@ class GenerationEngine:
             self._decode = obs.wrap_jit("decode", self._decode)
             self._decode_greedy = obs.wrap_jit("decode", self._decode_greedy)
             self._verify = obs.wrap_jit("verify", self._verify)
+            if self._fused:
+                self._multistep = obs.wrap_jit("multistep", self._multistep)
+                self._multistep_greedy = obs.wrap_jit(
+                    "multistep", self._multistep_greedy
+                )
             self._prefill_insert = obs.wrap_jit("prefill", self._prefill_insert)
             self._prefill_one_chunk = obs.wrap_jit(
                 "prefill", self._prefill_one_chunk
@@ -788,13 +880,16 @@ class GenerationEngine:
         # here — every dispatch avoided is a full HBM weight stream
         # the admissions shared instead of re-paying.
         self.prefill_forwards = 0
-        # Speculative observability (also read by bench.py's
-        # speculative_serving scenario): decode_forwards counts every
-        # weight-streaming decode/verify dispatch, decode_tokens every
-        # token those dispatches emitted.  Without speculation the ratio
-        # is exactly 1/(active slots); acceptance drives it lower still —
-        # each accepted draft is a token the weight stream did not have
-        # to be re-paid for.
+        # Speculative/fused observability (also read by bench.py's
+        # speculative_serving and multistep_serving scenarios):
+        # decode_forwards counts every decode/verify/multistep DISPATCH,
+        # decode_tokens every token those dispatches emitted.  In the
+        # single-step loop a dispatch is one weight stream and the ratio
+        # is exactly 1/(active slots); speculative acceptance drives it
+        # lower per weight stream, while a fused K-step dispatch streams
+        # the weights K times under ONE dispatch — so this ratio is the
+        # per-DISPATCH amortization (host/tunnel overhead), not
+        # weight-streams-per-token, once decodeSteps > 1.
         self.decode_forwards = 0
         self.decode_tokens = 0
         self.spec_verify_ticks = 0
@@ -829,6 +924,13 @@ class GenerationEngine:
         self._temps = jnp.zeros((self.max_slots,), jnp.float32)
         self._topk = jnp.zeros((self.max_slots,), jnp.int32)
         self._topp = jnp.ones((self.max_slots,), jnp.float32)
+        # Fused-decode chain state (device-resident active mask / budgets
+        # / EOS ids): valid only WITHIN one fused burst — every burst
+        # re-seeds it from host slot truth, so a recovery reset needs no
+        # special handling beyond dropping the stale references.
+        self._ms_active = None
+        self._ms_remaining = None
+        self._ms_eos = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -947,6 +1049,22 @@ class GenerationEngine:
                         self._dispatch_verify(
                             toks, inactive, zero_draft, window
                         )
+            if self._fused:
+                # Fused multi-step variants: one executable per
+                # (K, window) pair, both token rules — K is fixed per
+                # deployment so the sweep is |buckets| x 2.  Dispatched,
+                # not raw: followers must compile the same variants or
+                # the first live fused tick stalls the whole slice.
+                # All-inactive, zero-budget rows advance nothing.
+                zero_rem = np.zeros((self.max_slots,), np.int32)
+                no_eos = np.full((self.max_slots,), -1, np.int32)
+                for window in decode_window_buckets(self.capacity):
+                    self._dispatch_multistep(
+                        inactive, zero_rem, no_eos, window, False
+                    )
+                    self._dispatch_multistep(
+                        inactive, zero_rem, no_eos, window, True
+                    )
             # Fused-prefill buckets: each power-of-two prompt bucket is its
             # own executable (the padded ids shape is static), so admit one
             # dummy prompt per bucket — otherwise the first live request at
@@ -1328,15 +1446,21 @@ class GenerationEngine:
     def _record_tick(
         self, kind: str, t0: float, wall_s: float, *,
         active_slots: int = 0, batch_fill: int = 0, tokens: int = 0,
-        spec_accepted: int = 0, cost=None,
+        spec_accepted: int = 0, cost=None, steps: int = 0,
     ) -> None:
         """Journal one engine device dispatch (tick-kind metric + flight
-        recorder).  Callers skip warmup themselves; both sinks are
-        optional and the default (both None) costs one branch.
+        recorder + the dispatches-by-op counter).  Callers skip warmup
+        themselves; every sink is optional and the default costs one
+        dict update + branch per tick.
 
         ``cost`` is the tick's analytic ``(flops, hbm_bytes)`` (device
         telemetry only, None otherwise): joined with the wall into MFU /
-        bandwidth utilization — gauges plus extra recorder-tick fields."""
+        bandwidth utilization — gauges plus extra recorder-tick fields.
+        ``steps`` > 0 marks a fused multi-step tick (K scan iterations
+        in the one dispatch this record covers)."""
+        self.dispatches_total[kind] = self.dispatches_total.get(kind, 0) + 1
+        if self._on_dispatch is not None:
+            self._on_dispatch(kind)
         util = None
         if self._telemetry is not None and cost is not None:
             util = self._telemetry.tick_util(kind, wall_s, *cost)
@@ -1351,15 +1475,22 @@ class GenerationEngine:
                 tokens=tokens,
                 spec_accepted=spec_accepted,
                 util=util,
+                steps=steps,
             )
 
-    def _cost_decode(self, window: int, s: int = 1):
+    def _cost_decode(self, window: int, s: int = 1, steps: int = 1):
         """Analytic (flops, bytes) of one decode/verify tick — the
         program computes EVERY cache row (inactive rows too; the MXU
-        does not care), so the cost counts ``max_slots``."""
+        does not care), so the cost counts ``max_slots``.  ``steps`` > 1
+        scales for a fused multi-step tick: K scan iterations each pay
+        the full weight stream and (conservatively, at the pre-picked
+        window) the cache read."""
         if self._telemetry is None or self._telemetry.cost is None:
             return None
-        return self._telemetry.cost.decode(self.max_slots, window, s)
+        flops, nbytes = self._telemetry.cost.decode(self.max_slots, window, s)
+        if steps > 1:
+            flops, nbytes = flops * steps, nbytes * steps
+        return flops, nbytes
 
     def _cost_prefill(self, rows: int, chunk: int, attended=None):
         if self._telemetry is None or self._telemetry.cost is None:
@@ -2129,7 +2260,14 @@ class GenerationEngine:
         """Follower side of :meth:`_fail_all_and_recover`'s device reset."""
         self._reset_device_state()
 
-    def _record_token(self, slot_idx: int, token: int) -> None:
+    def _record_token(
+        self, slot_idx: int, token: int, t: float | None = None
+    ) -> None:
+        """Credit one emitted token to a slot.  ``t`` overrides the
+        token's wall timestamp (fused multi-step harvests reconstruct
+        per-token instants across the tick wall — K tokens landing on
+        one perf_counter() read would zero every ITL observation and
+        stack the Perfetto token instants on one point)."""
         slot = self._slots[slot_idx]
         assert slot is not None
         if slot.future.cancelled():
@@ -2144,7 +2282,7 @@ class GenerationEngine:
             slot.hist_len += 1
         slot.remaining -= 1
         if not self._in_warmup:
-            now = time.perf_counter()
+            now = time.perf_counter() if t is None else t
             if slot.t_last_token > 0.0 and self._on_itl is not None:
                 self._on_itl(now - slot.t_last_token)
             slot.t_last_token = now
@@ -2220,8 +2358,24 @@ class GenerationEngine:
         if self._spec is not None and not sampling and not self._in_warmup:
             drafts = self._collect_drafts()
             if any(drafts):
+                # Speculative slots fall back to verify ticks (a draft in
+                # hand amortizes the weight stream by acceptance, which a
+                # fixed-K scan cannot beat on draftable text); ticks with
+                # no drafts anywhere fuse below like plain traffic.
                 self._verify_tick(active_np, window, drafts)
                 return
+        if (
+            self._fused
+            and not self._in_warmup
+            and not self._pending
+            and self._queue.empty()
+        ):
+            # Fused multi-step decode engages only when the scheduler
+            # owes nothing else: no queued request waiting on a slot a
+            # K-step tick would hold for K tokens, no admission
+            # mid-prefill whose chunk cadence a fused tick would stall.
+            self._step_fused(active_np, sampling)
+            return
         t0 = time.perf_counter()
         self._dispatch_step(active_np, window, sampling)
         toks = np.asarray(self._tokens)[:, 0]
@@ -2258,6 +2412,226 @@ class GenerationEngine:
                 self._queue.qsize(),
                 len(self._pending),
             )
+
+    # -- fused multi-step decode (decodeSteps > 1) ---------------------------
+
+    def _step_fused(self, active_np, sampling: bool) -> None:
+        """A fused-decode BURST with lag-1 asynchronous readback.
+
+        Each iteration dispatches ONE jitted program that runs K decode
+        steps as a ``lax.scan`` (on-device sampling feeds each step's
+        token into the next; an on-device EOS latch freezes finished
+        rows mid-scan), then harvests the PREVIOUS dispatch's token
+        block — so the host-side work of tick N (sync, SSE emission,
+        recorder feed) overlaps tick N+1's device execution and the
+        dispatch bubble between ticks disappears.  Chained dispatches
+        pass NO host arrays: the active mask, per-row budgets, tokens,
+        keys, lengths, and the donated cache buffers all stay device-
+        resident between ticks.
+
+        Host knowledge therefore lags the device by one tick: slot
+        bookkeeping is exact through tick N-1 when tick N+1 is
+        dispatched.  Only two decisions need host state — whether to
+        keep the burst going, and the attention window — and both use
+        conservative bounds (a row can advance at most K per tick), so
+        a mid-scan EOS costs at most one trailing all-inactive dispatch,
+        never a wrong result.  The burst exits with every harvest
+        drained: the scheduler never leaves ``_step`` holding un-synced
+        tokens, so admission and shutdown paths see exact slot truth.
+        """
+        K = self._decode_steps
+        B = self.max_slots
+        # Burst-entry device inputs from exact host slot truth.
+        remaining = np.zeros((B,), np.int32)
+        eos_ids = np.full((B,), -1, np.int32)  # -1: no EOS (ids are >= 0)
+        hi = np.zeros((B,), np.int64)  # per-row next-write position bound
+        rem_hi = np.zeros((B,), np.int64)  # per-row emit-budget bound
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            remaining[i] = slot.remaining
+            if slot.eos_id is not None:
+                eos_ids[i] = slot.eos_id
+            hi[i] = slot.prompt_len + len(slot.generated)
+            rem_hi[i] = slot.remaining
+        pending = None  # (tok_block_dev, valid_dev, t0, window)
+        start = True
+        while True:
+            # Pre-pick the window for length + K: the scan cannot grow
+            # it mid-flight, and the LAST step attends positions up to
+            # needed + K - 1 (satellite: a row crossing a bucket edge
+            # inside K steps must already be covered).
+            needed_hi = int(
+                max(
+                    hi[i]
+                    for i in range(B)
+                    if self._slots[i] is not None and rem_hi[i] > 0
+                )
+            )
+            window = decode_window_bucket(
+                min(needed_hi + K - 1, self.capacity), self.capacity
+            )
+            t0 = time.perf_counter()
+            tok_block, valid = self._dispatch_multistep(
+                active_np if start else None,
+                remaining if start else None,
+                eos_ids if start else None,
+                window, sampling,
+            )
+            for i in range(B):
+                emit = min(int(rem_hi[i]), K)
+                hi[i] += emit
+                rem_hi[i] -= emit
+            start = False
+            if pending is not None:
+                # Lag-1: tick N+1 is in flight; block on tick N now.
+                self._harvest_fused(*pending)
+            pending = (tok_block, valid, t0, window)
+            may_be_active = any(
+                self._slots[i] is not None and rem_hi[i] > 0
+                for i in range(B)
+            )
+            if (
+                not may_be_active
+                or self._stop.is_set()
+                or self._pending
+                or not self._queue.empty()
+            ):
+                break
+            if (
+                self._spec is not None
+                and not sampling
+                and any(self._collect_drafts())
+            ):
+                # Speculative fallback is PER TICK: the harvest above
+                # refreshed slot histories, and a draft in hand beats a
+                # fixed-K scan on draftable text — end the burst so the
+                # next _step runs the verify path.
+                break
+        if pending is not None:
+            self._harvest_fused(*pending)
+
+    def _harvest_fused(self, tok_block_dev, valid_dev, t0, window) -> None:
+        """Block on one fused tick's outputs and credit its tokens.
+
+        ``valid[i]`` counts the scan steps row ``i`` was active for —
+        token columns at/after it are frozen copies the latch never
+        emitted (and whose K/V was never committed: the in-scan active
+        gate parks those writes, so no host-side truncation is needed).
+        Per-token timestamps are reconstructed by spacing the row's
+        valid tokens across the tick wall (clamped monotone against the
+        row's previous token): K tokens on one instant would zero every
+        ITL observation and stack the Perfetto instants."""
+        toks = np.asarray(tok_block_dev)  # the deferred device sync
+        valid = np.asarray(valid_dev)
+        end = time.perf_counter()
+        wall = end - t0
+        K = self._decode_steps
+        active_slots = int((valid > 0).sum())
+        total = int(valid.sum())
+        self.decode_forwards += 1
+        self._record_tick(
+            "multistep", t0, wall,
+            active_slots=active_slots, tokens=total, steps=K,
+            cost=self._cost_decode(window, steps=K),
+        )
+        if self._on_step is not None:
+            self._on_step(
+                active_slots, wall, self._queue.qsize(), len(self._pending)
+            )
+        for i in range(self.max_slots):
+            n = int(valid[i])
+            if n <= 0 or self._slots[i] is None:
+                continue
+            base = max(t0, self._slots[i].t_last_token)
+            span = max(end - base, 0.0)
+            for j in range(n):
+                self._record_token(
+                    i, int(toks[i, j]), t=base + span * (j + 1) / n
+                )
+                self.decode_tokens += 1
+                if self._slots[i] is None:
+                    break  # finished (eos/length) or cancelled mid-block
+
+    def _dispatch_multistep(self, active_np, remaining, eos_ids, window,
+                            sampling):
+        """Broadcast (multihost) then run one fused K-step decode.
+
+        ``active_np``/``remaining``/``eos_ids`` are host arrays on the
+        first tick of a burst and ``None`` on chained ticks — chained
+        state (mask, budgets, EOS ids) lives on device from the previous
+        fused tick, on followers exactly as on the leader."""
+        if self._channel is None:
+            return self._device_multistep(
+                active_np, remaining, eos_ids, window, sampling
+            )
+        from .multihost import OP_GEN_MULTISTEP, encode_message
+
+        payload = encode_message(
+            OP_GEN_MULTISTEP,
+            {
+                "active": active_np,
+                "remaining": remaining,
+                "eos_ids": eos_ids,
+                "window": int(window),
+                "sampling": bool(sampling),
+            },
+        )
+        return self._channel.run(
+            payload,
+            lambda: self._device_multistep(
+                active_np, remaining, eos_ids, window, sampling
+            ),
+        )
+
+    def _device_multistep(self, active_np, remaining, eos_ids, window,
+                          sampling):
+        import jax.numpy as jnp
+
+        if active_np is None:
+            act, rem, eos = self._ms_active, self._ms_remaining, self._ms_eos
+        else:
+            act = jnp.asarray(np.asarray(active_np, bool))
+            rem = jnp.asarray(np.asarray(remaining, np.int32))
+            eos = jnp.asarray(np.asarray(eos_ids, np.int32))
+            self._ms_eos = eos
+        if sampling:
+            (
+                tok_block, valid, self._tokens,
+                self._cache_k, self._cache_v, self._lengths,
+                self._ms_active, self._ms_remaining, self._keys,
+            ) = self._multistep(
+                self._params, self._tokens,
+                self._cache_k, self._cache_v, self._lengths,
+                act, rem, eos,
+                self._keys, self._temps, self._topk, self._topp,
+                int(window), self._decode_steps,
+            )
+        else:
+            (
+                tok_block, valid, self._tokens,
+                self._cache_k, self._cache_v, self._lengths,
+                self._ms_active, self._ms_remaining,
+            ) = self._multistep_greedy(
+                self._params, self._tokens,
+                self._cache_k, self._cache_v, self._lengths,
+                act, rem, eos,
+                int(window), self._decode_steps,
+            )
+        return tok_block, valid
+
+    def replay_multistep(self, active, remaining, eos_ids, window,
+                         sampling) -> None:
+        """Follower side of a fused multi-step tick (multihost lockstep).
+        ``active`` None = chained tick: the follower's own device-resident
+        chain state (maintained by its previous replay) is used, exactly
+        as on the leader."""
+        self._device_multistep(
+            None if active is None else np.asarray(active),
+            None if remaining is None else np.asarray(remaining),
+            None if eos_ids is None else np.asarray(eos_ids),
+            int(window), bool(sampling),
+        )
 
     # -- self-speculative decoding (n-gram draft + batched verify) -----------
 

@@ -233,15 +233,37 @@ class ServerMetrics:
         # Engine tick wall by kind: the aggregate view of the flight
         # recorder's per-tick journal (server/flight_recorder.py) — a
         # decode-cadence regression shows up as the decode kind's
-        # distribution shifting while packed-prefill's fattens.
+        # distribution shifting while packed-prefill's fattens.  A
+        # "multistep" tick covers K decode steps (decodeSteps), so read
+        # its wall against tokens, not against single-step decode ticks.
         self.tick_seconds = Histogram(
             "tpumlops_tick_seconds",
             "Engine tick wall time by kind "
-            "(decode/verify/prefill/packed-prefill/seed); prefill/seed "
-            "walls are dispatch-only unless the flight recorder is on "
-            "(traceRing > 0), which syncs them to cover device time",
+            "(decode/verify/multistep/prefill/packed-prefill/seed); "
+            "prefill/seed walls are dispatch-only unless the flight "
+            "recorder is on (traceRing > 0), which syncs them to cover "
+            "device time",
             ident_labels + ["kind"],
             buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        # Engine device dispatches by op: with generated_tokens this is
+        # the amortization series of record — dispatches-per-token is
+        # what the fused multi-step path (decodeSteps) collapses by ~K,
+        # and what prefix-cache/speculative/packed-prefill each already
+        # cut on their own axes.  One increment per journaled engine
+        # tick (a multi-chunk seed op counts once).  Registered
+        # UNCONDITIONALLY like the spec_* families (the series is
+        # meaningful for every serving mode, fused or not) — the
+        # decodeSteps:1 byte-identity contract covers the engine loop,
+        # tick records, and label VALUES (no op="multistep" children
+        # ever appear at K=1), not the family's presence; the inventory
+        # is pinned in tests/test_metrics_contract.py.
+        self.engine_dispatches = Counter(
+            "tpumlops_engine_dispatches",
+            "Engine device dispatches by tick kind (decode/verify/"
+            "multistep/prefill/packed-prefill/seed)",
+            ident_labels + ["op"],
             registry=self.registry,
         )
         # Self-speculative decoding (server/speculative.py): proposed vs
@@ -428,6 +450,9 @@ class ServerMetrics:
 
     def observe_tick(self, kind: str, seconds: float):
         self.tick_seconds.labels(**self.identity, kind=kind).observe(seconds)
+
+    def inc_dispatch(self, op: str):
+        self.engine_dispatches.labels(**self.identity, op=op).inc()
 
     def observe_speculative(self, proposed: int, accepted: int):
         self.spec_proposed_tokens.labels(**self.identity).inc(proposed)

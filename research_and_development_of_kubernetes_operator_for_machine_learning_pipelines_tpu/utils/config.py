@@ -211,6 +211,27 @@ def _parse_prefill_chunk(value) -> int | None:
     return chunk
 
 
+def _parse_decode_steps(value) -> int:
+    """``spec.tpu.decodeSteps``: decode iterations fused into ONE device
+    dispatch per engine tick (a ``lax.scan`` with on-device sampling and
+    an EOS latch, paired with lag-1 async token readback).  1 — the
+    default — is the single-step tick loop byte-for-byte.  Capped at 16:
+    over-run work past EOS/budget is bounded by K, and host token
+    cadence (SSE flushes, cancellation latency) coarsens with K — past
+    16 the dispatch amortization has long since saturated.
+
+    ``decodeSteps`` > 1 combined with ``speculative.enabled`` is NOT an
+    error: ticks holding draft proposals run verify (acceptance beats a
+    fixed-K scan on draftable text) and draft-less ticks fuse — a
+    documented per-slot fallback, not a contradiction."""
+    steps = int(value) if value is not None else 1
+    if not (1 <= steps <= 16):
+        raise ValueError(
+            f"spec.tpu.decodeSteps must be in [1, 16], got {value!r}"
+        )
+    return steps
+
+
 def _parse_admission_budget(value) -> int:
     """``spec.tpu.admissionQueueBudget``: estimated-token bound on
     queued-but-unadmitted generation work (0 = unbounded, the old
@@ -590,6 +611,13 @@ class TpuSpec:
     # Self-speculative n-gram decoding: batched multi-token verify
     # amortizes the per-tick HBM weight stream over accepted drafts.
     speculative: SpeculativeSpec = field(default_factory=SpeculativeSpec)
+    # Fused multi-step decode: K decode iterations per device dispatch
+    # (on-device sampling chain + EOS latch) with lag-1 async token
+    # readback — collapses per-token host dispatch overhead by ~K when
+    # the scheduler owes nothing else.  1 = single-step loop,
+    # byte-for-byte.  Composes with speculative per slot (draft ticks
+    # verify, draft-less ticks fuse) — see _parse_decode_steps.
+    decode_steps: int = 1
     # Engine flight recorder (per-tick journal + request traces at
     # /debug/engine and /debug/trace); traceRing 0 = off, zero overhead.
     observability: ObservabilitySpec = field(default_factory=ObservabilitySpec)
@@ -624,7 +652,8 @@ class TpuSpec:
                     "maxBatchSize", "maxBatchDelayMs", "maxSlots",
                     "maxInflightBatches", "compileCacheDir", "quantize",
                     "prefillChunk", "prefillBatch", "prefillTokenBudget",
-                    "prefixCache", "speculative", "observability",
+                    "prefixCache", "speculative", "decodeSteps",
+                    "observability",
                     "warmupFullGrid", "admissionQueueBudget",
                     "drainGraceSeconds",
                 }
@@ -669,6 +698,7 @@ class TpuSpec:
             ),
             prefix_cache=prefix_cache,
             speculative=SpeculativeSpec.from_spec(spec.get("speculative")),
+            decode_steps=_parse_decode_steps(spec.get("decodeSteps")),
             observability=ObservabilitySpec.from_spec(
                 spec.get("observability")
             ),

@@ -125,9 +125,41 @@ def test_tpu_spec_rejects_unknown_keys():
             "prefillTokenBudget": 512,
             "prefixCache": {"enabled": True, "budgetMB": 64},
             "speculative": {"enabled": True, "draftTokens": 4},
+            "decodeSteps": 4,
             "warmupFullGrid": False,
         }
     )
+
+
+def test_tpu_decode_steps_validation():
+    """spec.tpu.decodeSteps: typed reconcile-time rejection of
+    contradictory values — and the one NON-contradiction pinned: K > 1
+    combined with speculative.enabled is a documented per-slot fallback
+    (draft ticks verify, draft-less ticks fuse), never an error."""
+    assert TpuSpec.from_spec({}).decode_steps == 1  # default: single-step
+    assert TpuSpec.from_spec({"decodeSteps": 8}).decode_steps == 8
+    assert TpuSpec.from_spec({"decodeSteps": 16}).decode_steps == 16
+    for bad in (0, -1, 17, 64):
+        with pytest.raises(ValueError, match="decodeSteps"):
+            TpuSpec.from_spec({"decodeSteps": bad})
+    # Per-slot fallback, not a contradiction: both knobs together parse.
+    both = TpuSpec.from_spec(
+        {
+            "decodeSteps": 4,
+            "speculative": {"enabled": True, "draftTokens": 4},
+        }
+    )
+    assert both.decode_steps == 4 and both.speculative.enabled
+    # And composes with the rest of the serving stack at parse time.
+    full = TpuSpec.from_spec(
+        {
+            "decodeSteps": 2,
+            "prefillChunk": 64,
+            "prefillBatch": 4,
+            "prefixCache": {"enabled": True},
+        }
+    )
+    assert full.decode_steps == 2
 
 
 def test_tpu_prefill_batch_validation():

@@ -11,6 +11,7 @@ paired, and every per-token instant must fall inside its request span.
 import json
 import time
 
+import numpy as np
 import pytest
 
 from tpumlops.server.flight_recorder import FlightRecorder, RequestTrace
@@ -119,6 +120,82 @@ def test_chrome_trace_is_valid_and_spans_pair_up():
     assert {"engine ticks", "cache row 0", "cache row 2"} <= names
     kinds = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
     assert kinds == {"decode", "packed-prefill"}
+
+
+def test_tick_steps_field_only_on_multistep_records():
+    # Fused multi-step ticks carry "steps" (K scan iterations under the
+    # one dispatch); every other kind's record stays byte-for-byte the
+    # pre-fused shape — no new key.
+    rec = FlightRecorder(capacity=8)
+    rec.tick("decode", time.perf_counter(), 0.001, tokens=1)
+    rec.tick("multistep", time.perf_counter(), 0.004, tokens=7, steps=4)
+    ticks = rec.snapshot()["ticks"]
+    assert "steps" not in ticks[0]
+    assert ticks[1]["steps"] == 4 and ticks[1]["tokens"] == 7
+    doc = json.loads(json.dumps(rec.chrome_trace()))
+    by_kind = {
+        e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"
+    }
+    assert by_kind["multistep"]["args"]["steps"] == 4
+    assert "steps" not in by_kind["decode"]["args"]
+
+
+@pytest.mark.slow
+def test_multistep_tick_reconstructs_per_token_timestamps():
+    """Multi-token fused ticks must not corrupt ITL/tick accounting: the
+    K tokens of one dispatch get timestamps spaced across the tick wall
+    (never all on the harvest instant, never non-monotonic), the tick
+    record carries kind="multistep" with steps=K and the real token
+    count, and the Perfetto export keeps the instants distinct."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.float32)
+    rec = FlightRecorder(capacity=256)
+    itls: list = []
+    K = 4
+    engine = GenerationEngine(
+        params, cfg, max_slots=2, dtype=jnp.float32, decode_steps=K,
+        recorder=rec, on_itl=itls.append,
+    )
+    engine.start(warmup=True)
+    try:
+        trace = RequestTrace(request_id="ms-1")
+        out = engine.submit(
+            [5, 9, 2], 17, request_id="ms-1", trace=trace
+        ).result(timeout=300)
+        assert len(out) == 17
+    finally:
+        engine.shutdown()
+    snap = rec.snapshot()
+    ms = [t for t in snap["ticks"] if t["kind"] == "multistep"]
+    assert ms, "no fused tick recorded"
+    for t in ms:
+        assert t["steps"] == K
+        assert 1 <= t["tokens"] <= K
+        assert t["active_slots"] == 1
+    # 16 decode-emitted tokens in ceil(16/4)=4 fused dispatches.
+    assert len(ms) == 4
+    # Per-token instants: strictly increasing, spread across tick walls
+    # (reconstruction), never stacked on one harvest read.
+    times = trace.token_times
+    assert len(times) == 17
+    deltas = np.diff(times)
+    assert (deltas > 0).all(), "token timestamps must be monotone"
+    # ITL observations mirror the reconstructed spacing: all positive,
+    # and more than one distinct value would appear even within a
+    # single fused tick only by reconstruction.
+    assert len(itls) == 16 and all(d > 0 for d in itls)
+    doc = json.loads(json.dumps(rec.chrome_trace()))
+    toks = [
+        e["ts"] for e in doc["traceEvents"]
+        if e["ph"] == "i" and e["name"] == "token"
+    ]
+    assert len(set(toks)) == len(toks), "token instants must be distinct"
 
 
 def test_snapshot_is_json_serializable_and_isolated():

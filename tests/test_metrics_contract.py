@@ -39,6 +39,12 @@ EXPECTED_SERVER = {
     "tpumlops_engine_active_slots": ("gauge", _IDENT),
     "tpumlops_engine_admitting": ("gauge", _IDENT),
     "tpumlops_engine_queue_depth": ("gauge", _IDENT),
+    # Engine device dispatches by tick kind (decode/verify/multistep/
+    # prefill/packed-prefill/seed); exported as
+    # tpumlops_engine_dispatches_total.  With generated_tokens this is
+    # the dispatches-per-token amortization series the fused multi-step
+    # path (spec.tpu.decodeSteps) collapses ~K-fold.
+    "tpumlops_engine_dispatches": ("counter", _IDENT + ("op",)),
     # Admission control: sheds by typed reason ("budget" | "draining");
     # exported as tpumlops_engine_shed_total.  The autoscaler's alert
     # surface for "replica refusing load".
