@@ -124,12 +124,19 @@ class EngineMetrics:
     queue_depth: float | None = None
     admission_wait_p95_ms: float | None = None
     ttft_p95_s: float | None = None
+    # Requests held in the router's park buffer because the CR is at
+    # zero replicas (tpumlops_router_parked_requests / GET
+    # /router/parked).  THE wake signal for scale-to-zero: a parked
+    # request is a user already waiting, so the autoscaler wakes
+    # immediately on parked > 0.  None = no parking-capable source.
+    parked: float | None = None
 
     def as_dict(self) -> dict[str, Any]:
         return {
             "queue_depth": self.queue_depth,
             "admission_wait_p95_ms": self.admission_wait_p95_ms,
             "ttft_p95_s": self.ttft_p95_s,
+            "parked": self.parked,
         }
 
 

@@ -79,9 +79,12 @@ def start_model_server(
     tpu=None,
     ready_timeout_s: float = 180.0,
     warmup: bool = True,
+    wake_start_wall: float | None = None,
 ) -> ModelServerHandle:
     """Run a real inference server (aiohttp) on a daemon thread; raises
-    TimeoutError if it never becomes ready."""
+    TimeoutError if it never becomes ready.  ``wake_start_wall`` (unix
+    seconds) marks when the controller decided to wake this replica —
+    it anchors the server's ``tpumlops_cold_start_seconds`` ladder."""
     from ..server.app import build_server
     from ..utils.config import ServerConfig
 
@@ -95,7 +98,11 @@ def start_model_server(
     )
     if tpu is not None:
         cfg_kwargs["tpu"] = tpu
-    server = build_server(ServerConfig(**cfg_kwargs), warmup=warmup)
+    server = build_server(
+        ServerConfig(**cfg_kwargs),
+        warmup=warmup,
+        wake_start_wall=wake_start_wall,
+    )
     loop = asyncio.new_event_loop()
     handle = ModelServerHandle(server, loop, port)
     boot_error: list[BaseException] = []
@@ -224,6 +231,11 @@ class LocalReplicaSet:
                 h.port for handles in self._replicas.values() for h in handles
             ]
 
+    def replica_ports(self, predictor: str) -> list[int]:
+        """Live ports of ONE predictor (router backend resolution)."""
+        with self._lock:
+            return [h.port for h in self._replicas.get(predictor, [])]
+
     def replica_count(self, predictor: str | None = None) -> int:
         with self._lock:
             if predictor is not None:
@@ -242,8 +254,12 @@ class LocalReplicaSet:
         # down — the same order a rolling controller uses.
         for pred, n in desired.items():
             have = len(current.get(pred, []))
+            # A predictor going 0 -> n is a WAKE: stamp the decision
+            # instant so the replica's tpumlops_cold_start_seconds
+            # ladder carries the controller-side wake stage too.
+            wake = time.time() if have == 0 and n > 0 else None
             for _ in range(have, n):
-                self._start(pred)
+                self._start(pred, wake_start_wall=wake)
             if n != have:
                 self.scale_log.append((pred, n))
         for pred, handles in current.items():
@@ -251,7 +267,9 @@ class LocalReplicaSet:
             for handle in handles[keep:]:
                 self._drain_stop(pred, handle)
 
-    def _start(self, predictor: str) -> None:
+    def _start(
+        self, predictor: str, wake_start_wall: float | None = None
+    ) -> None:
         uri = self.model_uris[predictor]
         handle = start_model_server(
             uri,
@@ -262,6 +280,7 @@ class LocalReplicaSet:
             namespace=self.namespace,
             tpu=self.tpu,
             warmup=self.warmup,
+            wake_start_wall=wake_start_wall,
         )
         with self._lock:
             self._replicas.setdefault(predictor, []).append(handle)
@@ -318,9 +337,13 @@ class ReplicaSetMetrics:
 
     _FAMILY = "tpumlops_engine_queue_depth"
 
-    def __init__(self, ports, timeout: float = 2.0):
+    def __init__(self, ports, timeout: float = 2.0, router_admin=None):
         self._ports = ports  # Callable[[], list[int]]
         self._timeout = timeout
+        # RouterAdmin | None: when given, each engine_metrics read also
+        # reports the router's park-buffer depth — THE wake signal for a
+        # predictor at zero replicas (no replica ports to scrape there).
+        self._router_admin = router_admin
 
     def model_metrics(
         self, deployment_name, predictor_name, namespace, window_s=60
@@ -351,7 +374,13 @@ class ReplicaSetMetrics:
             for (name, labels), value in parse_prometheus_text(text).items():
                 if name == self._FAMILY and ident <= labels:
                     total = (total or 0.0) + value
-        return EngineMetrics(queue_depth=total)
+        parked = None
+        if self._router_admin is not None:
+            try:
+                parked = float(self._router_admin.parked().get("parked", 0))
+            except Exception:
+                parked = None  # router unreachable: park signal unknown
+        return EngineMetrics(queue_depth=total, parked=parked)
 
 
 class TrafficGenerator:

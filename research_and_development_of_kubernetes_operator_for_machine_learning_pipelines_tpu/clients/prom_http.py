@@ -153,8 +153,20 @@ class PrometheusSource:
             f"tpumlops_ttft_seconds_bucket{{{sel}}}[{w}]"
             ")) by (le))"
         )
+        # The router's park buffer (native/router.cc): requests held for
+        # a CR at zero replicas.  Keyed by deployment/namespace only —
+        # the router parks before any predictor is picked, so the gauge
+        # carries no predictor_name.  Same no-vector(0) discipline:
+        # None = park signal unobservable, and the autoscaler then
+        # refuses the last scale-down step to zero.
+        parked = self._query(
+            "sum(tpumlops_router_parked_requests{"
+            f'deployment_name="{deployment_name}", '
+            f'namespace="{namespace}"}})'
+        )
         return EngineMetrics(
             queue_depth=queue_depth,
             admission_wait_p95_ms=wait_p95,
             ttft_p95_s=ttft_p95,
+            parked=parked,
         )

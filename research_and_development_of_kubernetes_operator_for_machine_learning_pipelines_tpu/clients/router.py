@@ -157,6 +157,13 @@ class RouterAdmin:
     def metrics_text(self) -> str:
         return self._req("/router/metrics").decode()
 
+    def parked(self) -> dict:
+        """Park-buffer state (``GET /router/parked``): ``parked`` count,
+        ``capacity``, ``oldest_wait_s``, and the released/overflow/
+        timeout counters — the operator's wake signal for a CR whose
+        replicas are at zero."""
+        return json.loads(self._req("/router/parked"))
+
 
 def parse_prometheus_text(text: str) -> dict[tuple[str, frozenset], float]:
     """Parse Prometheus exposition text into {(name, labelset): value}."""
@@ -348,13 +355,30 @@ class RouterSync:
         backends = []
         for pred in spec.get("predictors") or []:
             name = pred.get("name")
-            host, port = self.resolve(name)
+            weight = int(pred.get("traffic", 0))
+            replicas = pred.get("replicas")
+            if replicas is not None and int(replicas) == 0:
+                # Scale-to-zero: the predictor holds NO capacity, so its
+                # traffic share drops to 0 regardless of the split — with
+                # every backend at weight 0 the router PARKS incoming
+                # requests (or sheds typed 503s past the buffer) instead
+                # of dialing a dead address.
+                weight = 0
+            try:
+                host, port = self.resolve(name)
+            except Exception:
+                if weight > 0:
+                    raise
+                # Parked predictor with no resolvable replica: keep a
+                # placeholder address (never dialed at weight 0) so the
+                # backend — and its histograms — survive the park.
+                host, port = "127.0.0.1", 9
             backends.append(
                 {
                     "name": name,
                     "host": host,
                     "port": port,
-                    "weight": int(pred.get("traffic", 0)),
+                    "weight": weight,
                 }
             )
         if backends:
@@ -381,12 +405,20 @@ class RouterProcess:
         namespace: str = "default",
         deployment: str = "router",
         binary: pathlib.Path | None = None,
+        park_buffer: int = 0,
+        park_timeout_s: float = 30.0,
     ):
         self.port = port
         self.backends = backends
         self.namespace = namespace
         self.deployment = deployment
         self.binary = binary or build_router()
+        # Scale-to-zero request parking: hold up to park_buffer requests
+        # while no backend has positive weight (0 = old behavior, an
+        # immediate 503), releasing them in arrival order when capacity
+        # returns; each parked request waits at most park_timeout_s.
+        self.park_buffer = int(park_buffer)
+        self.park_timeout_s = float(park_timeout_s)
         self.proc: subprocess.Popen | None = None
         self.admin = RouterAdmin(port)
 
@@ -397,6 +429,11 @@ class RouterProcess:
             "--namespace", self.namespace,
             "--deployment", self.deployment,
         ]
+        if self.park_buffer > 0:
+            argv += [
+                "--park-buffer", str(self.park_buffer),
+                "--park-timeout-s", str(self.park_timeout_s),
+            ]
         for name, (host, port, weight) in self.backends.items():
             argv += ["--backend", f"{name}={host}:{port}:{weight}"]
         self.proc = subprocess.Popen(

@@ -438,12 +438,14 @@ struct HttpMsg {
 
 std::string http_response(int code, const std::string& reason,
                           const std::string& content_type,
-                          const std::string& body) {
-  char head[256];
+                          const std::string& body,
+                          const std::string& extra_headers = "") {
+  char head[384];
   snprintf(head, sizeof(head),
            "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\n"
-           "Connection: keep-alive\r\n\r\n",
-           code, reason.c_str(), content_type.c_str(), body.size());
+           "Connection: keep-alive\r\n%s\r\n",
+           code, reason.c_str(), content_type.c_str(), body.size(),
+           extra_headers.c_str());
   return std::string(head) + body;
 }
 
@@ -479,7 +481,52 @@ struct ClientConn {
   int retries = 0;     // stale pooled-connection retries this request
   bool closing = false;   // close after out drains
   bool feedback = false;  // current request is /api/v1.0/feedback
+  bool parked = false;    // held in the scale-to-zero park buffer
+  double park_t = 0;      // when parking began (monotonic)
 };
+
+// ---------------------------------------------------------------------------
+// Scale-to-zero request parking
+//
+// When every backend's weight is 0 — the operator parked the CR's
+// Deployment at zero replicas — incoming requests are HELD (bounded
+// buffer, FIFO) instead of 503'd: the park count is the operator's wake
+// signal, and once capacity returns (a weight flips positive via
+// /router/weights or /router/config) the queue releases in arrival
+// order.  Overflow and timeout get a TYPED 503 + Retry-After so clients
+// know to back off, not fail.  --park-buffer 0 (default) preserves the
+// old immediate-503 behavior byte-for-byte.
+// ---------------------------------------------------------------------------
+
+int g_park_max = 0;             // --park-buffer (0 = parking disabled)
+double g_park_timeout_s = 30.0; // --park-timeout-s
+std::vector<ClientConn*> g_parked;  // FIFO arrival order
+uint64_t g_parked_total = 0;        // ever parked
+uint64_t g_park_released_total = 0; // released to a live backend
+uint64_t g_park_overflow_total = 0; // 503'd: buffer full
+uint64_t g_park_timeout_total = 0;  // 503'd: waited past the timeout
+Histogram g_park_wait_seconds;      // park duration of released requests
+
+std::string park_503_body(const char* why, int retry_after_s) {
+  char body[160];
+  snprintf(body, sizeof(body),
+           "{\"error\":\"no live backend\",\"reason\":\"%s\","
+           "\"retry_after_s\":%d}",
+           why, retry_after_s);
+  char hdr[64];
+  snprintf(hdr, sizeof(hdr), "Retry-After: %d\r\n", retry_after_s);
+  return http_response(503, "Service Unavailable", "application/json", body,
+                       hdr);
+}
+
+void unpark(ClientConn* c) {
+  c->parked = false;
+  for (auto it = g_parked.begin(); it != g_parked.end(); ++it)
+    if (*it == c) {
+      g_parked.erase(it);
+      break;
+    }
+}
 
 struct FdEntry {
   FdKind kind;
@@ -538,6 +585,7 @@ void close_upstream(UpstreamConn* u) {
 
 void close_client(ClientConn* c) {
   if (!c) return;
+  if (c->parked) unpark(c);  // a gone client must not be "released" later
   if (c->upstream) {
     c->upstream->client = nullptr;
     close_upstream(c->upstream);
@@ -621,6 +669,40 @@ std::string metrics_text() {
   snprintf(line, sizeof(line), "tpumlops_router_proxied_total %llu\n",
            (unsigned long long)g_state.proxied_total);
   out += line;
+  // Scale-to-zero park buffer: the gauge is the operator's wake signal
+  // (sum over routers = requests waiting on a CR with zero replicas).
+  // Identity labels deployment/namespace only — parking happens BEFORE
+  // any predictor is picked.
+  char plabels[192];
+  snprintf(plabels, sizeof(plabels),
+           "deployment_name=\"%s\",namespace=\"%s\"",
+           g_state.deployment.c_str(), g_state.ns.c_str());
+  out += "# TYPE tpumlops_router_parked_requests gauge\n";
+  snprintf(line, sizeof(line), "tpumlops_router_parked_requests{%s} %zu\n",
+           plabels, g_parked.size());
+  out += line;
+  out += "# TYPE tpumlops_router_parked_total counter\n";
+  snprintf(line, sizeof(line), "tpumlops_router_parked_total{%s} %llu\n",
+           plabels, (unsigned long long)g_parked_total);
+  out += line;
+  out += "# TYPE tpumlops_router_park_released_total counter\n";
+  snprintf(line, sizeof(line),
+           "tpumlops_router_park_released_total{%s} %llu\n", plabels,
+           (unsigned long long)g_park_released_total);
+  out += line;
+  out += "# TYPE tpumlops_router_park_overflow_total counter\n";
+  snprintf(line, sizeof(line),
+           "tpumlops_router_park_overflow_total{%s} %llu\n", plabels,
+           (unsigned long long)g_park_overflow_total);
+  out += line;
+  out += "# TYPE tpumlops_router_park_timeouts_total counter\n";
+  snprintf(line, sizeof(line),
+           "tpumlops_router_park_timeouts_total{%s} %llu\n", plabels,
+           (unsigned long long)g_park_timeout_total);
+  out += line;
+  out += "# TYPE tpumlops_router_park_wait_seconds histogram\n";
+  emit_histogram(&out, "tpumlops_router_park_wait_seconds", plabels,
+                 g_park_wait_seconds);
   return out;
 }
 
@@ -736,12 +818,34 @@ std::string apply_config(const std::string& ns, const std::string& dep,
   return "";
 }
 
+void release_parked();  // defined with the proxy path below
+
 void handle_admin(ClientConn* c) {
   const std::string& path = c->req.path;
   std::string body = c->req.buf.substr(c->req.body_start);
 
   if (path == "/router/healthz") {
     client_send(c, http_response(200, "OK", "text/plain", "ok\n"));
+  } else if (path == "/router/parked") {
+    // Park-buffer state: the wake signal an operator polls for a CR at
+    // zero replicas (also exported as tpumlops_router_parked_requests).
+    double oldest = 0.0;
+    double now = now_s();
+    for (ClientConn* pc : g_parked) {
+      double wait = now - pc->park_t;
+      if (wait > oldest) oldest = wait;
+    }
+    char body[256];
+    snprintf(body, sizeof(body),
+             "{\"parked\":%zu,\"capacity\":%d,\"oldest_wait_s\":%.3f,"
+             "\"parked_total\":%llu,\"released_total\":%llu,"
+             "\"overflow_total\":%llu,\"timeout_total\":%llu}",
+             g_parked.size(), g_park_max, oldest,
+             (unsigned long long)g_parked_total,
+             (unsigned long long)g_park_released_total,
+             (unsigned long long)g_park_overflow_total,
+             (unsigned long long)g_park_timeout_total);
+    client_send(c, http_response(200, "OK", "application/json", body));
   } else if (path == "/router/latencies") {
     // Read-and-clear: exact router-internal per-request latencies (us)
     // since the previous drain.
@@ -765,6 +869,9 @@ void handle_admin(ClientConn* c) {
       std::string bad = apply_config(ns, dep, specs);
       if (bad.empty()) {
         client_send(c, http_response(200, "OK", "application/json", config_json()));
+        // Capacity may just have returned (a replica came back / the
+        // operator woke the CR): release the park queue FIFO.
+        release_parked();
       } else {
         client_send(c, http_response(400, "Bad Request", "text/plain",
                                      "unresolvable backend host: " + bad + "\n"));
@@ -801,6 +908,7 @@ void handle_admin(ClientConn* c) {
           // Reset SWRR counters so the new split takes effect cleanly.
           for (auto& b : g_state.backends) b->swrr_current = 0;
           client_send(c, http_response(200, "OK", "application/json", "{}"));
+          release_parked();  // a positive weight wakes the park queue
         }
       }
     }
@@ -939,6 +1047,23 @@ void connect_upstream(ClientConn* c, bool allow_pool) {
 void start_proxy(ClientConn* c) {
   BackendPtr b = g_state.pick();
   if (!b) {
+    if (g_park_max > 0) {
+      if (int(g_parked.size()) < g_park_max) {
+        // Hold the fully-buffered request; released FIFO once a weight
+        // flips positive (the operator waking the CR), expired after
+        // --park-timeout-s.  c->req stays intact for the re-dispatch.
+        c->parked = true;
+        c->park_t = now_s();
+        g_parked.push_back(c);
+        g_parked_total++;
+        return;
+      }
+      g_park_overflow_total++;
+      client_send(c, park_503_body("park_overflow",
+                                   int(g_park_timeout_s)));
+      c->req.reset();
+      return;
+    }
     client_send(c, http_response(503, "Service Unavailable", "text/plain",
                                  "no backend with positive weight\n"));
     c->req.reset();
@@ -947,6 +1072,52 @@ void start_proxy(ClientConn* c) {
   c->backend = b;
   c->retries = 0;
   connect_upstream(c, /*allow_pool=*/true);
+}
+
+// A weight flipped positive: release the park buffer in arrival order.
+// Each released request re-enters start_proxy (and may re-park if the
+// weights dropped to zero again mid-release).
+void release_parked() {
+  if (g_parked.empty()) return;
+  bool capacity = false;
+  for (auto& b : g_state.backends)
+    if (b->weight > 0) capacity = true;
+  if (!capacity) return;
+  std::vector<ClientConn*> waiting;
+  waiting.swap(g_parked);
+  for (ClientConn* c : waiting) {
+    c->parked = false;
+    g_park_wait_seconds.observe(now_s() - c->park_t);
+    g_park_released_total++;
+    start_proxy(c);
+  }
+}
+
+// Expire parked requests older than the timeout with a typed 503 —
+// a client must never hang forever on a CR that refuses to wake.
+void expire_parked() {
+  if (g_parked.empty()) return;
+  double now = now_s();
+  std::vector<ClientConn*> keep;
+  std::vector<ClientConn*> expired;
+  for (ClientConn* c : g_parked)
+    (now - c->park_t >= g_park_timeout_s ? expired : keep).push_back(c);
+  if (expired.empty()) return;
+  g_parked.swap(keep);
+  for (ClientConn* c : expired) {
+    c->parked = false;
+    g_park_timeout_total++;
+    client_send(c, park_503_body("park_timeout", int(g_park_timeout_s)));
+    c->req.reset();
+    // Same contract as fail_502: a pipelined next request buffered
+    // while parked must still be answered, not hang until the client
+    // happens to write again.
+    if (!c->pending.empty()) {
+      c->req.buf = std::move(c->pending);
+      c->pending.clear();
+      advance_client(c);
+    }
+  }
 }
 
 // A pooled keep-alive connection can always lose a race with the backend's
@@ -983,7 +1154,7 @@ void dispatch_request(ClientConn* c) {
 // response completes, so nothing is dropped and bodies forwarded upstream
 // are framed exactly (no smuggling of the next request's bytes).
 void advance_client(ClientConn* c) {
-  while (!c->upstream && !c->closing) {
+  while (!c->upstream && !c->closing && !c->parked) {
     if (!c->req.headers_complete()) {
       if (!c->req.try_parse_headers(/*is_request=*/true)) {
         client_send(c, http_response(400, "Bad Request", "text/plain",
@@ -1002,6 +1173,7 @@ void advance_client(ClientConn* c) {
     }
     dispatch_request(c);  // resets c->req (admin/503/502) or sets upstream
     if (c->upstream) return;  // next request advances when the response lands
+    if (c->parked) return;    // held intact for the release re-dispatch
     if (c->pending.empty()) return;
     c->req.buf = std::move(c->pending);
     c->pending.clear();
@@ -1010,7 +1182,9 @@ void advance_client(ClientConn* c) {
 
 void on_client_readable(ClientConn* c) {
   char tmp[65536];
-  bool in_flight = c->upstream != nullptr;
+  // Parked counts as in flight: the buffered request must stay intact
+  // for the release re-dispatch, so later pipelined bytes go to pending.
+  bool in_flight = c->upstream != nullptr || c->parked;
   while (true) {
     ssize_t n = read(c->fd, tmp, sizeof(tmp));
     if (n > 0) {
@@ -1200,7 +1374,8 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
 
 void usage() {
   die("usage: tpumlops-router --port N [--namespace ns] [--deployment name]\n"
-      "       [--backend name=host:port:weight]...");
+      "       [--backend name=host:port:weight]...\n"
+      "       [--park-buffer N] [--park-timeout-s S]");
 }
 
 }  // namespace
@@ -1217,6 +1392,8 @@ int main(int argc, char** argv) {
     if (a == "--port") port = atoi(next().c_str());
     else if (a == "--namespace") g_state.ns = next();
     else if (a == "--deployment") g_state.deployment = next();
+    else if (a == "--park-buffer") g_park_max = atoi(next().c_str());
+    else if (a == "--park-timeout-s") g_park_timeout_s = atof(next().c_str());
     else if (a == "--backend") {
       // name=host:port:weight
       std::string v = next();
@@ -1262,11 +1439,14 @@ int main(int argc, char** argv) {
 
   epoll_event events[256];
   while (true) {
-    int n = epoll_wait(g_epoll, events, 256, -1);
+    // Bounded wait while requests are parked so timeouts fire without
+    // needing traffic to tick the loop; -1 (block forever) otherwise.
+    int n = epoll_wait(g_epoll, events, 256, g_parked.empty() ? -1 : 250);
     if (n < 0) {
       if (errno == EINTR) continue;
       die("epoll_wait: %s", strerror(errno));
     }
+    expire_parked();
     for (int i = 0; i < n; i++) {
       uint64_t key = events[i].data.u64;
       int fd = int(uint32_t(key));

@@ -143,23 +143,37 @@ def clamp_replicas(value: int, spec) -> int:
 def desired_replicas(spec, current: int, observed) -> tuple[int, str]:
     """The un-hysteresis'd replica target for one observation.
 
-    Queue depth is the primary signal (``ceil(total / target-per-
-    replica)``); a TTFT p95 above budget adds one replica on top even
-    when the queue looks fine — latency pressure without a backlog is
-    what long prompts under packed prefill look like.  Returns
-    ``(desired, reason)`` with the reason naming the binding signal.
+    Backlog — engine queue depth plus router-parked requests — is the
+    primary signal (``ceil(total / target-per-replica)``); a TTFT p95
+    above budget adds one replica on top even when the queue looks fine
+    — latency pressure without a backlog is what long prompts under
+    packed prefill look like.  Parked requests count at full weight: a
+    parked request is a user waiting on a CR with no capacity AT ALL.
+    Returns ``(desired, reason)`` with the reason naming the binding
+    signal.
     """
     wanted = spec.min_replicas
     reason = "idle"
     qd_target = spec.target_queue_depth_per_replica
-    if qd_target > 0 and observed.queue_depth is not None:
-        by_queue = math.ceil(observed.queue_depth / qd_target)
+    parked = getattr(observed, "parked", None)
+    backlog_known = observed.queue_depth is not None or parked is not None
+    backlog = (observed.queue_depth or 0.0) + (parked or 0.0)
+    if qd_target > 0 and backlog_known:
+        by_queue = math.ceil(backlog / qd_target)
+        if parked and by_queue < 1:
+            by_queue = 1  # a parked request needs at least one replica
         if by_queue > wanted:
             wanted = by_queue
-            reason = (
-                f"queue depth {observed.queue_depth:g} / target "
-                f"{qd_target:g} per replica"
-            )
+            if parked:
+                reason = (
+                    f"queue depth {backlog:g} ({parked:g} parked at the "
+                    f"router) / target {qd_target:g} per replica"
+                )
+            else:
+                reason = (
+                    f"queue depth {backlog:g} / target "
+                    f"{qd_target:g} per replica"
+                )
     ttft_target = spec.target_ttft_seconds
     if (
         ttft_target > 0
@@ -206,8 +220,11 @@ def decide(
             },
         )
 
+    parked = getattr(observed, "parked", None) if observed is not None else None
     blind = observed is None or (
-        observed.queue_depth is None and observed.ttft_p95_s is None
+        observed.queue_depth is None
+        and observed.ttft_p95_s is None
+        and parked is None
     )
     if blind:
         # Hold at current strength; also stop any pending scale-up clock
@@ -223,6 +240,17 @@ def decide(
         )
 
     desired, why = desired_replicas(spec, current, observed)
+
+    # Wake from zero: a parked/queued request is a user ALREADY waiting,
+    # so the stabilization window does not apply — every second of
+    # hysteresis is a second added to their cold start.  Jump straight
+    # to the demand.
+    if current == 0 and desired > 0:
+        return ScaleDecision(
+            replicas=desired,
+            state=ScalerState(last_scale_wall=now_wall, above_since_wall=None),
+            record=rec(desired, desired, f"wake from zero: {why}", None),
+        )
 
     # Scale-DOWN needs positive evidence of idleness.  With a queue
     # target configured, that evidence is the queue gauge itself — a
@@ -245,6 +273,23 @@ def decide(
                 record=rec(
                     current, desired,
                     "idle-evidence signal unavailable; holding scale-down",
+                    HOLD_METRICS_MISSING,
+                ),
+            )
+        if current == 1 and desired == 0 and parked is None:
+            # The LAST step to zero additionally needs the park signal
+            # wired (router /router/parked observable): without it the
+            # wake path could never see a waiting request and the CR
+            # would be unreachable-forever, which is worse than one idle
+            # replica.
+            return ScaleDecision(
+                replicas=current,
+                state=replace(state, above_since_wall=None),
+                record=rec(
+                    current, desired,
+                    "park signal unavailable; holding scale-to-zero "
+                    "(the wake path needs router parked-request "
+                    "visibility)",
                     HOLD_METRICS_MISSING,
                 ),
             )

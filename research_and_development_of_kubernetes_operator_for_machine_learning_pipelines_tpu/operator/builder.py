@@ -174,6 +174,12 @@ def _tpu_pod_spec(
         # admission/drain flags): an unannotated CR's manifest must stay
         # byte-for-byte what it was before the device telemetry layer.
         container["args"] += ["--device-telemetry", "1"]
+    if tpu.snapshot.enabled:
+        # Pre-baked weight snapshots (scale-to-zero fast restore).
+        # Appended only when enabled — same byte-identity contract.  The
+        # snapshot dir is node-local like the XLA cache: a woken pod on
+        # the same host restores without re-downloading or re-quantizing.
+        container["args"] += ["--snapshot-dir", tpu.snapshot.dir]
     if info.hosts > 1:
         unit = worker_unit_name(deployment_name, version)
         container["env"] += [
@@ -224,6 +230,21 @@ def _tpu_pod_spec(
                 },
             }
         ]
+    if tpu.snapshot.enabled:
+        # Snapshot store survives the pod the same way the XLA cache
+        # does — a wake-from-zero on the same node restores locally.
+        container.setdefault("volumeMounts", []).append(
+            {"name": "weight-snapshots", "mountPath": tpu.snapshot.dir}
+        )
+        pod.setdefault("volumes", []).append(
+            {
+                "name": "weight-snapshots",
+                "hostPath": {
+                    "path": "/var/cache/tpumlops/snapshots",
+                    "type": "DirectoryOrCreate",
+                },
+            }
+        )
     return {
         **pod,
         "nodeSelector": {
@@ -402,6 +423,58 @@ def build_worker_unit_manifests(
         },
     }
     return [headless, routed, statefulset]
+
+
+def build_warm_pool_manifests(
+    name: str,
+    namespace: str,
+    owner_uid: str,
+    config: OperatorConfig,
+    version: str,
+    model_uri: str,
+) -> list[dict[str, Any]]:
+    """Warm-pool Deployment for ``autoscaling.warmPoolSize`` replicas.
+
+    Each pod runs the server in ``--warm-pool`` mode: booted, compile
+    sweep run against the current version's snapshot geometry, holding
+    NO weights — deliberately NotReady (no traffic routes there) until
+    a ``POST /admin/attach``.  Even unattached, the pool keeps the
+    node-local snapshot + XLA caches hot, so a wake-from-zero replica
+    scheduled onto the same node restores instead of cold-loading.
+    Returns ``[]`` when the pool size is 0 (byte-identity) or the
+    backend is not ``tpu``.
+    """
+    size = config.autoscaling.warm_pool_size
+    if size <= 0 or config.backend != "tpu":
+        return []
+    unit = f"{name}-warm-pool"
+    labels = {
+        "app": unit,
+        "tpumlops/deployment": name,
+        "tpumlops/role": "warm-pool",
+    }
+    pod_spec = _tpu_pod_spec(version, model_uri, config, name, namespace)
+    pod_spec["containers"][0]["args"] += ["--warm-pool", "1"]
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": unit,
+                "namespace": namespace,
+                "labels": labels,
+                "ownerReferences": owner_reference(name, owner_uid),
+            },
+            "spec": {
+                "replicas": size,
+                "selector": {"matchLabels": {"app": unit}},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": pod_spec,
+                },
+            },
+        }
+    ]
 
 
 def build_deployment(

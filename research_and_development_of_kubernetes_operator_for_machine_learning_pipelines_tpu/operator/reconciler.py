@@ -252,6 +252,9 @@ class Reconciler:
             prior_status.get("replicas") is not None
             or prior_status.get("autoscaler") is not None
         )
+        # Scale-to-zero park context: same explicit-null contract — a CR
+        # waking from zero needs one patch clearing status.snapshot.
+        self._had_snapshot_key = prior_status.get("snapshot") is not None
         # Device-telemetry capacity summary: recomputed from spec each
         # step (no state round-trip needed); the explicit-null contract
         # mirrors the journal/scaler keys so disabling clears it once.
@@ -438,6 +441,14 @@ class Reconciler:
         new_state = state.with_(
             replicas=decision.replicas, scaler=decision.state.to_status()
         )
+        # Park context: while the Deployment is at zero, status.snapshot
+        # records the restore source the wake path will use.
+        if decision.replicas == 0:
+            snap = self._snapshot_status(config, state)
+            if snap is not None and new_state.snapshot != snap:
+                new_state = new_state.with_(snapshot=snap)
+        elif new_state.snapshot is not None:
+            new_state = new_state.with_(snapshot=None)
 
         if changed or first_take:
             self._last_scale_hold = None
@@ -471,11 +482,17 @@ class Reconciler:
             new_state = self._journal(config, new_state, applied_rec)
             self._patch_status(new_state)
             if applied_rec is not None and applied_rec.applied:
+                if applied_rec.to_replicas == 0:
+                    reason = "ScaledToZero"
+                elif applied_rec.from_replicas == 0:
+                    reason = "WokenFromZero"
+                elif applied_rec.to_replicas > applied_rec.from_replicas:
+                    reason = "ScaledUp"
+                else:
+                    reason = "ScaledDown"
                 ev = Event(
                     "Normal",
-                    "ScaledUp"
-                    if applied_rec.to_replicas > applied_rec.from_replicas
-                    else "ScaledDown",
+                    reason,
                     f"Scaled replicas {applied_rec.from_replicas} -> "
                     f"{applied_rec.to_replicas} ({applied_rec.reason}).",
                 )
@@ -498,6 +515,29 @@ class Reconciler:
         if new_state != state:
             self._patch_status(new_state)
         return new_state
+
+    def _snapshot_status(self, config: OperatorConfig, state) -> "dict | None":
+        """``status.snapshot`` for a CR parked at zero: the deterministic
+        snapshot location (``server/snapshot.py`` keys it by model URI;
+        quantize/mesh invalidation lives in the manifest's content hash)
+        so the wake path — and a human — can find the restore source
+        without the data plane running."""
+        if not config.tpu.snapshot.enabled or state.current_version is None:
+            return None
+        out: dict = {
+            "enabled": True,
+            "dir": config.tpu.snapshot.dir,
+            "quantize": config.tpu.quantize,
+        }
+        try:
+            uri = self._resolve_uri(config, state.current_version)
+            from ..server.snapshot import snapshot_path_for
+
+            out["modelUri"] = uri
+            out["uri"] = str(snapshot_path_for(config.tpu.snapshot.dir, uri))
+        except Exception as e:  # registry blip: park context still lands
+            self.log.warning(f"snapshot URI resolution failed: {e}")
+        return out
 
     # -- handlers ------------------------------------------------------------
 
@@ -1020,6 +1060,9 @@ class Reconciler:
     _UNIT_KIND_REFS = {
         "StatefulSet": {"group": "apps", "version": "v1", "plural": "statefulsets"},
         "Service": {"group": "", "version": "v1", "plural": "services"},
+        # Warm-pool replicas (autoscaling.warmPoolSize): weightless,
+        # compile-swept servers awaiting /admin/attach.
+        "Deployment": {"group": "apps", "version": "v1", "plural": "deployments"},
     }
 
     def _sync_worker_units(
@@ -1040,7 +1083,10 @@ class Reconciler:
         first-party.  Single-host topologies produce no units; the sync
         then only garbage-collects leftovers (e.g. after a topology edit).
         """
-        from .builder import build_worker_unit_manifests
+        from .builder import (
+            build_warm_pool_manifests,
+            build_worker_unit_manifests,
+        )
 
         owner_uid = (obj.get("metadata") or {}).get("uid", f"uid-{self.name}")
         desired: list[dict] = []
@@ -1056,6 +1102,12 @@ class Reconciler:
                 self.name, self.namespace, owner_uid, config,
                 state.current_version, uri,
             )
+            # Warm pool rides the current version (its snapshot geometry
+            # is the prewarm source); [] when warmPoolSize is 0.
+            desired += build_warm_pool_manifests(
+                self.name, self.namespace, owner_uid, config,
+                state.current_version, uri,
+            )
         if state.previous_version is not None and state.traffic_prev > 0:
             desired += build_worker_unit_manifests(
                 self.name, self.namespace, owner_uid, config,
@@ -1063,7 +1115,9 @@ class Reconciler:
                 self._resolve_uri(config, state.previous_version),
             )
 
-        desired_names: dict[str, set[str]] = {"StatefulSet": set(), "Service": set()}
+        desired_names: dict[str, set[str]] = {
+            kind: set() for kind in self._UNIT_KIND_REFS
+        }
         for manifest in desired:
             kind = manifest["kind"]
             name = manifest["metadata"]["name"]
@@ -1169,6 +1223,8 @@ class Reconciler:
         if getattr(self, "_had_scaler_keys", False):
             status.setdefault("replicas", None)
             status.setdefault("autoscaler", None)
+        if getattr(self, "_had_snapshot_key", False):
+            status.setdefault("snapshot", None)
         if getattr(self, "_capacity_known", False):
             cap = self._capacity_status
             if cap is not None:

@@ -72,6 +72,13 @@ class PromotionState:
     # anchors that must survive operator restarts.
     replicas: int | None = None
     scaler: Any = None
+    # Scale-to-zero park context (spec.tpu.snapshot + autoscaling
+    # minReplicas: 0): while the CR's Deployment is parked at zero
+    # replicas, status.snapshot records WHERE the pre-baked weight
+    # snapshot lives so the wake path (and a human reading kubectl -o
+    # yaml) knows the restore source.  None (and omitted from status)
+    # whenever the CR holds capacity.
+    snapshot: Any = None
 
     # -- transitions (pure; each returns a new state) -----------------------
 
@@ -91,6 +98,7 @@ class PromotionState:
             history=self.history,
             replicas=self.replicas,
             scaler=self.scaler,
+            snapshot=self.snapshot,
         )
 
     def new_version(self, version: str, initial_traffic: int) -> "PromotionState":
@@ -118,6 +126,7 @@ class PromotionState:
                 history=self.history,
                 replicas=self.replicas,
                 scaler=self.scaler,
+                snapshot=self.snapshot,
             )
         if (
             self.previous_version is not None
@@ -139,6 +148,7 @@ class PromotionState:
                 history=self.history,
                 replicas=self.replicas,
                 scaler=self.scaler,
+                snapshot=self.snapshot,
             )
         return PromotionState(
             phase=Phase.CANARY,
@@ -155,6 +165,7 @@ class PromotionState:
             # compares like with like.
             replicas=self.replicas,
             scaler=self.scaler,
+            snapshot=self.snapshot,
         )
 
     def promoted_step(self, step: int) -> "PromotionState":
@@ -191,6 +202,7 @@ class PromotionState:
             history=self.history,
             replicas=self.replicas,
             scaler=self.scaler,
+            snapshot=self.snapshot,
         )
 
     # -- serialization ------------------------------------------------------
@@ -300,6 +312,8 @@ class PromotionState:
             status["replicas"] = self.replicas
         if self.scaler is not None:
             status["autoscaler"] = dict(self.scaler)
+        if self.snapshot is not None:
+            status["snapshot"] = dict(self.snapshot)
         return status
 
     @classmethod
@@ -344,4 +358,5 @@ class PromotionState:
                 else None
             ),
             scaler=status.get("autoscaler"),
+            snapshot=status.get("snapshot"),
         )

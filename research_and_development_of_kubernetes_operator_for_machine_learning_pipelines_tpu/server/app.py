@@ -131,7 +131,7 @@ def _check_gen_params(params: dict, allowed: frozenset) -> None:
 class TpuInferenceServer:
     def __init__(
         self,
-        engine: InferenceEngine,
+        engine: InferenceEngine | None,
         metrics: ServerMetrics,
         model_name: str,
         max_batch_size: int = 32,
@@ -141,15 +141,19 @@ class TpuInferenceServer:
         recorder=None,
         drain_grace_s: float = 20.0,
         telemetry=None,
+        attach_fn=None,
+        cold_start_anchor_wall: float | None = None,
     ):
         self.engine = engine
         self.metrics = metrics
         self.model_name = model_name
         # Single source of truth for the serving lifecycle: loading ->
-        # ready -> draining -> shutdown.  /readyz, /v2/health/ready (the
-        # manifest's readiness-probe path — same handler), the drain
-        # protocol, and the SIGTERM path all read/write THIS field; there
-        # is no second "ready" boolean anywhere to fall out of sync.
+        # ready -> draining -> shutdown, plus "warm-pool" — booted,
+        # compile-swept, but holding NO weights until /admin/attach.
+        # /readyz, /v2/health/ready (the manifest's readiness-probe path
+        # — same handler), the drain protocol, and the SIGTERM path all
+        # read/write THIS field; there is no second "ready" boolean
+        # anywhere to fall out of sync.
         self.lifecycle = "loading"
         self.drain_grace_s = float(drain_grace_s)
         # Set by the SIGTERM path: the process is irrevocably exiting,
@@ -160,20 +164,63 @@ class TpuInferenceServer:
         self.gen_engine = gen_engine  # GenerationEngine for causal-LM flavors
         self.recorder = recorder  # flight_recorder.FlightRecorder | None
         self.telemetry = telemetry  # device_telemetry.DeviceTelemetry | None
+        # Warm-pool seam: builds (engine, gen_engine, predictor) for a
+        # model URI on demand — None on a normal (model-at-boot) server.
+        self.attach_fn = attach_fn
+        self.predictor = None  # set by attach (release target on replace)
+        self._batch_geometry = (max_batch_size, max_batch_delay_ms,
+                                max_inflight_batches)
+        # Wall-clock anchor of the current cold start (wake signal time
+        # when known, else boot time); the first token served after it
+        # closes the tpumlops_cold_start_seconds ladder.
+        self._cold_anchor_wall = cold_start_anchor_wall
         import threading
 
         self._profile_lock = threading.Lock()
+        self._attach_lock = asyncio.Lock()
+        self.batcher = None
+        if engine is not None:
+            self._wire_batcher(engine)
+
+    def _wire_batcher(self, engine) -> None:
         # Pipelined when the engine supports async dispatch (the jit
         # tier): batch N+1 stacks/dispatches while N executes on device.
+        max_batch_size, max_batch_delay_ms, max_inflight = (
+            self._batch_geometry
+        )
         has_async = hasattr(engine, "predict_async")
         self.batcher = DynamicBatcher(
             run_batch=engine.predict_async if has_async else engine.predict,
             max_batch_size=max_batch_size,
             max_batch_delay_ms=max_batch_delay_ms,
-            on_batch=metrics.observe_batch,
+            on_batch=self.metrics.observe_batch,
             materialize=engine.materialize if has_async else None,
-            max_inflight=max_inflight_batches,
+            max_inflight=max_inflight,
         )
+
+    def _not_attached(self) -> web.Response | None:
+        """Typed 503 while a warm-pool replica holds no model (clients
+        retry after the operator attaches one)."""
+        if self.engine is not None:
+            return None
+        return web.json_response(
+            {
+                "error": "no model attached to this warm-pool replica",
+                "reason": "warm_pool_empty",
+                "retry_after_s": 5,
+            },
+            status=503,
+            headers={"Retry-After": "5"},
+        )
+
+    def note_first_token(self) -> None:
+        """First token served since the cold-start anchor: close the
+        tpumlops_cold_start_seconds ladder (one-shot per boot/attach)."""
+        anchor = self._cold_anchor_wall
+        if anchor is None:
+            return
+        self._cold_anchor_wall = None
+        self.metrics.observe_cold_start("first_token", time.time() - anchor)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -192,6 +239,13 @@ class TpuInferenceServer:
             self.lifecycle = "draining"
 
     def startup(self, warmup: bool = True) -> None:
+        if self.engine is None:
+            # Warm-pool boot: compile programs are pre-baked (see
+            # prewarm_from_snapshot) but there are no weights to serve —
+            # readiness stays down until /admin/attach.
+            self.lifecycle = "warm-pool"
+            self.metrics.ready.labels(**self.metrics.identity).set(0)
+            return
         if warmup:
             self.engine.warmup()
         if self.gen_engine is not None:
@@ -257,7 +311,8 @@ class TpuInferenceServer:
             from ..utils.compile_cache import detach_observatory
 
             detach_observatory(self.telemetry.observatory)
-        self.batcher.stop()
+        if self.batcher is not None:
+            self.batcher.stop()
         if self.gen_engine is not None:
             self.gen_engine.shutdown()
         if hasattr(self.engine, "shutdown"):
@@ -307,6 +362,9 @@ class TpuInferenceServer:
         return _concat_batches(chunks_out)
 
     async def handle_v2_infer(self, request: web.Request) -> web.Response:
+        err = self._not_attached()
+        if err is not None:
+            return err
         t0 = time.perf_counter()
         code = 200
         try:
@@ -341,6 +399,9 @@ class TpuInferenceServer:
 
     async def handle_seldon_predict(self, request: web.Request) -> web.Response:
         """Seldon-protocol compatibility (``{"data": {"ndarray": ...}}``)."""
+        err = self._not_attached()
+        if err is not None:
+            return err
         t0 = time.perf_counter()
         code = 200
         try:
@@ -412,6 +473,9 @@ class TpuInferenceServer:
         request are scheduled independently — they share decode steps with
         every other in-flight request, not just each other.
         """
+        err = self._not_attached()
+        if err is not None:
+            return err
         t0 = time.perf_counter()
         code = 200
         try:
@@ -529,6 +593,7 @@ class TpuInferenceServer:
             outs = await asyncio.gather(
                 *(asyncio.wrap_future(f) for f in futures)
             )
+            self.note_first_token()
             summary = _timing_summary(rid, traces)
             self._log_completion(summary, code=200)
             payload = {
@@ -619,6 +684,8 @@ class TpuInferenceServer:
                 if item is None:
                     break
                 emitted.append(item)
+                if len(emitted) == 1:
+                    self.note_first_token()
                 payload = json.dumps({"index": len(emitted) - 1, "token": item})
                 await resp.write(f"data: {payload}\n\n".encode())
             if fut.cancelled():
@@ -864,7 +931,144 @@ class TpuInferenceServer:
             }
         )
 
+    async def handle_admin_attach(self, request: web.Request) -> web.Response:
+        """``POST /admin/attach``: snapshot-restore a model into a
+        warm-pool replica (or swap the attached one with ``replace``).
+
+        The warm-pool replica booted with the compile sweep already run
+        against the persistent cache, so the attach path is: restore the
+        pre-baked device tree (zero transform work) + deserialize the
+        pre-baked executables + flip ``/readyz`` — the whole
+        ``tpumlops_cold_start_seconds`` ladder minus the pod boot.
+
+        Body: ``{"model_uri": "...", "replace": false,
+        "wake_start_wall": <unix-seconds>?}`` — ``wake_start_wall`` is
+        stamped by whoever decided to wake the CR, so the ladder's
+        ``wake`` stage measures decision → attach receipt.
+        """
+        if self.attach_fn is None:
+            return web.json_response(
+                {
+                    "error": "not a warm-pool server (boot with "
+                    "--warm-pool 1 to attach models at runtime)"
+                },
+                status=400,
+            )
+        try:
+            body = await request.json() if request.can_read_body else {}
+            if not isinstance(body, dict):
+                raise ValueError("attach body must be a JSON object")
+            model_uri = body.get("model_uri")
+            if not model_uri or not isinstance(model_uri, str):
+                raise ValueError('attach requires "model_uri"')
+            replace = bool(body.get("replace", False))
+            wake_start = body.get("wake_start_wall")
+            wake_start = float(wake_start) if wake_start is not None else None
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if self.terminating or self.lifecycle == "shutdown":
+            return web.json_response(
+                {"error": "server is terminating"}, status=409
+            )
+        async with self._attach_lock:
+            if self.engine is not None and not replace:
+                return web.json_response(
+                    {
+                        "error": "a model is already attached; pass "
+                        '"replace": true to swap it',
+                        "lifecycle": self.lifecycle,
+                    },
+                    status=409,
+                )
+            t_receipt = time.time()
+            if wake_start is not None:
+                self.metrics.observe_cold_start(
+                    "wake", t_receipt - wake_start
+                )
+            # Local anchor for THIS attach's arithmetic: a request served
+            # during the startup await below one-shots (and nulls) the
+            # instance field via note_first_token — the ladder's "total"
+            # must not race it.
+            anchor = wake_start if wake_start is not None else t_receipt
+            self._cold_anchor_wall = anchor
+            loop = asyncio.get_running_loop()
+            old_predictor = self.predictor
+            if self.engine is not None:
+                # Replace: quiesce the old engine before its tree is
+                # freed (attach_fn releases the device buffers).
+                if self.batcher is not None:
+                    self.batcher.stop()
+                if self.gen_engine is not None:
+                    self.gen_engine.shutdown()
+                self.lifecycle = "loading"
+                self.metrics.ready.labels(**self.metrics.identity).set(0)
+                self.engine = None
+                self.gen_engine = None
+            try:
+                load_stats: dict = {}
+                attached = await loop.run_in_executor(
+                    None,
+                    lambda: self.attach_fn(
+                        model_uri, old_predictor, load_stats
+                    ),
+                )
+                self.predictor = attached["predictor"]
+                self.gen_engine = attached.get("gen_engine")
+                engine = attached["engine"]
+                self._wire_batcher(engine)
+                self.metrics.observe_model_load(load_stats)
+                restored = load_stats.get("restore_s") is not None
+                self.metrics.observe_cold_start(
+                    "restore" if restored else "load",
+                    load_stats.get("restore_s")
+                    or load_stats.get("wall_s")
+                    or 0.0,
+                )
+                t_warm = time.time()
+                # startup() runs the warmup sweep — against the compile
+                # cache the warm-pool boot already primed, so this is
+                # executable deserialization, not compilation.
+                self.engine = engine
+                await loop.run_in_executor(
+                    None, lambda: self.startup(warmup=True)
+                )
+                self.metrics.observe_cold_start(
+                    "compile", time.time() - t_warm
+                )
+                self.metrics.observe_cold_start(
+                    "total", time.time() - anchor
+                )
+            except Exception as e:
+                _log.exception("attach of %s failed", model_uri)
+                # Quiesce whatever got wired before the failure — a
+                # half-attached engine left running would leak its
+                # worker thread and device tree.
+                if self.batcher is not None:
+                    with contextlib.suppress(Exception):
+                        self.batcher.stop()
+                    self.batcher = None
+                if self.gen_engine is not None:
+                    with contextlib.suppress(Exception):
+                        self.gen_engine.shutdown()
+                self.engine = None
+                self.gen_engine = None
+                self.lifecycle = "warm-pool"
+                return web.json_response(
+                    {"error": f"attach failed: {e}"}, status=500
+                )
+        return web.json_response(
+            {
+                "lifecycle": self.lifecycle,
+                "model_uri": model_uri,
+                "restored": restored,
+                "load_breakdown_s": load_stats,
+            }
+        )
+
     async def handle_model_metadata(self, request: web.Request) -> web.Response:
+        err = self._not_attached()
+        if err is not None:
+            return err
         p = self.engine.predictor
         return web.json_response(
             {
@@ -892,10 +1096,15 @@ class TpuInferenceServer:
         app.router.add_get("/readyz", self.handle_ready)
         app.router.add_get("/livez", self.handle_live)
         app.router.add_post("/admin/drain", self.handle_admin_drain)
+        app.router.add_post("/admin/attach", self.handle_admin_attach)
         app.router.add_get(f"/v2/models/{name}", self.handle_model_metadata)
         app.router.add_get(f"/v2/models/{name}/ready", self.handle_ready)
         app.router.add_post(f"/v2/models/{name}/infer", self.handle_v2_infer)
-        if self.gen_engine is not None:
+        if self.gen_engine is not None or self.attach_fn is not None:
+            # Warm-pool servers register the generate route up front: the
+            # attached model may be a causal LM, and routes cannot be
+            # added after the app starts (pre-attach requests get the
+            # typed warm_pool_empty 503).
             app.router.add_post(f"/v2/models/{name}/generate", self.handle_generate)
         app.router.add_post("/api/v1.0/predictions", self.handle_seldon_predict)
         app.router.add_post("/api/v1.0/feedback", self.handle_feedback)
@@ -1077,8 +1286,76 @@ def make_gen_engine(
     )
 
 
+def prewarm_from_snapshot(config: ServerConfig) -> float | None:
+    """Warm-pool boot sweep: compile every engine program from the
+    snapshot manifest's *geometry* — a zero-filled tree of the exact
+    dtypes/shapes the real weights will have — so the XLA executables
+    land in the (persistent) compile cache before any model is attached.
+    The zero tree is released afterwards: the replica holds compiled
+    programs, not weights.  Best-effort; returns the sweep wall seconds
+    or None when there is no snapshot to read geometry from."""
+    import numpy as np
+
+    from ..models.registry import get_builder
+    from . import snapshot as _snap
+    from .loader import (
+        _build_config,
+        _unflatten,
+        release_predictor,
+    )
+
+    if not config.tpu.snapshot.enabled:
+        return None
+    spath = _snap.snapshot_path_for(
+        config.tpu.snapshot.dir, config.model_uri
+    )
+    if not (spath / _snap.MANIFEST_NAME).exists():
+        _log.info(
+            "warm-pool prewarm skipped: no snapshot at %s yet", spath
+        )
+        return None
+    t0 = time.perf_counter()
+    try:
+        manifest = _snap.read_manifest(spath)
+        if manifest["flavor"] != "llama-generate":
+            return None
+        flat = {
+            leaf["key"]: np.zeros(
+                leaf["shape"], dtype=_snap._dtype_from_name(leaf["dtype"])
+            )
+            for leaf in manifest["leaves"]
+        }
+        cfg = _build_config(manifest["flavor"], manifest.get("config", {}))
+        pred = get_builder(manifest["flavor"])(
+            _unflatten(flat),
+            **{
+                **manifest.get("builder_kwargs", {}),
+                **({"cfg": cfg} if cfg is not None else {}),
+            },
+        )
+        gen = make_gen_engine(pred, config)
+        try:
+            gen.start(warmup=True)
+        finally:
+            gen.shutdown()
+        release_predictor(pred)
+        wall = time.perf_counter() - t0
+        _log.info(
+            "warm-pool prewarm: compile sweep over snapshot geometry "
+            "done in %.1fs (programs pre-baked for attach)",
+            wall,
+        )
+        return wall
+    except Exception as e:
+        _log.warning("warm-pool prewarm failed (attach still works): %s", e)
+        return None
+
+
 def build_server(
-    config: ServerConfig, warmup: bool = True, transport=None
+    config: ServerConfig,
+    warmup: bool = True,
+    transport=None,
+    wake_start_wall: float | None = None,
 ) -> TpuInferenceServer:
     """Build the leader-side server.
 
@@ -1086,8 +1363,19 @@ def build_server(
     leader of a multi-host predictor unit: every engine call is broadcast
     so follower processes execute it in lockstep (SURVEY §7 hard part 5).
     Single-host units pass None and run the engine directly.
+
+    ``config.warm_pool`` boots the server with NO weights: the compile
+    sweep runs against the snapshot manifest's geometry (persistent
+    cache primed), and ``POST /admin/attach`` snapshot-restores a model
+    on demand.  ``wake_start_wall`` (unix seconds) is the instant the
+    controller decided to wake this replica — it anchors the
+    ``tpumlops_cold_start_seconds`` ladder's ``wake`` stage.
     """
+    boot_wall = time.time()
     mesh_shape = dict(config.tpu.mesh_shape)
+    snapshot_dir = (
+        config.tpu.snapshot.dir if config.tpu.snapshot.enabled else None
+    )
     telemetry = None
     if config.tpu.observability.device_telemetry:
         from .device_telemetry import DeviceTelemetry
@@ -1095,9 +1383,6 @@ def build_server(
         # Before load_predictor so even the loader-phase compiles (the
         # streamed quantizer) land in the observatory's journal.
         telemetry = DeviceTelemetry()
-    predictor = load_predictor(
-        config.model_uri, mesh_shape=mesh_shape, quantize=config.tpu.quantize
-    )
     metrics = ServerMetrics(
         deployment_name=config.deployment_name or config.model_name,
         predictor_name=config.predictor_name,
@@ -1106,10 +1391,87 @@ def build_server(
     )
     if telemetry is not None:
         telemetry.bind_metrics(metrics)
+    recorder = None
+    if config.tpu.observability.trace_ring > 0:
+        from .flight_recorder import FlightRecorder
+
+        recorder = FlightRecorder(config.tpu.observability.trace_ring)
+
+    def _build_engines(predictor, channel=None):
+        engine = InferenceEngine(
+            predictor,
+            max_batch_size=config.tpu.max_batch_size,
+            on_compile=lambda: metrics.compilations.labels(
+                **metrics.identity
+            ).inc(),
+            warmup_full_grid=config.tpu.warmup_full_grid,
+        )
+        gen_engine = None
+        if predictor.causal_lm is not None:
+            # On a multi-host unit the scheduler runs leader-side only;
+            # every device call is broadcast on the unit's channel so
+            # followers replay it in lockstep (their GenerationEngine is
+            # built in main()'s follower path, driven by follower_loop).
+            gen_engine = make_gen_engine(
+                predictor, config, channel=channel, metrics=metrics,
+                recorder=recorder, telemetry=telemetry,
+            )
+        return engine, gen_engine
+
+    if config.warm_pool:
+        if transport is not None:
+            raise ValueError(
+                "--warm-pool is single-host only (a multi-host unit "
+                "cannot attach weights after its process group formed)"
+            )
+
+        def attach_fn(model_uri, old_predictor, load_stats):
+            predictor = load_predictor(
+                model_uri,
+                mesh_shape=mesh_shape,
+                quantize=config.tpu.quantize,
+                load_stats=load_stats,
+                snapshot_dir=snapshot_dir,
+                release_first=old_predictor,
+            )
+            engine, gen_engine = _build_engines(predictor)
+            return {
+                "predictor": predictor,
+                "engine": engine,
+                "gen_engine": gen_engine,
+            }
+
+        server = TpuInferenceServer(
+            None,
+            metrics,
+            model_name=config.model_name,
+            max_batch_size=config.tpu.max_batch_size,
+            max_batch_delay_ms=config.tpu.max_batch_delay_ms,
+            max_inflight_batches=config.tpu.max_inflight_batches,
+            recorder=recorder,
+            drain_grace_s=config.tpu.drain_grace_s,
+            telemetry=telemetry,
+            attach_fn=attach_fn,
+        )
+        if warmup:
+            prewarm_from_snapshot(config)
+        server.startup(warmup=False)  # lifecycle -> "warm-pool"
+        return server
+
+    load_stats: dict = {}
+    predictor = load_predictor(
+        config.model_uri,
+        mesh_shape=mesh_shape,
+        quantize=config.tpu.quantize,
+        load_stats=load_stats,
+        snapshot_dir=snapshot_dir,
+    )
     engine = InferenceEngine(
         predictor,
         max_batch_size=config.tpu.max_batch_size,
-        on_compile=lambda: metrics.compilations.labels(**metrics.identity).inc(),
+        on_compile=lambda: metrics.compilations.labels(
+            **metrics.identity
+        ).inc(),
         warmup_full_grid=config.tpu.warmup_full_grid,
     )
     channel = None
@@ -1118,20 +1480,21 @@ def build_server(
 
         engine = MultihostEngine(engine, transport)
         channel = engine.channel
-    recorder = None
-    if config.tpu.observability.trace_ring > 0:
-        from .flight_recorder import FlightRecorder
-
-        recorder = FlightRecorder(config.tpu.observability.trace_ring)
     gen_engine = None
     if predictor.causal_lm is not None:
-        # On a multi-host unit the scheduler runs leader-side only; every
-        # device call is broadcast on the unit's channel so followers
-        # replay it in lockstep (their GenerationEngine is built in
-        # main()'s follower path and driven by follower_loop).
         gen_engine = make_gen_engine(
             predictor, config, channel=channel, metrics=metrics,
             recorder=recorder, telemetry=telemetry,
+        )
+    metrics.observe_model_load(load_stats)
+    restored = load_stats.get("restore_s") is not None
+    anchor = wake_start_wall if wake_start_wall is not None else boot_wall
+    if wake_start_wall is not None:
+        metrics.observe_cold_start("wake", boot_wall - wake_start_wall)
+    if load_stats:
+        metrics.observe_cold_start(
+            "restore" if restored else "load",
+            load_stats.get("restore_s") or load_stats.get("wall_s") or 0.0,
         )
     server = TpuInferenceServer(
         engine,
@@ -1144,8 +1507,13 @@ def build_server(
         recorder=recorder,
         drain_grace_s=config.tpu.drain_grace_s,
         telemetry=telemetry,
+        cold_start_anchor_wall=anchor,
     )
+    server.predictor = predictor
+    t_warm = time.time()
     server.startup(warmup=warmup)
+    metrics.observe_cold_start("compile", time.time() - t_warm)
+    metrics.observe_cold_start("total", time.time() - anchor)
     return server
 
 
@@ -1313,6 +1681,23 @@ def main(argv: list[str] | None = None) -> None:
         "(halves decode HBM traffic twice over)",
     )
     ap.add_argument(
+        "--snapshot-dir",
+        default="",
+        help="pre-baked weight snapshot directory (server/snapshot.py): "
+        "the post-shard, post-quantize device tree is baked here after "
+        "the first cold load and restored on later boots/attaches with "
+        "zero transform work (scale-to-zero fast path); empty disables",
+    )
+    ap.add_argument(
+        "--warm-pool",
+        type=int,
+        default=0,
+        help="1 boots a warm-pool replica: no weights, compile sweep run "
+        "against the snapshot manifest's geometry (persistent cache "
+        "primed), POST /admin/attach snapshot-restores a model on "
+        "demand; requires --snapshot-dir",
+    )
+    ap.add_argument(
         "--compile-cache-dir",
         default=os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache"),
         help="persistent XLA compile cache (SURVEY §7 hard part 3); "
@@ -1393,9 +1778,20 @@ def main(argv: list[str] | None = None) -> None:
                 },
                 "admissionQueueBudget": args.admission_queue_budget,
                 "drainGraceSeconds": args.drain_grace_seconds,
+                "snapshot": {
+                    "enabled": bool(args.snapshot_dir),
+                    **(
+                        {"dir": args.snapshot_dir}
+                        if args.snapshot_dir
+                        else {}
+                    ),
+                },
             }
         ),
+        warm_pool=bool(args.warm_pool),
     )
+    if config.warm_pool and not config.tpu.snapshot.enabled:
+        ap.error("--warm-pool requires --snapshot-dir")
 
     import jax  # deferred: process topology is meaningful only after init
 
@@ -1430,7 +1826,15 @@ def main(argv: list[str] | None = None) -> None:
     else:
         transport = None
 
-    server = build_server(config, transport=transport)
+    # Stamped by whoever decided to wake this replica (the operator's
+    # scale-from-zero path / LocalReplicaSet): anchors the
+    # tpumlops_cold_start_seconds ladder's "wake" stage.
+    wake_env = os.environ.get("TPUMLOPS_WAKE_START_WALL")
+    server = build_server(
+        config,
+        transport=transport,
+        wake_start_wall=float(wake_env) if wake_env else None,
+    )
 
     async def _serve() -> None:
         runner = web.AppRunner(server.build_app())

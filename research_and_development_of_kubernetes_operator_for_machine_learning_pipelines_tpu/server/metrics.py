@@ -312,6 +312,32 @@ class ServerMetrics:
             ident_labels + ["reason"],
             registry=self.registry,
         )
+        # Model-load stage breakdown (server/loader.py load_stats): the
+        # bench has measured disk/transfer/quantize/shard for rounds —
+        # this makes it a first-party series so a cold-start regression
+        # shows on dashboards, not just in bench JSON.  stage="restore"
+        # is the snapshot fast path (server/snapshot.py); "total" the
+        # load wall.  Registered unconditionally like engine_dispatches:
+        # children appear only when a load observes them, and the
+        # inventory is pinned in tests/test_metrics_contract.py.
+        self.model_load_seconds = Gauge(
+            "tpumlops_model_load_seconds",
+            "Most recent model load's stage breakdown "
+            "(disk/transfer/quantize/shard, or restore for a snapshot "
+            "restore; total = wall)",
+            ident_labels + ["stage"],
+            registry=self.registry,
+        )
+        # Scale-to-zero cold start ladder (wake -> restore -> compile ->
+        # first_token): stamped once per boot/attach so the whole
+        # CR-at-zero -> first-token path is observable per stage.
+        self.cold_start_seconds = Gauge(
+            "tpumlops_cold_start_seconds",
+            "Cold-start stage walls of the most recent boot/attach "
+            "(wake/load/restore/compile/first_token/total)",
+            ident_labels + ["stage"],
+            registry=self.registry,
+        )
         # Device telemetry layer (server/device_telemetry.py), registered
         # ONLY when spec.tpu.observability.deviceTelemetry is on: even an
         # unobserved labeled family adds HELP/TYPE lines to the
@@ -495,6 +521,29 @@ class ServerMetrics:
         if self.compile_cache_hits is not None:
             (self.compile_cache_hits if hit else self.compile_cache_misses
              ).labels(**self.identity).inc()
+
+    _LOAD_STAGES = {
+        "disk_s": "disk",
+        "transfer_s": "transfer",
+        "quantize_s": "quantize",
+        "shard_s": "shard",
+        "restore_s": "restore",
+        "wall_s": "total",
+    }
+
+    def observe_model_load(self, stats: dict):
+        """Export a loader ``load_stats`` breakdown (stage keys absent
+        from the stats simply don't materialize children)."""
+        for key, stage in self._LOAD_STAGES.items():
+            if stats.get(key) is not None:
+                self.model_load_seconds.labels(
+                    **self.identity, stage=stage
+                ).set(float(stats[key]))
+
+    def observe_cold_start(self, stage: str, seconds: float):
+        self.cold_start_seconds.labels(**self.identity, stage=stage).set(
+            max(0.0, float(seconds))
+        )
 
     def inc_generated_tokens(self, n: int = 1):
         # Separate from observe_decode_step: the first token of every

@@ -1,0 +1,387 @@
+"""Pre-baked weight snapshots: the device-resident tree on disk, restorable
+with zero transform work.
+
+Why: the measured 7B cold path (BENCH_7B_FULL.json) spends 102 s to
+first-servable — 92 s of it reading 12.55 GiB of bf16 from disk only to
+quantize it down to 6.4 GiB of int8 on device.  Both λScale and "Breaking
+the Ice" (PAPERS.md) locate the scale-to-zero win in the same place:
+stop re-deriving the device state on every boot.  A snapshot is the
+*exact post-shard, post-quantize* param tree — q8/scale planes included —
+written once after the first successful load, so a restore is a straight
+disk→device stream: ~2x fewer bytes read than the bf16 artifact and no
+``quantize_s`` / reshard stage at all.
+
+Layout (one directory per snapshot)::
+
+    <dir>/<content_hash>/
+        SNAPSHOT.json     # manifest: format version, identity, leaf index
+        chunk-00000.bin   # concatenated raw leaf bytes (bounded size)
+        chunk-00001.bin
+        ...
+
+The manifest indexes every leaf as ``(file, offset, nbytes, dtype, shape,
+crc32)``; leaves are never split across chunk files, so a restore can
+stream file-by-file with a reader thread while the consumer transfers the
+previous leaves to the device (same overlap discipline as
+``loader._stream_native_params``, minus the transform work).
+
+Identity and invalidation: the snapshot is keyed by a content hash of
+``(model version/uri, quantize mode, mesh shape, format version)``.  Any
+mismatch — a new model version, a different quantize mode, a resharded
+mesh, a format bump — makes the hash differ, so the restore path simply
+misses and the caller falls back to the cold load (which then re-bakes).
+Corruption (truncated chunk, CRC mismatch, malformed manifest) raises the
+typed :class:`SnapshotError` instead of serving garbage weights.
+"""
+
+from __future__ import annotations
+
+import binascii
+import hashlib
+import json
+import logging
+import os
+import shutil
+import tempfile
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+# Bump when the on-disk layout changes; a version mismatch is an ordinary
+# cache miss (cold load + re-bake), never an error.
+FORMAT_VERSION = 1
+
+MANIFEST_NAME = "SNAPSHOT.json"
+
+# Leaves are packed into chunk files of at most this many bytes (a leaf
+# larger than the bound gets its own file).  Bounded chunks keep restore
+# read-ahead and CRC verification incremental instead of one giant file.
+DEFAULT_CHUNK_BYTES = 256 * 2**20
+
+
+class SnapshotError(Exception):
+    """Typed failure of a snapshot read: corrupt/truncated chunk, CRC
+    mismatch, malformed manifest.  Callers treat it as 'this snapshot is
+    unusable' and fall back to the cold load path."""
+
+
+class SnapshotMismatch(SnapshotError):
+    """The snapshot on disk was baked for a different identity (model
+    version, quantize mode, mesh) or format version — a cache miss, not
+    corruption."""
+
+
+# ---------------------------------------------------------------------------
+# Identity
+# ---------------------------------------------------------------------------
+
+
+def snapshot_identity(
+    model_uri: str, quantize: str | None, mesh_shape: dict | None
+) -> dict[str, Any]:
+    """The invalidation key, as data: everything that changes the device
+    tree a load produces."""
+    return {
+        "model_uri": str(model_uri),
+        "quantize": quantize or "none",
+        "mesh_shape": {k: int(v) for k, v in sorted((mesh_shape or {}).items())},
+        "format_version": FORMAT_VERSION,
+    }
+
+
+def content_hash(identity: dict[str, Any]) -> str:
+    """Stable short hash of an identity dict (sorted-key JSON, sha256)."""
+    blob = json.dumps(identity, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def snapshot_path_for(snapshot_dir: str | Path, model_uri: str) -> Path:
+    """Deterministic snapshot location for a model artifact — the operator
+    computes the same path to record ``status.snapshot.uri`` on a parked
+    CR without ever touching the data plane.
+
+    Keyed by the model URI ONLY (a new model version is a new URI, so it
+    bakes beside the old); the quantize/mesh half of the identity lives
+    in the manifest's content hash, so flipping those knobs hits the
+    same location, mismatches, falls back to the cold load, and re-bakes
+    in place — stale state can never be restored, only replaced."""
+    tag = hashlib.sha256(str(model_uri).encode()).hexdigest()[:16]
+    return Path(snapshot_dir) / tag
+
+
+# ---------------------------------------------------------------------------
+# dtype round-trip (numpy has no native bf16; ml_dtypes supplies it)
+# ---------------------------------------------------------------------------
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _leaf_to_numpy(leaf: Any) -> np.ndarray:
+    """Device array -> host ndarray with its dtype intact (bf16 stays
+    bf16 — the whole point is writing the device-resident bytes)."""
+    arr = np.asarray(leaf)
+    return np.ascontiguousarray(arr)
+
+
+# ---------------------------------------------------------------------------
+# Writing
+# ---------------------------------------------------------------------------
+
+
+def write_snapshot(
+    snapshot_dir: str | Path,
+    params: Any,
+    *,
+    identity: dict[str, Any],
+    flavor: str,
+    config: dict | None = None,
+    builder_kwargs: dict | None = None,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+) -> Path:
+    """Write the device tree as a restorable snapshot; returns its path.
+
+    Atomic: everything is staged in a temp directory next to the target
+    and renamed into place, so a crash mid-write can never leave a
+    half-snapshot that a later restore would trust (restores also verify
+    per-leaf CRCs, but the rename makes the common case clean).  Writing
+    over an existing snapshot of the same model URI replaces it whole.
+    """
+    from .loader import _flatten  # one flattening scheme, spelled once
+
+    target = snapshot_path_for(snapshot_dir, identity["model_uri"])
+    target.parent.mkdir(parents=True, exist_ok=True)
+    t0 = time.perf_counter()
+    flat = _flatten(params)
+    staging = Path(
+        tempfile.mkdtemp(prefix=".snapshot-", dir=str(target.parent))
+    )
+    try:
+        leaves = []
+        chunk_idx = -1
+        chunk_f = None
+        chunk_used = chunk_bytes + 1  # force a fresh chunk on first leaf
+        total = 0
+        try:
+            for key in sorted(flat):
+                arr = _leaf_to_numpy(flat[key])
+                raw = arr.tobytes()
+                if chunk_used + len(raw) > chunk_bytes and chunk_used > 0:
+                    if chunk_f is not None:
+                        chunk_f.close()
+                    chunk_idx += 1
+                    chunk_f = open(staging / f"chunk-{chunk_idx:05d}.bin", "wb")
+                    chunk_used = 0
+                leaves.append(
+                    {
+                        "key": key,
+                        "dtype": arr.dtype.name,
+                        "shape": list(arr.shape),
+                        "file": f"chunk-{chunk_idx:05d}.bin",
+                        "offset": chunk_used,
+                        "nbytes": len(raw),
+                        "crc32": binascii.crc32(raw) & 0xFFFFFFFF,
+                    }
+                )
+                chunk_f.write(raw)
+                chunk_used += len(raw)
+                total += len(raw)
+        finally:
+            if chunk_f is not None:
+                chunk_f.close()
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "identity": identity,
+            "content_hash": content_hash(identity),
+            "flavor": flavor,
+            "config": config or {},
+            "builder_kwargs": builder_kwargs or {},
+            "total_bytes": total,
+            "leaves": leaves,
+        }
+        (staging / MANIFEST_NAME).write_text(json.dumps(manifest, indent=1))
+        if target.exists():
+            shutil.rmtree(target)
+        os.replace(staging, target)
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    _log.info(
+        "wrote snapshot %s: %d leaves, %.2f GiB in %.1fs",
+        target,
+        len(leaves),
+        total / 2**30,
+        time.perf_counter() - t0,
+    )
+    return target
+
+
+# ---------------------------------------------------------------------------
+# Reading
+# ---------------------------------------------------------------------------
+
+
+def read_manifest(path: str | Path) -> dict[str, Any]:
+    """Parse + structurally validate a snapshot manifest.  Raises
+    :class:`SnapshotError` on anything malformed."""
+    mf = Path(path) / MANIFEST_NAME
+    if not mf.exists():
+        raise SnapshotError(f"no {MANIFEST_NAME} in {path}")
+    try:
+        manifest = json.loads(mf.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise SnapshotError(f"unreadable snapshot manifest {mf}: {e}") from e
+    if not isinstance(manifest, dict) or not isinstance(
+        manifest.get("leaves"), list
+    ):
+        raise SnapshotError(f"malformed snapshot manifest {mf}")
+    return manifest
+
+
+def check_identity(manifest: dict, identity: dict[str, Any]) -> None:
+    """Raise :class:`SnapshotMismatch` unless the manifest was baked for
+    exactly this identity (format version rides inside the identity)."""
+    if int(manifest.get("format_version", -1)) != FORMAT_VERSION:
+        raise SnapshotMismatch(
+            f"snapshot format v{manifest.get('format_version')} != "
+            f"v{FORMAT_VERSION}"
+        )
+    if manifest.get("content_hash") != content_hash(identity):
+        raise SnapshotMismatch(
+            "snapshot identity mismatch: baked for "
+            f"{manifest.get('identity')}, requested {identity}"
+        )
+
+
+def load_snapshot(
+    path: str | Path,
+    *,
+    identity: dict[str, Any] | None = None,
+    stats: dict | None = None,
+    to_device: bool = True,
+) -> tuple[Any, dict[str, Any]]:
+    """Restore ``(params, manifest)`` from a snapshot directory.
+
+    Streams leaf-by-leaf with a reader thread so disk read overlaps the
+    host→device transfer (the restore is pure I/O: no quantize, no
+    reshard — the bytes on disk ARE the device layout).  Each leaf's CRC
+    is verified before its bytes are trusted; a truncated chunk or CRC
+    mismatch raises :class:`SnapshotError`.  When ``identity`` is given,
+    a mismatch raises :class:`SnapshotMismatch` BEFORE any data is read.
+
+    ``stats`` (optional dict) is filled with ``restore_s`` / ``disk_s`` /
+    ``transfer_s`` / ``read_gib`` so a slow restore says which stage was
+    slow — same shape the cold path's ``load_stats`` uses.
+    """
+    import queue as _queue
+    import threading
+
+    from .loader import _unflatten
+
+    path = Path(path)
+    manifest = read_manifest(path)
+    if identity is not None:
+        check_identity(manifest, identity)
+
+    t_wall = time.perf_counter()
+    timing = {"disk_s": 0.0, "transfer_s": 0.0, "read_bytes": 0}
+    q: _queue.Queue = _queue.Queue(maxsize=4)
+    reader_error: list[BaseException] = []
+    abort = threading.Event()
+
+    def reader() -> None:
+        open_file = None
+        open_name = None
+        try:
+            for leaf in manifest["leaves"]:
+                if abort.is_set():
+                    return
+                t0 = time.perf_counter()
+                if leaf["file"] != open_name:
+                    if open_file is not None:
+                        open_file.close()
+                    fpath = path / leaf["file"]
+                    if not fpath.exists():
+                        raise SnapshotError(
+                            f"snapshot chunk {leaf['file']} missing in {path}"
+                        )
+                    open_file = open(fpath, "rb")
+                    open_name = leaf["file"]
+                open_file.seek(leaf["offset"])
+                raw = open_file.read(leaf["nbytes"])
+                if len(raw) != leaf["nbytes"]:
+                    raise SnapshotError(
+                        f"snapshot chunk {leaf['file']} truncated at leaf "
+                        f"{leaf['key']!r}: wanted {leaf['nbytes']} bytes, "
+                        f"got {len(raw)}"
+                    )
+                if (binascii.crc32(raw) & 0xFFFFFFFF) != leaf["crc32"]:
+                    raise SnapshotError(
+                        f"snapshot leaf {leaf['key']!r} failed CRC in "
+                        f"{leaf['file']}"
+                    )
+                arr = np.frombuffer(
+                    raw, dtype=_dtype_from_name(leaf["dtype"])
+                ).reshape(leaf["shape"])
+                timing["disk_s"] += time.perf_counter() - t0
+                timing["read_bytes"] += leaf["nbytes"]
+                q.put((leaf["key"], arr))
+        except BaseException as e:
+            reader_error.append(e)
+        finally:
+            if open_file is not None:
+                open_file.close()
+            q.put(None)
+
+    rthread = threading.Thread(
+        target=reader, daemon=True, name="snapshot-reader"
+    )
+    rthread.start()
+
+    leaves: dict[str, Any] = {}
+    try:
+        if to_device:
+            import jax.numpy as jnp
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            key, arr = item
+            t0 = time.perf_counter()
+            leaves[key] = jnp.asarray(arr) if to_device else arr
+            timing["transfer_s"] += time.perf_counter() - t0
+    except BaseException:
+        # Same reader-unwedging contract as _stream_native_params: a
+        # consumer failure must not strand the reader on the bounded put.
+        abort.set()
+        while True:
+            try:
+                if q.get_nowait() is None:
+                    break
+            except _queue.Empty:
+                if not rthread.is_alive():
+                    break
+                time.sleep(0.01)
+        raise
+    if reader_error:
+        err = reader_error[0]
+        if isinstance(err, SnapshotError):
+            raise err
+        raise SnapshotError(f"snapshot read failed: {err}") from err
+    if stats is not None:
+        stats.update(
+            restore_s=round(time.perf_counter() - t_wall, 3),
+            disk_s=round(timing["disk_s"], 3),
+            transfer_s=round(timing["transfer_s"], 3),
+            read_gib=round(timing["read_bytes"] / 2**30, 3),
+        )
+    return _unflatten(leaves), manifest

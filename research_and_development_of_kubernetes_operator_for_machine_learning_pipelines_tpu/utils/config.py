@@ -380,6 +380,43 @@ class SpeculativeSpec:
 
 
 @dataclass(frozen=True)
+class SnapshotSpec:
+    """``spec.tpu.snapshot``: pre-baked weight snapshots (scale-to-zero
+    fast restore, ``server/snapshot.py``).
+
+    When enabled, the server bakes the post-shard, post-quantize device
+    tree into ``dir`` after its first successful cold load and restores
+    from it on every later boot/attach with zero transform work; the
+    snapshot is invalidated by a content hash of (model version/URI,
+    quantize mode, mesh shape).  Required for ``autoscaling.minReplicas:
+    0`` — without a restorable snapshot a woken CR would pay the full
+    cold path while a request is parked.  Disabled by default: an
+    unannotated CR's manifest and load path stay byte-for-byte.
+    """
+
+    enabled: bool = False
+    dir: str = "/var/cache/tpumlops/snapshots"
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "SnapshotSpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec, frozenset({"enabled", "dir"}), "spec.tpu.snapshot"
+        )
+        return cls(
+            enabled=bool(spec.get("enabled", False)),
+            dir=str(spec.get("dir", "/var/cache/tpumlops/snapshots")),
+        )
+
+    def __post_init__(self):
+        if self.enabled and not self.dir:
+            # Reject at reconcile time, not as a pod CrashLoopBackOff.
+            raise ValueError(
+                "snapshot.enabled requires a non-empty snapshot.dir"
+            )
+
+
+@dataclass(frozen=True)
 class ObservabilitySpec:
     """``spec.tpu.observability``: engine flight-recorder sizing and the
     device telemetry layer.
@@ -442,7 +479,16 @@ class AutoscalingSpec:
       count once the demand has persisted ``scale_up_stabilization_s``
       (0 = immediately); scale-down steps ONE replica at a time and only
       after ``scale_down_cooldown_s`` since the last scale event in
-      either direction.
+      either direction;
+    - ``min_replicas: 0`` is serverless scale-to-zero: an idle CR's
+      Deployment parks at zero replicas (requires
+      ``spec.tpu.snapshot.enabled`` so the wake restore is fast, and is
+      rejected on multi-host topologies), the router parks incoming
+      requests, and a parked/queued request wakes the CR immediately —
+      no stabilization window, a waiting user has already paid it;
+    - ``warm_pool_size`` reserves that many ``--warm-pool`` replicas
+      (booted, compile-swept, weightless) the wake path can attach a
+      snapshot to instead of booting a pod from scratch.
 
     Disabled (the default) keeps manifests, status patches, and engine
     admission behavior byte-for-byte what they were.
@@ -455,6 +501,7 @@ class AutoscalingSpec:
     target_ttft_seconds: float = 0.0  # <= 0: signal unused
     scale_up_stabilization_s: float = 0.0
     scale_down_cooldown_s: float = 300.0
+    warm_pool_size: int = 0  # 0 = no warm pool
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "AutoscalingSpec":
@@ -467,6 +514,7 @@ class AutoscalingSpec:
                     "targetQueueDepthPerReplica", "targetTTFTSeconds",
                     "scaleUpStabilizationSeconds",
                     "scaleDownCooldownSeconds",
+                    "warmPoolSize",
                 }
             ),
             "spec.autoscaling",
@@ -485,15 +533,26 @@ class AutoscalingSpec:
             scale_down_cooldown_s=float(
                 spec.get("scaleDownCooldownSeconds", 300.0)
             ),
+            warm_pool_size=int(spec.get("warmPoolSize", 0)),
         )
 
     def __post_init__(self):
         # Contradictory specs are rejected at reconcile time so they land
         # in CR status, not as an autoscaler oscillating or parked.
-        if self.min_replicas < 1:
+        if self.min_replicas < 0:
             raise ValueError(
-                f"autoscaling.minReplicas must be >= 1, got "
-                f"{self.min_replicas}"
+                f"autoscaling.minReplicas must be >= 0 (0 = serverless "
+                f"scale-to-zero), got {self.min_replicas}"
+            )
+        if self.max_replicas < 1:
+            raise ValueError(
+                f"autoscaling.maxReplicas must be >= 1, got "
+                f"{self.max_replicas}"
+            )
+        if not (0 <= self.warm_pool_size <= 16):
+            raise ValueError(
+                f"autoscaling.warmPoolSize must be in [0, 16], got "
+                f"{self.warm_pool_size}"
             )
         if self.min_replicas > self.max_replicas:
             raise ValueError(
@@ -519,6 +578,20 @@ class AutoscalingSpec:
                 "autoscaling.enabled requires a scaling target: set "
                 "targetQueueDepthPerReplica > 0 and/or "
                 "targetTTFTSeconds > 0"
+            )
+        if (
+            self.enabled
+            and self.min_replicas == 0
+            and self.target_queue_depth_per_replica <= 0
+        ):
+            # The wake signal for a CR at zero is backlog (router-parked
+            # + queued requests); a TTFT-only config samples nothing at
+            # zero traffic and could never wake.
+            raise ValueError(
+                "autoscaling.minReplicas: 0 requires "
+                "targetQueueDepthPerReplica > 0 (parked/queued backlog "
+                "is the wake signal; TTFT alone cannot wake a CR at "
+                "zero)"
             )
 
 
@@ -608,6 +681,10 @@ class TpuSpec:
     # Radix prefix KV cache: shared prompt prefixes (system prompts, chat
     # templates) prefill once and are copied thereafter.
     prefix_cache: PrefixCacheSpec = field(default_factory=PrefixCacheSpec)
+    # Pre-baked weight snapshots (server/snapshot.py): the post-shard,
+    # post-quantize device tree on disk, restored with zero transform
+    # work — the scale-to-zero wake path's fast restore.
+    snapshot: SnapshotSpec = field(default_factory=SnapshotSpec)
     # Self-speculative n-gram decoding: batched multi-token verify
     # amortizes the per-tick HBM weight stream over accepted drafts.
     speculative: SpeculativeSpec = field(default_factory=SpeculativeSpec)
@@ -653,7 +730,7 @@ class TpuSpec:
                     "maxInflightBatches", "compileCacheDir", "quantize",
                     "prefillChunk", "prefillBatch", "prefillTokenBudget",
                     "prefixCache", "speculative", "decodeSteps",
-                    "observability",
+                    "observability", "snapshot",
                     "warmupFullGrid", "admissionQueueBudget",
                     "drainGraceSeconds",
                 }
@@ -697,6 +774,7 @@ class TpuSpec:
                 spec.get("prefillTokenBudget")
             ),
             prefix_cache=prefix_cache,
+            snapshot=SnapshotSpec.from_spec(spec.get("snapshot")),
             speculative=SpeculativeSpec.from_spec(spec.get("speculative")),
             decode_steps=_parse_decode_steps(spec.get("decodeSteps")),
             observability=ObservabilitySpec.from_spec(
@@ -730,6 +808,11 @@ class ServerConfig:
     port: int = 9000
     metrics_port: int = 6000
     tpu: TpuSpec = field(default_factory=TpuSpec)
+    # Warm-pool boot (server --warm-pool): start with compiled programs
+    # pre-baked (the warmup sweep runs against the persistent compile
+    # cache using the snapshot manifest's geometry) but NO weights;
+    # POST /admin/attach snapshot-restores a model on demand.
+    warm_pool: bool = False
 
 
 @dataclass(frozen=True)
@@ -773,6 +856,26 @@ class OperatorConfig:
             raise ValueError(f"spec.backend must be 'seldon' or 'tpu', got {backend!r}")
         tpu = TpuSpec.from_spec(spec.get("tpu"))
         autoscaling = AutoscalingSpec.from_spec(spec.get("autoscaling"))
+        if (
+            autoscaling.enabled
+            and autoscaling.min_replicas == 0
+            and not tpu.snapshot.enabled
+        ):
+            # Scale-to-zero without a restorable snapshot means every
+            # wake pays the full cold path while a request is parked —
+            # the exact failure scale-to-zero exists to prevent.
+            raise ValueError(
+                "autoscaling.minReplicas: 0 requires spec.tpu.snapshot."
+                "enabled (the wake path restores pre-baked weights; "
+                "without a snapshot the parked request would wait out a "
+                "full cold load)"
+            )
+        if autoscaling.warm_pool_size > 0 and not tpu.snapshot.enabled:
+            raise ValueError(
+                "autoscaling.warmPoolSize > 0 requires spec.tpu."
+                "snapshot.enabled (warm-pool replicas attach models by "
+                "snapshot restore)"
+            )
         if backend == "tpu":
             info = TPU_TOPOLOGIES.get(tpu.topology)
             if info is None:
@@ -804,6 +907,21 @@ class OperatorConfig:
                     "supported: one worker unit per predictor version; "
                     "scale out with more MlflowModel CRs or a larger "
                     "slice"
+                )
+            if info.hosts > 1 and (
+                autoscaling.min_replicas == 0
+                or autoscaling.warm_pool_size > 0
+            ):
+                # Snapshots store a single-device tree; a multi-host
+                # unit's weights are distributed across hosts, so wake-
+                # from-zero cannot restore it (and a parked unit would
+                # strand the follower process group mid-collective).
+                raise ValueError(
+                    f"scale-to-zero (autoscaling.minReplicas: 0 / "
+                    f"warmPoolSize > 0) with multi-host topology "
+                    f"{tpu.topology!r} is not supported: the snapshot "
+                    "restore path is single-host; scale out with more "
+                    "MlflowModel CRs or keep minReplicas >= 1"
                 )
         return cls(
             model_name=str(model_name),

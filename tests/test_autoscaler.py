@@ -132,6 +132,134 @@ def test_steady_state_produces_no_record():
     assert d.replicas == 2 and d.record is None
 
 
+# ---------------------------------------------------------------------------
+# Scale-to-zero + wake (minReplicas: 0, router park signal)
+# ---------------------------------------------------------------------------
+
+
+def zspec(**kw) -> AutoscalingSpec:
+    base = dict(min_replicas=0, scale_down_cooldown_s=60.0)
+    base.update(kw)
+    return spec(**base)
+
+
+def zmetrics(qd=None, ttft=None, parked=None) -> EngineMetrics:
+    return EngineMetrics(queue_depth=qd, ttft_p95_s=ttft, parked=parked)
+
+
+def test_idle_scales_down_to_zero_after_cooldown():
+    """With minReplicas 0 and the park signal wired, an idle CR steps
+    1 -> 0 like any other cooldown-gated scale-down."""
+    s = zspec()
+    d = decide(s, 1, ScalerState(last_scale_wall=0.0),
+               zmetrics(qd=0, parked=0), now_wall=100.0)
+    assert d.replicas == 0
+    assert d.record is not None and d.record.applied
+    assert d.record.direction == "down"
+
+
+def test_scale_to_zero_held_without_park_signal():
+    """The LAST step to zero requires the park signal observable: a CR
+    that scaled to zero blind to parked requests could never wake."""
+    d = decide(zspec(), 1, ScalerState(last_scale_wall=0.0),
+               zmetrics(qd=0, parked=None), now_wall=100.0)
+    assert d.replicas == 1
+    assert d.record.hold == HOLD_METRICS_MISSING
+    assert "park signal" in d.record.reason
+    # 2 -> 1 does NOT need it (there is still capacity to route to).
+    d = decide(zspec(), 2, ScalerState(last_scale_wall=0.0),
+               zmetrics(qd=0, parked=None), now_wall=100.0)
+    assert d.replicas == 1
+
+
+def test_parked_request_wakes_from_zero_immediately():
+    """A parked request is a user already waiting: the wake bypasses the
+    stabilization window entirely."""
+    s = zspec(scale_up_stabilization_s=30.0)
+    d = decide(s, 0, ScalerState(), zmetrics(parked=1), now_wall=1000.0)
+    assert d.replicas == 1
+    assert d.record is not None and d.record.applied
+    assert "wake from zero" in d.record.reason
+    assert "parked" in d.record.reason
+    assert d.state.last_scale_wall == 1000.0
+    # Backlog sizes the wake: 9 parked at 2-per-replica wakes to 5.
+    d = decide(zspec(target_queue_depth_per_replica=2.0), 0,
+               ScalerState(), zmetrics(parked=9), now_wall=1000.0)
+    assert d.replicas == 5
+
+
+def test_at_zero_idle_and_blind_both_stay_at_zero():
+    # parked=0 observable: stay parked, nothing to journal.
+    d = decide(zspec(), 0, ScalerState(), zmetrics(parked=0),
+               now_wall=1000.0)
+    assert d.replicas == 0 and d.record is None
+    # Fully blind at zero: hold (metrics blackout must not wake or park
+    # anything it cannot see).
+    d = decide(zspec(), 0, ScalerState(), zmetrics(), now_wall=1000.0)
+    assert d.replicas == 0
+    assert d.record.hold == HOLD_METRICS_MISSING
+
+
+def test_reconciler_parks_at_zero_records_snapshot_and_wakes():
+    """Full operator loop for scale-to-zero: the Deployment parks at 0
+    replicas, status.snapshot records the restore source, a parked
+    request wakes it (WokenFromZero), and the park context clears."""
+    zero_auto = {
+        "enabled": True,
+        "minReplicas": 0,
+        "maxReplicas": 4,
+        "targetQueueDepthPerReplica": 2,
+        "scaleUpStabilizationSeconds": 0,
+        "scaleDownCooldownSeconds": 60,
+    }
+    kube, registry, fm, clock, rec, wall = make_world(
+        {
+            "autoscaling": dict(zero_auto),
+            "tpu": {"snapshot": {"enabled": True, "dir": "/snaps"}},
+        }
+    )
+    fm.set_engine_metrics(
+        "m", "v1", "ns", EngineMetrics(queue_depth=0.0, parked=0.0)
+    )
+    reconcile(kube, rec)  # Stable at 1 (adopted)
+    wall[0] += 120.0
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 0
+    replicas, ann = deployed_replicas(kube)
+    assert replicas == {"v1": 0}
+    assert ann["tpumlops.dev/replicas"] == "0"
+    status = kube.get(CR)["status"]
+    snap_status = status["snapshot"]
+    assert snap_status["enabled"] is True
+    assert snap_status["dir"] == "/snaps"
+    assert snap_status["uri"].startswith("/snaps/")
+    assert "ScaledToZero" in kube.event_reasons()
+
+    # A request lands at the router: parked > 0 wakes immediately.
+    fm.set_engine_metrics(
+        "m", "v1", "ns", EngineMetrics(parked=1.0)
+    )
+    wall[0] += 1.0
+    out = reconcile(kube, rec)
+    assert out.state.replicas == 1
+    replicas, _ = deployed_replicas(kube)
+    assert replicas == {"v1": 1}
+    assert "WokenFromZero" in kube.event_reasons()
+    # Park context cleared (explicit null patched over the old key).
+    assert kube.get(CR)["status"].get("snapshot") is None
+    # The wake rode the journal: reason names the parked backlog.
+    assert out.scale is not None and "wake from zero" in out.scale.reason
+
+
+def test_parked_counts_into_backlog_above_zero():
+    """Parked requests add to queue depth when sizing a live fleet (a
+    router may park during a weight flip even with replicas up)."""
+    d = decide(spec(), 1, ScalerState(),
+               zmetrics(qd=6, parked=6), now_wall=0.0)
+    assert d.replicas == 3  # ceil(12 / 4)
+    assert "parked" in d.record.reason
+
+
 def test_scaler_state_round_trips_through_status():
     st = ScalerState(last_scale_wall=123.5, above_since_wall=120.0)
     assert ScalerState.from_status(st.to_status()) == st

@@ -252,3 +252,74 @@ def test_drain_grace_extends_pod_termination_grace():
     assert "terminationGracePeriodSeconds" not in _pod_spec_of({})
     spec = _pod_spec_of({"drainGraceSeconds": 120})
     assert spec["terminationGracePeriodSeconds"] >= 120 + 3  # + --drain-s lag
+
+
+def test_snapshot_flag_and_volume_emitted_only_when_enabled():
+    base = {
+        "modelName": "m",
+        "modelAlias": "prod",
+        "backend": "tpu",
+        "tpu": {"tpuTopology": "v5e-1", "meshShape": {"tp": 1}},
+    }
+    off = build_deployment(
+        "m", "ns", "uid", OperatorConfig.from_spec(base), "1", "s3://x", 100
+    )
+    container = off["spec"]["predictors"][0]["componentSpecs"][0]["spec"][
+        "containers"
+    ][0]
+    assert "--snapshot-dir" not in container["args"]
+    assert all(
+        v["name"] != "weight-snapshots"
+        for v in off["spec"]["predictors"][0]["componentSpecs"][0]["spec"].get(
+            "volumes", []
+        )
+    )
+
+    base["tpu"]["snapshot"] = {"enabled": True, "dir": "/snaps"}
+    on = build_deployment(
+        "m", "ns", "uid", OperatorConfig.from_spec(base), "1", "s3://x", 100
+    )
+    spec = on["spec"]["predictors"][0]["componentSpecs"][0]["spec"]
+    container = spec["containers"][0]
+    i = container["args"].index("--snapshot-dir")
+    assert container["args"][i + 1] == "/snaps"
+    assert any(v["name"] == "weight-snapshots" for v in spec["volumes"])
+    assert any(
+        m["name"] == "weight-snapshots" and m["mountPath"] == "/snaps"
+        for m in container["volumeMounts"]
+    )
+
+
+def test_warm_pool_manifest_emitted_and_inert_by_default():
+    from tpumlops.operator.builder import build_warm_pool_manifests
+
+    base = {
+        "modelName": "m",
+        "modelAlias": "prod",
+        "backend": "tpu",
+        "tpu": {
+            "tpuTopology": "v5e-1",
+            "meshShape": {"tp": 1},
+            "snapshot": {"enabled": True, "dir": "/snaps"},
+        },
+    }
+    # Default (warmPoolSize 0): nothing — byte-identity.
+    assert build_warm_pool_manifests(
+        "m", "ns", "uid", OperatorConfig.from_spec(base), "3", "s3://x"
+    ) == []
+
+    base["autoscaling"] = {"warmPoolSize": 2}
+    (dep,) = build_warm_pool_manifests(
+        "m", "ns", "uid", OperatorConfig.from_spec(base), "3", "s3://x"
+    )
+    assert dep["kind"] == "Deployment"
+    assert dep["metadata"]["name"] == "m-warm-pool"
+    assert dep["spec"]["replicas"] == 2
+    assert dep["metadata"]["labels"]["tpumlops/role"] == "warm-pool"
+    assert dep["metadata"]["ownerReferences"][0]["name"] == "m"
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    args = container["args"]
+    assert args[args.index("--warm-pool") + 1] == "1"
+    assert args[args.index("--snapshot-dir") + 1] == "/snaps"
+    # The pool pod still pins the TPU (attach needs the chip).
+    assert container["resources"]["limits"]["google.com/tpu"] == "1"

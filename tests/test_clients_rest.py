@@ -326,17 +326,22 @@ def test_prometheus_engine_metrics_queries_and_none_semantics():
         base_url="http://prom", transport=httpx.MockTransport(handler)
     )
     em = src.engine_metrics("iris", "v2", "models", 30)
-    assert len(queries) == 3
+    assert len(queries) == 4
     assert queries[0].startswith("sum(tpumlops_engine_queue_depth{")
     assert 'deployment_name="iris"' in queries[0]
     assert "histogram_quantile(0.95" in queries[1]
     assert "tpumlops_admission_wait_ms_bucket" in queries[1]
     assert "[30s]" in queries[1]
     assert "tpumlops_ttft_seconds_bucket" in queries[2]
+    # The router's park gauge (the scale-to-zero wake signal) carries no
+    # predictor_name — parking happens before any predictor is picked.
+    assert queries[3].startswith("sum(tpumlops_router_parked_requests{")
+    assert "predictor_name" not in queries[3]
     assert all("vector(0)" not in q for q in queries)
     assert em.queue_depth == 7.0
     assert em.admission_wait_p95_ms == 42.5
     assert em.ttft_p95_s == 1.25
+    assert em.parked == 7.0
 
     def empty(request):
         return httpx.Response(200, json={"data": {"result": []}})

@@ -272,7 +272,7 @@ def test_autoscaling_contradictory_specs_rejected():
             )
         )
     with pytest.raises(ValueError, match="minReplicas"):
-        OperatorConfig.from_spec(minimal_spec(autoscaling={"minReplicas": 0}))
+        OperatorConfig.from_spec(minimal_spec(autoscaling={"minReplicas": -1}))
     # Enabled with no scaling target: nothing to steer by.
     with pytest.raises(ValueError, match="target"):
         OperatorConfig.from_spec(
@@ -316,6 +316,108 @@ def test_autoscaling_multihost_rejected_like_replicas():
         )
     )
     assert cfg.autoscaling.max_replicas == 3
+
+
+def test_snapshot_spec_parsing_and_defaults():
+    """spec.tpu.snapshot: disabled default is byte-for-byte inert; keys
+    are typo-guarded; enabled requires a directory."""
+    from tpumlops.utils.config import SnapshotSpec, TpuSpec
+
+    d = TpuSpec.from_spec({})
+    assert d.snapshot.enabled is False
+    assert d.snapshot.dir == "/var/cache/tpumlops/snapshots"
+    s = TpuSpec.from_spec(
+        {"snapshot": {"enabled": True, "dir": "/mnt/snaps"}}
+    ).snapshot
+    assert (s.enabled, s.dir) == (True, "/mnt/snaps")
+    with pytest.raises(ValueError, match="snapshot.dir"):
+        SnapshotSpec(enabled=True, dir="")
+    with pytest.raises(ValueError, match="enable"):
+        TpuSpec.from_spec({"snapshot": {"enable": True}})
+
+
+def test_scale_to_zero_requires_snapshot():
+    """minReplicas: 0 without a restorable snapshot would make every
+    wake a full cold load while a request is parked — typed rejection."""
+    zero = {
+        "enabled": True,
+        "minReplicas": 0,
+        "maxReplicas": 2,
+        "targetQueueDepthPerReplica": 2,
+    }
+    with pytest.raises(ValueError, match="snapshot"):
+        OperatorConfig.from_spec(minimal_spec(autoscaling=dict(zero)))
+    # With the snapshot enabled the same spec parses.
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            autoscaling=dict(zero),
+            tpu={"snapshot": {"enabled": True}},
+        )
+    )
+    assert cfg.autoscaling.min_replicas == 0
+    # ...but a TTFT-only config could never wake (no traffic at zero =
+    # no TTFT sample): the backlog target is mandatory.
+    with pytest.raises(ValueError, match="wake"):
+        OperatorConfig.from_spec(
+            minimal_spec(
+                autoscaling={
+                    "enabled": True,
+                    "minReplicas": 0,
+                    "maxReplicas": 2,
+                    "targetTTFTSeconds": 1.0,
+                },
+                tpu={"snapshot": {"enabled": True}},
+            )
+        )
+
+
+def test_warm_pool_size_bounds_and_snapshot_requirement():
+    with pytest.raises(ValueError, match="warmPoolSize"):
+        OperatorConfig.from_spec(
+            minimal_spec(autoscaling={"warmPoolSize": -1})
+        )
+    with pytest.raises(ValueError, match="warmPoolSize"):
+        OperatorConfig.from_spec(
+            minimal_spec(autoscaling={"warmPoolSize": 17})
+        )
+    # Warm-pool replicas attach models by snapshot restore.
+    with pytest.raises(ValueError, match="snapshot"):
+        OperatorConfig.from_spec(
+            minimal_spec(autoscaling={"warmPoolSize": 2})
+        )
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            autoscaling={"warmPoolSize": 2},
+            tpu={"snapshot": {"enabled": True}},
+        )
+    )
+    assert cfg.autoscaling.warm_pool_size == 2
+
+
+def test_scale_to_zero_multihost_rejected():
+    """A multi-host unit's weights are distributed — the single-host
+    snapshot restore cannot wake it; reject at reconcile time."""
+    for auto in (
+        {
+            "enabled": True,
+            "minReplicas": 0,
+            "maxReplicas": 1,
+            "targetQueueDepthPerReplica": 2,
+        },
+        {"warmPoolSize": 1},
+    ):
+        with pytest.raises(ValueError, match="multi-host"):
+            OperatorConfig.from_spec(
+                minimal_spec(
+                    backend="tpu",
+                    tpu={
+                        "tpuTopology": "v5e-16",
+                        "meshShape": {"tp": 16},
+                        "snapshot": {"enabled": True},
+                    },
+                    autoscaling=dict(auto),
+                )
+            )
 
 
 def test_tpu_admission_and_drain_knobs():

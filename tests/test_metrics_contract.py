@@ -52,6 +52,12 @@ EXPECTED_SERVER = {
     "tpumlops_feedback_reward_total": ("gauge", _IDENT),
     "tpumlops_generated_tokens": ("counter", _IDENT),
     "tpumlops_itl_seconds": ("histogram", _IDENT),
+    # Model-load stage breakdown (loader load_stats made first-party):
+    # disk/transfer/quantize/shard, restore on the snapshot path, total.
+    "tpumlops_model_load_seconds": ("gauge", _IDENT + ("stage",)),
+    # Scale-to-zero cold-start ladder: wake/load|restore/compile/
+    # first_token/total of the most recent boot or /admin/attach.
+    "tpumlops_cold_start_seconds": ("gauge", _IDENT + ("stage",)),
     "tpumlops_model_ready": ("gauge", _IDENT),
     "tpumlops_pipeline_wait_seconds": ("histogram", _IDENT),
     "tpumlops_prefill_batch_fill": ("histogram", _IDENT),

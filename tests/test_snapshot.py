@@ -1,0 +1,257 @@
+"""Pre-baked weight snapshots (server/snapshot.py + loader integration).
+
+The scale-to-zero wake path trusts a snapshot to reproduce the EXACT
+device tree a cold load would have produced — bf16, int8 q8/scale
+planes, every dtype and byte.  These tests pin:
+
+- bit-identical round-trips for bf16, int8 and int8kv trees;
+- identity invalidation: quantize/mesh/format changes hash differently,
+  fall back to the cold load with ONE structured warning, and re-bake;
+- corruption: a truncated or bit-flipped chunk raises the typed
+  ``SnapshotError`` (never garbage weights), and the loader quarantines
+  the bad snapshot so the next cold load re-bakes it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumlops.models import llama
+from tpumlops.server import snapshot as snap
+from tpumlops.server.loader import (
+    _flatten,
+    load_predictor,
+    save_native_model,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_artifact(tmp_path_factory):
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    root = tmp_path_factory.mktemp("snap-artifact")
+    art = root / "model"
+    save_native_model(
+        art,
+        "llama-generate",
+        llama.init(jax.random.key(7), cfg, dtype=jnp.bfloat16),
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+        builder_kwargs={"eos_id": 2},
+    )
+    return str(art)
+
+
+def _trees_bit_identical(a, b) -> None:
+    fa, fb = _flatten(a), _flatten(b)
+    assert sorted(fa) == sorted(fb)
+    for key in fa:
+        x, y = np.asarray(fa[key]), np.asarray(fb[key])
+        assert x.dtype == y.dtype, key
+        assert x.shape == y.shape, key
+        # Bitwise, not allclose: the snapshot stores the device bytes.
+        assert np.array_equal(
+            x.view(np.uint8), y.view(np.uint8)
+        ), f"leaf {key} not bit-identical"
+
+
+@pytest.mark.parametrize("quantize", ["none", "int8", "int8kv"])
+def test_round_trip_bit_identical(tiny_artifact, tmp_path, quantize):
+    """bf16 and quantized trees (q8 + scale planes included) restore
+    bit-for-bit what the cold load produced."""
+    snapdir = str(tmp_path / f"snaps-{quantize}")
+    cold = load_predictor(
+        tiny_artifact, quantize=quantize, snapshot_dir=snapdir
+    )
+    stats: dict = {}
+    restored = load_predictor(
+        tiny_artifact, quantize=quantize, snapshot_dir=snapdir,
+        load_stats=stats,
+    )
+    assert stats.get("restore_s") is not None, stats
+    # The restore path does zero transform work: no quantize stage.
+    assert "quantize_s" not in stats
+    _trees_bit_identical(
+        cold.causal_lm["params"], restored.causal_lm["params"]
+    )
+    if quantize in ("int8", "int8kv"):
+        # The scale planes travelled as their own leaves.
+        flat = _flatten(restored.causal_lm["params"])
+        assert any(k.endswith("|scale") for k in flat)
+        assert any(k.endswith("|q8") for k in flat)
+    # eos_id (builder kwargs) survives the manifest round-trip.
+    assert restored.causal_lm.get("eos_id") == 2
+
+
+def test_identity_hash_covers_quantize_mesh_and_format(tiny_artifact):
+    base = snap.snapshot_identity(tiny_artifact, "int8", {"tp": 1})
+    assert snap.content_hash(base) == snap.content_hash(
+        snap.snapshot_identity(tiny_artifact, "int8", {"tp": 1})
+    )
+    for other in (
+        snap.snapshot_identity(tiny_artifact, "int8kv", {"tp": 1}),
+        snap.snapshot_identity(tiny_artifact, "none", {"tp": 1}),
+        snap.snapshot_identity(tiny_artifact, "int8", {"tp": 2}),
+        snap.snapshot_identity(tiny_artifact, "int8", {"dp": 1}),
+        snap.snapshot_identity(tiny_artifact + "x", "int8", {"tp": 1}),
+    ):
+        assert snap.content_hash(other) != snap.content_hash(base)
+    # Mesh key order is canonicalized, not hashed raw.
+    assert snap.content_hash(
+        snap.snapshot_identity(tiny_artifact, "int8", {"dp": 1, "tp": 2})
+    ) == snap.content_hash(
+        snap.snapshot_identity(tiny_artifact, "int8", {"tp": 2, "dp": 1})
+    )
+
+
+def test_quantize_mismatch_falls_back_with_one_warning_and_rebakes(
+    tiny_artifact, tmp_path, caplog
+):
+    snapdir = str(tmp_path / "snaps")
+    load_predictor(tiny_artifact, quantize="int8", snapshot_dir=snapdir)
+    spath = snap.snapshot_path_for(snapdir, tiny_artifact)
+    assert (spath / snap.MANIFEST_NAME).exists()
+    with caplog.at_level(logging.WARNING):
+        stats: dict = {}
+        load_predictor(
+            tiny_artifact, quantize="none", snapshot_dir=snapdir,
+            load_stats=stats,
+        )
+    # Cold path ran (no restore), exactly one invalidation warning.
+    assert "restore_s" not in stats
+    warnings = [
+        r for r in caplog.records if "snapshot invalidated" in r.message
+    ]
+    assert len(warnings) == 1, [r.message for r in caplog.records]
+    # ...and the cold load re-baked in place: the next load restores.
+    stats2: dict = {}
+    load_predictor(
+        tiny_artifact, quantize="none", snapshot_dir=snapdir,
+        load_stats=stats2,
+    )
+    assert stats2.get("restore_s") is not None
+
+
+def test_format_version_mismatch_is_a_miss_not_an_error(
+    tiny_artifact, tmp_path
+):
+    snapdir = str(tmp_path / "snaps")
+    load_predictor(tiny_artifact, quantize="none", snapshot_dir=snapdir)
+    spath = snap.snapshot_path_for(snapdir, tiny_artifact)
+    manifest = json.loads((spath / snap.MANIFEST_NAME).read_text())
+    manifest["format_version"] = snap.FORMAT_VERSION + 1
+    (spath / snap.MANIFEST_NAME).write_text(json.dumps(manifest))
+    with pytest.raises(snap.SnapshotMismatch):
+        snap.load_snapshot(
+            spath,
+            identity=snap.snapshot_identity(tiny_artifact, "none", None),
+        )
+    # The loader treats it as an ordinary cache miss: cold load succeeds.
+    pred = load_predictor(
+        tiny_artifact, quantize="none", snapshot_dir=snapdir
+    )
+    assert pred.causal_lm is not None
+
+
+def test_truncated_chunk_raises_typed_error(tiny_artifact, tmp_path):
+    snapdir = str(tmp_path / "snaps")
+    load_predictor(tiny_artifact, quantize="none", snapshot_dir=snapdir)
+    spath = snap.snapshot_path_for(snapdir, tiny_artifact)
+    chunk = sorted(spath.glob("chunk-*.bin"))[0]
+    chunk.write_bytes(chunk.read_bytes()[:-100])
+    with pytest.raises(snap.SnapshotError, match="truncated"):
+        snap.load_snapshot(spath)
+
+
+def test_bitflip_fails_crc_with_typed_error(tiny_artifact, tmp_path):
+    snapdir = str(tmp_path / "snaps")
+    load_predictor(tiny_artifact, quantize="none", snapshot_dir=snapdir)
+    spath = snap.snapshot_path_for(snapdir, tiny_artifact)
+    chunk = sorted(spath.glob("chunk-*.bin"))[0]
+    raw = bytearray(chunk.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    chunk.write_bytes(bytes(raw))
+    with pytest.raises(snap.SnapshotError, match="CRC"):
+        snap.load_snapshot(spath)
+
+
+def test_corrupt_snapshot_quarantined_and_rebaked(
+    tiny_artifact, tmp_path, caplog
+):
+    """The loader must never serve (or keep trusting) corrupt bytes: the
+    bad snapshot is quarantined, the cold load serves, and the re-bake
+    makes the NEXT load restore again."""
+    snapdir = str(tmp_path / "snaps")
+    load_predictor(tiny_artifact, quantize="none", snapshot_dir=snapdir)
+    spath = snap.snapshot_path_for(snapdir, tiny_artifact)
+    chunk = sorted(spath.glob("chunk-*.bin"))[0]
+    chunk.write_bytes(chunk.read_bytes()[: chunk.stat().st_size // 2])
+    with caplog.at_level(logging.WARNING):
+        stats: dict = {}
+        pred = load_predictor(
+            tiny_artifact, quantize="none", snapshot_dir=snapdir,
+            load_stats=stats,
+        )
+    assert pred.causal_lm is not None
+    assert "restore_s" not in stats
+    assert any("snapshot unusable" in r.message for r in caplog.records)
+    stats2: dict = {}
+    load_predictor(
+        tiny_artifact, quantize="none", snapshot_dir=snapdir,
+        load_stats=stats2,
+    )
+    assert stats2.get("restore_s") is not None, stats2
+
+
+def test_missing_manifest_is_silent_cold_start(tiny_artifact, tmp_path, caplog):
+    """Never-baked is not an anomaly: no warning, ordinary cold load,
+    bake as a side effect."""
+    snapdir = str(tmp_path / "snaps")
+    with caplog.at_level(logging.WARNING):
+        load_predictor(
+            tiny_artifact, quantize="none", snapshot_dir=snapdir
+        )
+    assert not [
+        r for r in caplog.records if "snapshot" in r.message.lower()
+    ]
+    spath = snap.snapshot_path_for(snapdir, tiny_artifact)
+    assert (spath / snap.MANIFEST_NAME).exists()
+
+
+def test_write_is_atomic_no_partial_dir_on_failure(tmp_path):
+    """A crash mid-write must not leave a half-snapshot a later restore
+    would trust: the staging dir is renamed into place only when
+    complete."""
+    class Boom(Exception):
+        pass
+
+    class ExplodingLeaf:
+        dtype = np.dtype(np.float32)
+
+        def __array__(self, *a, **k):
+            raise Boom("disk full mid-leaf")
+
+    ident = snap.snapshot_identity("uri", "none", None)
+    with pytest.raises(Boom):
+        snap.write_snapshot(
+            tmp_path / "snaps",
+            {"a": np.zeros(4, np.float32), "b": ExplodingLeaf()},
+            identity=ident,
+            flavor="llama-generate",
+        )
+    target = snap.snapshot_path_for(tmp_path / "snaps", "uri")
+    assert not target.exists()
+    leftovers = list((tmp_path / "snaps").glob(".snapshot-*"))
+    assert leftovers == [], leftovers

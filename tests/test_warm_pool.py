@@ -1,0 +1,223 @@
+"""Warm-pool serving mode (server --warm-pool + POST /admin/attach).
+
+A warm-pool replica boots with NO weights: the compile sweep runs
+against the snapshot manifest's geometry (so the persistent compile
+cache holds every program), readiness stays down with a typed 503, and
+``/admin/attach`` snapshot-restores a model on demand — the scale-to-
+zero wake path minus the pod boot.  Pinned here: the pre-attach typed
+surface, the attach→ready flip with the cold-start ladder stamped, the
+replace swap (old device tree released BEFORE the new one streams —
+the warm-reload OOM fix), and the attach-failure fallback to warm-pool.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpumlops.clients.localplane import free_port
+from tpumlops.models import llama
+from tpumlops.server.app import build_server
+from tpumlops.server.loader import save_native_model
+from tpumlops.utils.config import ServerConfig, TpuSpec
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    root = tmp_path_factory.mktemp("warmpool")
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    dims = {
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "num_layers": cfg.num_layers,
+        "num_heads": cfg.num_heads,
+        "num_kv_heads": cfg.num_kv_heads,
+        "intermediate_size": cfg.intermediate_size,
+        "max_seq": cfg.max_seq,
+    }
+    uris = {}
+    for tag, seed in (("1", 3), ("2", 4)):
+        art = root / f"v{tag}"
+        save_native_model(
+            art, "llama-generate",
+            llama.init(jax.random.key(seed), cfg, dtype=jnp.bfloat16),
+            config=dims,
+        )
+        uris[tag] = str(art)
+    snap_dir = str(root / "snaps")
+    tpu = TpuSpec.from_spec(
+        {
+            "meshShape": {"tp": 1},
+            "maxBatchSize": 2,
+            "maxSlots": 2,
+            "snapshot": {"enabled": True, "dir": snap_dir},
+        }
+    )
+    # Bake v1's snapshot once (a normal boot writes it), so the warm
+    # pool's attach is a RESTORE.
+    baker = build_server(
+        ServerConfig(model_name="llm", model_uri=uris["1"], tpu=tpu),
+        warmup=False,
+    )
+    baker.shutdown()
+
+    server = build_server(
+        ServerConfig(
+            model_name="llm", model_uri=uris["1"], tpu=tpu, warm_pool=True
+        ),
+        warmup=False,  # prewarm sweep exercised implicitly via attach
+    )
+    port = free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(server.build_app())
+        loop.run_until_complete(runner.setup())
+        loop.run_until_complete(
+            web.TCPSite(runner, "127.0.0.1", port).start()
+        )
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/livez", timeout=1
+            )
+            break
+        except Exception:
+            time.sleep(0.05)
+    yield server, port, uris
+    server.shutdown()
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def _req(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def test_warm_pool_lifecycle_attach_and_replace(world):
+    server, port, uris = world
+
+    # 1. Pre-attach: not ready, typed 503s everywhere a model would be.
+    assert server.lifecycle == "warm-pool"
+    code, body, _ = _req(port, "/readyz")
+    assert code == 503 and body["lifecycle"] == "warm-pool"
+    for path, payload in (
+        ("/v2/models/llm/generate",
+         {"prompt_ids": [1, 2, 3], "max_new_tokens": 2}),
+        ("/v2/models/llm/infer", {"inputs": []}),
+    ):
+        code, body, headers = _req(port, path, payload)
+        assert code == 503, (path, body)
+        assert body["reason"] == "warm_pool_empty"
+        assert headers.get("Retry-After") == "5"
+
+    # 2. Attach restores the baked snapshot and flips readiness; the
+    # wake stamp anchors the cold-start ladder.
+    code, body, _ = _req(
+        port, "/admin/attach",
+        {"model_uri": uris["1"], "wake_start_wall": time.time() - 0.5},
+    )
+    assert code == 200, body
+    assert body["restored"] is True
+    assert body["load_breakdown_s"].get("restore_s") is not None
+    code, body, _ = _req(port, "/readyz")
+    assert code == 200
+
+    code, body, _ = _req(
+        port, "/v2/models/llm/generate",
+        {"prompt_ids": [1, 2, 3], "max_new_tokens": 3},
+    )
+    assert code == 200, body
+    v1_tokens = body["outputs"][0]["data"]
+
+    expo = server.metrics.exposition().decode()
+    stages = {
+        line.split('stage="')[1].split('"')[0]
+        for line in expo.splitlines()
+        if line.startswith("tpumlops_cold_start_seconds{")
+    }
+    assert {"wake", "restore", "compile", "total", "first_token"} <= stages
+
+    # 3. Double-attach refused; replace swaps versions in place (the
+    # old tree is released before the new one streams).
+    code, body, _ = _req(port, "/admin/attach", {"model_uri": uris["2"]})
+    assert code == 409, body
+    code, body, _ = _req(
+        port, "/admin/attach", {"model_uri": uris["2"], "replace": True}
+    )
+    assert code == 200, body
+    code, body, _ = _req(
+        port, "/v2/models/llm/generate",
+        {"prompt_ids": [1, 2, 3], "max_new_tokens": 3},
+    )
+    assert code == 200, body
+    # Different weights serve different tokens: the swap took effect.
+    assert body["outputs"][0]["data"] != v1_tokens
+
+    # 4. Attach failure (bad URI) returns 500 and falls back to the
+    # warm-pool state instead of wedging half-attached.
+    code, body, _ = _req(
+        port, "/admin/attach",
+        {"model_uri": "/nonexistent/model", "replace": True},
+    )
+    assert code == 500, body
+    assert server.lifecycle == "warm-pool"
+    code, body, _ = _req(
+        port, "/v2/models/llm/generate",
+        {"prompt_ids": [1], "max_new_tokens": 1},
+    )
+    assert code == 503 and body["reason"] == "warm_pool_empty"
+    # ...and recovers on the next good attach.
+    code, body, _ = _req(
+        port, "/admin/attach", {"model_uri": uris["1"], "replace": True}
+    )
+    assert code == 200, body
+    assert server.lifecycle == "ready"
+
+
+def test_attach_requires_model_uri_and_warm_pool_flag(world):
+    server, port, uris = world
+    code, body, _ = _req(port, "/admin/attach", {})
+    assert code == 400 and "model_uri" in body["error"]
+
+
+def test_prewarm_from_snapshot_primes_compile_caches(world):
+    """The boot sweep compiles from the snapshot manifest's GEOMETRY —
+    zero weights held afterwards; best-effort and skipped cleanly when
+    no snapshot exists."""
+    from tpumlops.server.app import prewarm_from_snapshot
+
+    server, port, uris = world
+    tpu = TpuSpec.from_spec(
+        {
+            "meshShape": {"tp": 1},
+            "maxBatchSize": 2,
+            "maxSlots": 2,
+            "snapshot": {"enabled": True, "dir": "/nonexistent-snaps"},
+        }
+    )
+    cfg = ServerConfig(model_name="llm", model_uri=uris["1"], tpu=tpu)
+    assert prewarm_from_snapshot(cfg) is None  # no snapshot: clean skip
