@@ -2646,6 +2646,159 @@ def _llama_7b_inner() -> None:
 # Scenario registry (CLI selection + --dry-run schema contract)
 # ---------------------------------------------------------------------------
 
+def bench_disaggregated() -> dict:
+    """Disaggregated prefill/decode fleet vs independent replicas
+    (server/kv_transfer.py + the router's prefix-affinity relay).
+
+    The fleet problem: N independent replicas each prefill the shared
+    system prompt ONCE PER REPLICA, so fleet-wide cache hit rate decays
+    1/N and warm TTFT regresses to cold whenever the router's spray
+    lands a repeat prefix on a replica that has not seen it.  The
+    disaggregated shape prefills once on the prefill pool, hands the
+    serialized K/V to every decode replica (radix-chunk wire format,
+    int8kv-compact), and affinity-routes repeats — so the whole decode
+    pool serves warm.
+
+    Measured at 2 decode replicas under a mixed shared-prefix load:
+    per-request TTFT through the real engine scheduler, round-robin
+    (baseline: independent replicas, each pays its own cold prefill)
+    vs handoff-seeded (fleet: one cold prefill on the prefill engine +
+    one import per decode replica, then every request warm).  Handoff
+    wall (export + wire round-trip + import) reported at p99 alongside
+    the blob size; token_agreement pins the f64-proven parity at bf16
+    greedy (identical token ids both ways)."""
+    import threading
+
+    jax = _setup_jax()
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama
+    from tpumlops.server import kv_transfer
+    from tpumlops.server.generation import GenerationEngine
+    from tpumlops.server.prefix_cache import PrefixCacheConfig
+
+    cfg = llama.LlamaConfig(
+        vocab_size=4000, hidden_size=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, intermediate_size=704, max_seq=768,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    C = 128
+    REPLICAS = 2
+    N_REQ = 8
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, size=512, dtype=np.int64)
+
+    def make_engine():
+        e = GenerationEngine(
+            params, cfg, max_slots=4, dtype=jnp.bfloat16,
+            prefix_cache=PrefixCacheConfig(
+                enabled=True, budget_bytes=64 * 2**20, chunk_tokens=C
+            ),
+        )
+        e.start(warmup=True)
+        return e
+
+    def one_request(engine, suffix_seed: int):
+        sfx = np.random.default_rng(1000 + suffix_seed).integers(
+            1, cfg.vocab_size, size=32, dtype=np.int64
+        )
+        prompt = np.concatenate([shared, sfx]).tolist()
+        first = threading.Event()
+        t0 = time.perf_counter()
+        fut = engine.submit(prompt, 4, on_token=lambda _t: first.set())
+        assert first.wait(timeout=300), "no first token"
+        ttft = time.perf_counter() - t0
+        return ttft, fut.result(timeout=300).tolist()
+
+    def run_fleet(seed_handoff: bool):
+        decode = [make_engine() for _ in range(REPLICAS)]
+        handoff_walls, handoff_bytes = [], 0
+        try:
+            if seed_handoff:
+                prefill = make_engine()
+                try:
+                    probe = np.concatenate(
+                        [shared, [1]]
+                    ).astype(np.int32)
+                    prefill.generate(probe, 1)  # the one cold prefill
+                    for d in decode:
+                        t0 = time.perf_counter()
+                        matched, chunks = prefill.export_prefix_kv(probe)
+                        blob = kv_transfer.serialize_chunks(
+                            C, probe, chunks
+                        )
+                        header, wire = kv_transfer.deserialize_chunks(blob)
+                        d.import_prefix_kv(
+                            kv_transfer.chunk_token_ids(header), wire
+                        )
+                        handoff_walls.append(time.perf_counter() - t0)
+                        handoff_bytes = len(blob)
+                finally:
+                    prefill.shutdown()
+            ttfts, outs = [], []
+            for i in range(N_REQ):
+                ttft, out = one_request(decode[i % REPLICAS], i)
+                ttfts.append(ttft * 1000)
+                outs.append(out)
+            hits = sum(d.prefix_hits for d in decode)
+            lookups = sum(
+                d._prefix_cache.lookups for d in decode
+            )
+        finally:
+            for d in decode:
+                d.shutdown()
+        ttfts.sort()
+        return {
+            "ttft_p50_ms": ttfts[len(ttfts) // 2],
+            "ttft_p99_ms": ttfts[-1],
+            "hit_rate": hits / max(lookups, 1),
+            "handoff_walls": handoff_walls,
+            "handoff_bytes": handoff_bytes,
+            "outs": outs,
+        }
+
+    baseline = run_fleet(seed_handoff=False)
+    fleet = run_fleet(seed_handoff=True)
+    handoff_p99_ms = (
+        sorted(fleet["handoff_walls"])[-1] * 1000
+        if fleet["handoff_walls"]
+        else None
+    )
+    agreement = float(baseline["outs"] == fleet["outs"])
+    return {
+        "requests": N_REQ,
+        "replicas": REPLICAS,
+        "prompt_tokens": 544,
+        "prefill_chunk": C,
+        "baseline_ttft_p50_ms": round(baseline["ttft_p50_ms"], 1),
+        "baseline_ttft_p99_ms": round(baseline["ttft_p99_ms"], 1),
+        "fleet_ttft_p50_ms": round(fleet["ttft_p50_ms"], 1),
+        "fleet_ttft_p99_ms": round(fleet["ttft_p99_ms"], 1),
+        "ttft_p99_speedup": round(
+            baseline["ttft_p99_ms"] / max(fleet["ttft_p99_ms"], 1e-9), 2
+        ),
+        "affinity_hit_rate": round(fleet["hit_rate"], 3),
+        "baseline_hit_rate": round(baseline["hit_rate"], 3),
+        "handoff_p99_ms": (
+            round(handoff_p99_ms, 1) if handoff_p99_ms is not None else None
+        ),
+        "handoff_bytes": fleet["handoff_bytes"],
+        "token_agreement": agreement,
+        "note": "baseline = independent replicas each cold-prefilling "
+                "the shared 512-token prefix; fleet = one prefill + KV "
+                "handoff into every decode replica (wire round-trip "
+                "included), then the same round-robin load serves warm.",
+        **_device_cost_keys(params, cfg, 4, 544 / max(
+            fleet["ttft_p50_ms"] / 1000, 1e-9)),
+    }
+
+
 # Cost-ordered under the wall budget (measured end-to-end run: ~55 min
 # cold): cheap entries and the 1.35B ladder land first; the 7B goes LAST
 # because its checkpoint load alone has taken 1-12 min in this
@@ -2668,6 +2821,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("observability_serving", "bench_observability"),
     ("device_telemetry_serving", "bench_device_telemetry"),
     ("cold_start_serving", "bench_cold_start"),
+    ("disaggregated_serving", "bench_disaggregated"),
     ("llama_1p35b_decode", "bench_llama_decode"),
     ("serve_path_http", "bench_serve_path"),
     ("llama_7b_decode", "bench_llama_7b_decode"),
@@ -2730,6 +2884,14 @@ SCENARIO_SCHEMAS: dict = {
         "restore_speedup_vs_hf", "restore_speedup_vs_native",
         "cold_read_gib", "snapshot_read_gib", "bytes_reduction",
         "cold_breakdown_s", "restore_breakdown_s",
+        "token_agreement", "mfu", "hbm_peak_bytes",
+    ),
+    "disaggregated_serving": (
+        "requests", "replicas", "prompt_tokens", "prefill_chunk",
+        "baseline_ttft_p50_ms", "baseline_ttft_p99_ms",
+        "fleet_ttft_p50_ms", "fleet_ttft_p99_ms", "ttft_p99_speedup",
+        "affinity_hit_rate", "baseline_hit_rate",
+        "handoff_p99_ms", "handoff_bytes",
         "token_agreement", "mfu", "hbm_peak_bytes",
     ),
 }
@@ -2832,6 +2994,10 @@ _COMPACT_KEYS = {
     "cold_start_serving": (
         "hf_cold_s", "native_cold_s", "snapshot_restore_s",
         "restore_speedup_vs_hf", "bytes_reduction", "token_agreement"),
+    "disaggregated_serving": (
+        "baseline_ttft_p99_ms", "fleet_ttft_p99_ms", "ttft_p99_speedup",
+        "affinity_hit_rate", "handoff_p99_ms", "token_agreement",
+        "mfu", "hbm_peak_bytes"),
     "serve_path_http": (
         "server_queue_mean_ms", "server_device_run_mean_ms",
         "server_pipeline_wait_mean_ms", "server_observed_mean_ms",

@@ -164,6 +164,12 @@ class RouterAdmin:
         replicas are at zero."""
         return json.loads(self._req("/router/parked"))
 
+    def fleet(self) -> dict:
+        """Disaggregated-fleet state (``GET /router/fleet``): affinity
+        hit/miss tallies, KV handoff counts/bytes/failures, ring size,
+        and per-backend role + known-prefix counts."""
+        return json.loads(self._req("/router/fleet"))
+
 
 def parse_prometheus_text(text: str) -> dict[tuple[str, frozenset], float]:
     """Parse Prometheus exposition text into {(name, labelset): value}."""
@@ -373,14 +379,23 @@ class RouterSync:
                 # placeholder address (never dialed at weight 0) so the
                 # backend — and its histograms — survive the park.
                 host, port = "127.0.0.1", 9
-            backends.append(
-                {
-                    "name": name,
-                    "host": host,
-                    "port": port,
-                    "weight": weight,
-                }
-            )
+            entry = {
+                "name": name,
+                "host": host,
+                "port": port,
+                "weight": weight,
+            }
+            # Disaggregated pools: whoever materializes the fleet
+            # (tests / a local plane today — an in-cluster controller
+            # reading the builder's tpumlops.dev/fleet-* annotations is
+            # ROADMAP item 2's open end) stamps the pool role on the
+            # predictor entry; the router needs it for ring membership
+            # and relay targeting.  ALWAYS sent — to the router an
+            # omitted role means "keep the survivor's role", which would
+            # pin a backend once tagged prefill out of client traffic
+            # forever after disaggregation is turned off.
+            entry["role"] = str(pred.get("tpumlopsFleetRole") or "unified")
+            backends.append(entry)
         if backends:
             self.admin.set_config(
                 backends,
@@ -401,14 +416,21 @@ class RouterProcess:
     def __init__(
         self,
         port: int,
-        backends: dict[str, tuple[str, int, int]],
+        backends: dict[str, tuple],
         namespace: str = "default",
         deployment: str = "router",
         binary: pathlib.Path | None = None,
         park_buffer: int = 0,
         park_timeout_s: float = 30.0,
+        affinity_tokens: int = 0,
+        kv_handoff: bool = True,
+        handoff_retries: int = 1,
     ):
         self.port = port
+        # Values are (host, port, weight) or (host, port, weight, role)
+        # — role in {"unified", "prefill", "decode"} for disaggregated
+        # fleets (prefill backends serve KV exports, not client traffic;
+        # decode backends join the prefix-affinity ring).
         self.backends = backends
         self.namespace = namespace
         self.deployment = deployment
@@ -419,6 +441,15 @@ class RouterProcess:
         # returns; each parked request waits at most park_timeout_s.
         self.park_buffer = int(park_buffer)
         self.park_timeout_s = float(park_timeout_s)
+        # Prefix affinity + KV handoff relay: hash the first
+        # affinity_tokens prompt ids onto a consistent-hash ring over
+        # decode-role backends; cold prompts relay prefill→import→
+        # forward, retrying on up to handoff_retries ADDITIONAL prefill
+        # replicas after the first export fails before the unified
+        # fallback.  0 (default) = old routing byte-for-byte.
+        self.affinity_tokens = int(affinity_tokens)
+        self.kv_handoff = bool(kv_handoff)
+        self.handoff_retries = int(handoff_retries)
         self.proc: subprocess.Popen | None = None
         self.admin = RouterAdmin(port)
 
@@ -434,8 +465,19 @@ class RouterProcess:
                 "--park-buffer", str(self.park_buffer),
                 "--park-timeout-s", str(self.park_timeout_s),
             ]
-        for name, (host, port, weight) in self.backends.items():
-            argv += ["--backend", f"{name}={host}:{port}:{weight}"]
+        if self.affinity_tokens > 0:
+            argv += [
+                "--affinity-tokens", str(self.affinity_tokens),
+                "--kv-handoff", "1" if self.kv_handoff else "0",
+                "--handoff-retries", str(self.handoff_retries),
+            ]
+        for name, spec in self.backends.items():
+            host, port, weight = spec[0], spec[1], spec[2]
+            role = spec[3] if len(spec) > 3 else None
+            arg = f"{name}={host}:{port}:{weight}"
+            if role:
+                arg += f":{role}"
+            argv += ["--backend", arg]
         self.proc = subprocess.Popen(
             argv, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
         )

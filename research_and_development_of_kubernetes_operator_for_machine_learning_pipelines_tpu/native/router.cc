@@ -47,8 +47,10 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <map>
 #include <memory>
+#include <set>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -111,7 +113,16 @@ struct Backend {
   std::string host;
   int port = 0;
   int weight = 0;
+  // Disaggregated-fleet role: "unified" (default) serves everything;
+  // "decode" joins the prefix-affinity ring and receives KV imports;
+  // "prefill" is EXCLUDED from the general SWRR pick — it serves
+  // /admin/kv/export relays only (its chips do prefill, not decode).
+  std::string role = "unified";
   int swrr_current = 0;  // smooth-WRR running counter
+  // Prefix hashes whose KV this (decode) backend is known to hold —
+  // because this router handed it off there.  Bounded; cleared on
+  // repoint (a different pod holds nothing we gave its predecessor).
+  std::set<uint64_t> known_prefixes;
   sockaddr_in addr{};    // resolved at config time (getaddrinfo)
   uint32_t addr_epoch = 0;  // bumped on repoint; gates pool admission
 
@@ -163,11 +174,34 @@ struct RouterState {
 
   // nginx smooth weighted round-robin: deterministic interleave, exact
   // long-run proportions.  Returns nullptr when all weights are 0.
+  // Prefill-role backends are excluded: they serve KV-export relays,
+  // not client traffic (no prefill role configured = old behavior).
   BackendPtr pick() {
     BackendPtr best;
     int total = 0;
     for (auto& b : backends) {
-      if (b->weight <= 0) continue;
+      if (b->weight <= 0 || b->role == "prefill") continue;
+      b->swrr_current += b->weight;
+      total += b->weight;
+      if (!best || b->swrr_current > best->swrr_current) best = b;
+    }
+    if (best) best->swrr_current -= total;
+    return best;
+  }
+
+  // SWRR restricted to prefill-role backends (the relay's export leg).
+  // ``exclude`` holds backends already tried this relay (retry budget)
+  // — shared_ptrs, so a backend removed by a mid-relay /router/config
+  // commit stays alive (and comparable) instead of dangling.
+  BackendPtr pick_prefill(const std::vector<BackendPtr>& exclude) {
+    BackendPtr best;
+    int total = 0;
+    for (auto& b : backends) {
+      if (b->weight <= 0 || b->role != "prefill") continue;
+      bool skip = false;
+      for (const BackendPtr& e : exclude)
+        if (e == b) skip = true;
+      if (skip) continue;
       b->swrr_current += b->weight;
       total += b->weight;
       if (!best || b->swrr_current > best->swrr_current) best = b;
@@ -178,6 +212,114 @@ struct RouterState {
 };
 
 RouterState g_state;
+
+// ---------------------------------------------------------------------------
+// Prefix affinity: consistent-hash ring over decode-role backends
+//
+// The router hashes the first --affinity-tokens prompt_ids of a
+// /generate request and maps the hash onto a ring of virtual nodes, so
+// a repeated template prefix lands on the decode replica that already
+// holds its KV — cache hit rate survives scale-out instead of diluting
+// 1/N.  --affinity-tokens 0 (default) disables everything here:
+// routing, relays, and metrics stay byte-for-byte the old router.
+// ---------------------------------------------------------------------------
+
+int g_affinity_tokens = 0;   // leading prompt ids hashed (0 = disabled)
+int g_handoff_enabled = 1;   // --kv-handoff 0 disables the relay leg
+int g_handoff_retries = 1;   // prefill replicas tried per cold prompt
+constexpr size_t kMaxKnownPrefixes = 4096;  // per decode backend
+
+uint64_t g_affinity_hits = 0;
+uint64_t g_affinity_misses = 0;
+uint64_t g_kv_handoff_bytes = 0;
+uint64_t g_kv_handoff_failures = 0;
+Histogram g_kv_handoff_seconds;
+
+constexpr int kRingVnodes = 32;  // virtual nodes per decode backend
+std::vector<std::pair<uint64_t, Backend*>> g_ring;  // sorted by hash
+
+uint64_t fnv1a(const void* data, size_t n, uint64_t h = 1469598103934665603ull) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Rebuilt on every config commit (the only place backends are added or
+// removed, so the raw pointers can never dangle).
+void rebuild_ring() {
+  g_ring.clear();
+  for (auto& b : g_state.backends) {
+    if (b->role != "decode") continue;
+    for (int i = 0; i < kRingVnodes; i++) {
+      std::string vnode = b->name + "#" + std::to_string(i);
+      g_ring.push_back({fnv1a(vnode.data(), vnode.size()), b.get()});
+    }
+  }
+  std::sort(g_ring.begin(), g_ring.end());
+}
+
+// First clockwise ring entry with positive weight (consistent hashing:
+// adding/removing one replica remaps only its arc, so most repeat
+// prefixes keep landing where their KV lives).
+BackendPtr pick_decode(uint64_t h) {
+  if (g_ring.empty()) return nullptr;
+  auto it = std::lower_bound(
+      g_ring.begin(), g_ring.end(), std::make_pair(h, (Backend*)nullptr));
+  for (size_t i = 0; i < g_ring.size(); i++) {
+    if (it == g_ring.end()) it = g_ring.begin();
+    Backend* b = it->second;
+    if (b->weight > 0) return g_state.find(b->name);
+    ++it;
+  }
+  return nullptr;
+}
+
+// Extract up to g_affinity_tokens leading integers of the request's
+// "prompt_ids" (first sequence when nested) and FNV-1a them.  Returns
+// false when the body carries no parseable prompt — the request then
+// routes through the plain SWRR pick.
+bool affinity_hash(const std::string& body, uint64_t* out) {
+  size_t pos = body.find("\"prompt_ids\"");
+  if (pos == std::string::npos) return false;
+  pos = body.find(':', pos);
+  if (pos == std::string::npos) return false;
+  pos = body.find('[', pos);
+  if (pos == std::string::npos) return false;
+  pos++;
+  // Nested form [[...]]: step into the first row.
+  while (pos < body.size() &&
+         (body[pos] == ' ' || body[pos] == '\n' || body[pos] == '\t'))
+    pos++;
+  if (pos < body.size() && body[pos] == '[') pos++;
+  uint64_t h = 1469598103934665603ull;
+  int count = 0;
+  while (pos < body.size() && count < g_affinity_tokens) {
+    while (pos < body.size() &&
+           (body[pos] == ',' || body[pos] == ' ' || body[pos] == '\n' ||
+            body[pos] == '\t'))
+      pos++;
+    if (pos >= body.size() || body[pos] == ']') break;
+    char* end = nullptr;
+    long v = strtol(body.c_str() + pos, &end, 10);
+    if (end == body.c_str() + pos) return false;  // not an integer
+    uint64_t le = (uint64_t)v;
+    h = fnv1a(&le, sizeof(le), h);
+    count++;
+    pos = size_t(end - body.c_str());
+  }
+  if (count == 0) return false;
+  *out = h;
+  return true;
+}
+
+void remember_prefix(const BackendPtr& b, uint64_t h) {
+  if (b->known_prefixes.size() >= kMaxKnownPrefixes)
+    b->known_prefixes.clear();  // crude bound; repeats re-learn fast
+  b->known_prefixes.insert(h);
+}
 
 // ---------------------------------------------------------------------------
 // Minimal JSON: parse flat {"name": int} maps and the config document
@@ -278,6 +420,7 @@ bool parse_weights(const std::string& body, std::map<std::string, int>* out) {
 struct BackendSpec {
   std::string name, host;
   int port = 0, weight = 0;
+  std::string role;  // "" = keep survivor's role (or "unified")
 };
 
 bool parse_config(const std::string& body, std::string* ns, std::string* dep,
@@ -301,6 +444,7 @@ bool parse_config(const std::string& body, std::string* ns, std::string* dep,
           else if (k2 == "host") s.host = j.parse_string();
           else if (k2 == "port") s.port = int(j.parse_number());
           else if (k2 == "weight") s.weight = int(j.parse_number());
+          else if (k2 == "role") s.role = j.parse_string();
           else j.skip_value();
           if (j.peek(',')) j.consume(',');
         }
@@ -469,6 +613,19 @@ struct UpstreamConn {
   bool reused = false;  // taken from the keep-alive pool (stale-retry eligible)
 };
 
+// KV-handoff relay stages (prefix-affinity miss on a cold prompt):
+//   Export  — POST the original body to a prefill backend's
+//             /admin/kv/export; the response body is the KV blob.
+//   Import  — POST the blob to the chosen decode backend's
+//             /admin/kv/import.
+//   Forward — the original request to the decode backend, carrying the
+//             x-tpumlops-handoff header; response handling is the
+//             normal proxy path.
+// Any sub-request failure falls back to unified serving: the original
+// request forwards to the decode backend WITHOUT a handoff (it holds
+// the full model, so nothing is lost — just slower).
+enum class RelayStage { None, Export, Import, Forward };
+
 struct ClientConn {
   int fd = -1;
   HttpMsg req;
@@ -483,6 +640,18 @@ struct ClientConn {
   bool feedback = false;  // current request is /api/v1.0/feedback
   bool parked = false;    // held in the scale-to-zero park buffer
   double park_t = 0;      // when parking began (monotonic)
+  // KV-handoff relay state (RelayStage::None outside a relay).
+  RelayStage relay_stage = RelayStage::None;
+  BackendPtr relay_decode;   // ring-chosen decode target
+  uint64_t relay_hash = 0;   // affinity hash of the prompt prefix
+  double relay_t0 = 0;       // handoff start (monotonic)
+  int relay_attempts = 0;    // export legs attempted
+  std::vector<BackendPtr> relay_tried;  // prefill backends already tried
+  std::string relay_out;     // the synthesized sub-request bytes
+  size_t relay_blob_bytes = 0;  // exported KV blob size (metrics only —
+                                // the blob itself lives in relay_out;
+                                // a second copy would hold multi-MB
+                                // handoffs 3x per in-flight relay)
 };
 
 // ---------------------------------------------------------------------------
@@ -703,6 +872,29 @@ std::string metrics_text() {
   out += "# TYPE tpumlops_router_park_wait_seconds histogram\n";
   emit_histogram(&out, "tpumlops_router_park_wait_seconds", plabels,
                  g_park_wait_seconds);
+  // Disaggregated-fleet routing: affinity ring outcomes and the KV
+  // handoff relay.  Deployment-scoped like the park series — the
+  // decision happens before any predictor is picked.
+  out += "# TYPE tpumlops_router_affinity_hits counter\n";
+  snprintf(line, sizeof(line), "tpumlops_router_affinity_hits{%s} %llu\n",
+           plabels, (unsigned long long)g_affinity_hits);
+  out += line;
+  out += "# TYPE tpumlops_router_affinity_misses counter\n";
+  snprintf(line, sizeof(line), "tpumlops_router_affinity_misses{%s} %llu\n",
+           plabels, (unsigned long long)g_affinity_misses);
+  out += line;
+  out += "# TYPE tpumlops_router_kv_handoff_bytes counter\n";
+  snprintf(line, sizeof(line), "tpumlops_router_kv_handoff_bytes{%s} %llu\n",
+           plabels, (unsigned long long)g_kv_handoff_bytes);
+  out += line;
+  out += "# TYPE tpumlops_router_kv_handoff_failures counter\n";
+  snprintf(line, sizeof(line),
+           "tpumlops_router_kv_handoff_failures{%s} %llu\n", plabels,
+           (unsigned long long)g_kv_handoff_failures);
+  out += line;
+  out += "# TYPE tpumlops_router_kv_handoff_seconds histogram\n";
+  emit_histogram(&out, "tpumlops_router_kv_handoff_seconds", plabels,
+                 g_kv_handoff_seconds);
   return out;
 }
 
@@ -715,8 +907,10 @@ std::string config_json() {
     first = false;
     char item[512];
     snprintf(item, sizeof(item),
-             "{\"name\":\"%s\",\"host\":\"%s\",\"port\":%d,\"weight\":%d}",
-             b->name.c_str(), b->host.c_str(), b->port, b->weight);
+             "{\"name\":\"%s\",\"host\":\"%s\",\"port\":%d,\"weight\":%d,"
+             "\"role\":\"%s\"}",
+             b->name.c_str(), b->host.c_str(), b->port, b->weight,
+             b->role.c_str());
     out += item;
   }
   out += "]}";
@@ -738,9 +932,10 @@ void drain_pool(Backend* b) {
   b->idle_conns.clear();
 }
 
-// Returns the name of the first unresolvable backend, or "" on success.
-// Two-phase: resolve/validate EVERY spec first, then commit — a rejected
-// update must leave the running config fully intact (the operator treats a
+// Returns a one-line error message naming the first invalid backend
+// (unresolvable host / unknown role), or "" on success.  Two-phase:
+// resolve/validate EVERY spec first, then commit — a rejected update
+// must leave the running config fully intact (the operator treats a
 // 400 as "nothing changed"; a half-applied weight table would silently
 // shift live traffic).
 std::string apply_config(const std::string& ns, const std::string& dep,
@@ -766,12 +961,21 @@ std::string apply_config(const std::string& ns, const std::string& dep,
     st.addr_changed = !st.survivor || probe.host != st.survivor->host ||
                       probe.port != st.survivor->port;
     if (st.addr_changed) {
-      if (!resolve_backend(&probe)) return s.name;
+      if (!resolve_backend(&probe))
+        return "unresolvable backend host: " + s.name;
       st.addr = probe.addr;
     } else {
       st.addr = st.survivor->addr;
     }
     staged.push_back(std::move(st));
+  }
+
+  // Validate roles before commit (same atomicity contract as addresses).
+  for (const auto& st : staged) {
+    const std::string& r = st.spec.role;
+    if (!r.empty() && r != "unified" && r != "prefill" && r != "decode")
+      return "invalid role '" + r + "' for backend '" + st.spec.name +
+             "' (use unified, prefill, or decode)";
   }
 
   // Commit. Preserve histograms of surviving backends (promotion changes
@@ -786,9 +990,13 @@ std::string apply_config(const std::string& ns, const std::string& dep,
         st.survivor->addr = st.addr;
         st.survivor->addr_epoch++;  // in-flight conns to the old address
                                     // must not re-enter the pool
+        // A repointed backend is a different pod: nothing we handed the
+        // old one is known to the new one.
+        st.survivor->known_prefixes.clear();
         repointed.push_back(st.survivor.get());
       }
       st.survivor->weight = st.spec.weight;
+      if (!st.spec.role.empty()) st.survivor->role = st.spec.role;
       next.push_back(st.survivor);
     } else {
       auto b = std::make_shared<Backend>();
@@ -796,6 +1004,7 @@ std::string apply_config(const std::string& ns, const std::string& dep,
       b->host = st.spec.host;
       b->port = st.spec.port;
       b->weight = st.spec.weight;
+      if (!st.spec.role.empty()) b->role = st.spec.role;
       b->addr = st.addr;
       next.push_back(std::move(b));
     }
@@ -815,6 +1024,7 @@ std::string apply_config(const std::string& ns, const std::string& dep,
   }
   g_state.backends = std::move(next);
   for (auto& b : removed) drain_pool(b.get());
+  rebuild_ring();  // membership/roles may have changed
   return "";
 }
 
@@ -846,6 +1056,36 @@ void handle_admin(ClientConn* c) {
              (unsigned long long)g_park_overflow_total,
              (unsigned long long)g_park_timeout_total);
     client_send(c, http_response(200, "OK", "application/json", body));
+  } else if (path == "/router/fleet") {
+    // Disaggregated-fleet introspection: ring size, affinity and
+    // handoff tallies, per-backend role + known-prefix counts.
+    std::string out = "{";
+    char buf[256];
+    snprintf(buf, sizeof(buf),
+             "\"affinity_tokens\":%d,\"ring_vnodes\":%zu,"
+             "\"affinity_hits\":%llu,\"affinity_misses\":%llu,"
+             "\"kv_handoffs\":%llu,\"kv_handoff_bytes\":%llu,"
+             "\"kv_handoff_failures\":%llu,\"backends\":[",
+             g_affinity_tokens, g_ring.size(),
+             (unsigned long long)g_affinity_hits,
+             (unsigned long long)g_affinity_misses,
+             (unsigned long long)g_kv_handoff_seconds.count,
+             (unsigned long long)g_kv_handoff_bytes,
+             (unsigned long long)g_kv_handoff_failures);
+    out += buf;
+    bool first = true;
+    for (auto& b : g_state.backends) {
+      if (!first) out += ",";
+      first = false;
+      snprintf(buf, sizeof(buf),
+               "{\"name\":\"%s\",\"role\":\"%s\",\"weight\":%d,"
+               "\"known_prefixes\":%zu}",
+               b->name.c_str(), b->role.c_str(), b->weight,
+               b->known_prefixes.size());
+      out += buf;
+    }
+    out += "]}";
+    client_send(c, http_response(200, "OK", "application/json", out));
   } else if (path == "/router/latencies") {
     // Read-and-clear: exact router-internal per-request latencies (us)
     // since the previous drain.
@@ -874,7 +1114,7 @@ void handle_admin(ClientConn* c) {
         release_parked();
       } else {
         client_send(c, http_response(400, "Bad Request", "text/plain",
-                                     "unresolvable backend host: " + bad + "\n"));
+                                     bad + "\n"));
       }
     } else {
       client_send(c, http_response(400, "Bad Request", "text/plain",
@@ -937,8 +1177,24 @@ void finish_request(const BackendPtr& b, int code, double seconds,
 }
 
 void advance_client(ClientConn* c);  // defined below
+void relay_sub_failed(ClientConn* c);  // defined with the relay logic
 
 void fail_502(ClientConn* c, const char* why) {
+  if (c->relay_stage == RelayStage::Export ||
+      c->relay_stage == RelayStage::Import) {
+    // A relay SUB-request failed (prefill replica died mid-handoff,
+    // import refused): the client request is untouched in c->req —
+    // retry the relay or fall back to unified serving, never 502 the
+    // client over an internal leg.
+    if (c->upstream) {
+      c->upstream->client = nullptr;
+      close_upstream(c->upstream);
+      c->upstream = nullptr;
+    }
+    relay_sub_failed(c);
+    return;
+  }
+  c->relay_stage = RelayStage::None;  // Forward leg fails like any proxy
   if (c->backend)
     finish_request(c->backend, 502, now_s() - c->t_start, c->feedback);
   client_send(c, http_response(502, "Bad Gateway", "text/plain",
@@ -980,22 +1236,49 @@ std::string dechunk(const std::string& framed) {
 // framing headers are dropped: forwarding a request that carries BOTH
 // Transfer-Encoding and Content-Length verbatim invites request-smuggling
 // desync on the pooled backend connection if the backend frames by the
-// other header than we did.
-std::string build_upstream_request(const HttpMsg& req) {
+// other header than we did.  ``extra_headers`` rides complete "k: v\r\n"
+// lines (the relay's x-tpumlops-handoff stamp).
+std::string build_upstream_request(const HttpMsg& req,
+                                   const std::string& extra_headers = "") {
   std::string body = req.buf.substr(req.body_start);
   if (req.chunked) body = dechunk(body);
   std::string out = req.method + " " + req.path + " HTTP/1.1\r\n";
   for (auto& [k, v] : req.headers) {
     if (k == "connection" || k == "keep-alive" || k == "proxy-connection" ||
         k == "te" || k == "upgrade" || k == "trailer" ||
-        k == "content-length" || k == "transfer-encoding")
-      continue;
+        k == "content-length" || k == "transfer-encoding" ||
+        k == "x-tpumlops-handoff")  // router-asserted only: a client
+      continue;                     // must not forge relay stamps
     out += k + ": " + v + "\r\n";
   }
+  out += extra_headers;
   out += "content-length: " + std::to_string(body.size()) + "\r\n";
   out += "connection: keep-alive\r\n\r\n";
   out += body;
   return out;
+}
+
+// A synthesized relay sub-request (export/import legs).
+std::string relay_request(const std::string& path,
+                          const std::string& content_type,
+                          const std::string& body) {
+  std::string out = "POST " + path + " HTTP/1.1\r\n";
+  out += "host: tpumlops-router\r\n";
+  out += "content-type: " + content_type + "\r\n";
+  out += "content-length: " + std::to_string(body.size()) + "\r\n";
+  out += "connection: keep-alive\r\n\r\n";
+  out += body;
+  return out;
+}
+
+// A complete upstream response's body bytes (chunked frames decoded).
+std::string response_body(const HttpMsg& resp, bool eof) {
+  ssize_t end = resp.message_end(/*is_request=*/false, eof);
+  if (end < 0) return "";
+  std::string framed = resp.buf.substr(
+      resp.body_start, size_t(end) - resp.body_start);
+  if (resp.chunked) return dechunk(framed);
+  return framed;
 }
 
 // Attach the client's buffered request to a backend connection (pooled or
@@ -1038,13 +1321,196 @@ void connect_upstream(ClientConn* c, bool allow_pool) {
   }
   u->client = c;
   u->resp.reset();
-  u->resp.request_method = c->req.method;  // HEAD responses carry no body
-  u->out = build_upstream_request(c->req);
+  // Relay sub-requests (and the handoff-stamped forward) carry
+  // pre-built bytes; everything else re-frames the client request.
+  if (c->relay_stage != RelayStage::None) {
+    u->resp.request_method = "POST";
+    u->out = c->relay_out;
+  } else {
+    u->resp.request_method = c->req.method;  // HEAD: no response body
+    u->out = build_upstream_request(c->req);
+  }
   u->out_off = 0;
   c->upstream = u;
 }
 
+// ---------------------------------------------------------------------------
+// KV-handoff relay (prefix-affinity miss on a cold prompt)
+// ---------------------------------------------------------------------------
+
+void relay_clear(ClientConn* c) {
+  c->relay_stage = RelayStage::None;
+  c->relay_decode = nullptr;
+  c->relay_out.clear();
+  c->relay_blob_bytes = 0;
+  c->relay_tried.clear();
+}
+
+// The client's (dechunked) request body — the export leg forwards it
+// verbatim so the prefill replica sees the exact prompt_ids.
+std::string client_body(const ClientConn* c) {
+  std::string body = c->req.buf.substr(c->req.body_start);
+  if (c->req.chunked) body = dechunk(body);
+  return body;
+}
+
+void start_relay_export(ClientConn* c, const BackendPtr& prefill) {
+  c->relay_stage = RelayStage::Export;
+  c->relay_attempts++;
+  c->relay_tried.push_back(prefill);
+  c->relay_out = relay_request(
+      "/admin/kv/export", "application/json", client_body(c));
+  c->backend = prefill;
+  c->retries = 0;
+  connect_upstream(c, /*allow_pool=*/true);
+}
+
+// Forward the ORIGINAL request to the decode (or any) backend without a
+// handoff — unified serving, the typed fallback for every relay
+// failure.  The request is never lost: every replica holds the full
+// model, a failed handoff only costs the local prefill.
+void relay_fallback(ClientConn* c, const char* why,
+                    bool count_failure = true) {
+  (void)why;
+  if (count_failure) g_kv_handoff_failures++;
+  BackendPtr target = c->relay_decode ? c->relay_decode : g_state.pick();
+  if (target && target->weight > 0) {
+    // The unified fallback prefills LOCALLY on the ring target, which
+    // warms its radix cache — record that so the next repeat of this
+    // prefix routes straight there as a hit instead of re-relaying.
+    remember_prefix(target, c->relay_hash);
+  }
+  relay_clear(c);
+  if (!target || target->weight <= 0) target = g_state.pick();
+  if (!target) {
+    // Past the retry budget with NOTHING able to serve: typed 503.
+    client_send(c, http_response(
+        503, "Service Unavailable", "application/json",
+        "{\"error\":\"kv handoff failed and no decode backend has "
+        "positive weight\",\"reason\":\"no_decode_backend\","
+        "\"retry_after_s\":1}",
+        "Retry-After: 1\r\n"));
+    c->req.reset();
+    if (!c->pending.empty()) {
+      c->req.buf = std::move(c->pending);
+      c->pending.clear();
+      advance_client(c);
+    }
+    return;
+  }
+  c->backend = target;
+  c->retries = 0;
+  connect_upstream(c, /*allow_pool=*/true);
+}
+
+// An Export/Import sub-request failed at the transport level (or the
+// peer answered non-200): retry the export on an untried prefill
+// replica while the budget lasts, else fall back to unified serving.
+void relay_sub_failed(ClientConn* c) {
+  if (c->relay_stage == RelayStage::Export &&
+      c->relay_attempts <= g_handoff_retries) {
+    BackendPtr next = g_state.pick_prefill(c->relay_tried);
+    if (next) {
+      start_relay_export(c, next);
+      return;
+    }
+  }
+  relay_fallback(c, "sub-request failed");
+}
+
+// A relay sub-request's response arrived complete.
+void relay_on_response(ClientConn* c, int status, std::string body) {
+  if (c->relay_stage == RelayStage::Export) {
+    if (status >= 400 && status < 500) {
+      // A 4xx export is DETERMINISTIC: the prompt itself is handoff-
+      // ineligible (shorter than one radix chunk, multi-sequence body),
+      // so every prefill replica would answer the same — retrying adds
+      // round trips to TTFT for nothing, and counting a "failure" for a
+      // request that was never handoff-eligible poisons the metric.
+      // Fall straight back to unified serving; the fallback remembers
+      // the prefix, so this prompt shape relays at most once.
+      relay_fallback(c, "export ineligible", /*count_failure=*/false);
+      return;
+    }
+    if (status != 200 || body.empty()) {
+      relay_sub_failed(c);
+      return;
+    }
+    c->relay_blob_bytes = body.size();
+    c->relay_stage = RelayStage::Import;
+    c->relay_out = relay_request(
+        "/admin/kv/import", "application/octet-stream", body);
+    c->backend = c->relay_decode;
+    c->retries = 0;
+    connect_upstream(c, /*allow_pool=*/true);
+    return;
+  }
+  // Import leg.
+  if (status != 200) {
+    relay_fallback(c, "import refused");
+    return;
+  }
+  double handoff_s = now_s() - c->relay_t0;
+  g_kv_handoff_seconds.observe(handoff_s);
+  g_kv_handoff_bytes += c->relay_blob_bytes;
+  remember_prefix(c->relay_decode, c->relay_hash);
+  // Final leg: the original request, stamped so the server's request
+  // trace carries the router-measured handoff wall.
+  char hdr[64];
+  snprintf(hdr, sizeof(hdr), "x-tpumlops-handoff: %.3f\r\n",
+           handoff_s * 1000.0);
+  c->relay_stage = RelayStage::Forward;
+  c->relay_out = build_upstream_request(c->req, hdr);
+  c->backend = c->relay_decode;
+  c->retries = 0;
+  connect_upstream(c, /*allow_pool=*/true);
+}
+
+// Prefix-affinity routing for a /generate POST.  Returns true when the
+// request was taken over (affinity forward or relay started); false =
+// fall through to the plain SWRR pick.
+bool try_affinity_route(ClientConn* c) {
+  if (g_affinity_tokens <= 0 || c->req.method != "POST") return false;
+  const std::string& p = c->req.path;
+  const std::string tail = "/generate";
+  if (p.size() < tail.size() ||
+      p.compare(p.size() - tail.size(), tail.size(), tail) != 0)
+    return false;
+  uint64_t h = 0;
+  if (!affinity_hash(client_body(c), &h)) return false;
+  BackendPtr d = pick_decode(h);
+  if (!d) return false;  // no live decode pool: plain routing
+  c->relay_hash = h;
+  if (d->known_prefixes.count(h)) {
+    g_affinity_hits++;
+    c->backend = d;
+    c->retries = 0;
+    connect_upstream(c, /*allow_pool=*/true);
+    return true;
+  }
+  g_affinity_misses++;
+  if (g_handoff_enabled) {
+    BackendPtr prefill = g_state.pick_prefill({});
+    if (prefill) {
+      c->relay_decode = d;
+      c->relay_t0 = now_s();
+      c->relay_attempts = 0;
+      c->relay_tried.clear();
+      start_relay_export(c, prefill);
+      return true;
+    }
+  }
+  // No prefill pool (or handoff off): serve on the ring target anyway —
+  // its local prefill warms its cache, so the NEXT repeat is a hit.
+  remember_prefix(d, h);
+  c->backend = d;
+  c->retries = 0;
+  connect_upstream(c, /*allow_pool=*/true);
+  return true;
+}
+
 void start_proxy(ClientConn* c) {
+  if (try_affinity_route(c)) return;
   BackendPtr b = g_state.pick();
   if (!b) {
     if (g_park_max > 0) {
@@ -1154,7 +1620,8 @@ void dispatch_request(ClientConn* c) {
 // response completes, so nothing is dropped and bodies forwarded upstream
 // are framed exactly (no smuggling of the next request's bytes).
 void advance_client(ClientConn* c) {
-  while (!c->upstream && !c->closing && !c->parked) {
+  while (!c->upstream && !c->closing && !c->parked &&
+         c->relay_stage == RelayStage::None) {
     if (!c->req.headers_complete()) {
       if (!c->req.try_parse_headers(/*is_request=*/true)) {
         client_send(c, http_response(400, "Bad Request", "text/plain",
@@ -1184,7 +1651,10 @@ void on_client_readable(ClientConn* c) {
   char tmp[65536];
   // Parked counts as in flight: the buffered request must stay intact
   // for the release re-dispatch, so later pipelined bytes go to pending.
-  bool in_flight = c->upstream != nullptr || c->parked;
+  // A relay in any stage likewise: c->req is the original request the
+  // final Forward leg still needs.
+  bool in_flight = c->upstream != nullptr || c->parked ||
+                   c->relay_stage != RelayStage::None;
   while (true) {
     ssize_t n = read(c->fd, tmp, sizeof(tmp));
     if (n > 0) {
@@ -1235,6 +1705,42 @@ void on_client_writable(ClientConn* c) {
     return;
   }
   epoll_set(c->fd, EPOLLIN);
+}
+
+// Detach-time connection disposal, shared by the normal proxy path and
+// the relay legs (one copy of the reuse rules, so they can never
+// diverge): return the upstream to its backend's keep-alive pool
+// unless the response/backend semantics force a close.  Caller must
+// have detached u from its client already; u->resp is consumed.
+// Returns true when the response was close-delimited (the CLIENT can
+// then only find the body's end by connection close).
+bool pool_or_close_upstream(UpstreamConn* u, bool eof) {
+  // A close-delimited response (no Content-Length, not chunked, not a
+  // no-body status) completed only because eof arrived.
+  bool close_delimited =
+      u->resp.message_end(/*is_request=*/false, /*eof=*/false) < 0;
+  // HTTP/1.0 defaults to close (http.server-style backends); HTTP/1.1
+  // to keep-alive; an explicit Connection header overrides either.  A
+  // conn whose backend was repointed since connect must not re-enter
+  // the pool — it still talks to the OLD address/version.
+  auto conn_hdr = u->resp.headers.find("connection");
+  bool http10 = u->resp.version == "HTTP/1.0";
+  bool backend_close = eof || close_delimited;
+  if (conn_hdr != u->resp.headers.end()) {
+    std::string cv = lower(conn_hdr->second);
+    backend_close |= cv.find("close") != std::string::npos;
+    if (cv.find("keep-alive") != std::string::npos) http10 = false;
+  }
+  backend_close |= http10;
+  backend_close |= u->addr_epoch != u->backend->addr_epoch;
+  if (backend_close) {
+    close_upstream(u);
+  } else {
+    u->resp.reset();
+    u->backend->idle_conns.push_back(u->fd);
+    epoll_set(u->fd, EPOLLIN);  // observe idle-close
+  }
+  return close_delimited;
 }
 
 void on_upstream_event(UpstreamConn* u, uint32_t events) {
@@ -1312,44 +1818,32 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
     }
     if (!u->resp.headers_complete()) u->resp.try_parse_headers(/*is_request=*/false);
     if (u->resp.headers_complete() && u->resp.complete(/*is_request=*/false, eof)) {
+      if (c->relay_stage == RelayStage::Export ||
+          c->relay_stage == RelayStage::Import) {
+        // Internal relay leg: the response never reaches the client and
+        // never lands in the gate histograms (these are admin calls,
+        // not predictions).  Detach + pool the connection exactly like
+        // the normal path, then advance the relay state machine.
+        int status = u->resp.status;
+        std::string body = response_body(u->resp, eof);
+        c->upstream = nullptr;
+        u->client = nullptr;
+        pool_or_close_upstream(u, eof);
+        relay_on_response(c, status, std::move(body));
+        return;
+      }
+      c->relay_stage = RelayStage::None;  // Forward leg completed
       double dt = now_s() - c->t_start;
       finish_request(u->backend, u->resp.status, dt, c->feedback);
-      // A close-delimited response (no Content-Length, not chunked, not a
-      // no-body status) is forwarded verbatim — the CLIENT can then only
-      // find the body's end by connection close, so close our side too.
-      // (completion that required eof == close-delimited; 204/304/HEAD
-      // complete without it)
-      bool close_delimited =
-          u->resp.message_end(/*is_request=*/false, /*eof=*/false) < 0;
       client_send(c, u->resp.buf);
-      if (close_delimited) c->closing = true;
       c->req.reset();
       c->upstream = nullptr;
       u->client = nullptr;
-      // Return to pool if backend keeps the connection open.  HTTP/1.0
-      // defaults to close (http.server-style backends); HTTP/1.1 to
-      // keep-alive; an explicit Connection header overrides either.  A
-      // conn whose backend was repointed since connect must not re-enter
-      // the pool — it still talks to the OLD address/version.
-      // Pool BEFORE advancing the client so a pipelined next request can
-      // reuse this very connection.
-      auto conn_hdr = u->resp.headers.find("connection");
-      bool http10 = u->resp.version == "HTTP/1.0";
-      bool backend_close = eof;
-      if (conn_hdr != u->resp.headers.end()) {
-        std::string cv = lower(conn_hdr->second);
-        backend_close |= cv.find("close") != std::string::npos;
-        if (cv.find("keep-alive") != std::string::npos) http10 = false;
-      }
-      backend_close |= http10;
-      backend_close |= u->addr_epoch != u->backend->addr_epoch;
-      if (backend_close) {
-        close_upstream(u);
-      } else {
-        u->resp.reset();
-        u->backend->idle_conns.push_back(u->fd);
-        epoll_set(u->fd, EPOLLIN);  // observe idle-close
-      }
+      // Pool BEFORE advancing the client so a pipelined next request
+      // can reuse this very connection.  A close-delimited response is
+      // forwarded verbatim — the CLIENT can then only find the body's
+      // end by connection close, so close our side too.
+      if (pool_or_close_upstream(u, eof)) c->closing = true;
       // A pipelined next request may be waiting; dispatch it now.
       if (!c->pending.empty()) {
         c->req.buf = std::move(c->pending);
@@ -1374,8 +1868,9 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
 
 void usage() {
   die("usage: tpumlops-router --port N [--namespace ns] [--deployment name]\n"
-      "       [--backend name=host:port:weight]...\n"
-      "       [--park-buffer N] [--park-timeout-s S]");
+      "       [--backend name=host:port:weight[:role]]...\n"
+      "       [--park-buffer N] [--park-timeout-s S]\n"
+      "       [--affinity-tokens N] [--kv-handoff 0|1] [--handoff-retries N]");
 }
 
 }  // namespace
@@ -1394,8 +1889,11 @@ int main(int argc, char** argv) {
     else if (a == "--deployment") g_state.deployment = next();
     else if (a == "--park-buffer") g_park_max = atoi(next().c_str());
     else if (a == "--park-timeout-s") g_park_timeout_s = atof(next().c_str());
+    else if (a == "--affinity-tokens") g_affinity_tokens = atoi(next().c_str());
+    else if (a == "--kv-handoff") g_handoff_enabled = atoi(next().c_str());
+    else if (a == "--handoff-retries") g_handoff_retries = atoi(next().c_str());
     else if (a == "--backend") {
-      // name=host:port:weight
+      // name=host:port:weight[:role]
       std::string v = next();
       BackendSpec s;
       size_t eq = v.find('=');
@@ -1404,16 +1902,22 @@ int main(int argc, char** argv) {
       if (eq == std::string::npos || c1 == std::string::npos ||
           c2 == std::string::npos)
         usage();
+      size_t c3 = v.find(':', c2 + 1);
       s.name = v.substr(0, eq);
       s.host = v.substr(eq + 1, c1 - eq - 1);
       s.port = atoi(v.substr(c1 + 1, c2 - c1 - 1).c_str());
-      s.weight = atoi(v.substr(c2 + 1).c_str());
+      if (c3 == std::string::npos) {
+        s.weight = atoi(v.substr(c2 + 1).c_str());
+      } else {
+        s.weight = atoi(v.substr(c2 + 1, c3 - c2 - 1).c_str());
+        s.role = v.substr(c3 + 1);
+      }
       specs.push_back(s);
     } else usage();
   }
   if (!port) usage();
   std::string bad = apply_config("", "", specs);
-  if (!bad.empty()) die("unresolvable backend host for '%s'", bad.c_str());
+  if (!bad.empty()) die("%s", bad.c_str());
 
   signal(SIGPIPE, SIG_IGN);
 

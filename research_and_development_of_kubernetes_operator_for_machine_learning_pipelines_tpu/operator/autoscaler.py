@@ -67,6 +67,10 @@ class ScaleRecord:
     reason: str = ""
     hold: str | None = None
     version: str | None = None  # predictor version observed
+    # Disaggregated pool this record sizes ("prefill"/"decode"); None =
+    # the whole-predictor count (and the key is OMITTED from as_dict, so
+    # pre-fleet journal records stay byte-for-byte).
+    pool: str | None = None
     observed: Mapping[str, Any] = field(default_factory=dict)
     targets: Mapping[str, Any] = field(default_factory=dict)
 
@@ -81,7 +85,7 @@ class ScaleRecord:
         return "up" if self.to_replicas > self.from_replicas else "down"
 
     def as_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "kind": "scale",
             "ts": self.wall,
             "time": _iso(self.wall),
@@ -95,6 +99,9 @@ class ScaleRecord:
             "observed": dict(self.observed),
             "targets": dict(self.targets),
         }
+        if self.pool is not None:
+            out["pool"] = self.pool
+        return out
 
 
 @dataclass(frozen=True)
@@ -337,3 +344,184 @@ def decide(
         )
 
     return ScaleDecision(replicas=current, state=state, record=None)
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode pools (spec.fleet) — each pool evaluated
+# on ITS OWN saturation signal through the same decide() hysteresis:
+#
+#   prefill — admission wait p95 (queued prompts stalling before their
+#             prefill begins is THE prefill-capacity signal; queue depth
+#             conflates it with decode backlog);
+#   decode  — the main autoscaling targets (queue depth / TTFT), which
+#             at a decode pool measure token-streaming capacity.
+#
+# decide() reads only a duck-typed subset of AutoscalingSpec, so each
+# pool gets a synthetic spec with its own band and targets — InferLine's
+# "right-size each stage independently", without duplicating the
+# cooldown/stabilization/blind-hold machinery.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _PoolSpec:
+    """The duck-typed subset of AutoscalingSpec that decide() reads."""
+
+    min_replicas: int
+    max_replicas: int
+    target_queue_depth_per_replica: float
+    target_ttft_seconds: float
+    scale_up_stabilization_s: float
+    scale_down_cooldown_s: float
+
+
+@dataclass(frozen=True)
+class FleetDecision:
+    """Per-pool counts + states + journal records for one evaluation."""
+
+    prefill: ScaleDecision
+    decode: ScaleDecision
+
+    def to_status(
+        self, prior: Mapping[str, Any] | None = None
+    ) -> dict[str, Any]:
+        out = dict(prior or {})
+        out["prefillReplicas"] = self.prefill.replicas
+        out["decodeReplicas"] = self.decode.replicas
+        out["prefillScaler"] = self.prefill.state.to_status()
+        out["decodeScaler"] = self.decode.state.to_status()
+        return out
+
+
+def fleet_counts(fleet_spec, status: Mapping[str, Any] | None) -> tuple[int, int]:
+    """Current (prefill, decode) pool counts: status.fleet when the
+    autoscaler has taken control, else the spec counts."""
+    status = status or {}
+    prefill = status.get("prefillReplicas")
+    decode = status.get("decodeReplicas")
+    return (
+        int(prefill) if prefill is not None else fleet_spec.prefill_replicas,
+        int(decode) if decode is not None else fleet_spec.decode_replicas,
+    )
+
+
+def decide_fleet(
+    auto,
+    fleet_spec,
+    status: Mapping[str, Any] | None,
+    observed_prefill,
+    observed_decode,
+    now_wall: float,
+) -> FleetDecision:
+    """One per-pool evaluation (pure; the reconciler applies it).
+
+    ``observed_prefill``/``observed_decode`` are per-pool
+    :class:`~..clients.base.EngineMetrics` (or None = blind, which
+    decide() holds on).  The prefill pool's admission-wait signal is
+    mapped onto decide()'s TTFT slot — same shape (a p95 latency above a
+    budget adds one replica), different series.
+    """
+    status = status or {}
+    cur_prefill, cur_decode = fleet_counts(fleet_spec, status)
+
+    wait_target_s = fleet_spec.prefill_target_admission_wait_ms / 1000.0
+    prefill_spec = _PoolSpec(
+        min_replicas=fleet_spec.prefill_min_replicas,
+        max_replicas=fleet_spec.prefill_max_replicas,
+        target_queue_depth_per_replica=0.0,
+        target_ttft_seconds=wait_target_s,
+        scale_up_stabilization_s=auto.scale_up_stabilization_s,
+        scale_down_cooldown_s=auto.scale_down_cooldown_s,
+    )
+    decode_backlog = (
+        observed_decode.queue_depth
+        if observed_decode is not None and observed_decode.queue_depth
+        else 0.0
+    )
+    if wait_target_s <= 0 or not auto.enabled:
+        # Pool fixed at its current count: no signal, no record.
+        dp = ScaleDecision(
+            replicas=cur_prefill,
+            state=ScalerState.from_status(status.get("prefillScaler")),
+        )
+    elif cur_prefill == 0 and decode_backlog > 0:
+        # Wake from zero: a prefill pool at zero exports NO admission-
+        # wait series, so its own signal can never wake it — the decode
+        # pool's backlog is the fleet's "users are waiting" evidence
+        # (cold prompts are falling back to unified prefill on decode
+        # chips right now).  Same no-stabilization contract as the
+        # predictor-level wake.
+        dp = ScaleDecision(
+            replicas=max(1, fleet_spec.prefill_min_replicas),
+            state=ScalerState(last_scale_wall=now_wall),
+            record=ScaleRecord(
+                wall=now_wall,
+                from_replicas=0,
+                to_replicas=max(1, fleet_spec.prefill_min_replicas),
+                desired=max(1, fleet_spec.prefill_min_replicas),
+                reason=(
+                    f"wake from zero: decode backlog {decode_backlog:g} "
+                    "(cold prompts falling back to unified prefill)"
+                ),
+                observed=(
+                    observed_decode.as_dict()
+                    if observed_decode is not None
+                    else {}
+                ),
+            ),
+        )
+    else:
+        wait = (
+            observed_prefill.admission_wait_p95_ms
+            if observed_prefill is not None
+            else None
+        )
+        from ..clients.base import EngineMetrics
+
+        # parked=0.0 rides along whenever the wait series answers:
+        # decide()'s last-step-to-zero guard demands park visibility,
+        # and for a POOL the wake signal is the decode backlog above —
+        # observable exactly when the wait series is (live pods).
+        mapped = EngineMetrics(
+            ttft_p95_s=(wait / 1000.0) if wait is not None else None,
+            parked=0.0 if wait is not None else None,
+        )
+        dp = decide(
+            prefill_spec,
+            cur_prefill,
+            ScalerState.from_status(status.get("prefillScaler")),
+            mapped,
+            now_wall,
+        )
+    decode_spec = _PoolSpec(
+        min_replicas=fleet_spec.decode_min_replicas,
+        max_replicas=fleet_spec.decode_max_replicas,
+        target_queue_depth_per_replica=auto.target_queue_depth_per_replica,
+        target_ttft_seconds=auto.target_ttft_seconds,
+        scale_up_stabilization_s=auto.scale_up_stabilization_s,
+        scale_down_cooldown_s=auto.scale_down_cooldown_s,
+    )
+    if not auto.enabled:
+        dd = ScaleDecision(
+            replicas=cur_decode,
+            state=ScalerState.from_status(status.get("decodeScaler")),
+        )
+    else:
+        dd = decide(
+            decode_spec,
+            cur_decode,
+            ScalerState.from_status(status.get("decodeScaler")),
+            observed_decode,
+            now_wall,
+        )
+
+    def tag(decision: ScaleDecision, pool: str) -> ScaleDecision:
+        if decision.record is None:
+            return decision
+        return ScaleDecision(
+            replicas=decision.replicas,
+            state=decision.state,
+            record=replace(decision.record, pool=pool),
+        )
+
+    return FleetDecision(prefill=tag(dp, "prefill"), decode=tag(dd, "decode"))

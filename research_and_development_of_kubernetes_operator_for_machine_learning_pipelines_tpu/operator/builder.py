@@ -169,6 +169,12 @@ def _tpu_pod_spec(
         container["args"] += [
             "--drain-grace-seconds", str(tpu.drain_grace_s),
         ]
+    if tpu.prefix_cache.l2_budget_mb > 0:
+        # Host-RAM second prefix-cache tier. Appended only when a budget
+        # is set — same byte-identity contract as the flags above.
+        container["args"] += [
+            "--prefix-cache-l2-budget-mb", str(tpu.prefix_cache.l2_budget_mb),
+        ]
     if tpu.observability.device_telemetry:
         # Appended only when enabled (same byte-identity contract as the
         # admission/drain flags): an unannotated CR's manifest must stay
@@ -477,6 +483,112 @@ def build_warm_pool_manifests(
     ]
 
 
+def fleet_pool_name(deployment_name: str, version: str, pool: str) -> str:
+    """Name of one disaggregated pool's Deployment/Service."""
+    return f"{deployment_name}-v{version}-{pool}"
+
+
+def build_fleet_pool_manifests(
+    name: str,
+    namespace: str,
+    owner_uid: str,
+    config: OperatorConfig,
+    version: str,
+    model_uri: str,
+    prefill_replicas: int | None = None,
+    decode_replicas: int | None = None,
+) -> list[dict[str, Any]]:
+    """Disaggregated prefill/decode pools for one predictor version.
+
+    Two Deployments (each pod a full server flagged with its
+    ``--fleet-role``) plus a routed Service per pool — the router's
+    backend table points at the Services, role-tagged, so the
+    prefix-affinity ring covers the decode pool and the KV-export relay
+    targets the prefill pool.  Replica counts default to ``spec.fleet``
+    and are overridden by the per-pool autoscaler (``status.fleet``).
+    Returns ``[]`` when disaggregation is off (byte-identity) or the
+    backend is not ``tpu``.
+    """
+    fleet = config.fleet
+    if not fleet.disaggregation or config.backend != "tpu":
+        return []
+    counts = {
+        "prefill": (
+            fleet.prefill_replicas
+            if prefill_replicas is None
+            else int(prefill_replicas)
+        ),
+        "decode": (
+            fleet.decode_replicas
+            if decode_replicas is None
+            else int(decode_replicas)
+        ),
+    }
+    owner = owner_reference(name, owner_uid)
+    out: list[dict[str, Any]] = []
+    for pool, replicas in counts.items():
+        unit = fleet_pool_name(name, version, pool)
+        labels = {
+            "app": unit,
+            "tpumlops/deployment": name,
+            "tpumlops/predictor": f"v{version}",
+            "tpumlops/fleet-role": pool,
+        }
+        pod_spec = _tpu_pod_spec(version, model_uri, config, name, namespace)
+        args = pod_spec["containers"][0]["args"]
+        # Pool replicas export their OWN metric identity
+        # (predictor_name "v<ver>-prefill"/"-decode"): the per-pool
+        # autoscaler reads each pool's saturation series separately,
+        # and pool pods must not pollute the unified predictor's
+        # summed signals.
+        args[args.index("--predictor-name") + 1] = f"v{version}-{pool}"
+        args += ["--fleet-role", pool]
+        out.append(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {
+                    "name": unit,
+                    "namespace": namespace,
+                    "labels": labels,
+                    "ownerReferences": owner,
+                },
+                "spec": {
+                    "replicas": replicas,
+                    "selector": {"matchLabels": {"app": unit}},
+                    "template": {
+                        "metadata": {"labels": labels},
+                        "spec": pod_spec,
+                    },
+                },
+            }
+        )
+        out.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {
+                    "name": unit,
+                    "namespace": namespace,
+                    "labels": labels,
+                    "ownerReferences": owner,
+                },
+                "spec": {
+                    "selector": {"app": unit},
+                    "ports": [
+                        {"name": "http", "port": 9000, "targetPort": 9000},
+                        {
+                            "name": "metrics",
+                            "port": 6000,
+                            "targetPort": 6000,
+                        },
+                    ],
+                },
+            }
+        )
+    return out
+
+
 def build_deployment(
     name: str,
     namespace: str,
@@ -537,6 +649,28 @@ def build_deployment(
         # `kubectl get sdep -o yaml` explains the replica count without
         # chasing the owning MlflowModel's status.
         annotations["tpumlops.dev/replicas"] = str(replicas)
+    if config.backend == "tpu" and config.fleet.disaggregation:
+        # Fleet routing contract (absent = byte-for-byte): whatever
+        # fronts this predictor (the native router in local/router
+        # mode, a mesh config elsewhere) reads the affinity/handoff
+        # knobs and the pool Service names from HERE — the manifest is
+        # the handoff point, exactly as traffic weights are.
+        fleet = config.fleet
+        annotations["tpumlops.dev/fleet-disaggregation"] = "true"
+        annotations["tpumlops.dev/fleet-prefill-service"] = fleet_pool_name(
+            name, current_version, "prefill"
+        )
+        annotations["tpumlops.dev/fleet-decode-service"] = fleet_pool_name(
+            name, current_version, "decode"
+        )
+        if fleet.prefix_affinity.enabled:
+            annotations["tpumlops.dev/fleet-affinity-tokens"] = str(
+                fleet.prefix_affinity.tokens
+            )
+        if fleet.kv_transfer.enabled:
+            annotations["tpumlops.dev/fleet-kv-retries"] = str(
+                fleet.kv_transfer.retries
+            )
 
     return {
         "apiVersion": SELDON_API_VERSION,

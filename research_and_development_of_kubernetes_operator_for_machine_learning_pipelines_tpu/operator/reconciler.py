@@ -255,6 +255,8 @@ class Reconciler:
         # Scale-to-zero park context: same explicit-null contract — a CR
         # waking from zero needs one patch clearing status.snapshot.
         self._had_snapshot_key = prior_status.get("snapshot") is not None
+        # Disaggregated-fleet pool counts: same explicit-null contract.
+        self._had_fleet_key = prior_status.get("fleet") is not None
         # Device-telemetry capacity summary: recomputed from spec each
         # step (no state round-trip needed); the explicit-null contract
         # mirrors the journal/scaler keys so disabling clears it once.
@@ -311,6 +313,7 @@ class Reconciler:
             self._ensure_deployment(obj, config, state)
             state = self._shed_disabled_journal(config, state)
             state = self._autoscale_step(obj, config, state, events)
+            state = self._fleet_step(obj, config, state, events)
             return ReconcileOutcome(state, config.monitoring_interval_s, events)
 
         # 3. New version detected (reference :97-149).
@@ -330,6 +333,7 @@ class Reconciler:
             self._ensure_deployment(obj, config, state)
             state = self._shed_disabled_journal(config, state)
             state = self._autoscale_step(obj, config, state, events)
+            state = self._fleet_step(obj, config, state, events)
         return ReconcileOutcome(state, config.monitoring_interval_s, events)
 
     def _sync_capacity_status(self, state: PromotionState) -> None:
@@ -513,6 +517,102 @@ class Reconciler:
                 hold_rec = record
         new_state = self._journal(config, new_state, hold_rec)
         if new_state != state:
+            self._patch_status(new_state)
+        return new_state
+
+    def _fleet_step(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        events: list[Event],
+    ) -> PromotionState:
+        """One per-pool fleet autoscaler evaluation (disaggregated CRs,
+        steady state only — frozen during canary like the whole-predictor
+        autoscaler).
+
+        The prefill pool sizes on its own admission-wait signal, the
+        decode pool on the main autoscaling targets; every APPLIED
+        change journals a pool-tagged ``ScaleRecord`` and re-applies the
+        pool Deployments through the worker-unit sync."""
+        from . import autoscaler as _scaling
+
+        fleet = config.fleet
+        if not fleet.disaggregation:
+            if state.fleet is not None:
+                # Disaggregation switched off: clear the status key and
+                # re-apply so the worker-unit sync GCs the pool
+                # Deployments/Services this CR no longer wants.
+                state = state.with_(fleet=None)
+                self._apply_for_state(obj, config, state)
+                self._patch_status(state)
+            return state
+        if not config.autoscaling.enabled or state.current_version is None:
+            if (
+                state.fleet is not None
+                and state.current_version is not None
+            ):
+                # Autoscaling switched off mid-flight: hand the pool
+                # counts back to spec.fleet and clear the status key —
+                # a stale status.fleet would silently pin the pools at
+                # the autoscaler's last counts through later spec edits.
+                state = state.with_(fleet=None)
+                self._apply_for_state(obj, config, state)
+                self._patch_status(state)
+            return state
+        source = self._metrics_source(config)
+        fetch = getattr(source, "engine_metrics", None)
+        obs_prefill = obs_decode = None
+        if fetch is not None:
+            try:
+                with self._op_timer("scale_read"):
+                    obs_prefill = fetch(
+                        self.name,
+                        f"v{state.current_version}-prefill",
+                        self.namespace,
+                        config.canary.metrics_window_s,
+                    )
+                    obs_decode = fetch(
+                        self.name,
+                        f"v{state.current_version}-decode",
+                        self.namespace,
+                        config.canary.metrics_window_s,
+                    )
+            except Exception as e:
+                # Blind = hold, same contract as the predictor scaler.
+                self.log.warning(f"fleet engine metrics read failed: {e}")
+        decision = _scaling.decide_fleet(
+            config.autoscaling, fleet, state.fleet,
+            obs_prefill, obs_decode, self._wall(),
+        )
+        cur_prefill, cur_decode = _scaling.fleet_counts(fleet, state.fleet)
+        changed = (
+            decision.prefill.replicas != cur_prefill
+            or decision.decode.replicas != cur_decode
+        )
+        new_state = state.with_(fleet=decision.to_status(state.fleet))
+        applied = [
+            dataclasses.replace(d.record, version=state.current_version)
+            for d in (decision.prefill, decision.decode)
+            if d.record is not None and d.record.applied
+        ]
+        if changed:
+            self._apply_for_state(obj, config, new_state)
+            new_state = self._journal(config, new_state, *applied)
+            self._patch_status(new_state)
+            for rec in applied:
+                ev = Event(
+                    "Normal",
+                    "FleetScaled",
+                    f"Scaled {rec.pool} pool {rec.from_replicas} -> "
+                    f"{rec.to_replicas} ({rec.reason}).",
+                )
+                events.append(ev)
+                self.kube.emit_event(self.cr_ref, ev)
+                self.log.info(ev.message)
+        elif new_state != state:
+            # Stabilization/cooldown clocks moved (or the key is new):
+            # persist them without journaling per-poll hold records.
             self._patch_status(new_state)
         return new_state
 
@@ -740,6 +840,7 @@ class Reconciler:
         # one monitoring interval later.
         if new_state.phase == Phase.STABLE:
             new_state = self._autoscale_step(obj, config, new_state, events)
+            new_state = self._fleet_step(obj, config, new_state, events)
 
         # Canary: go straight to the first gate check (the reference enters
         # its metrics loop immediately after the initial apply, :296-310).
@@ -1084,6 +1185,7 @@ class Reconciler:
         then only garbage-collects leftovers (e.g. after a topology edit).
         """
         from .builder import (
+            build_fleet_pool_manifests,
             build_warm_pool_manifests,
             build_worker_unit_manifests,
         )
@@ -1108,12 +1210,35 @@ class Reconciler:
                 self.name, self.namespace, owner_uid, config,
                 state.current_version, uri,
             )
+            # Disaggregated prefill/decode pools ([] when off): counts
+            # come from status.fleet when the per-pool autoscaler has
+            # taken control, else spec.fleet.
+            if config.fleet.disaggregation:
+                from . import autoscaler as _scaling
+
+                n_prefill, n_decode = _scaling.fleet_counts(
+                    config.fleet, state.fleet
+                )
+                desired += build_fleet_pool_manifests(
+                    self.name, self.namespace, owner_uid, config,
+                    state.current_version, uri,
+                    prefill_replicas=n_prefill,
+                    decode_replicas=n_decode,
+                )
         if state.previous_version is not None and state.traffic_prev > 0:
+            prev_uri = self._resolve_uri(config, state.previous_version)
             desired += build_worker_unit_manifests(
                 self.name, self.namespace, owner_uid, config,
-                state.previous_version,
-                self._resolve_uri(config, state.previous_version),
+                state.previous_version, prev_uri,
             )
+            if config.fleet.disaggregation:
+                # The outgoing version's pools at SPEC counts: the fleet
+                # autoscaler is frozen during a canary, same contract as
+                # the whole-predictor count.
+                desired += build_fleet_pool_manifests(
+                    self.name, self.namespace, owner_uid, config,
+                    state.previous_version, prev_uri,
+                )
 
         desired_names: dict[str, set[str]] = {
             kind: set() for kind in self._UNIT_KIND_REFS
@@ -1225,6 +1350,8 @@ class Reconciler:
             status.setdefault("autoscaler", None)
         if getattr(self, "_had_snapshot_key", False):
             status.setdefault("snapshot", None)
+        if getattr(self, "_had_fleet_key", False):
+            status.setdefault("fleet", None)
         if getattr(self, "_capacity_known", False):
             cap = self._capacity_status
             if cap is not None:

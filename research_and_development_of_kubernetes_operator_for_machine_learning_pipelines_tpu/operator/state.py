@@ -79,6 +79,13 @@ class PromotionState:
     # yaml) knows the restore source.  None (and omitted from status)
     # whenever the CR holds capacity.
     snapshot: Any = None
+    # Disaggregated prefill/decode pools (spec.fleet.disaggregation):
+    # the per-pool replica counts + hysteresis state the fleet
+    # autoscaler controls, e.g. {"prefillReplicas": 1,
+    # "decodeReplicas": 3, "prefillScaler": {...}, "decodeScaler":
+    # {...}}.  None (and omitted from status) when disaggregation is
+    # off — an unannotated CR's status stays byte-for-byte.
+    fleet: Any = None
 
     # -- transitions (pure; each returns a new state) -----------------------
 
@@ -99,6 +106,7 @@ class PromotionState:
             replicas=self.replicas,
             scaler=self.scaler,
             snapshot=self.snapshot,
+            fleet=self.fleet,
         )
 
     def new_version(self, version: str, initial_traffic: int) -> "PromotionState":
@@ -127,6 +135,7 @@ class PromotionState:
                 replicas=self.replicas,
                 scaler=self.scaler,
                 snapshot=self.snapshot,
+            fleet=self.fleet,
             )
         if (
             self.previous_version is not None
@@ -149,6 +158,7 @@ class PromotionState:
                 replicas=self.replicas,
                 scaler=self.scaler,
                 snapshot=self.snapshot,
+            fleet=self.fleet,
             )
         return PromotionState(
             phase=Phase.CANARY,
@@ -166,6 +176,7 @@ class PromotionState:
             replicas=self.replicas,
             scaler=self.scaler,
             snapshot=self.snapshot,
+            fleet=self.fleet,
         )
 
     def promoted_step(self, step: int) -> "PromotionState":
@@ -203,6 +214,7 @@ class PromotionState:
             replicas=self.replicas,
             scaler=self.scaler,
             snapshot=self.snapshot,
+            fleet=self.fleet,
         )
 
     # -- serialization ------------------------------------------------------
@@ -314,6 +326,8 @@ class PromotionState:
             status["autoscaler"] = dict(self.scaler)
         if self.snapshot is not None:
             status["snapshot"] = dict(self.snapshot)
+        if self.fleet is not None:
+            status["fleet"] = dict(self.fleet)
         return status
 
     @classmethod
@@ -359,4 +373,5 @@ class PromotionState:
             ),
             scaler=status.get("autoscaler"),
             snapshot=status.get("snapshot"),
+            fleet=status.get("fleet"),
         )

@@ -143,6 +143,7 @@ class TpuInferenceServer:
         telemetry=None,
         attach_fn=None,
         cold_start_anchor_wall: float | None = None,
+        fleet_role: str = "unified",
     ):
         self.engine = engine
         self.metrics = metrics
@@ -174,6 +175,10 @@ class TpuInferenceServer:
         # when known, else boot time); the first token served after it
         # closes the tpumlops_cold_start_seconds ladder.
         self._cold_anchor_wall = cold_start_anchor_wall
+        # Disaggregated-fleet role (unified | prefill | decode):
+        # advisory identity on /readyz and log lines — the router's
+        # role-tagged backend table decides who exports/imports KV.
+        self.fleet_role = fleet_role
         import threading
 
         self._profile_lock = threading.Lock()
@@ -580,6 +585,7 @@ class TpuInferenceServer:
                 )
                 for i in range(len(prompts))
             ]
+            _stamp_handoff(request, traces)
             futures = [
                 self.gen_engine.submit(
                     p, max_new, eos_id,
@@ -659,6 +665,7 @@ class TpuInferenceServer:
             loop.call_soon_threadsafe(tokens.put_nowait, int(t))
 
         trace = RequestTrace(request_id=request_id)
+        _stamp_handoff(request, [trace])
         fut = self.gen_engine.submit(
             prompt, max_new, eos_id, **sampling, on_token=on_token,
             request_id=request_id, trace=trace,
@@ -885,6 +892,8 @@ class TpuInferenceServer:
         with the state named in the body either way."""
         status = 200 if self.lifecycle == "ready" else 503
         body = {"ready": self.lifecycle == "ready", "lifecycle": self.lifecycle}
+        if self.fleet_role != "unified":
+            body["fleetRole"] = self.fleet_role
         if self.lifecycle == "draining" and self.gen_engine is not None:
             body["inFlight"] = self.gen_engine.inflight()
         return web.json_response(body, status=status)
@@ -1065,6 +1074,220 @@ class TpuInferenceServer:
             }
         )
 
+    # -- KV handoff (disaggregated prefill/decode fleets) --------------------
+
+    def _kv_engine_or_error(self) -> tuple[object | None, web.Response | None]:
+        """Common gating for the KV endpoints: attached causal-LM engine
+        with the radix prefix cache on (the handoff unit IS its chunk)."""
+        err = self._not_attached()
+        if err is not None:
+            return None, err
+        if self.gen_engine is None:
+            return None, web.json_response(
+                {"error": f"model {self.model_name} is not a causal LM"},
+                status=400,
+            )
+        if getattr(self.gen_engine, "_prefix_cache", None) is None:
+            return None, web.json_response(
+                {
+                    "error": "KV handoff requires the radix prefix cache; "
+                    "enable spec.tpu.prefixCache (--prefix-cache 1)",
+                    "reason": "prefix_cache_disabled",
+                },
+                status=409,
+            )
+        return self.gen_engine, None
+
+    async def handle_admin_kv_export(self, request: web.Request) -> web.Response:
+        """``POST /admin/kv/export``: serialize a prompt's committed
+        prefix K/V for handoff to a decode replica.
+
+        Body is the generate shape (``{"prompt_ids": [...]}``); the
+        response is one ``application/octet-stream`` handoff blob
+        (``server/kv_transfer.py`` wire format) covering the prompt's
+        whole-chunk prefix.  A prefix not yet in this replica's radix
+        cache is prefilled first (one max_new_tokens=1 admission whose
+        write-backs populate the cache) — that forward pass is the work
+        the decode pool is NOT doing, which is the point."""
+        from . import kv_transfer
+        from .flight_recorder import RequestTrace
+
+        engine, err = self._kv_engine_or_error()
+        if err is not None:
+            return err
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("export body must be a JSON object")
+            raw = body.get("prompt_ids")
+            if raw is None:
+                raise ValueError('export requires "prompt_ids"')
+            if raw and not np.isscalar(raw[0]):
+                if len(raw) != 1:
+                    raise ValueError(
+                        "export supports exactly one prompt sequence"
+                    )
+                raw = raw[0]
+            prompt = engine.validate(raw, 1)
+            covered = engine.exportable_prefix_tokens(prompt)
+            if covered <= 0:
+                code = 400
+                return web.json_response(
+                    {
+                        "error": f"prompt of {prompt.size} tokens has no "
+                        "whole-chunk prefix to export",
+                        "reason": "prompt_too_short",
+                    },
+                    status=400,
+                )
+            loop = asyncio.get_running_loop()
+            matched, chunks = await loop.run_in_executor(
+                None, engine.export_prefix_kv, prompt
+            )
+            if matched < covered:
+                # Cold prefix: prefill it here (write-backs land the
+                # chunks in the radix cache), then re-read.  Sheds and
+                # validation errors surface as their usual statuses —
+                # the router treats any non-200 as "fall back".
+                rid = request.get("request_id") or request_id_from_headers(
+                    request.headers
+                )
+                trace = RequestTrace(request_id=rid)
+                fut = engine.submit(
+                    prompt, 1, request_id=rid, trace=trace
+                )
+                await asyncio.wrap_future(fut)
+                matched, chunks = await loop.run_in_executor(
+                    None, engine.export_prefix_kv, prompt
+                )
+            if matched <= 0 or not chunks:
+                code = 503
+                return web.json_response(
+                    {
+                        "error": "prefix did not land in the radix cache "
+                        "(budget too small for the prompt?)",
+                        "reason": "export_unavailable",
+                        "retry_after_s": 1,
+                    },
+                    status=503,
+                    headers={"Retry-After": "1"},
+                )
+            blob = await loop.run_in_executor(
+                None,
+                lambda: kv_transfer.serialize_chunks(
+                    engine._prefill_chunk_size, prompt, chunks
+                ),
+            )
+            return web.Response(
+                body=blob,
+                content_type="application/octet-stream",
+                headers={"X-Tpumlops-Kv-Tokens": str(matched)},
+            )
+        except EngineOverloaded as e:
+            code = 429
+            return web.json_response(
+                {
+                    "error": str(e),
+                    "reason": e.reason,
+                    "retry_after_s": e.retry_after_s,
+                },
+                status=429,
+                headers={"Retry-After": str(e.retry_after_s)},
+            )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            code = 400
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            _log.exception("kv export failed")
+            code = 500
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self.metrics.observe_request(
+                time.perf_counter() - t0, code=code, service="kv-export"
+            )
+
+    async def handle_admin_kv_import(self, request: web.Request) -> web.Response:
+        """``POST /admin/kv/import``: install a handoff blob into this
+        replica's radix prefix cache.
+
+        The blob's geometry (chunk size, K/V shape, dtype) must match
+        this engine exactly — a mismatch is a typed 409, never a silent
+        cast that would blur the token-for-token handoff parity.  The
+        import journals a ``kv-import`` engine tick, so the relayed
+        request that follows is reconstructable from ``/debug/trace``."""
+        from . import kv_transfer
+
+        engine, err = self._kv_engine_or_error()
+        if err is not None:
+            return err
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            blob = await request.read()
+            loop = asyncio.get_running_loop()
+            try:
+                header, chunks = await loop.run_in_executor(
+                    None, kv_transfer.deserialize_chunks, blob
+                )
+            except kv_transfer.KvTransferError as e:
+                code = 400
+                return web.json_response(
+                    {"error": str(e), "reason": "bad_blob"}, status=400
+                )
+            C = engine._prefill_chunk_size
+            cfg = engine._cfg
+            expected_shape = [
+                cfg.num_layers, 1, C, cfg.num_kv_heads, cfg.head_dim,
+            ]
+            if int(header["chunk_tokens"]) != C or list(
+                header["kv_shape"]
+            ) != expected_shape:
+                code = 409
+                return web.json_response(
+                    {
+                        "error": f"handoff geometry {header['kv_shape']} "
+                        f"@ {header['chunk_tokens']} tokens does not "
+                        f"match this engine ({expected_shape} @ {C})",
+                        "reason": "geometry_mismatch",
+                    },
+                    status=409,
+                )
+            import jax.numpy as jnp
+
+            if kv_transfer._dtype_from_name(
+                header["dtype"]
+            ) != jnp.dtype(engine._dtype):
+                code = 409
+                return web.json_response(
+                    {
+                        "error": f"handoff dtype {header['dtype']} does "
+                        f"not match engine dtype "
+                        f"{jnp.dtype(engine._dtype).name}",
+                        "reason": "dtype_mismatch",
+                    },
+                    status=409,
+                )
+            prompt = kv_transfer.chunk_token_ids(header)
+            imported = await loop.run_in_executor(
+                None, engine.import_prefix_kv, prompt, chunks
+            )
+            return web.json_response(
+                {"imported_tokens": int(imported), "chunks": len(chunks)}
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            code = 400
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            _log.exception("kv import failed")
+            code = 500
+            return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self.metrics.observe_request(
+                time.perf_counter() - t0, code=code, service="kv-import"
+            )
+
     async def handle_model_metadata(self, request: web.Request) -> web.Response:
         err = self._not_attached()
         if err is not None:
@@ -1106,6 +1329,12 @@ class TpuInferenceServer:
             # added after the app starts (pre-attach requests get the
             # typed warm_pool_empty 503).
             app.router.add_post(f"/v2/models/{name}/generate", self.handle_generate)
+            # KV handoff endpoints (disaggregated fleets): export on
+            # prefill replicas, import on decode replicas — registered
+            # on every role (the router's role table decides who is
+            # asked what; a unified replica can do both).
+            app.router.add_post("/admin/kv/export", self.handle_admin_kv_export)
+            app.router.add_post("/admin/kv/import", self.handle_admin_kv_import)
         app.router.add_post("/api/v1.0/predictions", self.handle_seldon_predict)
         app.router.add_post("/api/v1.0/feedback", self.handle_feedback)
         app.router.add_get("/metrics", self.handle_metrics)
@@ -1120,6 +1349,25 @@ class TpuInferenceServer:
 
         app.on_shutdown.append(on_shutdown)
         return app
+
+
+def _stamp_handoff(request: web.Request, traces) -> None:
+    """Relayed-request stamp: the router forwards a request AFTER a
+    prefill→decode KV handoff with ``X-Tpumlops-Handoff: <ms>`` (the
+    handoff wall it measured).  ``t_handoff`` anchors the relay in this
+    process's perf_counter domain; ``handoff_ms`` carries the router's
+    cross-process measurement verbatim."""
+    hdr = request.headers.get("X-Tpumlops-Handoff")
+    if not hdr:
+        return
+    try:
+        hms = float(hdr)
+    except ValueError:
+        return  # malformed stamp: treat as not relayed, never half-mark
+    now = time.perf_counter()
+    for tr in traces:
+        tr.t_handoff = now
+        tr.handoff_ms = hms
 
 
 def _add_batch_dim(out: Any) -> Any:
@@ -1224,6 +1472,7 @@ def make_gen_engine(
             enabled=True,
             budget_bytes=config.tpu.prefix_cache.budget_mb * 2**20,
             chunk_tokens=config.tpu.prefix_cache.chunk_tokens,
+            l2_budget_bytes=config.tpu.prefix_cache.l2_budget_mb * 2**20,
         )
     speculative = None
     if config.tpu.speculative.enabled:
@@ -1255,6 +1504,7 @@ def make_gen_engine(
         prefix_cache=prefix_cache,
         on_prefix_hit=metrics.observe_prefix_hit if metrics else None,
         on_prefix_evict=metrics.inc_prefix_evictions if metrics else None,
+        on_prefix_l2=metrics.inc_prefix_l2 if metrics else None,
         speculative=speculative,
         on_spec=metrics.observe_speculative if metrics else None,
         # Fused multi-step decode: same K on leader and followers (this
@@ -1452,6 +1702,7 @@ def build_server(
             drain_grace_s=config.tpu.drain_grace_s,
             telemetry=telemetry,
             attach_fn=attach_fn,
+            fleet_role=config.fleet_role,
         )
         if warmup:
             prewarm_from_snapshot(config)
@@ -1508,6 +1759,7 @@ def build_server(
         drain_grace_s=config.tpu.drain_grace_s,
         telemetry=telemetry,
         cold_start_anchor_wall=anchor,
+        fleet_role=config.fleet_role,
     )
     server.predictor = predictor
     t_warm = time.time()
@@ -1630,6 +1882,22 @@ def main(argv: list[str] | None = None) -> None:
         help="prefix reuse unit in tokens (0 = follow --prefill-chunk, or "
         "64 when that is unset too); an explicit mismatch with "
         "--prefill-chunk is rejected at startup",
+    )
+    ap.add_argument(
+        "--prefix-cache-l2-budget-mb",
+        type=int,
+        default=0,
+        help="second-tier host-RAM pool for evicted prefix chunks (LRU "
+        "under this budget, promoted back on a radix-walk miss); 0 "
+        "(default) = single-tier behavior byte-for-byte",
+    )
+    ap.add_argument(
+        "--fleet-role",
+        default="unified",
+        choices=["unified", "prefill", "decode"],
+        help="disaggregated-fleet role of this replica (advisory: "
+        "surfaced on /readyz and logs; the router's role-tagged backend "
+        "table decides who is asked to export/import KV)",
     )
     ap.add_argument(
         "--speculative",
@@ -1763,6 +2031,7 @@ def main(argv: list[str] | None = None) -> None:
                     "enabled": bool(args.prefix_cache),
                     "budgetMB": args.prefix_cache_budget_mb,
                     "chunkTokens": args.prefix_cache_chunk or None,
+                    "l2BudgetMB": args.prefix_cache_l2_budget_mb,
                 },
                 "speculative": {
                     "enabled": bool(args.speculative),
@@ -1789,9 +2058,15 @@ def main(argv: list[str] | None = None) -> None:
             }
         ),
         warm_pool=bool(args.warm_pool),
+        fleet_role=args.fleet_role,
     )
     if config.warm_pool and not config.tpu.snapshot.enabled:
         ap.error("--warm-pool requires --snapshot-dir")
+    if config.fleet_role != "unified" and not config.tpu.prefix_cache.enabled:
+        ap.error(
+            "--fleet-role prefill/decode requires --prefix-cache 1 "
+            "(KV handoff moves radix prefix-cache chunks)"
+        )
 
     import jax  # deferred: process topology is meaningful only after init
 

@@ -8,7 +8,9 @@ material for that question in three bounded rings:
 
 - **ticks** — one record per engine device dispatch (kind: ``decode`` /
   ``verify`` / ``multistep`` / ``packed-prefill`` / ``prefill`` /
-  ``seed``) with wall time, batch fill, active slots, queue depth,
+  ``seed`` / ``kv-import`` — the last is a handed-off prefix landing in
+  the radix cache, host-side) with wall time, batch fill, active slots,
+  queue depth,
   tokens emitted, and accepted speculative drafts; fused multi-step
   ticks additionally carry ``steps`` (K scan iterations per dispatch),
   and their per-token instants in the request traces are reconstructed
@@ -62,6 +64,12 @@ class RequestTrace:
     t_admit: float = 0.0
     t_first: float = 0.0
     t_finish: float = 0.0
+    # Disaggregated-fleet relay: stamped at request receipt when the
+    # router forwarded this request AFTER a prefill→decode KV handoff
+    # (X-Tpumlops-Handoff header); ``handoff_ms`` is the router-measured
+    # handoff wall riding the same header.  0.0/None = not relayed.
+    t_handoff: float = 0.0
+    handoff_ms: float | None = None
     prefill_chunks: int = 0
     cached_tokens: int = 0
     spec_proposed: int = 0
@@ -96,6 +104,10 @@ class RequestTrace:
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
             "tokens": self.tokens,
+            # KV relay context (None = not a relayed request): the
+            # router's measured handoff wall, so /debug/trace alone
+            # reconstructs export → import → forward → seed.
+            "handoff_ms": self.handoff_ms,
             "finish_reason": self.finish_reason or "in-flight",
         }
 
@@ -333,6 +345,25 @@ class FlightRecorder:
             begin = self._us(tr.t_submit) if tr.t_submit > 0 else 0
             end = self._us(tr.t_finish) if tr.t_finish > 0 else begin
             end = max(end, begin)  # clock skew must never invert the span
+            if tr.t_handoff > 0 and tr.handoff_ms:
+                # The router-measured KV handoff, anchored in this
+                # process's clock by the receipt stamp: the relay span
+                # ENDS at t_handoff and lasted handoff_ms.  Emitted only
+                # for relayed requests — the non-fleet export stays
+                # byte-for-byte.
+                dur_us = int(tr.handoff_ms * 1000.0)
+                out.append(
+                    {
+                        "name": "kv-handoff",
+                        "cat": "handoff",
+                        "ph": "X",
+                        "ts": max(self._us(tr.t_handoff) - dur_us, 0),
+                        "dur": dur_us,
+                        "pid": 1,
+                        "tid": tid,
+                        "args": {"request_id": tr.request_id},
+                    }
+                )
             out.append(
                 {
                     "name": "request",

@@ -99,6 +99,14 @@ def _safe_fail(fut: Future, exc: Exception) -> None:
     except Exception:
         pass
 
+class _Wake:
+    """Queue sentinel that only unblocks the scheduler's idle wait (a
+    control op arrived); carries no request and must never be confused
+    with the None shutdown sentinel."""
+
+
+_WAKE = _Wake()
+
 _MIN_BUCKET = 16
 
 
@@ -253,6 +261,7 @@ class GenerationEngine:
         prefix_cache=None,  # PrefixCacheConfig | None
         on_prefix_hit: Callable[[int], None] | None = None,
         on_prefix_evict: Callable[[], None] | None = None,
+        on_prefix_l2: Callable[[str], None] | None = None,
         speculative=None,  # speculative.SpeculativeConfig | None
         on_spec: Callable[[int, int], None] | None = None,
         prefill_batch: int = 1,
@@ -385,6 +394,7 @@ class GenerationEngine:
         # Device telemetry also syncs: a dispatch-only prefill wall would
         # read as an absurd MFU.
         self._sync_ticks = recorder is not None or telemetry is not None
+        self._on_prefix_l2 = on_prefix_l2
         if prefix_enabled:
             from .prefix_cache import RadixPrefixCache
 
@@ -392,6 +402,10 @@ class GenerationEngine:
                 budget_bytes=int(prefix_cache.budget_bytes),
                 chunk_tokens=self._prefill_chunk_size,
                 on_evict=self._note_prefix_evict,
+                l2_budget_bytes=int(
+                    getattr(prefix_cache, "l2_budget_bytes", 0) or 0
+                ),
+                on_l2_event=self._note_prefix_l2,
             )
         # Self-speculative n-gram decoding: disabled (None) = byte-for-byte
         # the plain single-token tick.  Enabled: greedy-only ticks draft up
@@ -848,6 +862,12 @@ class GenerationEngine:
         # on the scheduler thread ahead of every packed dispatch.
         self._zero_kd = np.asarray(jax.random.key_data(jax.random.key(0)))
         self._queue: queue.Queue[_Request | None] = queue.Queue()
+        # Control operations (KV export/import, fleet introspection):
+        # closures any thread may enqueue that MUST run on the scheduler
+        # thread — the radix prefix cache and slot truth are
+        # single-threaded by design.  Drained at the top of every
+        # admission phase; one empty get_nowait per tick when idle.
+        self._control_ops: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # Admission control (the data-plane half of the autoscaling
@@ -1122,9 +1142,20 @@ class GenerationEngine:
                 slot.future.cancel()
         while True:
             try:
+                fn_fut = self._control_ops.get_nowait()
+            except queue.Empty:
+                break
+            _safe_fail(
+                fn_fut[1],
+                EngineShutdown("engine shut down before the control op ran"),
+            )
+        while True:
+            try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
+            if isinstance(req, _Wake):
+                continue
             if req is not None:
                 self._release_queued(req)
             if req is not None and not req.future.done():
@@ -1669,6 +1700,123 @@ class GenerationEngine:
         self.prefix_evictions += 1
         if self._on_prefix_evict is not None and not self._in_warmup:
             self._on_prefix_evict()
+
+    def _note_prefix_l2(self, kind: str) -> None:
+        """Second-tier prefix-cache event (``hit``/``spill``/``evict``)
+        — mirrored to the tpumlops_prefix_cache_l2_* counters."""
+        if self._on_prefix_l2 is not None and not self._in_warmup:
+            self._on_prefix_l2(kind)
+
+    # -- KV handoff (disaggregated prefill/decode fleets) --------------------
+
+    def run_control(self, fn: Callable[[], object]) -> Future:
+        """Run ``fn`` on the scheduler thread at the next admission phase
+        (thread-safe); returns a Future with its result.  Control ops
+        never occupy a cache slot and run even when every slot is busy —
+        they exist for state that is single-threaded by design (the
+        radix prefix cache, slot truth)."""
+        fut: Future = Future()
+        if self._stop.is_set():
+            # Shut down (or shutting down): the scheduler will never pop
+            # this op — fail typed NOW instead of letting the caller
+            # block out its timeout.
+            _safe_fail(
+                fut,
+                EngineShutdown("engine shut down before the control op ran"),
+            )
+            return fut
+        self._control_ops.put((fn, fut))
+        self._queue.put(_WAKE)  # unblock an idle scheduler promptly
+        if self._stop.is_set():
+            # Raced stop(): its queue drain may already have missed this
+            # op, so drain ourselves.  Both drains use get_nowait and
+            # _safe_fail is idempotent, so double-draining is harmless.
+            while True:
+                try:
+                    _fn2, fut2 = self._control_ops.get_nowait()
+                except queue.Empty:
+                    break
+                _safe_fail(
+                    fut2,
+                    EngineShutdown(
+                        "engine shut down before the control op ran"
+                    ),
+                )
+        return fut
+
+    def _drain_control_ops(self) -> None:
+        while True:
+            try:
+                fn, fut = self._control_ops.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                _safe_resolve(fut, fn())
+            except Exception as exc:
+                _safe_fail(fut, exc)
+
+    def _require_prefix_cache(self):
+        if self._prefix_cache is None:
+            raise RuntimeError(
+                "KV handoff requires the radix prefix cache: enable "
+                "spec.tpu.prefixCache (--prefix-cache 1)"
+            )
+        return self._prefix_cache
+
+    def exportable_prefix_tokens(self, prompt: np.ndarray) -> int:
+        """Whole-chunk token count of ``prompt`` a handoff can cover
+        (the radix lookup's strict cap below the prompt length)."""
+        cache = self._require_prefix_cache()
+        C = cache.chunk_tokens
+        return ((int(np.asarray(prompt).size) - 1) // C) * C
+
+    def export_prefix_kv(
+        self, prompt: np.ndarray, timeout: float | None = 60.0
+    ) -> tuple[int, list]:
+        """Committed prefix K/V of ``prompt`` as host chunk pairs —
+        ``(matched_tokens, [(k, v), ...])`` in radix storage layout.
+        Thread-safe: the lookup (an LRU-touching radix walk) runs as a
+        control op on the scheduler thread; the returned host arrays are
+        immutable snapshots safe to serialize from any thread."""
+        cache = self._require_prefix_cache()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        return self.run_control(lambda: cache.lookup(prompt)).result(timeout)
+
+    def import_prefix_kv(
+        self,
+        prompt: np.ndarray,
+        chunks: list,
+        timeout: float | None = 60.0,
+    ) -> int:
+        """Install handed-off prefix chunks into the radix cache; returns
+        the tokens now covered.  Runs on the scheduler thread and
+        journals one ``kv-import`` tick so a relayed request is
+        reconstructable from ``/debug/trace`` — the import is the tick
+        between the router's handoff and the request's seed."""
+        cache = self._require_prefix_cache()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        C = cache.chunk_tokens
+        if len(chunks) * C > prompt.size:
+            raise ValueError(
+                f"{len(chunks)} chunks of {C} tokens exceed the "
+                f"{prompt.size}-token prompt"
+            )
+
+        def op() -> int:
+            t0 = time.perf_counter()
+            installed = 0
+            for idx, (k, v) in enumerate(chunks):
+                if not cache.insert_chunk(prompt, idx, k, v):
+                    break  # parent path evicted mid-walk: stop cleanly
+                installed += 1
+            self._record_tick(
+                "kv-import", t0, time.perf_counter() - t0,
+                active_slots=sum(s is not None for s in self._slots),
+                batch_fill=installed, tokens=installed * C,
+            )
+            return installed * C
+
+        return int(self.run_control(op).result(timeout))
 
     def _maybe_cache_chunk(self, prog: _PrefillProgress) -> None:
         """Write the chunk just prefilled (index ``prog.next_idx``) back
@@ -2839,6 +2987,7 @@ class GenerationEngine:
         never more than one prefill tick away — in-flight streams keep
         their token cadence under long prompts.  Returns False on the
         shutdown sentinel."""
+        self._drain_control_ops()
         if self._packed:
             return self._admit_phase_packed()
         if self._pending:
@@ -2859,6 +3008,9 @@ class GenerationEngine:
                 req = self._queue.get(block=idle, timeout=1.0)
             except queue.Empty:
                 break
+            if isinstance(req, _Wake):
+                self._drain_control_ops()
+                continue
             if req is not None:
                 self._release_queued(req)  # left the admission queue
             if req is None or self._stop.is_set():
@@ -2901,6 +3053,9 @@ class GenerationEngine:
                 req = self._queue.get(block=idle and not popped, timeout=1.0)
             except queue.Empty:
                 break
+            if isinstance(req, _Wake):
+                self._drain_control_ops()
+                continue
             if req is not None:
                 self._release_queued(req)  # left the admission queue
             if req is None or self._stop.is_set():

@@ -1,0 +1,231 @@
+"""KV handoff wire format: committed prefix K/V rows between replicas.
+
+Disaggregated prefill/decode fleets (docs/SCALE.md) split the engine's
+two phases across pools: prefill-heavy replicas compute prompt K/V,
+decode-heavy replicas stream tokens.  λScale's observation (PAPERS.md)
+is the economics: moving serialized K/V state between instances is far
+cheaper than recomputing it — a 512-token prefix is a few MB of int8kv
+bytes on the wire vs a full weight-streaming forward pass per replica.
+
+The transfer unit is the radix prefix cache's chunk (PR 1): host copies
+of one prefill chunk's K/V in the seq-prefill layout ``[L, 1, C, NKV,
+D]``, exactly what ``GenerationEngine._read_slot`` produces and
+``_seed_slot`` consumes — so an imported prefix re-enters the device
+cache through the same seed program a local radix hit uses, and the
+int8kv round trip stays lossless (PR 3's dequant/requant identity).
+
+Wire layout (one blob per handoff)::
+
+    MAGIC (6 bytes: b"TPKV1\\n")
+    header length (8 bytes, little-endian uint64)
+    JSON header:
+        format_version, chunk_tokens, dtype, kv_shape,
+        total_tokens, chunks: [
+            {tokens, k_offset, k_nbytes, k_crc32,
+                     v_offset, v_nbytes, v_crc32}, ...]
+    raw payload (concatenated k/v bytes at the indexed offsets)
+
+Every chunk's K and V carry their own CRC32 — a truncated or bit-flipped
+blob raises the typed :class:`KvTransferError` at import instead of
+splicing corrupt K/V into a request (the same contract as
+``snapshot.py``'s per-leaf CRCs).  Token ids ride IN the manifest: the
+radix cache keys chunks by exact token bytes, so the importer re-derives
+the cumulative keys without trusting the sender's hashing.
+"""
+
+from __future__ import annotations
+
+import binascii
+import json
+from typing import Any
+
+import numpy as np
+
+MAGIC = b"TPKV1\n"
+
+# Bump when the wire layout changes; a mismatch is a typed error — the
+# router falls back to unified serving, never to garbage K/V.
+FORMAT_VERSION = 1
+
+# A handoff blob is one prompt's prefix, not a checkpoint: cap it well
+# below anything a misbehaving peer could use to balloon the importer.
+MAX_BLOB_BYTES = 1 << 30
+
+
+class KvTransferError(Exception):
+    """Typed failure of a KV handoff blob: bad magic, truncation, CRC
+    mismatch, malformed manifest, or a geometry that does not match the
+    importing engine.  Callers treat it as 'this handoff is unusable'
+    and fall back to local prefill (unified serving)."""
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def serialize_chunks(
+    chunk_tokens: int,
+    prompt: np.ndarray,
+    chunks: list,
+) -> bytes:
+    """Pack ``chunks`` — ``[(k, v), ...]`` host pairs in radix storage
+    layout ``[L, 1, C, NKV, D]``, one per matched chunk of ``prompt`` —
+    into one handoff blob.  ``len(chunks) * chunk_tokens`` leading tokens
+    of ``prompt`` are the covered prefix; their ids ride in the manifest
+    so the importer rebuilds the exact radix keys."""
+    if not chunks:
+        raise KvTransferError("no chunks to serialize")
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    C = int(chunk_tokens)
+    if len(chunks) * C > prompt.size:
+        raise KvTransferError(
+            f"{len(chunks)} chunks of {C} tokens exceed the "
+            f"{prompt.size}-token prompt"
+        )
+    k0 = np.ascontiguousarray(np.asarray(chunks[0][0]))
+    header: dict[str, Any] = {
+        "format_version": FORMAT_VERSION,
+        "chunk_tokens": C,
+        "dtype": k0.dtype.name,
+        "kv_shape": list(k0.shape),
+        "total_tokens": len(chunks) * C,
+        "chunks": [],
+    }
+    payload = bytearray()
+    for idx, (k, v) in enumerate(chunks):
+        k = np.ascontiguousarray(np.asarray(k))
+        v = np.ascontiguousarray(np.asarray(v))
+        if k.shape != k0.shape or v.shape != k0.shape or k.dtype != k0.dtype:
+            raise KvTransferError(
+                f"chunk {idx} geometry {k.shape}/{k.dtype} differs from "
+                f"chunk 0 {k0.shape}/{k0.dtype}"
+            )
+        kraw, vraw = k.tobytes(), v.tobytes()
+        header["chunks"].append(
+            {
+                "tokens": prompt[idx * C : (idx + 1) * C].tolist(),
+                "k_offset": len(payload),
+                "k_nbytes": len(kraw),
+                "k_crc32": binascii.crc32(kraw) & 0xFFFFFFFF,
+                "v_offset": len(payload) + len(kraw),
+                "v_nbytes": len(vraw),
+                "v_crc32": binascii.crc32(vraw) & 0xFFFFFFFF,
+            }
+        )
+        payload += kraw
+        payload += vraw
+    head = json.dumps(header).encode()
+    return (
+        MAGIC
+        + len(head).to_bytes(8, "little")
+        + head
+        + bytes(payload)
+    )
+
+
+def deserialize_chunks(blob: bytes) -> tuple[dict[str, Any], list]:
+    """Unpack a handoff blob into ``(header, [(k, v), ...])``.
+
+    Every chunk's CRC is verified before its bytes are trusted; any
+    structural problem raises :class:`KvTransferError`."""
+    if len(blob) > MAX_BLOB_BYTES:
+        raise KvTransferError(
+            f"handoff blob of {len(blob)} bytes exceeds the "
+            f"{MAX_BLOB_BYTES}-byte cap"
+        )
+    if not blob.startswith(MAGIC):
+        raise KvTransferError("bad magic: not a KV handoff blob")
+    if len(blob) < len(MAGIC) + 8:
+        raise KvTransferError("truncated handoff blob: no header length")
+    head_len = int.from_bytes(blob[len(MAGIC) : len(MAGIC) + 8], "little")
+    head_start = len(MAGIC) + 8
+    if head_start + head_len > len(blob):
+        raise KvTransferError("truncated handoff blob: header cut short")
+    try:
+        header = json.loads(blob[head_start : head_start + head_len])
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise KvTransferError(f"malformed handoff header: {e}") from e
+    if not isinstance(header, dict) or not isinstance(
+        header.get("chunks"), list
+    ):
+        raise KvTransferError("malformed handoff header: bad shape")
+    if int(header.get("format_version", -1)) != FORMAT_VERSION:
+        raise KvTransferError(
+            f"handoff format v{header.get('format_version')} != "
+            f"v{FORMAT_VERSION}"
+        )
+    try:
+        dtype = _dtype_from_name(str(header["dtype"]))
+        shape = tuple(int(d) for d in header["kv_shape"])
+        C = int(header["chunk_tokens"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise KvTransferError(f"malformed handoff header: {e}") from e
+    payload = blob[head_start + head_len :]
+    chunks: list = []
+    expected_off = 0
+    for idx, entry in enumerate(header["chunks"]):
+        try:
+            tokens = entry["tokens"]
+            pairs = [
+                (entry["k_offset"], entry["k_nbytes"], entry["k_crc32"]),
+                (entry["v_offset"], entry["v_nbytes"], entry["v_crc32"]),
+            ]
+        except (KeyError, TypeError) as e:
+            raise KvTransferError(
+                f"malformed chunk {idx} manifest: {e}"
+            ) from e
+        if not isinstance(tokens, list) or len(tokens) != C:
+            raise KvTransferError(
+                f"chunk {idx} carries {len(tokens) if isinstance(tokens, list) else '?'} "
+                f"tokens, expected {C}"
+            )
+        # The serializer lays chunks out sequentially; require exactly
+        # that, so manifest entries cannot alias the same payload bytes
+        # — MAX_BLOB_BYTES bounds the wire size, and sequential offsets
+        # are what make it also bound the DECODED size (a peer declaring
+        # 1000 chunks over one region would otherwise materialize 1000x
+        # the payload in host arrays before any geometry check runs).
+        (k_off, k_n, _), (v_off, v_n, _) = (
+            (int(p[0]), int(p[1]), p[2]) for p in pairs
+        )
+        if k_off != expected_off or v_off != k_off + k_n:
+            raise KvTransferError(
+                f"chunk {idx} payload offsets overlap or leave gaps "
+                "(sequential layout required)"
+            )
+        expected_off = v_off + v_n
+        arrs = []
+        for off, nbytes, crc in pairs:
+            off, nbytes = int(off), int(nbytes)
+            raw = payload[off : off + nbytes]
+            if len(raw) != nbytes:
+                raise KvTransferError(
+                    f"chunk {idx} truncated: wanted {nbytes} bytes at "
+                    f"offset {off}, got {len(raw)}"
+                )
+            if (binascii.crc32(raw) & 0xFFFFFFFF) != int(crc):
+                raise KvTransferError(f"chunk {idx} failed CRC")
+            try:
+                arrs.append(np.frombuffer(raw, dtype=dtype).reshape(shape))
+            except ValueError as e:
+                # nbytes disagrees with the manifest's shape x dtype —
+                # structural corruption stays TYPED like every other.
+                raise KvTransferError(
+                    f"chunk {idx} byte count {nbytes} does not fit "
+                    f"shape {shape} x {dtype}: {e}"
+                ) from e
+        chunks.append((arrs[0], arrs[1]))
+    return header, chunks
+
+
+def chunk_token_ids(header: dict[str, Any]) -> np.ndarray:
+    """The covered prefix's token ids, concatenated in chunk order —
+    the prompt prefix the importer keys the radix inserts by."""
+    out: list[int] = []
+    for entry in header["chunks"]:
+        out.extend(int(t) for t in entry["tokens"])
+    return np.asarray(out, np.int32)

@@ -155,6 +155,29 @@ class ServerMetrics:
             ident_labels,
             registry=self.registry,
         )
+        # Second-tier (host-RAM) prefix cache (prefixCache.l2BudgetMB):
+        # chunks the first tier evicted that were caught, re-promoted,
+        # or aged out of the L2 pool.  Registered unconditionally like
+        # the L1 family — children appear only when the tier is on.
+        self.prefix_cache_l2_hits = Counter(
+            "tpumlops_prefix_cache_l2_hits",
+            "Radix-walk misses served by the second-tier host-RAM pool "
+            "(chunk promoted back into the tree)",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.prefix_cache_l2_spills = Counter(
+            "tpumlops_prefix_cache_l2_spills",
+            "First-tier evictions caught by the second-tier pool",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.prefix_cache_l2_evictions = Counter(
+            "tpumlops_prefix_cache_l2_evictions",
+            "Chunks aged out of the second-tier pool (LRU byte budget)",
+            ident_labels,
+            registry=self.registry,
+        )
         # Engine occupancy telemetry (fed per decode tick from the
         # engine's on_step callback): lets the operator correlate
         # speculative acceptance — and every other per-tick rate — with
@@ -497,6 +520,15 @@ class ServerMetrics:
 
     def inc_prefix_evictions(self, n: int = 1):
         self.prefix_cache_evictions.labels(**self.identity).inc(n)
+
+    def inc_prefix_l2(self, kind: str):
+        counter = {
+            "hit": self.prefix_cache_l2_hits,
+            "spill": self.prefix_cache_l2_spills,
+            "evict": self.prefix_cache_l2_evictions,
+        }.get(kind)
+        if counter is not None:
+            counter.labels(**self.identity).inc()
 
     # -- device telemetry (families exist only with deviceTelemetry on) ------
 

@@ -28,6 +28,14 @@ Design:
 - Eviction is LRU over leaves under a byte budget.  Interior nodes are
   never evicted (their descendants' keys would dangle); a cold branch
   drains leaf-first, which is also reference-count order.
+- An optional SECOND tier (``l2_budget_bytes`` > 0) catches evicted
+  leaves instead of dropping them: the chunk's K/V moves to a flat
+  host-RAM pool keyed by its cumulative token bytes, under its own LRU
+  byte budget.  A radix-walk miss consults the L2 before giving up;
+  a hit promotes the chunk back into the tree (re-seeded into the
+  device cache through the existing ``_seed_slot`` path on the next
+  admission), extending prefix reuse beyond what the first tier's
+  budget — sized against HBM-adjacent copy bandwidth — can hold.
 
 Thread-safety: all calls happen on the engine's single scheduler
 thread; no locking needed.
@@ -35,6 +43,7 @@ thread; no locking needed.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable
 
@@ -51,6 +60,9 @@ class PrefixCacheConfig:
     # prefillChunk is unset, becomes it — enabling the cache enables
     # chunked prefill).
     chunk_tokens: int = 64
+    # Second-tier host-RAM pool for evicted chunks (0 = off, the
+    # single-tier behavior byte-for-byte).
+    l2_budget_bytes: int = 0
 
 
 class _Node:
@@ -80,6 +92,8 @@ class RadixPrefixCache:
         budget_bytes: int,
         chunk_tokens: int,
         on_evict: Callable[[int], None] | None = None,
+        l2_budget_bytes: int = 0,
+        on_l2_event: Callable[[str], None] | None = None,
     ):
         if budget_bytes <= 0:
             raise ValueError(
@@ -88,6 +102,10 @@ class RadixPrefixCache:
         if chunk_tokens <= 0:
             raise ValueError(
                 f"prefix cache chunk_tokens must be positive, got {chunk_tokens}"
+            )
+        if l2_budget_bytes < 0:
+            raise ValueError(
+                f"prefix cache L2 budget must be >= 0, got {l2_budget_bytes}"
             )
         self.budget_bytes = int(budget_bytes)
         self.chunk_tokens = int(chunk_tokens)
@@ -101,6 +119,30 @@ class RadixPrefixCache:
         self.lookups = 0
         self.evictions = 0
         self._tick = 0
+        # Second tier: cumulative-token-bytes -> (k, v, nbytes), LRU via
+        # OrderedDict order (hit -> move_to_end).  0 budget = disabled:
+        # every L2 code path below is behind `self.l2_budget_bytes`.
+        self.l2_budget_bytes = int(l2_budget_bytes)
+        self._on_l2_event = on_l2_event
+        self._l2: OrderedDict[bytes, tuple] = OrderedDict()
+        self.l2_bytes = 0
+        self.l2_hits = 0
+        self.l2_spills = 0
+        self.l2_evictions = 0
+
+    def _note_l2(self, kind: str) -> None:
+        if self._on_l2_event is not None:
+            self._on_l2_event(kind)
+
+    def _cum_key(self, node: _Node) -> bytes:
+        """Cumulative token bytes of ``node``'s whole prefix (root path).
+        Walked on demand — only spill/promote pay it, never the hot
+        radix walk."""
+        parts = []
+        while node is not None and node.parent is not None:
+            parts.append(node.key)
+            node = node.parent
+        return b"".join(reversed(parts))
 
     # -- queries -------------------------------------------------------------
 
@@ -122,12 +164,43 @@ class RadixPrefixCache:
         out: list = []
         for i in range(max_chunks):
             child = node.children.get(_chunk_key(prompt, i, C))
+            if child is None and self.l2_budget_bytes:
+                # Second tier: an evicted chunk may still be in host RAM
+                # — promote it back into the tree so this admission (and
+                # every later one) re-seeds it through the device path.
+                child = self._promote_from_l2(prompt, i, node)
             if child is None:
                 break
             child.last_used = self._tick
             out.append(child.kv)
             node = child
         return len(out) * C, out
+
+    def _promote_from_l2(self, prompt: np.ndarray, idx: int, parent: _Node):
+        """L2 hit: move a spilled chunk back under its (present) parent
+        path.  Returns the re-attached node, or None on a miss — or when
+        the promotion itself was immediately re-evicted (a chunk larger
+        than the whole first tier)."""
+        C = self.chunk_tokens
+        cum = np.asarray(prompt[: (idx + 1) * C], np.int32).tobytes()
+        entry = self._l2.pop(cum, None)
+        if entry is None:
+            return None
+        k, v, nbytes = entry
+        self.l2_bytes -= nbytes
+        self.l2_hits += 1
+        self._note_l2("hit")
+        key = _chunk_key(prompt, idx, C)
+        child = _Node(key, (k, v), nbytes, parent)
+        child.last_used = self._tick
+        parent.children[key] = child
+        if parent is not self._root:
+            self._leaves.discard(parent)
+        self._leaves.add(child)
+        self.bytes += nbytes
+        while self.bytes > self.budget_bytes and self._evict_lru():
+            pass
+        return parent.children.get(key)
 
     # -- inserts / eviction --------------------------------------------------
 
@@ -176,6 +249,15 @@ class RadixPrefixCache:
             return False  # one chunk bigger than the whole pool
         child = _Node(key, (k, v), nbytes, node)
         child.last_used = self._tick
+        if self.l2_budget_bytes:
+            # A fresh insert supersedes any spilled copy of the SAME
+            # chunk still sitting in L2 (possible when an earlier chunk
+            # of the prompt aged out of the flat tier but deeper ones
+            # remain): purge it, or the duplicate squats on L2 budget
+            # until it ages out as a phantom eviction.
+            stale = self._l2.pop(self._cum_key(child), None)
+            if stale is not None:
+                self.l2_bytes -= stale[2]
         node.children[key] = child
         if node is not self._root:
             self._leaves.discard(node)  # gained a child: interior now
@@ -197,6 +279,8 @@ class RadixPrefixCache:
         victim = min(self._leaves, key=lambda n: (n.last_used, n.key))
         parent = victim.parent
         assert parent is not None
+        if self.l2_budget_bytes and victim.kv is not None:
+            self._spill_to_l2(victim)
         del parent.children[victim.key]
         self._leaves.discard(victim)
         if not parent.children and parent is not self._root:
@@ -206,6 +290,26 @@ class RadixPrefixCache:
         if self._on_evict is not None:
             self._on_evict(victim.nbytes)
         return True
+
+    def _spill_to_l2(self, victim: _Node) -> None:
+        """Move an evicted leaf's K/V into the flat second tier (keyed by
+        its CUMULATIVE token bytes — the node identity the tree encoded
+        positionally), LRU-bounded by its own byte budget."""
+        if victim.nbytes > self.l2_budget_bytes:
+            return  # one chunk bigger than the whole second tier
+        cum = self._cum_key(victim)
+        old = self._l2.pop(cum, None)
+        if old is not None:
+            self.l2_bytes -= old[2]
+        self._l2[cum] = (victim.kv[0], victim.kv[1], victim.nbytes)
+        self.l2_bytes += victim.nbytes
+        self.l2_spills += 1
+        self._note_l2("spill")
+        while self.l2_bytes > self.l2_budget_bytes:
+            _key, (_k, _v, nb) = self._l2.popitem(last=False)
+            self.l2_bytes -= nb
+            self.l2_evictions += 1
+            self._note_l2("evict")
 
     # -- introspection -------------------------------------------------------
 

@@ -274,6 +274,10 @@ class PrefixCacheSpec:
     enabled: bool = False
     budget_mb: int = 256
     chunk_tokens: int = 64
+    # Second-tier host-RAM pool: chunks the first tier evicts spill here
+    # (LRU under this budget) and promote back on a radix-walk miss.
+    # 0 — the default — is the single-tier behavior byte-for-byte.
+    l2_budget_mb: int = 0
 
     @classmethod
     def from_spec(
@@ -284,7 +288,7 @@ class PrefixCacheSpec:
         spec = spec or {}
         _reject_unknown_keys(
             spec,
-            frozenset({"enabled", "budgetMB", "chunkTokens"}),
+            frozenset({"enabled", "budgetMB", "chunkTokens", "l2BudgetMB"}),
             "spec.tpu.prefixCache",
         )
         enabled = bool(spec.get("enabled", False))
@@ -310,6 +314,7 @@ class PrefixCacheSpec:
             enabled=enabled,
             budget_mb=int(spec.get("budgetMB", 256)),
             chunk_tokens=chunk_tokens,
+            l2_budget_mb=int(spec.get("l2BudgetMB", 0)),
         )
 
     def __post_init__(self):
@@ -323,6 +328,11 @@ class PrefixCacheSpec:
                 raise ValueError(
                     "prefixCache.chunkTokens must be >= 1, got "
                     f"{self.chunk_tokens}"
+                )
+            if self.l2_budget_mb < 0:
+                raise ValueError(
+                    "prefixCache.l2BudgetMB must be >= 0, got "
+                    f"{self.l2_budget_mb}"
                 )
 
 
@@ -596,6 +606,205 @@ class AutoscalingSpec:
 
 
 @dataclass(frozen=True)
+class PrefixAffinitySpec:
+    """``spec.fleet.prefixAffinity``: route repeat prefixes to the decode
+    replica already holding their KV.
+
+    The router hashes the first ``tokens`` prompt ids onto a consistent-
+    hash ring over decode-role backends, so a shared template prefix
+    lands on the same replica every time — cache hit rate survives
+    scale-out instead of diluting 1/N per replica."""
+
+    enabled: bool = True
+    tokens: int = 64  # leading prompt ids hashed onto the decode ring
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "PrefixAffinitySpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec, frozenset({"enabled", "tokens"}), "spec.fleet.prefixAffinity"
+        )
+        return cls(
+            enabled=bool(spec.get("enabled", True)),
+            tokens=int(spec.get("tokens", 64)),
+        )
+
+    def __post_init__(self):
+        if self.enabled and not (1 <= self.tokens <= 4096):
+            raise ValueError(
+                f"fleet.prefixAffinity.tokens must be in [1, 4096], got "
+                f"{self.tokens}"
+            )
+
+
+@dataclass(frozen=True)
+class KvTransferSpec:
+    """``spec.fleet.kvTransfer``: the prefill→decode KV handoff relay.
+
+    ``retries`` is the number of ADDITIONAL prefill replicas the router
+    tries after the first export fails (total export attempts =
+    1 + retries) before falling back to unified serving — the decode
+    replica prefills locally: slower, never lost."""
+
+    enabled: bool = True
+    retries: int = 1
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "KvTransferSpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec, frozenset({"enabled", "retries"}), "spec.fleet.kvTransfer"
+        )
+        return cls(
+            enabled=bool(spec.get("enabled", True)),
+            retries=int(spec.get("retries", 1)),
+        )
+
+    def __post_init__(self):
+        if not (0 <= self.retries <= 8):
+            raise ValueError(
+                f"fleet.kvTransfer.retries must be in [0, 8], got "
+                f"{self.retries}"
+            )
+
+
+@dataclass(frozen=True)
+class FleetSpec:
+    """``spec.fleet``: disaggregated prefill/decode replica pools.
+
+    ``disaggregation: true`` splits the predictor into two pools — a
+    prefill-heavy one that computes prompt K/V and a decode-heavy one
+    that streams tokens — connected by the KV handoff relay
+    (``server/kv_transfer.py``) and fronted by the prefix-affinity
+    router.  Per-pool ``min``/``max`` bounds let the autoscaler size
+    each pool on its own signal (prefill: admission wait; decode:
+    queue depth / ITL) instead of one count serving two workloads.
+
+    Disabled (the default) keeps manifests, router behavior, and engine
+    ticks byte-for-byte what they were.
+    """
+
+    disaggregation: bool = False
+    prefill_replicas: int = 1
+    decode_replicas: int = 2
+    prefill_min_replicas: int = 1
+    prefill_max_replicas: int = 1
+    decode_min_replicas: int = 1
+    decode_max_replicas: int = 1
+    # Prefill pool's own scaling signal (0 = pool fixed at its count):
+    # admission wait p95 above this adds a prefill replica.
+    prefill_target_admission_wait_ms: float = 0.0
+    prefix_affinity: PrefixAffinitySpec = field(
+        default_factory=PrefixAffinitySpec
+    )
+    kv_transfer: KvTransferSpec = field(default_factory=KvTransferSpec)
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "FleetSpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec,
+            frozenset(
+                {
+                    "disaggregation", "prefillReplicas", "decodeReplicas",
+                    "prefillMinReplicas", "prefillMaxReplicas",
+                    "decodeMinReplicas", "decodeMaxReplicas",
+                    "prefillTargetAdmissionWaitMs",
+                    "prefixAffinity", "kvTransfer",
+                }
+            ),
+            "spec.fleet",
+        )
+        disagg = bool(spec.get("disaggregation", False))
+        prefill = int(spec.get("prefillReplicas", 1 if disagg else 0))
+        decode = int(spec.get("decodeReplicas", 2 if disagg else 0))
+        if not disagg:
+            # A pool size without the mode is a contradiction the CR
+            # author must resolve — silently ignoring it would leave
+            # them believing a prefill pool exists.
+            for key in (
+                "prefillReplicas", "decodeReplicas", "prefillMinReplicas",
+                "prefillMaxReplicas", "decodeMinReplicas",
+                "decodeMaxReplicas",
+            ):
+                if spec.get(key) is not None:
+                    raise ValueError(
+                        f"fleet.{key} requires fleet.disaggregation: true"
+                    )
+        return cls(
+            disaggregation=disagg,
+            prefill_replicas=prefill,
+            decode_replicas=decode,
+            prefill_min_replicas=int(
+                spec.get("prefillMinReplicas", min(1, prefill))
+            ),
+            prefill_max_replicas=int(
+                spec.get("prefillMaxReplicas", prefill)
+            ),
+            decode_min_replicas=int(
+                spec.get("decodeMinReplicas", min(1, decode))
+            ),
+            decode_max_replicas=int(spec.get("decodeMaxReplicas", decode)),
+            prefill_target_admission_wait_ms=float(
+                spec.get("prefillTargetAdmissionWaitMs", 0.0)
+            ),
+            prefix_affinity=PrefixAffinitySpec.from_spec(
+                spec.get("prefixAffinity")
+            ),
+            kv_transfer=KvTransferSpec.from_spec(spec.get("kvTransfer")),
+        )
+
+    def __post_init__(self):
+        if not self.disaggregation:
+            return
+        # Reject contradictions at reconcile time so they land in CR
+        # status, not as an empty pool serving 503s.
+        if self.prefill_replicas < 1:
+            raise ValueError(
+                "fleet.disaggregation requires prefillReplicas >= 1, got "
+                f"{self.prefill_replicas}"
+            )
+        if self.decode_replicas < 1:
+            raise ValueError(
+                "fleet.disaggregation requires decodeReplicas >= 1, got "
+                f"{self.decode_replicas}"
+            )
+        for label, lo, hi, count in (
+            (
+                "prefill", self.prefill_min_replicas,
+                self.prefill_max_replicas, self.prefill_replicas,
+            ),
+            (
+                "decode", self.decode_min_replicas,
+                self.decode_max_replicas, self.decode_replicas,
+            ),
+        ):
+            if lo < 0:
+                raise ValueError(
+                    f"fleet.{label}MinReplicas must be >= 0, got {lo}"
+                )
+            if hi < 1:
+                raise ValueError(
+                    f"fleet.{label}MaxReplicas must be >= 1, got {hi}"
+                )
+            if lo > hi:
+                raise ValueError(
+                    f"fleet.{label}MinReplicas {lo} > {label}MaxReplicas "
+                    f"{hi}"
+                )
+            if not (lo <= count <= hi):
+                raise ValueError(
+                    f"fleet.{label}Replicas {count} outside "
+                    f"[{label}MinReplicas {lo}, {label}MaxReplicas {hi}]"
+                )
+        if self.prefill_target_admission_wait_ms < 0:
+            raise ValueError(
+                "fleet.prefillTargetAdmissionWaitMs must be >= 0, got "
+                f"{self.prefill_target_admission_wait_ms}"
+            )
+
+
+@dataclass(frozen=True)
 class RolloutObservability:
     """``spec.observability``: rollout decision-journal surfacing on the CR.
 
@@ -813,6 +1022,12 @@ class ServerConfig:
     # cache using the snapshot manifest's geometry) but NO weights;
     # POST /admin/attach snapshot-restores a model on demand.
     warm_pool: bool = False
+    # Disaggregated-fleet role of this replica (server --fleet-role):
+    # "prefill" computes prompt K/V for handoff, "decode" receives
+    # handoffs and streams tokens, "unified" (the default) does both —
+    # advisory identity surfaced on /readyz and in logs; the KV
+    # endpoints exist on every role (the router decides who does what).
+    fleet_role: str = "unified"
 
 
 @dataclass(frozen=True)
@@ -844,6 +1059,9 @@ class OperatorConfig:
     # SLO-driven replica autoscaling (operator/autoscaler.py); disabled
     # default = manifests and status byte-for-byte unchanged.
     autoscaling: AutoscalingSpec = field(default_factory=AutoscalingSpec)
+    # Disaggregated prefill/decode pools with KV handoff and prefix-
+    # affinity routing; disabled default = byte-for-byte.
+    fleet: FleetSpec = field(default_factory=FleetSpec)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
@@ -856,6 +1074,30 @@ class OperatorConfig:
             raise ValueError(f"spec.backend must be 'seldon' or 'tpu', got {backend!r}")
         tpu = TpuSpec.from_spec(spec.get("tpu"))
         autoscaling = AutoscalingSpec.from_spec(spec.get("autoscaling"))
+        fleet = FleetSpec.from_spec(spec.get("fleet"))
+        if fleet.disaggregation:
+            if backend != "tpu":
+                raise ValueError(
+                    "fleet.disaggregation requires backend: tpu (the "
+                    "Seldon backend has no KV handoff data plane)"
+                )
+            if not tpu.prefix_cache.enabled:
+                # The handoff wire format IS the radix cache's chunk —
+                # without the cache there is nothing to export, seed, or
+                # route affinity for.
+                raise ValueError(
+                    "fleet.disaggregation requires spec.tpu.prefixCache."
+                    "enabled (handed-off K/V re-enters the decode replica "
+                    "through the radix prefix cache's seed path)"
+                )
+            if fleet.prefill_min_replicas == 0 and not tpu.snapshot.enabled:
+                raise ValueError(
+                    "fleet.prefillMinReplicas: 0 requires spec.tpu."
+                    "snapshot.enabled (a prefill pool woken from zero "
+                    "must restore pre-baked weights while the cold "
+                    "prompt waits; without a snapshot it pays the full "
+                    "cold load)"
+                )
         if (
             autoscaling.enabled
             and autoscaling.min_replicas == 0
@@ -908,6 +1150,16 @@ class OperatorConfig:
                     "scale out with more MlflowModel CRs or a larger "
                     "slice"
                 )
+            if info.hosts > 1 and fleet.disaggregation:
+                # A pool replica is one pod; a multi-host unit is N pods
+                # forming one process group — neither pool machinery nor
+                # the per-replica KV handoff models that.
+                raise ValueError(
+                    f"fleet.disaggregation with multi-host topology "
+                    f"{tpu.topology!r} is not supported: pools scale "
+                    "single-host replicas; use a larger slice or more "
+                    "MlflowModel CRs"
+                )
             if info.hosts > 1 and (
                 autoscaling.min_replicas == 0
                 or autoscaling.warm_pool_size > 0
@@ -941,4 +1193,5 @@ class OperatorConfig:
                 spec.get("observability")
             ),
             autoscaling=autoscaling,
+            fleet=fleet,
         )

@@ -13,6 +13,7 @@ from tpumlops.operator.autoscaler import (
     HOLD_COOLDOWN,
     HOLD_METRICS_MISSING,
     HOLD_STABILIZATION,
+    ScaleRecord,
     ScalerState,
     decide,
 )
@@ -604,3 +605,270 @@ def test_enabling_autoscaling_journals_the_adoption_jump():
     out = reconcile(kube, rec)
     assert out.state.replicas == 2
     assert out.scale.hold == HOLD_COOLDOWN
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated fleet: per-pool decisions (decide_fleet)
+# ---------------------------------------------------------------------------
+
+
+def fleet_spec(**kw):
+    from tpumlops.utils.config import FleetSpec
+
+    base = dict(
+        disaggregation=True,
+        prefill_replicas=1,
+        decode_replicas=2,
+        prefill_min_replicas=1,
+        prefill_max_replicas=4,
+        decode_min_replicas=1,
+        decode_max_replicas=8,
+        prefill_target_admission_wait_ms=200.0,
+    )
+    base.update(kw)
+    return FleetSpec(**base)
+
+
+def test_fleet_pools_scale_on_their_own_signals():
+    from tpumlops.operator.autoscaler import decide_fleet
+
+    auto = spec(target_queue_depth_per_replica=4.0)
+    # Prefill pool: admission wait over budget; decode pool: deep queue.
+    d = decide_fleet(
+        auto, fleet_spec(), None,
+        metrics(wait=500.0),              # prefill: 500ms > 200ms target
+        metrics(qd=16.0),                 # decode: 16 / 4-per-replica = 4
+        now_wall=1000.0,
+    )
+    assert d.prefill.replicas == 2       # +1 on latency pressure
+    assert d.decode.replicas == 4
+    assert d.prefill.record.pool == "prefill"
+    assert d.decode.record.pool == "decode"
+    assert d.prefill.record.as_dict()["pool"] == "prefill"
+    st = d.to_status(None)
+    assert st["prefillReplicas"] == 2 and st["decodeReplicas"] == 4
+
+    # Next evaluation resumes from the persisted status.
+    d2 = decide_fleet(
+        auto, fleet_spec(), st,
+        metrics(wait=50.0), metrics(qd=16.0), now_wall=1001.0,
+    )
+    assert d2.prefill.replicas == 2      # below target: held (cooldown)
+    assert d2.decode.replicas == 4
+
+
+def test_fleet_blind_pools_hold():
+    from tpumlops.operator.autoscaler import decide_fleet
+
+    auto = spec(target_queue_depth_per_replica=4.0)
+    status = {"prefillReplicas": 3, "decodeReplicas": 5}
+    d = decide_fleet(auto, fleet_spec(), status, None, None, 1000.0)
+    assert d.prefill.replicas == 3 and d.decode.replicas == 5
+    assert d.prefill.record.hold == HOLD_METRICS_MISSING
+    assert d.decode.record.hold == HOLD_METRICS_MISSING
+
+
+def test_fleet_prefill_pool_fixed_without_wait_target():
+    from tpumlops.operator.autoscaler import decide_fleet
+
+    auto = spec(target_queue_depth_per_replica=4.0)
+    d = decide_fleet(
+        auto,
+        fleet_spec(prefill_target_admission_wait_ms=0.0),
+        None,
+        metrics(wait=10_000.0),  # screaming — but the pool is fixed
+        metrics(qd=0.0),
+        1000.0,
+    )
+    assert d.prefill.replicas == 1
+    assert d.prefill.record is None
+
+
+def test_fleet_decode_cooldown_steps_one():
+    from tpumlops.operator.autoscaler import decide_fleet
+
+    auto = spec(target_queue_depth_per_replica=4.0, scale_down_cooldown_s=60.0)
+    status = {
+        "prefillReplicas": 1,
+        "decodeReplicas": 6,
+        "decodeScaler": {"lastScaleTime": 1000.0},
+    }
+    # Idle decode pool inside the cooldown: held.
+    d = decide_fleet(
+        auto, fleet_spec(), status, metrics(wait=10.0), metrics(qd=0.0),
+        1030.0,
+    )
+    assert d.decode.replicas == 6
+    assert d.decode.record.hold == HOLD_COOLDOWN
+    # Past the cooldown: ONE step down, never straight to the floor.
+    d = decide_fleet(
+        auto, fleet_spec(), status, metrics(wait=10.0), metrics(qd=0.0),
+        1061.0,
+    )
+    assert d.decode.replicas == 5
+
+
+def test_fleet_prefill_pool_reaches_zero_and_wakes_on_decode_backlog():
+    """The validated prefillMinReplicas: 0 knob must actually engage.
+
+    A pool's mapped metrics carry parked=0.0 whenever the wait series
+    answers — the wake signal for a POOL is the decode backlog (below),
+    observable exactly when live pods are — so decide()'s park-visibility
+    guard must not pin the pool at 1 forever."""
+    from tpumlops.operator.autoscaler import decide_fleet
+
+    auto = spec(target_queue_depth_per_replica=4.0, scale_down_cooldown_s=60.0)
+    fs = fleet_spec(prefill_min_replicas=0)
+    status = {
+        "prefillReplicas": 1,
+        "decodeReplicas": 2,
+        "prefillScaler": {"lastScaleTime": 1000.0},
+    }
+    # Idle prefill pool past the cooldown: the LAST step to zero lands.
+    d = decide_fleet(
+        auto, fs, status, metrics(wait=10.0), metrics(qd=0.0), 1061.0
+    )
+    assert d.prefill.replicas == 0
+    assert d.prefill.record.hold is None
+
+    # At zero with an idle decode pool: stays parked (no wake evidence).
+    st = d.to_status(status)
+    d2 = decide_fleet(auto, fs, st, None, metrics(qd=0.0), 1122.0)
+    assert d2.prefill.replicas == 0
+
+    # Decode backlog = users already waiting (cold prompts falling back
+    # to unified prefill on decode chips): wake 0->1, no stabilization.
+    d3 = decide_fleet(auto, fs, st, None, metrics(qd=3.0), 1123.0)
+    assert d3.prefill.replicas == 1
+    assert "wake from zero" in d3.prefill.record.reason
+    assert d3.prefill.record.pool == "prefill"
+
+
+def test_plain_scale_record_omits_pool_key():
+    """Pre-fleet journal records must stay byte-for-byte: no pool key
+    unless a pool produced the record."""
+    rec = ScaleRecord(wall=5.0, from_replicas=1, to_replicas=2, desired=2)
+    assert "pool" not in rec.as_dict()
+
+
+# ---------------------------------------------------------------------------
+# Reconciler integration: disaggregated pools scale independently
+# ---------------------------------------------------------------------------
+
+
+FLEET_SPEC = {
+    "backend": "tpu",
+    "tpu": {
+        "tpuTopology": "v5e-1",
+        "meshShape": {"dp": 1, "tp": 1},
+        "prefixCache": {"enabled": True},
+    },
+    "fleet": {
+        "disaggregation": True,
+        "prefillReplicas": 1,
+        "prefillMaxReplicas": 3,
+        "decodeReplicas": 2,
+        "decodeMaxReplicas": 6,
+        "prefillTargetAdmissionWaitMs": 200,
+    },
+    "autoscaling": AUTOSCALE,
+    "observability": {"historyLimit": 16},
+}
+
+
+def _pool_deployment(kube, name):
+    ref = ObjectRef(
+        namespace="ns", name=name, group="apps", version="v1",
+        plural="deployments",
+    )
+    return kube.get(ref)
+
+
+def test_fleet_pools_materialize_and_scale_independently():
+    kube, registry, fm, clock, rec, wall = make_world(FLEET_SPEC)
+    reconcile(kube, rec)  # v1 -> Stable; pools materialize at spec counts
+    assert _pool_deployment(kube, "m-v1-prefill")["spec"]["replicas"] == 1
+    assert _pool_deployment(kube, "m-v1-decode")["spec"]["replicas"] == 2
+    labels = _pool_deployment(kube, "m-v1-decode")["metadata"]["labels"]
+    assert labels["tpumlops/fleet-role"] == "decode"
+
+    # Decode backlog + prefill admission-wait pressure: each pool moves
+    # on ITS OWN signal.
+    fm.set_engine_metrics(
+        "m", "v1-decode", "ns", EngineMetrics(queue_depth=9)
+    )
+    fm.set_engine_metrics(
+        "m", "v1-prefill", "ns",
+        EngineMetrics(admission_wait_p95_ms=800.0),
+    )
+    out = reconcile(kube, rec)
+    status = kube.get(CR)["status"]
+    assert status["fleet"]["decodeReplicas"] == 5  # ceil(9/2)
+    assert status["fleet"]["prefillReplicas"] == 2  # +1 latency pressure
+    assert _pool_deployment(kube, "m-v1-decode")["spec"]["replicas"] == 5
+    assert _pool_deployment(kube, "m-v1-prefill")["spec"]["replicas"] == 2
+    pool_recs = [
+        r for r in status["history"]
+        if r["kind"] == "scale" and r.get("pool")
+    ]
+    assert {r["pool"] for r in pool_recs} == {"prefill", "decode"}
+    assert "FleetScaled" in kube.event_reasons()
+    assert out.state.fleet["decodeReplicas"] == 5
+
+    # Decode drains while prefill stays saturated: decode steps down
+    # one per cooldown while prefill KEEPS GROWING on its own signal —
+    # the pools genuinely move independently.
+    fm.set_engine_metrics(
+        "m", "v1-decode", "ns", EngineMetrics(queue_depth=0)
+    )
+    wall[0] += 61
+    reconcile(kube, rec)
+    status = kube.get(CR)["status"]
+    assert status["fleet"]["decodeReplicas"] == 4
+    assert status["fleet"]["prefillReplicas"] == 3
+
+
+def test_fleet_status_cleared_when_disaggregation_disabled():
+    kube, registry, fm, clock, rec, wall = make_world(FLEET_SPEC)
+    reconcile(kube, rec)
+    fm.set_engine_metrics(
+        "m", "v1-decode", "ns", EngineMetrics(queue_depth=9)
+    )
+    reconcile(kube, rec)
+    assert kube.get(CR)["status"]["fleet"]["decodeReplicas"] == 5
+    # Disaggregation off: status.fleet clears, pool Deployments are GC'd.
+    obj = kube.get(CR)
+    spec = dict(obj["spec"])
+    spec.pop("fleet")
+    kube.replace(CR, {**obj, "spec": spec})
+    reconcile(kube, rec)
+    assert kube.get(CR)["status"].get("fleet") is None
+    import pytest as _pytest
+
+    from tpumlops.clients.base import NotFound
+
+    with _pytest.raises(NotFound):
+        _pool_deployment(kube, "m-v1-decode")
+
+
+def test_fleet_status_cleared_when_autoscaling_disabled():
+    """Switching autoscaling off hands the pool counts back to
+    spec.fleet: a stale status.fleet must not pin the pools at the
+    autoscaler's last counts through later spec edits."""
+    kube, registry, fm, clock, rec, wall = make_world(FLEET_SPEC)
+    reconcile(kube, rec)
+    fm.set_engine_metrics(
+        "m", "v1-decode", "ns", EngineMetrics(queue_depth=9)
+    )
+    reconcile(kube, rec)
+    assert kube.get(CR)["status"]["fleet"]["decodeReplicas"] == 5
+    assert _pool_deployment(kube, "m-v1-decode")["spec"]["replicas"] == 5
+
+    obj = kube.get(CR)
+    spec_d = dict(obj["spec"])
+    spec_d["autoscaling"] = {**dict(spec_d["autoscaling"]), "enabled": False}
+    kube.replace(CR, {**obj, "spec": spec_d})
+    reconcile(kube, rec)
+    assert kube.get(CR)["status"].get("fleet") is None
+    assert _pool_deployment(kube, "m-v1-decode")["spec"]["replicas"] == 2
+    assert _pool_deployment(kube, "m-v1-prefill")["spec"]["replicas"] == 1

@@ -227,7 +227,8 @@ for name in ("bench_xgboost", "bench_resnet", "bench_prefix_cache",
              "bench_speculative", "bench_multistep",
              "bench_packed_prefill",
              "bench_observability", "bench_device_telemetry",
-             "bench_admission_control",
+             "bench_admission_control", "bench_cold_start",
+             "bench_disaggregated",
              "bench_llama_decode", "bench_serve_path",
              "bench_llama_7b_decode"):
     setattr(bench, name, {tail_fn})

@@ -290,6 +290,20 @@ def test_snapshot_flag_and_volume_emitted_only_when_enabled():
     )
 
 
+def test_prefix_cache_l2_flag_emitted_only_when_budgeted():
+    """spec.tpu.prefixCache.l2BudgetMB must reach the pod args — the
+    operator-facing knob is otherwise silently inert — while the default
+    0 keeps the manifest byte-for-byte."""
+    args = _pod_spec_of({"prefixCache": {"enabled": True}})["containers"][0][
+        "args"
+    ]
+    assert "--prefix-cache-l2-budget-mb" not in args
+    args = _pod_spec_of(
+        {"prefixCache": {"enabled": True, "l2BudgetMB": 512}}
+    )["containers"][0]["args"]
+    assert args[args.index("--prefix-cache-l2-budget-mb") + 1] == "512"
+
+
 def test_warm_pool_manifest_emitted_and_inert_by_default():
     from tpumlops.operator.builder import build_warm_pool_manifests
 
@@ -323,3 +337,119 @@ def test_warm_pool_manifest_emitted_and_inert_by_default():
     assert args[args.index("--snapshot-dir") + 1] == "/snaps"
     # The pool pod still pins the TPU (attach needs the chip).
     assert container["resources"]["limits"]["google.com/tpu"] == "1"
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode pool manifests (spec.fleet)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cfg(**fleet_extra):
+    return cfg(
+        backend="tpu",
+        tpu={
+            "tpuTopology": "v5e-1",
+            "meshShape": {"dp": 1, "tp": 1},
+            "prefixCache": {"enabled": True},
+        },
+        fleet={"disaggregation": True, "prefillReplicas": 1,
+               "decodeReplicas": 2, **fleet_extra},
+    )
+
+
+def test_fleet_pool_manifests_shape_and_roles():
+    from tpumlops.operator.builder import build_fleet_pool_manifests
+
+    out = build_fleet_pool_manifests(
+        "llm", "models", "uid-1", _fleet_cfg(), "3", "s3://x"
+    )
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in out]
+    assert kinds == [
+        ("Deployment", "llm-v3-prefill"),
+        ("Service", "llm-v3-prefill"),
+        ("Deployment", "llm-v3-decode"),
+        ("Service", "llm-v3-decode"),
+    ]
+    by_name = {m["metadata"]["name"]: m for m in out if m["kind"] == "Deployment"}
+    assert by_name["llm-v3-prefill"]["spec"]["replicas"] == 1
+    assert by_name["llm-v3-decode"]["spec"]["replicas"] == 2
+    for pool in ("prefill", "decode"):
+        dep = by_name[f"llm-v3-{pool}"]
+        labels = dep["metadata"]["labels"]
+        assert labels["tpumlops/fleet-role"] == pool
+        assert labels["tpumlops/deployment"] == "llm"
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        i = args.index("--fleet-role")
+        assert args[i + 1] == pool
+        # Pool pods export their OWN metric identity — the per-pool
+        # autoscaler reads v3-prefill/v3-decode series, and pool pods
+        # must not pollute the unified predictor's summed signals.
+        j = args.index("--predictor-name")
+        assert args[j + 1] == f"v3-{pool}"
+        # The pools run the prefix cache (the handoff wire format).
+        assert "--prefix-cache" in args
+        assert dep["metadata"]["ownerReferences"][0]["name"] == "llm"
+
+
+def test_fleet_routing_annotations_on_manifest():
+    """The routing manifest is the router-wiring contract (like traffic
+    weights): affinity/handoff knobs + pool Service names ride as
+    annotations; absent entirely when disaggregation is off."""
+    manifest = build_deployment(
+        name="llm", namespace="models", owner_uid="uid-1",
+        config=_fleet_cfg(
+            prefixAffinity={"tokens": 128}, kvTransfer={"retries": 2}
+        ),
+        current_version="3", new_model_uri="s3://x", traffic_current=100,
+    )
+    ann = manifest["metadata"]["annotations"]
+    assert ann["tpumlops.dev/fleet-disaggregation"] == "true"
+    assert ann["tpumlops.dev/fleet-prefill-service"] == "llm-v3-prefill"
+    assert ann["tpumlops.dev/fleet-decode-service"] == "llm-v3-decode"
+    assert ann["tpumlops.dev/fleet-affinity-tokens"] == "128"
+    assert ann["tpumlops.dev/fleet-kv-retries"] == "2"
+    plain = build_deployment(
+        name="llm", namespace="models", owner_uid="uid-1",
+        config=cfg(
+            backend="tpu",
+            tpu={"tpuTopology": "v5e-1", "meshShape": {"dp": 1, "tp": 1}},
+        ),
+        current_version="3", new_model_uri="s3://x", traffic_current=100,
+    )
+    assert not any(
+        k.startswith("tpumlops.dev/fleet-")
+        for k in plain["metadata"]["annotations"]
+    )
+
+
+def test_fleet_pool_autoscaler_counts_override_spec():
+    from tpumlops.operator.builder import build_fleet_pool_manifests
+
+    out = build_fleet_pool_manifests(
+        "llm", "models", "uid-1", _fleet_cfg(), "3", "s3://x",
+        prefill_replicas=2, decode_replicas=5,
+    )
+    by_name = {m["metadata"]["name"]: m for m in out if m["kind"] == "Deployment"}
+    assert by_name["llm-v3-prefill"]["spec"]["replicas"] == 2
+    assert by_name["llm-v3-decode"]["spec"]["replicas"] == 5
+
+
+def test_fleet_disabled_emits_nothing_and_manifest_byte_identical():
+    """Default-off contract: no fleet block = no pool manifests AND the
+    routing manifest is byte-for-byte what it was before spec.fleet
+    existed."""
+    from tpumlops.operator.builder import build_fleet_pool_manifests
+
+    base = dict(
+        backend="tpu",
+        tpu={"tpuTopology": "v5e-1", "meshShape": {"dp": 1, "tp": 1}},
+    )
+    assert build_fleet_pool_manifests(
+        "llm", "models", "uid-1", cfg(**base), "3", "s3://x"
+    ) == []
+    kwargs = dict(
+        name="llm", namespace="models", owner_uid="uid-1",
+        config=cfg(**base), current_version="3",
+        new_model_uri="s3://x", traffic_current=100,
+    )
+    assert build_deployment(**kwargs) == build_deployment(**kwargs)

@@ -435,3 +435,125 @@ def test_tpu_admission_and_drain_knobs():
         TpuSpec.from_spec({"admissionQueueBudget": -1})
     with pytest.raises(ValueError, match="drainGraceSeconds"):
         TpuSpec.from_spec({"drainGraceSeconds": -0.5})
+
+
+# ---------------------------------------------------------------------------
+# spec.fleet: disaggregated prefill/decode pools
+# ---------------------------------------------------------------------------
+
+
+def _fleet_spec(fleet=None, tpu=None, **extra):
+    base_tpu = {
+        "meshShape": {"dp": 1, "tp": 1},
+        "tpuTopology": "v5e-1",
+        "prefixCache": {"enabled": True},
+    }
+    base_tpu.update(tpu or {})
+    return minimal_spec(backend="tpu", tpu=base_tpu, fleet=fleet, **extra)
+
+
+def test_fleet_defaults_off_and_parsing():
+    cfg = OperatorConfig.from_spec(minimal_spec())
+    assert not cfg.fleet.disaggregation
+    cfg = OperatorConfig.from_spec(
+        _fleet_spec(
+            fleet={
+                "disaggregation": True,
+                "prefillReplicas": 2,
+                "decodeReplicas": 4,
+                "decodeMaxReplicas": 8,
+                "prefillTargetAdmissionWaitMs": 250,
+                "prefixAffinity": {"tokens": 128},
+                "kvTransfer": {"retries": 2},
+            }
+        )
+    )
+    assert cfg.fleet.disaggregation
+    assert cfg.fleet.prefill_replicas == 2
+    assert cfg.fleet.decode_replicas == 4
+    assert cfg.fleet.decode_max_replicas == 8
+    assert cfg.fleet.prefill_target_admission_wait_ms == 250
+    assert cfg.fleet.prefix_affinity.tokens == 128
+    assert cfg.fleet.kv_transfer.retries == 2
+
+
+def test_fleet_pool_sizes_require_disaggregation():
+    """The ISSUE's first typed rejection: prefillReplicas > 0 without
+    disaggregation: true is a contradiction, not a silent no-op."""
+    with pytest.raises(ValueError, match="disaggregation"):
+        OperatorConfig.from_spec(_fleet_spec(fleet={"prefillReplicas": 2}))
+    with pytest.raises(ValueError, match="disaggregation"):
+        OperatorConfig.from_spec(_fleet_spec(fleet={"decodeReplicas": 3}))
+
+
+def test_fleet_disaggregation_rejected_on_multihost():
+    with pytest.raises(ValueError, match="multi-host"):
+        OperatorConfig.from_spec(
+            _fleet_spec(
+                fleet={"disaggregation": True},
+                tpu={"tpuTopology": "v5e-16", "meshShape": {"tp": 16}},
+            )
+        )
+
+
+def test_fleet_prefill_scale_to_zero_requires_snapshot():
+    """The ISSUE's third rejection: a prefill pool allowed to reach zero
+    without a restorable snapshot would make every cold prompt wait out
+    a full cold load on wake."""
+    with pytest.raises(ValueError, match="snapshot"):
+        OperatorConfig.from_spec(
+            _fleet_spec(
+                fleet={"disaggregation": True, "prefillMinReplicas": 0}
+            )
+        )
+    # With snapshots it parses.
+    cfg = OperatorConfig.from_spec(
+        _fleet_spec(
+            fleet={"disaggregation": True, "prefillMinReplicas": 0},
+            tpu={"snapshot": {"enabled": True}},
+        )
+    )
+    assert cfg.fleet.prefill_min_replicas == 0
+
+
+def test_fleet_requires_prefix_cache():
+    with pytest.raises(ValueError, match="prefixCache"):
+        OperatorConfig.from_spec(
+            _fleet_spec(
+                fleet={"disaggregation": True},
+                tpu={"prefixCache": {"enabled": False}},
+            )
+        )
+
+
+def test_fleet_band_and_unknown_key_validation():
+    with pytest.raises(ValueError, match="decodeMinReplicas"):
+        OperatorConfig.from_spec(
+            _fleet_spec(
+                fleet={
+                    "disaggregation": True,
+                    "decodeReplicas": 1,
+                    "decodeMinReplicas": 3,
+                    "decodeMaxReplicas": 4,
+                }
+            )
+        )
+    with pytest.raises(ValueError, match="unknown key"):
+        OperatorConfig.from_spec(
+            _fleet_spec(fleet={"disaggregation": True, "prefilReplicas": 1})
+        )
+    with pytest.raises(ValueError, match="tokens"):
+        OperatorConfig.from_spec(
+            _fleet_spec(
+                fleet={
+                    "disaggregation": True,
+                    "prefixAffinity": {"tokens": 0},
+                }
+            )
+        )
+    with pytest.raises(ValueError, match="retries"):
+        OperatorConfig.from_spec(
+            _fleet_spec(
+                fleet={"disaggregation": True, "kvTransfer": {"retries": 9}}
+            )
+        )

@@ -26,12 +26,16 @@ def _chrome_invariants(doc: dict) -> None:
         assert isinstance(e["ph"], str)
         assert isinstance(e["ts"], int) if "ts" in e else True
         assert e.get("pid") == 1 or e["ph"] == "M"
-    # Complete (tick) events: non-negative duration, tid 0 (engine track).
+    # Complete events: engine ticks on tid 0, or a relayed request's
+    # kv-handoff span on its row track. Non-negative durations on both.
     ticks = [e for e in events if e["ph"] == "X"]
     for t in ticks:
         assert t["dur"] >= 0
-        assert t["tid"] == 0
-        assert t["cat"] == "tick"
+        assert t["cat"] in ("tick", "handoff")
+        if t["cat"] == "tick":
+            assert t["tid"] == 0
+        else:
+            assert t["name"] == "kv-handoff"
     # Async request spans: every begin pairs with exactly one end of the
     # same id, end never precedes begin, and both sit on the same track.
     begins = {e["id"]: e for e in events if e["ph"] == "b"}
@@ -88,6 +92,46 @@ def test_request_trace_timing_block_math():
     assert block["finish_reason"] == "eos"
     # Unset endpoints report None, never a negative delta.
     assert RequestTrace("x").timing_block()["ttft_ms"] is None
+
+
+def test_kv_import_tick_and_handoff_stamps():
+    """Disaggregated-fleet relay reconstruction: the ``kv-import`` tick
+    kind journals like any engine tick, and a relayed request's trace
+    carries the router-measured handoff wall in its timing block."""
+    rec = FlightRecorder(capacity=16)
+    t0 = time.perf_counter()
+    rec.tick("kv-import", t0, 0.002, batch_fill=2, tokens=16)
+    snap = rec.snapshot()
+    tick = snap["ticks"][-1]
+    assert tick["kind"] == "kv-import"
+    assert tick["batch_fill"] == 2 and tick["tokens"] == 16
+    assert "steps" not in tick  # not a fused tick: record shape unchanged
+
+    tr = RequestTrace(request_id="relay-1")
+    tr.t_submit = t0
+    tr.t_handoff = t0 - 0.005
+    tr.handoff_ms = 12.5
+    tr.finish("length", t=t0 + 0.1)
+    assert tr.timing_block()["handoff_ms"] == 12.5
+    # Non-relayed requests carry None — the key exists, the value says
+    # "no handoff", and old assertions on other fields are untouched.
+    assert RequestTrace("x").timing_block()["handoff_ms"] is None
+    # The chrome export renders the kv-import tick on the engine track.
+    rec.complete(tr)
+    doc = rec.chrome_trace()
+    kinds = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "kv-import" in kinds
+    # ...and the receipt stamp anchors the router-measured handoff as a
+    # span on the request's track, ending at t_handoff.
+    spans = [
+        e
+        for e in doc["traceEvents"]
+        if e["ph"] == "X" and e["name"] == "kv-handoff"
+    ]
+    assert len(spans) == 1
+    assert spans[0]["dur"] == 12500
+    assert spans[0]["args"]["request_id"] == "relay-1"
+    _chrome_invariants(doc)
 
 
 def test_chrome_trace_is_valid_and_spans_pair_up():

@@ -1,0 +1,530 @@
+"""KV handoff (disaggregated prefill/decode fleets): wire format
+integrity, f64 token-for-token parity across engines, and the HTTP
+export/import endpoints.
+
+The acceptance bar is the ISSUE's: a prompt prefilled on replica A,
+KV-handed-off, and decoded on replica B must produce BIT-identical
+tokens to single-replica serving — including the int8kv round trip and
+the prefix-cache L2 re-seed path.  Parity runs in float64 so no backend
+fast-math can blur the comparison (same policy as test_prefix_cache).
+"""
+
+import numpy as np
+import pytest
+
+from tpumlops.server import kv_transfer
+from tpumlops.server.kv_transfer import (
+    KvTransferError,
+    chunk_token_ids,
+    deserialize_chunks,
+    serialize_chunks,
+)
+from tpumlops.server.prefix_cache import PrefixCacheConfig
+
+
+# ---------------------------------------------------------------------------
+# Wire format (pure host, fast tranche)
+# ---------------------------------------------------------------------------
+
+
+def _chunk_pair(seed: int, shape=(2, 1, 4, 2, 3), dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.standard_normal(shape).astype(dtype),
+        rng.standard_normal(shape).astype(dtype),
+    )
+
+
+def _blob(n_chunks=2, C=4):
+    prompt = np.arange(1, n_chunks * C + 2, dtype=np.int32)
+    chunks = [_chunk_pair(i) for i in range(n_chunks)]
+    return prompt, chunks, serialize_chunks(C, prompt, chunks)
+
+
+def test_wire_round_trip_is_exact():
+    prompt, chunks, blob = _blob()
+    header, out = deserialize_chunks(blob)
+    assert header["total_tokens"] == 8
+    assert header["chunk_tokens"] == 4
+    assert len(out) == 2
+    for (k0, v0), (k1, v1) in zip(chunks, out):
+        assert np.array_equal(k0, k1) and k0.dtype == k1.dtype
+        assert np.array_equal(v0, v1)
+    # Token ids round-trip for radix keying.
+    assert chunk_token_ids(header).tolist() == prompt[:8].tolist()
+
+
+def test_wire_rejects_corruption_and_truncation():
+    _, _, blob = _blob()
+    # Bad magic.
+    with pytest.raises(KvTransferError, match="magic"):
+        deserialize_chunks(b"NOPE" + blob[4:])
+    # Truncated payload.
+    with pytest.raises(KvTransferError, match="truncated"):
+        deserialize_chunks(blob[:-10])
+    # One flipped payload bit -> CRC mismatch, typed error.
+    corrupt = bytearray(blob)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(KvTransferError, match="CRC"):
+        deserialize_chunks(bytes(corrupt))
+    # Wrong format version.
+    import json as _json
+
+    head_len = int.from_bytes(blob[6:14], "little")
+    header = _json.loads(blob[14 : 14 + head_len])
+    header["format_version"] = 999
+    head2 = _json.dumps(header).encode()
+    blob2 = (
+        kv_transfer.MAGIC
+        + len(head2).to_bytes(8, "little")
+        + head2
+        + blob[14 + head_len :]
+    )
+    with pytest.raises(KvTransferError, match="format"):
+        deserialize_chunks(blob2)
+
+
+def test_wire_rejects_aliased_payload_offsets():
+    """Manifest entries must not alias the same payload bytes: the wire
+    cap bounds the blob, and only the serializer's sequential layout
+    makes it also bound the DECODED size (N entries over one region
+    would materialize N copies before any geometry check)."""
+    import json as _json
+
+    _, _, blob = _blob(n_chunks=2)
+    head_len = int.from_bytes(blob[6:14], "little")
+    header = _json.loads(blob[14 : 14 + head_len])
+    # Point chunk 1 back at chunk 0's bytes (CRCs stay consistent).
+    header["chunks"][1] = dict(
+        header["chunks"][0], tokens=header["chunks"][1]["tokens"]
+    )
+    head2 = _json.dumps(header).encode()
+    blob2 = (
+        kv_transfer.MAGIC
+        + len(head2).to_bytes(8, "little")
+        + head2
+        + blob[14 + head_len :]
+    )
+    with pytest.raises(KvTransferError, match="overlap"):
+        deserialize_chunks(blob2)
+
+
+def test_wire_rejects_shape_byte_count_mismatch():
+    """A CRC-consistent manifest whose kv_shape disagrees with the chunk
+    byte counts must fail TYPED — not leak numpy's ValueError past the
+    module's 'any structural problem raises KvTransferError' contract."""
+    import json as _json
+
+    _, _, blob = _blob()
+    head_len = int.from_bytes(blob[6:14], "little")
+    header = _json.loads(blob[14 : 14 + head_len])
+    header["kv_shape"] = [3, 1, 4, 2, 3]  # payload really holds [2,1,4,2,3]
+    head2 = _json.dumps(header).encode()
+    blob2 = (
+        kv_transfer.MAGIC
+        + len(head2).to_bytes(8, "little")
+        + head2
+        + blob[14 + head_len :]
+    )
+    with pytest.raises(KvTransferError, match="does not fit"):
+        deserialize_chunks(blob2)
+
+
+def test_serialize_rejects_mismatched_geometry():
+    prompt = np.arange(1, 10, dtype=np.int32)
+    good = _chunk_pair(0)
+    bad = _chunk_pair(1, shape=(2, 1, 4, 2, 5))
+    with pytest.raises(KvTransferError, match="geometry"):
+        serialize_chunks(4, prompt, [good, bad])
+    with pytest.raises(KvTransferError, match="no chunks"):
+        serialize_chunks(4, prompt, [])
+    with pytest.raises(KvTransferError, match="exceed"):
+        serialize_chunks(4, np.arange(4, dtype=np.int32), [good, good])
+
+
+def test_bfloat16_payload_round_trips():
+    import ml_dtypes
+
+    prompt = np.arange(1, 6, dtype=np.int32)
+    k, v = _chunk_pair(7)
+    k = k.astype(ml_dtypes.bfloat16)
+    v = v.astype(ml_dtypes.bfloat16)
+    blob = serialize_chunks(4, prompt, [(k, v)])
+    header, [(k2, v2)] = deserialize_chunks(blob)
+    assert header["dtype"] == "bfloat16"
+    assert k2.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert np.array_equal(k.view(np.uint16), k2.view(np.uint16))
+    assert np.array_equal(v.view(np.uint16), v2.view(np.uint16))
+
+
+# ---------------------------------------------------------------------------
+# Engine-to-engine handoff parity (tiny CPU llama, f64, slow tranche)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def x64():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
+@pytest.fixture(scope="module")
+def tiny(x64):
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.float64)
+    return params, cfg
+
+
+def _engine(params, cfg, **kw):
+    import jax.numpy as jnp
+
+    from tpumlops.server.generation import GenerationEngine
+
+    return GenerationEngine(
+        params, cfg, max_slots=2, dtype=jnp.float64,
+        prefix_cache=PrefixCacheConfig(
+            enabled=True, budget_bytes=1 << 22, chunk_tokens=8
+        ),
+        **kw,
+    )
+
+
+def _ref(params, cfg, prompt, n):
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+
+    out = llama.generate_greedy(
+        params, jnp.asarray([prompt], jnp.int32), n, cfg, dtype=jnp.float64
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def _handoff(src, dst, prompt):
+    """Prefill ``prompt`` on ``src``, export, wire round-trip, import on
+    ``dst``.  Returns the tokens the handoff covered."""
+    prompt = np.asarray(prompt, np.int32)
+    covered = src.exportable_prefix_tokens(prompt)
+    matched, chunks = src.export_prefix_kv(prompt)
+    if matched < covered:
+        src.generate(prompt, 1)  # populate via write-back
+        matched, chunks = src.export_prefix_kv(prompt)
+    assert matched == covered and chunks
+    blob = serialize_chunks(src._prefill_chunk_size, prompt, chunks)
+    header, wire_chunks = deserialize_chunks(blob)
+    return dst.import_prefix_kv(chunk_token_ids(header), wire_chunks)
+
+
+@pytest.mark.slow
+def test_handoff_tokens_bit_identical_to_local_serving(tiny):
+    """Prefill on A, hand off, decode on B: bit-identical to the greedy
+    reference AND B never recomputed the handed-off chunks."""
+    params, cfg = tiny
+    prompt = list(range(2, 22))  # 20 tokens; C=8 -> handoff covers 16
+    ref = _ref(params, cfg, prompt, 5)
+
+    a = _engine(params, cfg)
+    b = _engine(params, cfg)
+    a.start(warmup=True)
+    b.start(warmup=True)
+    try:
+        imported = _handoff(a, b, prompt)
+        assert imported == 16
+        chunks_before = b.prefill_chunks_dispatched
+        out = b.generate(prompt, 5).tolist()
+        chunks_spent = b.prefill_chunks_dispatched - chunks_before
+    finally:
+        a.shutdown()
+        b.shutdown()
+    assert out == ref
+    # Only the uncovered suffix chunk prefilled on B (3 chunks locally).
+    assert chunks_spent == 1
+    assert b.prefix_hits == 1 and b.prefix_cached_tokens == 16
+
+
+@pytest.mark.slow
+def test_handoff_parity_through_int8kv_round_trip(tiny):
+    """int8kv engines exchange DEQUANTIZED chunks (the lossless PR 3
+    round trip): a handed-off prefix must decode bit-identically to the
+    same engine's own warm (locally cached) serving."""
+    params, cfg = tiny
+    prompt = list(range(3, 21))  # 18 tokens -> 16 covered
+    a = _engine(params, cfg, kv_quant=True)
+    b = _engine(params, cfg, kv_quant=True)
+    local = _engine(params, cfg, kv_quant=True)
+    for e in (a, b, local):
+        e.start(warmup=True)
+    try:
+        local.generate(prompt, 1)  # populate local cache
+        ref_warm = local.generate(prompt, 6).tolist()
+        imported = _handoff(a, b, prompt)
+        assert imported == 16
+        out = b.generate(prompt, 6).tolist()
+    finally:
+        for e in (a, b, local):
+            e.shutdown()
+    assert out == ref_warm
+
+
+@pytest.mark.slow
+def test_handoff_parity_through_l2_reseed(tiny):
+    """The acceptance criterion's L2 leg: the imported prefix spills to
+    the second tier under L1 pressure, promotes back on lookup, and the
+    decode is still bit-identical to the reference."""
+    import jax.numpy as jnp
+
+    from tpumlops.server.generation import GenerationEngine
+
+    params, cfg = tiny
+    prompt = list(range(2, 22))
+    other = list(range(40, 60))  # disjoint 2-chunk prefix (L1 pressure)
+    ref = _ref(params, cfg, prompt, 5)
+    a = _engine(params, cfg)
+    # B's L1 fits ~2.5 chunks: the import lands whole, then the OTHER
+    # prompt's write-backs evict the imported chunks into the L2.
+    chunk_bytes = (
+        cfg.num_layers * 8 * cfg.num_kv_heads * cfg.head_dim * 8 * 2
+    )
+    b = GenerationEngine(
+        params, cfg, max_slots=2, dtype=jnp.float64,
+        prefix_cache=PrefixCacheConfig(
+            enabled=True,
+            budget_bytes=2 * chunk_bytes + chunk_bytes // 2,
+            chunk_tokens=8,
+            l2_budget_bytes=1 << 22,
+        ),
+    )
+    a.start(warmup=True)
+    b.start(warmup=True)
+    try:
+        imported = _handoff(a, b, prompt)
+        assert imported == 16
+        cache = b._prefix_cache
+        b.generate(other, 2)  # fresh write-backs spill the import to L2
+        assert cache.l2_spills >= 1
+        out = b.generate(prompt, 5).tolist()
+        assert cache.l2_hits >= 1
+    finally:
+        a.shutdown()
+        b.shutdown()
+    assert out == ref
+
+
+@pytest.mark.slow
+def test_export_requires_prefix_cache():
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.float32)
+    engine = GenerationEngine(params, cfg, max_slots=2, dtype=jnp.float32)
+    with pytest.raises(RuntimeError, match="prefix cache"):
+        engine.export_prefix_kv(np.arange(1, 20, dtype=np.int32))
+    with pytest.raises(RuntimeError, match="prefix cache"):
+        engine.import_prefix_kv(np.arange(1, 20, dtype=np.int32), [])
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints (live servers, slow tranche)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def kv_servers(tmp_path_factory):
+    """One prefill-role and one decode-role live server over the same
+    tiny llama artifact, prefix cache + flight recorder on."""
+    import asyncio
+    import threading
+    import time
+
+    import httpx
+    import jax
+    from aiohttp import web
+
+    from tpumlops.models import llama
+    from tpumlops.server.app import build_server
+    from tpumlops.server.loader import save_native_model
+    from tpumlops.utils.config import ServerConfig, TpuSpec
+
+    class _Handle:
+        def __init__(self, server, port):
+            self.server = server
+            self.port = port
+            self.base = f"http://127.0.0.1:{port}"
+            self._loop = asyncio.new_event_loop()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            asyncio.set_event_loop(self._loop)
+            self._runner = web.AppRunner(self.server.build_app())
+            self._loop.run_until_complete(self._runner.setup())
+            self._loop.run_until_complete(
+                web.TCPSite(self._runner, "127.0.0.1", self.port).start()
+            )
+            self._loop.run_forever()
+
+        def start(self):
+            self._thread.start()
+            for _ in range(200):
+                try:
+                    httpx.get(self.base + "/v2/health/live", timeout=0.5)
+                    return self
+                except Exception:
+                    time.sleep(0.05)
+            raise RuntimeError("server did not come up")
+
+        def stop(self):
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self.server.shutdown()
+
+    def _free_port():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(3), cfg)
+    art = tmp_path_factory.mktemp("kvart") / "llm"
+    save_native_model(
+        art,
+        "llama-generate",
+        params,
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+    )
+    tpu = {
+        "meshShape": {"tp": 1},
+        "maxBatchSize": 4,
+        "prefixCache": {"enabled": True, "chunkTokens": 8},
+        "observability": {"traceRing": 256},
+    }
+    handles = []
+    for role in ("prefill", "decode"):
+        server = build_server(
+            ServerConfig(
+                model_name="llm",
+                model_uri=str(art),
+                predictor_name=f"v1-{role}",
+                deployment_name="llm",
+                namespace="models",
+                tpu=TpuSpec.from_spec(tpu),
+                fleet_role=role,
+            )
+        )
+        handles.append(_Handle(server, _free_port()).start())
+    yield handles
+    for h in handles:
+        h.stop()
+
+
+@pytest.mark.slow
+def test_http_export_import_relay_round_trip(kv_servers):
+    import httpx
+
+    prefill, decode = kv_servers
+    prompt = list(range(2, 22))
+    # Local reference from the decode replica BEFORE any handoff.
+    ref = httpx.post(
+        decode.base + "/v2/models/llm/generate",
+        json={"prompt_ids": prompt, "max_new_tokens": 5},
+        timeout=120,
+    )
+    assert ref.status_code == 200, ref.text
+    ref_ids = ref.json()["outputs"][0]["data"]
+
+    # Roles surface on /readyz.
+    assert (
+        httpx.get(prefill.base + "/readyz", timeout=10).json()["fleetRole"]
+        == "prefill"
+    )
+
+    exp = httpx.post(
+        prefill.base + "/admin/kv/export",
+        json={"prompt_ids": prompt},
+        timeout=120,
+    )
+    assert exp.status_code == 200, exp.text
+    assert exp.headers["X-Tpumlops-Kv-Tokens"] == "16"
+    assert exp.headers["Content-Type"] == "application/octet-stream"
+
+    imp = httpx.post(
+        decode.base + "/admin/kv/import",
+        content=exp.content,
+        headers={"Content-Type": "application/octet-stream"},
+        timeout=120,
+    )
+    assert imp.status_code == 200, imp.text
+    assert imp.json() == {"imported_tokens": 16, "chunks": 2}
+
+    # The relayed request (handoff header stamped by the router).
+    out = httpx.post(
+        decode.base + "/v2/models/llm/generate",
+        json={"prompt_ids": prompt, "max_new_tokens": 5, "debug": True},
+        headers={
+            "X-Tpumlops-Handoff": "12.5",
+            "X-Request-Id": "relay-req-1",
+        },
+        timeout=120,
+    )
+    assert out.status_code == 200, out.text
+    assert out.json()["outputs"][0]["data"] == ref_ids
+    assert out.json()["timing"]["rows"][0]["handoff_ms"] == 12.5
+
+    # Reconstructable from /debug/trace alone: the kv-import tick is in
+    # the journal and the relayed request's trace carries handoff_ms.
+    eng = httpx.get(decode.base + "/debug/engine", timeout=30).json()
+    kinds = {t["kind"] for t in eng["ticks"]}
+    assert "kv-import" in kinds
+    relayed = [
+        r for r in eng["requests"] if r["request_id"] == "relay-req-1"
+    ]
+    assert relayed and relayed[0]["handoff_ms"] == 12.5
+
+
+@pytest.mark.slow
+def test_http_import_rejects_corrupt_and_mismatched_blobs(kv_servers):
+    import httpx
+
+    prefill, decode = kv_servers
+    prompt = list(range(30, 48))
+    exp = httpx.post(
+        prefill.base + "/admin/kv/export",
+        json={"prompt_ids": prompt},
+        timeout=120,
+    )
+    assert exp.status_code == 200
+    corrupt = bytearray(exp.content)
+    corrupt[-1] ^= 0xFF
+    imp = httpx.post(
+        decode.base + "/admin/kv/import", content=bytes(corrupt), timeout=60
+    )
+    assert imp.status_code == 400
+    assert imp.json()["reason"] == "bad_blob"
+    # A too-short prompt has no whole-chunk prefix to export.
+    short = httpx.post(
+        prefill.base + "/admin/kv/export",
+        json={"prompt_ids": [1, 2, 3]},
+        timeout=60,
+    )
+    assert short.status_code == 400
+    assert short.json()["reason"] == "prompt_too_short"

@@ -64,6 +64,11 @@ EXPECTED_SERVER = {
     "tpumlops_prefix_cache_cached_tokens": ("counter", _IDENT),
     "tpumlops_prefix_cache_evictions": ("counter", _IDENT),
     "tpumlops_prefix_cache_hits": ("counter", _IDENT),
+    # Second-tier (host-RAM) prefix cache (prefixCache.l2BudgetMB):
+    # spills caught from L1 eviction, promote-back hits, LRU age-outs.
+    "tpumlops_prefix_cache_l2_evictions": ("counter", _IDENT),
+    "tpumlops_prefix_cache_l2_hits": ("counter", _IDENT),
+    "tpumlops_prefix_cache_l2_spills": ("counter", _IDENT),
     "tpumlops_queue_seconds": ("histogram", _IDENT),
     "tpumlops_request_tokens": ("histogram", _IDENT),
     "tpumlops_spec_acceptance_rate": ("histogram", _IDENT),
@@ -155,6 +160,53 @@ def test_device_telemetry_families_absent_from_disabled_exposition():
 
 def test_operator_metric_families_are_pinned():
     assert _inventory(OperatorTelemetry()) == EXPECTED_OPERATOR
+
+
+def test_router_fleet_series_pinned():
+    """The router's first-party series are emitted by native/router.cc,
+    not prometheus_client — pin the full family inventory against a live
+    binary so a rename there fails HERE too (the affinity/handoff
+    dashboards in docs/OBSERVABILITY.md read these exact names)."""
+    import socket
+    import time
+
+    from tpumlops.clients.router import RouterProcess, parse_prometheus_text
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    router = RouterProcess(port=port, backends={}, deployment="d",
+                           namespace="n").start()
+    try:
+        names = set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not names:
+            parsed = parse_prometheus_text(router.admin.metrics_text())
+            names = {
+                name.replace("_bucket", "").replace("_sum", "")
+                .replace("_count", "")
+                for name, _ in parsed
+            }
+        # Per-BACKEND families (seldon_api_executor_*) emit only once a
+        # backend exists; their identity is pinned in tests/
+        # test_router.py.  This set is the backend-independent surface.
+        assert names == {
+            "tpumlops_router_proxied_total",
+            "tpumlops_router_parked_requests",
+            "tpumlops_router_parked_total",
+            "tpumlops_router_park_released_total",
+            "tpumlops_router_park_overflow_total",
+            "tpumlops_router_park_timeouts_total",
+            "tpumlops_router_park_wait_seconds",
+            # Disaggregated fleets: prefix-affinity ring + KV handoff.
+            "tpumlops_router_affinity_hits",
+            "tpumlops_router_affinity_misses",
+            "tpumlops_router_kv_handoff_bytes",
+            "tpumlops_router_kv_handoff_failures",
+            "tpumlops_router_kv_handoff_seconds",
+        }
+    finally:
+        router.stop()
 
 
 def test_gate_series_present_in_exposition():

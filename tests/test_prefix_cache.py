@@ -147,6 +147,86 @@ def test_oversized_chunk_and_bad_config_rejected():
 
 
 # ---------------------------------------------------------------------------
+# Second tier (host-RAM L2): spill on L1 eviction, promote on miss
+# ---------------------------------------------------------------------------
+
+
+def test_l2_catches_evictions_and_promotes_on_lookup():
+    events = []
+    cache = RadixPrefixCache(
+        budget_bytes=2 * 128, chunk_tokens=4,
+        l2_budget_bytes=1 << 20, on_l2_event=events.append,
+    )
+    pa = _chunks([1, 1, 1, 1], [0])
+    pb = _chunks([2, 2, 2, 2], [0])
+    pc = _chunks([3, 3, 3, 3], [0])
+    ka, va = _kv(64)
+    cache.insert_chunk(pa, 0, ka, va)
+    cache.insert_chunk(pb, 0, *_kv(64))
+    # Overflow: pa (LRU) spills into the L2 instead of vanishing.
+    cache.insert_chunk(pc, 0, *_kv(64))
+    assert cache.evictions == 1 and cache.l2_spills == 1
+    assert cache.l2_bytes == 128
+    assert events == ["spill"]
+    # The radix walk misses, the L2 serves, the chunk is BACK in the
+    # tree (and out of the L2) with its exact arrays.
+    n, kvs = cache.lookup(pa)
+    assert n == 4
+    assert kvs[0][0] is ka and kvs[0][1] is va
+    # Promotion freed pa's L2 entry and spilled the then-LRU (pb) down.
+    assert cache.l2_hits == 1 and cache.l2_bytes == 128
+    assert events == ["spill", "hit", "spill"]
+    # Promotion kept L1 within budget by spilling the then-LRU entry.
+    assert cache.bytes <= cache.budget_bytes
+
+
+def test_l2_lru_ages_out_under_its_own_budget():
+    cache = RadixPrefixCache(
+        budget_bytes=128, chunk_tokens=4, l2_budget_bytes=2 * 128
+    )
+    prompts = [_chunks([i, i, i, i], [0]) for i in range(1, 5)]
+    for p in prompts:
+        cache.insert_chunk(p, 0, *_kv(64))
+    # Each insert evicts the previous leaf into the L2; the L2 itself
+    # holds 2 entries, so the two oldest spills aged out.
+    assert cache.l2_spills == 3
+    assert cache.l2_evictions == 1
+    assert cache.l2_bytes == 2 * 128
+    # The aged-out chunk is gone from both tiers.
+    assert cache.lookup(prompts[0])[0] == 0
+    assert cache.l2_hits == 0
+    # A surviving spill still promotes.
+    assert cache.lookup(prompts[2])[0] == 4
+    assert cache.l2_hits == 1
+
+
+def test_l2_disabled_is_single_tier_byte_for_byte():
+    cache = RadixPrefixCache(budget_bytes=128, chunk_tokens=4)
+    pa = _chunks([1, 1, 1, 1], [0])
+    pb = _chunks([2, 2, 2, 2], [0])
+    cache.insert_chunk(pa, 0, *_kv(64))
+    cache.insert_chunk(pb, 0, *_kv(64))
+    cache.insert_chunk(_chunks([3, 3, 3, 3], [0]), 0, *_kv(64))
+    assert cache.evictions >= 1
+    assert cache.l2_spills == 0 and cache.l2_bytes == 0
+    assert cache.lookup(pa)[0] == 0  # evicted means GONE, no second tier
+
+
+def test_l2_spec_knob_parses_and_rejects_negatives():
+    from tpumlops.utils.config import TpuSpec
+
+    t = TpuSpec.from_spec(
+        {"prefixCache": {"enabled": True, "l2BudgetMB": 512}}
+    )
+    assert t.prefix_cache.l2_budget_mb == 512
+    assert TpuSpec.from_spec({}).prefix_cache.l2_budget_mb == 0
+    with pytest.raises(ValueError, match="l2BudgetMB"):
+        TpuSpec.from_spec(
+            {"prefixCache": {"enabled": True, "l2BudgetMB": -1}}
+        )
+
+
+# ---------------------------------------------------------------------------
 # Engine integration on the tiny CPU llama fixture (slow tranche)
 # ---------------------------------------------------------------------------
 

@@ -640,6 +640,382 @@ def test_park_buffer_zero_preserves_immediate_503(binary):
         srv.shutdown()
 
 
+# ---------------------------------------------------------------------------
+# Disaggregated fleets: role-tagged backends, prefix-affinity ring, and
+# the prefill -> import -> forward KV-handoff relay with typed fallback.
+# ---------------------------------------------------------------------------
+
+
+class _FleetBackend(_Echo):
+    """A stub fleet replica: answers /generate with its tag + the relay
+    headers it saw, serves a recognizable KV blob on /admin/kv/export,
+    and acknowledges /admin/kv/import (tallying what it received)."""
+
+    imports: list  # class-level, set per subclass in _fleet_backend
+    export_status = 200
+    export_delay_s = 0.0
+
+    def do_POST(self):  # noqa: N802
+        import time as _time
+
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if self.path == "/admin/kv/export":
+            self.exports.append(body)
+            if self.export_delay_s:
+                _time.sleep(self.export_delay_s)
+            if self.export_status != 200:
+                payload = b'{"error":"export refused"}'
+                self.send_response(self.export_status)
+            else:
+                payload = b"KVBLOB-" + self.tag.encode() + b"-" + body[:16]
+                self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        if self.path == "/admin/kv/import":
+            self.imports.append(body)
+            payload = b'{"imported_tokens":16}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        payload = json.dumps(
+            {
+                "who": self.tag,
+                "handoff": self.headers.get("X-Tpumlops-Handoff"),
+                "echo": body.decode() or None,
+            }
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+def _fleet_backend(tag: str, **attrs):
+    cls = type(
+        f"Fleet_{tag}",
+        (_FleetBackend,),
+        {"tag": tag, "imports": [], "exports": [], **attrs},
+    )
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1], cls
+
+
+def _gen(port: int, prompt, path="/v2/models/m/generate") -> dict:
+    return ask(port, path=path, body={"prompt_ids": prompt, "max_new_tokens": 4})
+
+
+@pytest.fixture()
+def fleet(binary):
+    """1 prefill + 2 decode replicas behind an affinity-routing router."""
+    servers = {}
+    classes = {}
+    ports = {}
+    for tag, role in (("p1", "prefill"), ("d1", "decode"), ("d2", "decode")):
+        srv, port, cls = _fleet_backend(tag)
+        servers[tag], ports[tag], classes[tag] = srv, port, cls
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "p1": ("127.0.0.1", ports["p1"], 100, "prefill"),
+            "d1": ("127.0.0.1", ports["d1"], 50, "decode"),
+            "d2": ("127.0.0.1", ports["d2"], 50, "decode"),
+        },
+        namespace="models",
+        deployment="fleet",
+        binary=binary,
+        affinity_tokens=8,
+    ).start()
+    yield router, servers, classes, ports
+    router.stop()
+    for srv in servers.values():
+        srv.shutdown()
+
+
+def test_affinity_relay_then_sticky_hit(fleet):
+    """Cold shared prefix: export -> import -> forward with the handoff
+    header; repeat prefix: direct forward to the SAME decode replica,
+    no second handoff."""
+    router, servers, classes, ports = fleet
+    prompt = [7, 7, 7, 7, 1, 2, 3]
+    first = _gen(router.port, prompt)
+    # Relayed: served by a decode backend, handoff header stamped.
+    assert first["who"] in ("d1", "d2")
+    assert first["handoff"] is not None and float(first["handoff"]) >= 0
+    target = first["who"]
+    assert len(classes[target].imports) == 1
+    assert classes[target].imports[0].startswith(b"KVBLOB-p1-")
+
+    st = router.admin.fleet()
+    assert st["affinity_misses"] == 1 and st["affinity_hits"] == 0
+    assert st["kv_handoffs"] == 1 and st["kv_handoff_failures"] == 0
+    assert st["kv_handoff_bytes"] > 0
+
+    # Same prefix again: sticky, no relay, no handoff header.
+    second = _gen(router.port, prompt)
+    assert second["who"] == target
+    assert second["handoff"] is None
+    st = router.admin.fleet()
+    assert st["affinity_hits"] == 1 and st["kv_handoffs"] == 1
+
+    # The new series are on the metric surface with identity labels.
+    mt = router.admin.metrics_text()
+    ident = 'deployment_name="fleet",namespace="models"'
+    assert f"tpumlops_router_affinity_hits{{{ident}}} 1" in mt
+    assert f"tpumlops_router_affinity_misses{{{ident}}} 1" in mt
+    assert f"tpumlops_router_kv_handoff_seconds_count{{{ident}}} 1" in mt
+    assert "tpumlops_router_kv_handoff_bytes{" in mt
+
+
+def test_affinity_ring_is_consistent_per_prefix(fleet):
+    """Distinct prefixes spread over the ring; each prefix is sticky."""
+    router, *_ = fleet
+    owners = {}
+    for seed in range(8):
+        prompt = [seed] * 8 + [1, 2]
+        owners[seed] = _gen(router.port, prompt)["who"]
+    for seed in range(8):
+        prompt = [seed] * 8 + [9, 9]  # same 8-token prefix, new suffix
+        assert _gen(router.port, prompt)["who"] == owners[seed]
+    st = router.admin.fleet()
+    assert st["affinity_hits"] == 8 and st["affinity_misses"] == 8
+
+
+def test_prefill_role_excluded_from_client_traffic(fleet):
+    """Non-generate traffic (and generate without a parseable prompt)
+    never lands on a prefill-role backend — its chips do prefill."""
+    router, *_ = fleet
+    for _ in range(10):
+        assert ask(router.port)["who"] in ("d1", "d2")
+    # Generate-shaped path but no prompt_ids: plain SWRR (still no p1).
+    out = ask(router.port, path="/v2/models/m/generate", body={"x": 1})
+    assert out["who"] in ("d1", "d2")
+
+
+def test_chaos_prefill_death_mid_handoff_falls_back_unified(fleet):
+    """The chaos bar: the prefill replica dies; cold prompts still serve
+    (unified fallback on the decode target), ZERO lost requests, and the
+    failure is counted — no 502/503 inside the retry-then-fallback path."""
+    router, servers, classes, ports = fleet
+    servers["p1"].shutdown()  # kill the prefill replica
+    servers["p1"].server_close()  # and its listening socket (RST, not hang)
+    results = []
+    for seed in range(6):
+        prompt = [100 + seed] * 8 + [1]
+        results.append(_gen(router.port, prompt))
+    assert all(r["who"] in ("d1", "d2") for r in results)
+    assert all(r["handoff"] is None for r in results)  # no handoff happened
+    st = router.admin.fleet()
+    assert st["kv_handoff_failures"] == 6
+    assert st["kv_handoffs"] == 0
+    # The fallback warmed the decode replicas' caches: repeats are hits.
+    again = _gen(router.port, [100] * 8 + [1])
+    assert again["who"] == results[0]["who"]
+    assert router.admin.fleet()["affinity_hits"] >= 1
+
+
+def test_export_refusal_retries_then_falls_back(binary):
+    """A prefill replica answering non-200 exports burns the retry
+    budget, then the request serves unified — typed 503 ONLY when no
+    decode capacity remains at fallback time."""
+    srv_p, port_p, _ = _fleet_backend("p1", export_status=500)
+    srv_d, port_d, cls_d = _fleet_backend("d1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "p1": ("127.0.0.1", port_p, 100, "prefill"),
+            "d1": ("127.0.0.1", port_d, 100, "decode"),
+        },
+        binary=binary,
+        affinity_tokens=8,
+        handoff_retries=1,
+    ).start()
+    try:
+        out = _gen(router.port, [5] * 8 + [1])
+        assert out["who"] == "d1" and out["handoff"] is None
+        assert cls_d.imports == []
+        assert router.admin.fleet()["kv_handoff_failures"] == 1
+    finally:
+        router.stop()
+        srv_p.shutdown()
+        srv_d.shutdown()
+
+
+def test_export_4xx_falls_back_without_retry_or_failure_count(binary):
+    """A 4xx export is DETERMINISTIC (the prompt itself is handoff-
+    ineligible: shorter than one radix chunk, multi-sequence body) —
+    every prefill replica would answer the same, so the router must fall
+    back to unified serving after ONE attempt and must not count a
+    kv_handoff_failure for a request that was never eligible."""
+    srv_p1, port_p1, cls_p1 = _fleet_backend("p1", export_status=400)
+    srv_p2, port_p2, cls_p2 = _fleet_backend("p2", export_status=400)
+    srv_d, port_d, _ = _fleet_backend("d1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "p1": ("127.0.0.1", port_p1, 50, "prefill"),
+            "p2": ("127.0.0.1", port_p2, 50, "prefill"),
+            "d1": ("127.0.0.1", port_d, 100, "decode"),
+        },
+        binary=binary,
+        affinity_tokens=8,
+        handoff_retries=3,
+    ).start()
+    try:
+        out = _gen(router.port, [5] * 8 + [1])
+        assert out["who"] == "d1" and out["handoff"] is None
+        assert len(cls_p1.exports) + len(cls_p2.exports) == 1
+        st = router.admin.fleet()
+        assert st["kv_handoff_failures"] == 0, st
+        # The fallback remembered the prefix: the repeat is an affinity
+        # hit, not another doomed relay.
+        out = _gen(router.port, [5] * 8 + [2])
+        assert out["who"] == "d1"
+        assert len(cls_p1.exports) + len(cls_p2.exports) == 1
+        assert router.admin.fleet()["affinity_hits"] >= 1
+    finally:
+        router.stop()
+        srv_p1.shutdown()
+        srv_p2.shutdown()
+        srv_d.shutdown()
+
+
+def test_handoff_failure_with_no_capacity_is_typed_503(binary):
+    """Past the retry budget with every weight at 0 (the decode pool
+    scaled away mid-relay), the client gets the TYPED 503 — not a hang,
+    not a bare 502."""
+    import time as _time
+
+    srv_p, port_p, _ = _fleet_backend("p1", export_status=500,
+                                      export_delay_s=1.0)
+    srv_d, port_d, _ = _fleet_backend("d1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "p1": ("127.0.0.1", port_p, 100, "prefill"),
+            "d1": ("127.0.0.1", port_d, 100, "decode"),
+        },
+        binary=binary,
+        affinity_tokens=8,
+        handoff_retries=0,
+    ).start()
+    try:
+        results: list = []
+        t = threading.Thread(
+            target=lambda: results.append(_catch_gen(router.port, [6] * 9))
+        )
+        t.start()
+        _time.sleep(0.3)  # relay is inside the slow export leg
+        router.admin.set_weights({"p1": 0, "d1": 0})
+        t.join(timeout=10)
+        code, body = results[0]
+        assert code == 503
+        assert body["reason"] == "no_decode_backend"
+    finally:
+        router.stop()
+        srv_p.shutdown()
+        srv_d.shutdown()
+
+
+def _catch_gen(port, prompt):
+    try:
+        return 200, _gen(port, prompt)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_affinity_disabled_is_old_router_byte_for_byte(binary):
+    """--affinity-tokens 0 (the default): generate traffic routes by
+    plain SWRR even with role-tagged decode backends, no fleet counters
+    move, and the relay never engages."""
+    srv1, p1, cls1 = _fleet_backend("d1")
+    srv2, p2, cls2 = _fleet_backend("d2")
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "d1": ("127.0.0.1", p1, 50, "decode"),
+            "d2": ("127.0.0.1", p2, 50, "decode"),
+        },
+        binary=binary,
+    ).start()
+    try:
+        hits = {"d1": 0, "d2": 0}
+        for i in range(10):
+            hits[_gen(router.port, [1, 2, 3])["who"]] += 1
+        assert hits == {"d1": 5, "d2": 5}  # SWRR, not ring-sticky
+        st = router.admin.fleet()
+        assert st["affinity_hits"] == 0 and st["affinity_misses"] == 0
+        assert cls1.imports == [] and cls2.imports == []
+    finally:
+        router.stop()
+        srv1.shutdown()
+        srv2.shutdown()
+
+
+def test_router_sync_passes_fleet_roles(binary):
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
+        RouterSync,
+    )
+
+    srv, port, _ = _fleet_backend("d1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"seed": ("127.0.0.1", port, 100)},
+        binary=binary,
+    ).start()
+    try:
+        sync = RouterSync(router.admin, lambda n: ("127.0.0.1", port))
+        sync.sync_manifest(
+            {
+                "metadata": {"namespace": "models", "name": "m"},
+                "spec": {
+                    "predictors": [
+                        {"name": "v1-prefill", "traffic": 50,
+                         "tpumlopsFleetRole": "prefill"},
+                        {"name": "v1-decode", "traffic": 50,
+                         "tpumlopsFleetRole": "decode"},
+                    ]
+                },
+            }
+        )
+        roles = {
+            b["name"]: b["role"]
+            for b in router.admin.get_config()["backends"]
+        }
+        assert roles == {"v1-prefill": "prefill", "v1-decode": "decode"}
+
+        # Disaggregation turned off: the next sync omits the role key,
+        # which must RESET the survivors to unified — a backend stuck
+        # tagged prefill would be excluded from client traffic forever.
+        sync.sync_manifest(
+            {
+                "metadata": {"namespace": "models", "name": "m"},
+                "spec": {
+                    "predictors": [
+                        {"name": "v1-prefill", "traffic": 50},
+                        {"name": "v1-decode", "traffic": 50},
+                    ]
+                },
+            }
+        )
+        roles = {
+            b["name"]: b["role"]
+            for b in router.admin.get_config()["backends"]
+        }
+        assert roles == {"v1-prefill": "unified", "v1-decode": "unified"}
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
 def test_router_sync_parks_zero_replica_predictors(binary):
     """RouterSync maps a zero-replica predictor (a parked CR) to weight
     0 — even when no replica address resolves — so the router parks
