@@ -2808,6 +2808,187 @@ def bench_disaggregated() -> dict:
 # stubs (and future monkeypatching) that setattr a bench_* replacement
 # are honored — a registry of bound callables would silently pin the
 # originals.
+def bench_chaos() -> dict:
+    """Failure containment end to end: kill/restart a live replica under
+    sustained load (native router, health probes + failover on).
+
+    Two real tiny-llama servers behind the compiled router; three client
+    threads drive /generate continuously.  Mid-load, one replica is
+    HARD-killed (ChaosProxy severs its listener and every established
+    connection — the dead-pod shape), later restarted on the same
+    address.  The scenario gates the ISSUE's acceptance numbers: ZERO
+    bare 502s and zero hangs (every request resolves 200 or typed with
+    Retry-After), ejection within the failure threshold, and half-open
+    re-admission bounded by 2x the capped probe interval."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np  # noqa: F401  (parity with sibling scenarios)
+
+    from tpumlops.clients.chaos import ChaosProxy
+    from tpumlops.clients.router import RouterProcess
+    from tpumlops.clients.localplane import free_port, start_model_server
+    from tpumlops.models import llama
+    from tpumlops.server.loader import save_native_model
+    from tpumlops.utils.config import TpuSpec
+
+    jax = _setup_jax()
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    art = tempfile.mkdtemp() + "/llm"
+    save_native_model(
+        art,
+        "llama-generate",
+        llama.init(jax.random.key(3), cfg),
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+    )
+    tpu = TpuSpec.from_spec(
+        {"meshShape": {"tp": 1}, "maxBatchSize": 2, "maxSlots": 2}
+    )
+    pa, pb = free_port(), free_port()
+    ha = start_model_server(
+        art, "a", pa, model_name="llm", namespace="bench", tpu=tpu,
+        warmup=False,
+    )
+    hb = start_model_server(
+        art, "b", pb, model_name="llm", namespace="bench", tpu=tpu,
+        warmup=False,
+    )
+    chaos = ChaosProxy(pb)
+    PROBE_S = 0.3
+    THRESHOLD = 3
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "a": ("127.0.0.1", pa, 50),
+            "b": ("127.0.0.1", chaos.port, 50),
+        },
+        namespace="bench",
+        deployment="llm",
+        health_probes=True,
+        health_threshold=THRESHOLD,
+        probe_interval_s=PROBE_S,
+        failover_retries=2,
+    ).start()
+
+    body = json.dumps(
+        {"prompt_ids": [5, 9, 2], "max_new_tokens": 2}
+    ).encode()
+    url = f"http://127.0.0.1:{router.port}/v2/models/llm/generate"
+    results: list = []  # (code|None, typed: bool, retry_after: bool)
+    stop_load = threading.Event()
+
+    def one(timeout=30.0):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                resp.read()
+                return (resp.status, True, True)
+        except urllib.error.HTTPError as e:
+            raw = e.read() or b""
+            try:
+                typed = bool(json.loads(raw).get("reason"))
+            except json.JSONDecodeError:
+                typed = False
+            return (e.code, typed, e.headers.get("Retry-After") is not None)
+        except Exception:
+            return (None, False, False)
+
+    def loader():
+        while not stop_load.is_set():
+            results.append(one())
+
+    def fleet_health():
+        return {
+            b["name"]: b["healthy"]
+            for b in router.admin.fleet()["backends"]
+        }
+
+    def wait_until(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return time.monotonic()
+            time.sleep(0.02)
+        raise TimeoutError(what)
+
+    try:
+        for _ in range(6):  # prime lazy compiles on both replicas
+            code, _, _ = one(timeout=300.0)
+            assert code == 200
+        loaders = [
+            threading.Thread(target=loader, daemon=True) for _ in range(3)
+        ]
+        for t in loaders:
+            t.start()
+        time.sleep(1.0)
+
+        t_kill = time.monotonic()
+        chaos.stop()
+        t_eject = wait_until(
+            lambda: not fleet_health()["b"], 20, "ejection"
+        ) - t_kill
+        time.sleep(0.5)  # single-replica window under load
+
+        t_restart = time.monotonic()
+        chaos.restart()
+        t_readmit = wait_until(
+            lambda: fleet_health()["b"], 2 * PROBE_S * 8 + 5, "re-admission"
+        ) - t_restart
+        time.sleep(1.0)
+        stop_load.set()
+        for t in loaders:
+            t.join(timeout=60)
+
+        fleet = router.admin.fleet()
+        b_rec = next(x for x in fleet["backends"] if x["name"] == "b")
+        n = len(results)
+        ok = sum(1 for c, _, _ in results if c == 200)
+        hangs = sum(1 for c, _, _ in results if c is None)
+        bare = sum(
+            1
+            for c, typed, _ in results
+            if c is not None and c != 200 and not typed
+        )
+        typed_errors = n - ok - hangs - bare
+        # The acceptance gates — a regression here FAILS the bench.
+        assert hangs == 0, f"{hangs} hung/transport-failed requests"
+        assert bare == 0, f"{bare} non-typed client errors"
+        assert t_readmit < 2 * PROBE_S * 8, t_readmit
+        return {
+            "requests": n,
+            "ok": ok,
+            "typed_errors": typed_errors,
+            "bare_502": bare,
+            "hangs": hangs,
+            "availability_pct": round(100.0 * ok / max(1, n), 2),
+            "eject_s": round(t_eject, 3),
+            "readmit_s": round(t_readmit, 3),
+            "probe_interval_s": PROBE_S,
+            "health_threshold": THRESHOLD,
+            "failover_total": fleet["failovers"],
+            "circuit_open_total": b_rec["circuit_opened"],
+        }
+    finally:
+        stop_load.set()
+        router.stop()
+        chaos.stop()
+        ha.stop()
+        hb.stop()
+
+
 SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("time_to_100pct_traffic", "bench_time_to_100"),
     ("iris_sklearn_linear", "bench_iris"),
@@ -2822,6 +3003,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("device_telemetry_serving", "bench_device_telemetry"),
     ("cold_start_serving", "bench_cold_start"),
     ("disaggregated_serving", "bench_disaggregated"),
+    ("chaos_serving", "bench_chaos"),
     ("llama_1p35b_decode", "bench_llama_decode"),
     ("serve_path_http", "bench_serve_path"),
     ("llama_7b_decode", "bench_llama_7b_decode"),
@@ -2893,6 +3075,12 @@ SCENARIO_SCHEMAS: dict = {
         "affinity_hit_rate", "baseline_hit_rate",
         "handoff_p99_ms", "handoff_bytes",
         "token_agreement", "mfu", "hbm_peak_bytes",
+    ),
+    "chaos_serving": (
+        "requests", "ok", "typed_errors", "bare_502", "hangs",
+        "availability_pct", "eject_s", "readmit_s",
+        "probe_interval_s", "health_threshold",
+        "failover_total", "circuit_open_total",
     ),
 }
 
@@ -2998,6 +3186,9 @@ _COMPACT_KEYS = {
         "baseline_ttft_p99_ms", "fleet_ttft_p99_ms", "ttft_p99_speedup",
         "affinity_hit_rate", "handoff_p99_ms", "token_agreement",
         "mfu", "hbm_peak_bytes"),
+    "chaos_serving": (
+        "availability_pct", "bare_502", "hangs",
+        "eject_s", "readmit_s", "failover_total"),
     "serve_path_http": (
         "server_queue_mean_ms", "server_device_run_mean_ms",
         "server_pipeline_wait_mean_ms", "server_observed_mean_ms",

@@ -425,6 +425,10 @@ class RouterProcess:
         affinity_tokens: int = 0,
         kv_handoff: bool = True,
         handoff_retries: int = 1,
+        health_probes: bool = False,
+        health_threshold: int = 3,
+        probe_interval_s: float = 0.5,
+        failover_retries: int = 0,
     ):
         self.port = port
         # Values are (host, port, weight) or (host, port, weight, role)
@@ -450,6 +454,18 @@ class RouterProcess:
         self.affinity_tokens = int(affinity_tokens)
         self.kv_handoff = bool(kv_handoff)
         self.handoff_retries = int(handoff_retries)
+        # Failure containment (both default off = old router byte-for-
+        # byte).  health_probes: consecutive connect/5xx failures trip a
+        # per-backend circuit (ejected from SWRR + the affinity ring)
+        # and half-open GET /healthz probes at a capped exponential
+        # interval re-admit it.  failover_retries: a request whose
+        # upstream dies before any response byte retries on up to N
+        # other healthy backends, then sheds a TYPED 503
+        # {reason: upstream_failed} — never a bare 502.
+        self.health_probes = bool(health_probes)
+        self.health_threshold = int(health_threshold)
+        self.probe_interval_s = float(probe_interval_s)
+        self.failover_retries = int(failover_retries)
         self.proc: subprocess.Popen | None = None
         self.admin = RouterAdmin(port)
 
@@ -471,6 +487,14 @@ class RouterProcess:
                 "--kv-handoff", "1" if self.kv_handoff else "0",
                 "--handoff-retries", str(self.handoff_retries),
             ]
+        if self.health_probes:
+            argv += [
+                "--health-probes", "1",
+                "--health-threshold", str(self.health_threshold),
+                "--probe-interval-s", str(self.probe_interval_s),
+            ]
+        if self.failover_retries > 0:
+            argv += ["--failover-retries", str(self.failover_retries)]
         for name, spec in self.backends.items():
             host, port, weight = spec[0], spec[1], spec[2]
             role = spec[3] if len(spec) > 3 else None

@@ -126,6 +126,20 @@ struct Backend {
   sockaddr_in addr{};    // resolved at config time (getaddrinfo)
   uint32_t addr_epoch = 0;  // bumped on repoint; gates pool admission
 
+  // Passive health (--health-probes): consecutive connect/5xx failures
+  // trip the circuit, ejecting the backend from the SWRR pick and the
+  // affinity ring until a half-open GET /healthz probe (capped
+  // exponential interval) answers 200.  All zeroed on repoint — a new
+  // pod starts with a clean record.
+  int consecutive_failures = 0;
+  bool circuit_open = false;
+  bool probe_inflight = false;
+  int probe_fd = -1;             // in-flight probe socket (-1 = none)
+  double probe_deadline_t = 0.0; // when the in-flight probe is declared wedged
+  double next_probe_t = 0.0;     // monotonic; earliest next probe
+  double probe_interval = 0.0;   // current backoff (doubles, capped)
+  uint64_t circuit_open_total = 0;  // times the circuit tripped
+
   Histogram client_latency;  // client_requests_seconds (predictions only)
   // server_requests_seconds{code=,service=} keyed (code, service): the
   // gate counts errors across services (mlflow_operator.py:375) and
@@ -160,6 +174,46 @@ bool resolve_backend(Backend* b) {
 // matching Seldon executor behavior when a predictor is deleted).
 using BackendPtr = std::shared_ptr<Backend>;
 
+// ---------------------------------------------------------------------------
+// Failure containment knobs + counters (--health-probes / --failover-retries)
+//
+// Defaults keep the router byte-for-byte: no circuits, no probes, a dead
+// upstream still answers the classic bare 502.  With health probes on, a
+// backend accumulating --health-threshold consecutive connect/5xx
+// failures is ejected from every pick (SWRR, prefill SWRR, affinity
+// ring) and re-admitted only by half-open probing; with failover on, a
+// request whose upstream dies before ANY response byte retries on
+// another healthy backend (generation has not started — idempotent),
+// and exhaustion yields a TYPED 503 {reason: upstream_failed}, never a
+// bare 502.
+// ---------------------------------------------------------------------------
+
+int g_health_probes = 0;        // --health-probes (0 = off, old behavior)
+int g_health_threshold = 3;     // consecutive failures that trip a circuit
+double g_probe_interval_s = 0.5;  // half-open probe base interval
+int g_failover_retries = 0;     // --failover-retries (0 = old bare-502)
+constexpr double kProbeBackoffCap = 8.0;  // interval caps at 8x base
+// A probe whose backend accepted the connect but never answers (wedged
+// pod, conntrack blackhole) must not hold probe_inflight forever —
+// circuit-open backends are excluded from every pick, so no live
+// request could ever close the circuit either.  Timed out at
+// max(2x base interval, floor); a timeout counts as a failed probe.
+constexpr double kProbeTimeoutFloorS = 1.0;
+double probe_timeout_s() {
+  return std::max(2.0 * g_probe_interval_s, kProbeTimeoutFloorS);
+}
+
+uint64_t g_failover_total = 0;  // requests re-dispatched to another backend
+Histogram g_probe_seconds;      // half-open probe round-trip walls
+
+// A backend is pickable when it carries weight AND (health probing off,
+// or its circuit is closed).  One predicate shared by every pick path
+// so the SWRR, the prefill SWRR, the affinity ring, and the park
+// release can never disagree about who is alive.
+bool backend_usable(const Backend& b) {
+  return b.weight > 0 && (!g_health_probes || !b.circuit_open);
+}
+
 struct RouterState {
   std::string ns = "default";
   std::string deployment = "router";
@@ -173,14 +227,24 @@ struct RouterState {
   }
 
   // nginx smooth weighted round-robin: deterministic interleave, exact
-  // long-run proportions.  Returns nullptr when all weights are 0.
+  // long-run proportions.  Returns nullptr when all weights are 0 (or,
+  // with health probes on, every weighted backend's circuit is open).
   // Prefill-role backends are excluded: they serve KV-export relays,
   // not client traffic (no prefill role configured = old behavior).
-  BackendPtr pick() {
+  // ``exclude`` (may be null) holds backends already tried by this
+  // request's failover budget — shared_ptrs, same lifetime contract as
+  // pick_prefill's list.
+  BackendPtr pick(const std::vector<BackendPtr>* exclude = nullptr) {
     BackendPtr best;
     int total = 0;
     for (auto& b : backends) {
-      if (b->weight <= 0 || b->role == "prefill") continue;
+      if (!backend_usable(*b) || b->role == "prefill") continue;
+      if (exclude) {
+        bool skip = false;
+        for (const BackendPtr& e : *exclude)
+          if (e == b) skip = true;
+        if (skip) continue;
+      }
       b->swrr_current += b->weight;
       total += b->weight;
       if (!best || b->swrr_current > best->swrr_current) best = b;
@@ -197,7 +261,7 @@ struct RouterState {
     BackendPtr best;
     int total = 0;
     for (auto& b : backends) {
-      if (b->weight <= 0 || b->role != "prefill") continue;
+      if (!backend_usable(*b) || b->role != "prefill") continue;
       bool skip = false;
       for (const BackendPtr& e : exclude)
         if (e == b) skip = true;
@@ -261,9 +325,11 @@ void rebuild_ring() {
   std::sort(g_ring.begin(), g_ring.end());
 }
 
-// First clockwise ring entry with positive weight (consistent hashing:
+// First clockwise ring entry that is usable (consistent hashing:
 // adding/removing one replica remaps only its arc, so most repeat
-// prefixes keep landing where their KV lives).
+// prefixes keep landing where their KV lives).  A circuit-open backend
+// is skipped exactly like a weight-0 one — its keys re-hash to the
+// survivors until half-open probing re-admits it.
 BackendPtr pick_decode(uint64_t h) {
   if (g_ring.empty()) return nullptr;
   auto it = std::lower_bound(
@@ -271,7 +337,7 @@ BackendPtr pick_decode(uint64_t h) {
   for (size_t i = 0; i < g_ring.size(); i++) {
     if (it == g_ring.end()) it = g_ring.begin();
     Backend* b = it->second;
-    if (b->weight > 0) return g_state.find(b->name);
+    if (backend_usable(*b)) return g_state.find(b->name);
     ++it;
   }
   return nullptr;
@@ -611,6 +677,9 @@ struct UpstreamConn {
   HttpMsg resp;
   bool connecting = false;
   bool reused = false;  // taken from the keep-alive pool (stale-retry eligible)
+  // Half-open health probe (GET /healthz): no client, never pooled.
+  bool probe = false;
+  double probe_t0 = 0.0;  // probe dispatch time (monotonic)
 };
 
 // KV-handoff relay stages (prefix-affinity miss on a cold prompt):
@@ -640,6 +709,17 @@ struct ClientConn {
   bool feedback = false;  // current request is /api/v1.0/feedback
   bool parked = false;    // held in the scale-to-zero park buffer
   double park_t = 0;      // when parking began (monotonic)
+  // FIRST park instant of the current request (0 = never parked):
+  // survives release/re-park cycles, so the --park-timeout-s bound is
+  // CUMULATIVE — a request released to a draining replica that loses
+  // its backend and re-parks must not restart the clock (it would hang
+  // past the timeout for as long as the weights keep flapping).
+  double park_first_t = 0;
+  // Before-first-byte failover (--failover-retries): backends already
+  // tried by this request.  Same shared_ptr lifetime contract as
+  // relay_tried.
+  int failover_attempts = 0;
+  std::vector<BackendPtr> failover_tried;
   // KV-handoff relay state (RelayStage::None outside a relay).
   RelayStage relay_stage = RelayStage::None;
   BackendPtr relay_decode;   // ring-chosen decode target
@@ -695,6 +775,70 @@ void unpark(ClientConn* c) {
       g_parked.erase(it);
       break;
     }
+}
+
+// ---------------------------------------------------------------------------
+// Passive backend health (circuit breaking)
+// ---------------------------------------------------------------------------
+
+void release_parked();  // defined with the proxy path below
+
+void reset_swrr() {
+  // Membership of the pick set changed: restart the interleave so the
+  // new split takes effect cleanly (same rule as /router/weights).
+  for (auto& b : g_state.backends) b->swrr_current = 0;
+}
+
+// One connect/5xx failure observed against ``b``.  Trips the circuit at
+// the threshold: ejected from every pick, first half-open probe due
+// after the base interval.
+void note_backend_failure(const BackendPtr& b) {
+  if (!g_health_probes || !b) return;
+  b->consecutive_failures++;
+  if (!b->circuit_open && b->consecutive_failures >= g_health_threshold) {
+    b->circuit_open = true;
+    b->circuit_open_total++;
+    b->probe_interval = g_probe_interval_s;
+    b->next_probe_t = now_s() + b->probe_interval;
+    reset_swrr();
+    fprintf(stderr,
+            "tpumlops-router: circuit OPEN for backend %s (%d consecutive "
+            "failures); half-open probes every %.2fs (capped x%g)\n",
+            b->name.c_str(), b->consecutive_failures, b->probe_interval,
+            kProbeBackoffCap);
+  }
+}
+
+// A healthy response observed against ``b``: clears the failure streak,
+// and — if an in-flight request beat the prober to the recovery — closes
+// the circuit early.
+void note_backend_success(const BackendPtr& b) {
+  if (!g_health_probes || !b) return;
+  b->consecutive_failures = 0;
+  if (b->circuit_open) {
+    b->circuit_open = false;
+    b->probe_interval = 0.0;
+    reset_swrr();
+    fprintf(stderr,
+            "tpumlops-router: circuit CLOSED for backend %s (live response)\n",
+            b->name.c_str());
+    release_parked();
+  }
+}
+
+bool any_circuit_open() {
+  if (!g_health_probes) return false;
+  for (auto& b : g_state.backends)
+    if (b->circuit_open || b->probe_inflight) return true;
+  return false;
+}
+
+// Any backend a client pick could ever return once circuits recover —
+// decides park-vs-shed when no backend is usable right now.
+bool any_weighted_client_backend() {
+  for (auto& b : g_state.backends)
+    if (b->weight > 0 && b->role != "prefill") return true;
+  return false;
 }
 
 struct FdEntry {
@@ -771,6 +915,150 @@ void close_client(ClientConn* c) {
 void client_send(ClientConn* c, const std::string& data) {
   c->out += data;
   epoll_set(c->fd, EPOLLIN | EPOLLOUT);
+}
+
+// ---------------------------------------------------------------------------
+// Half-open recovery probes (GET /healthz against circuit-open backends)
+// ---------------------------------------------------------------------------
+
+void probe_done(UpstreamConn* u, bool ok) {
+  BackendPtr b = u->backend;
+  uint32_t probe_epoch = u->addr_epoch;
+  g_probe_seconds.observe(now_s() - u->probe_t0);
+  close_upstream(u);
+  if (!b) return;
+  b->probe_inflight = false;
+  b->probe_fd = -1;
+  if (probe_epoch != b->addr_epoch) return;  // repointed mid-probe: the
+                                             // answer describes the OLD pod
+  if (ok) {
+    b->circuit_open = false;
+    b->consecutive_failures = 0;
+    b->probe_interval = 0.0;
+    reset_swrr();
+    fprintf(stderr,
+            "tpumlops-router: circuit CLOSED for backend %s (healthz probe "
+            "answered 200)\n",
+            b->name.c_str());
+    // Capacity may just have returned to a fully-tripped fleet.
+    release_parked();
+  } else {
+    // Capped exponential backoff: a dead pod is probed gently, a
+    // restarting one is re-admitted within 2x the current interval.
+    b->probe_interval =
+        std::min(b->probe_interval * 2.0, g_probe_interval_s * kProbeBackoffCap);
+    if (b->probe_interval <= 0.0) b->probe_interval = g_probe_interval_s;
+    b->next_probe_t = now_s() + b->probe_interval;
+  }
+}
+
+void handle_probe_event(UpstreamConn* u, uint32_t events) {
+  if (events & EPOLLERR) {
+    probe_done(u, false);
+    return;
+  }
+  if (events & EPOLLHUP) events |= EPOLLIN;  // drain whatever was written
+  u->connecting = false;
+  if (events & EPOLLOUT) {
+    while (u->out_off < u->out.size()) {
+      ssize_t n =
+          write(u->fd, u->out.data() + u->out_off, u->out.size() - u->out_off);
+      if (n > 0) {
+        u->out_off += size_t(n);
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        probe_done(u, false);
+        return;
+      }
+    }
+    if (u->out_off >= u->out.size()) epoll_set(u->fd, EPOLLIN);
+  }
+  if (events & EPOLLIN) {
+    char tmp[8192];
+    bool eof = false;
+    while (true) {
+      ssize_t n = read(u->fd, tmp, sizeof(tmp));
+      if (n > 0) {
+        u->resp.buf.append(tmp, size_t(n));
+      } else if (n == 0) {
+        eof = true;
+        break;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        eof = true;
+        break;
+      }
+    }
+    if (!u->resp.headers_complete())
+      u->resp.try_parse_headers(/*is_request=*/false);
+    if (u->resp.headers_complete() &&
+        u->resp.complete(/*is_request=*/false, eof)) {
+      probe_done(u, u->resp.status == 200);
+      return;
+    }
+    if (eof) probe_done(u, false);
+  }
+}
+
+// Launch probes for every circuit-open backend whose backoff expired.
+// One in flight per backend; results re-arm the next interval.
+void start_due_probes() {
+  if (!g_health_probes) return;
+  double now = now_s();
+  for (auto& b : g_state.backends) {
+    if (b->probe_inflight) {
+      // Wedged-probe guard: a backend that accepted the connect but
+      // never answers would otherwise pin probe_inflight forever and
+      // the backend would stay ejected past recovery.
+      if (now >= b->probe_deadline_t) {
+        auto it = g_fds.find(b->probe_fd);
+        if (b->probe_fd >= 0 && it != g_fds.end() && it->second.upstream &&
+            it->second.upstream->probe) {
+          probe_done(it->second.upstream, false);  // timeout = failed probe
+        } else {  // stale bookkeeping (fd already gone)
+          b->probe_inflight = false;
+          b->probe_fd = -1;
+        }
+      }
+      continue;
+    }
+    if (!b->circuit_open || now < b->next_probe_t)
+      continue;
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) continue;
+    set_nonblock(fd);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr = b->addr;
+    int rc = connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) {
+      close(fd);
+      // Immediate refusal still counts as a completed (failed) probe.
+      g_probe_seconds.observe(0.0);
+      b->probe_interval = std::min(b->probe_interval * 2.0,
+                                   g_probe_interval_s * kProbeBackoffCap);
+      if (b->probe_interval <= 0.0) b->probe_interval = g_probe_interval_s;
+      b->next_probe_t = now + b->probe_interval;
+      continue;
+    }
+    auto* u = new UpstreamConn();
+    u->fd = fd;
+    u->backend = b;
+    u->addr_epoch = b->addr_epoch;
+    u->connecting = (rc < 0);
+    u->probe = true;
+    u->probe_t0 = now;
+    u->resp.request_method = "GET";
+    u->out =
+        "GET /healthz HTTP/1.1\r\nhost: tpumlops-router\r\n"
+        "connection: close\r\n\r\n";
+    u->out_off = 0;
+    b->probe_inflight = true;
+    b->probe_fd = fd;
+    b->probe_deadline_t = now + probe_timeout_s();
+    g_fds[fd] = {FdKind::Upstream, nullptr, u};
+    epoll_add(fd, EPOLLIN | EPOLLOUT);
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -895,6 +1183,38 @@ std::string metrics_text() {
   out += "# TYPE tpumlops_router_kv_handoff_seconds histogram\n";
   emit_histogram(&out, "tpumlops_router_kv_handoff_seconds", plabels,
                  g_kv_handoff_seconds);
+  // Failure containment: per-backend circuit state (healthy == circuit
+  // closed; always 1 with --health-probes off) and trip counts, plus the
+  // deployment-scoped failover tally and half-open probe walls.
+  char hline[640];
+  out += "# TYPE tpumlops_router_backend_healthy gauge\n";
+  for (auto& b : g_state.backends) {
+    char labels[256];
+    snprintf(labels, sizeof(labels),
+             "deployment_name=\"%s\",predictor_name=\"%s\",namespace=\"%s\"",
+             g_state.deployment.c_str(), b->name.c_str(), g_state.ns.c_str());
+    snprintf(hline, sizeof(hline), "tpumlops_router_backend_healthy{%s} %d\n",
+             labels, b->circuit_open ? 0 : 1);
+    out += hline;
+  }
+  out += "# TYPE tpumlops_router_circuit_open_total counter\n";
+  for (auto& b : g_state.backends) {
+    char labels[256];
+    snprintf(labels, sizeof(labels),
+             "deployment_name=\"%s\",predictor_name=\"%s\",namespace=\"%s\"",
+             g_state.deployment.c_str(), b->name.c_str(), g_state.ns.c_str());
+    snprintf(hline, sizeof(hline),
+             "tpumlops_router_circuit_open_total{%s} %llu\n", labels,
+             (unsigned long long)b->circuit_open_total);
+    out += hline;
+  }
+  out += "# TYPE tpumlops_router_failover_total counter\n";
+  snprintf(line, sizeof(line), "tpumlops_router_failover_total{%s} %llu\n",
+           plabels, (unsigned long long)g_failover_total);
+  out += line;
+  out += "# TYPE tpumlops_router_probe_seconds histogram\n";
+  emit_histogram(&out, "tpumlops_router_probe_seconds", plabels,
+                 g_probe_seconds);
   return out;
 }
 
@@ -991,8 +1311,12 @@ std::string apply_config(const std::string& ns, const std::string& dep,
         st.survivor->addr_epoch++;  // in-flight conns to the old address
                                     // must not re-enter the pool
         // A repointed backend is a different pod: nothing we handed the
-        // old one is known to the new one.
+        // old one is known to the new one — and the old pod's failure
+        // record must not keep the new one's circuit open.
         st.survivor->known_prefixes.clear();
+        st.survivor->circuit_open = false;
+        st.survivor->consecutive_failures = 0;
+        st.survivor->probe_interval = 0.0;
         repointed.push_back(st.survivor.get());
       }
       st.survivor->weight = st.spec.weight;
@@ -1065,13 +1389,15 @@ void handle_admin(ClientConn* c) {
              "\"affinity_tokens\":%d,\"ring_vnodes\":%zu,"
              "\"affinity_hits\":%llu,\"affinity_misses\":%llu,"
              "\"kv_handoffs\":%llu,\"kv_handoff_bytes\":%llu,"
-             "\"kv_handoff_failures\":%llu,\"backends\":[",
+             "\"kv_handoff_failures\":%llu,"
+             "\"health_probes\":%d,\"failovers\":%llu,\"backends\":[",
              g_affinity_tokens, g_ring.size(),
              (unsigned long long)g_affinity_hits,
              (unsigned long long)g_affinity_misses,
              (unsigned long long)g_kv_handoff_seconds.count,
              (unsigned long long)g_kv_handoff_bytes,
-             (unsigned long long)g_kv_handoff_failures);
+             (unsigned long long)g_kv_handoff_failures,
+             g_health_probes, (unsigned long long)g_failover_total);
     out += buf;
     bool first = true;
     for (auto& b : g_state.backends) {
@@ -1079,9 +1405,13 @@ void handle_admin(ClientConn* c) {
       first = false;
       snprintf(buf, sizeof(buf),
                "{\"name\":\"%s\",\"role\":\"%s\",\"weight\":%d,"
-               "\"known_prefixes\":%zu}",
+               "\"known_prefixes\":%zu,\"healthy\":%s,"
+               "\"consecutive_failures\":%d,\"circuit_opened\":%llu}",
                b->name.c_str(), b->role.c_str(), b->weight,
-               b->known_prefixes.size());
+               b->known_prefixes.size(),
+               b->circuit_open ? "false" : "true",
+               b->consecutive_failures,
+               (unsigned long long)b->circuit_open_total);
       out += buf;
     }
     out += "]}";
@@ -1178,8 +1508,20 @@ void finish_request(const BackendPtr& b, int code, double seconds,
 
 void advance_client(ClientConn* c);  // defined below
 void relay_sub_failed(ClientConn* c);  // defined with the relay logic
+void connect_upstream(ClientConn* c, bool allow_pool);  // defined below
 
-void fail_502(ClientConn* c, const char* why) {
+bool any_usable_client_backend() {
+  for (auto& b : g_state.backends)
+    if (backend_usable(*b) && b->role != "prefill") return true;
+  return false;
+}
+
+// An upstream leg failed.  ``first_byte_seen`` = response bytes had
+// arrived before the failure (generation may have started; the request
+// is no longer failover-idempotent).  With --failover-retries 0 (the
+// default) every path below collapses to the classic bare 502,
+// byte-for-byte.
+void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
   if (c->relay_stage == RelayStage::Export ||
       c->relay_stage == RelayStage::Import) {
     // A relay SUB-request failed (prefill replica died mid-handoff,
@@ -1191,18 +1533,73 @@ void fail_502(ClientConn* c, const char* why) {
       close_upstream(c->upstream);
       c->upstream = nullptr;
     }
+    note_backend_failure(c->backend);  // passive health sees relay legs too
     relay_sub_failed(c);
     return;
   }
   c->relay_stage = RelayStage::None;  // Forward leg fails like any proxy
-  if (c->backend)
-    finish_request(c->backend, 502, now_s() - c->t_start, c->feedback);
-  client_send(c, http_response(502, "Bad Gateway", "text/plain",
-                               std::string(why) + "\n"));
   if (c->upstream) {
     c->upstream->client = nullptr;
     close_upstream(c->upstream);
     c->upstream = nullptr;
+  }
+  note_backend_failure(c->backend);
+  // Before-first-byte failover: the upstream died without producing a
+  // single response byte, so generation never started — the request
+  // retries verbatim on another healthy backend.  Feedback posts never
+  // REPLAY (retry or park — a reward the backend recorded before dying
+  // would double-count), but they still shed the typed 503 below, never
+  // the bare 502.
+  if (g_failover_retries > 0) {
+    if (c->backend) c->failover_tried.push_back(c->backend);
+    const bool replayable = !first_byte_seen && !c->feedback;
+    if (replayable && c->failover_attempts < g_failover_retries) {
+      BackendPtr next = g_state.pick(&c->failover_tried);
+      if (next) {
+        c->failover_attempts++;
+        g_failover_total++;
+        c->backend = next;
+        c->retries = 0;
+        connect_upstream(c, /*allow_pool=*/true);
+        return;
+      }
+    }
+    // Exhausted: never a bare 502.  A fully-tripped fleet PARKS when
+    // parking is on — the request waits for a probe to re-admit
+    // capacity instead of bouncing 503s — but ONLY while replay is
+    // idempotent: a response that had started (generation may have
+    // run) sheds typed instead of being re-dispatched from the park.
+    if (replayable && !any_usable_client_backend() && g_park_max > 0) {
+      if (int(g_parked.size()) < g_park_max) {
+        c->parked = true;
+        c->park_t = now_s();
+        if (c->park_first_t == 0) c->park_first_t = c->park_t;
+        g_parked.push_back(c);
+        g_parked_total++;
+        return;
+      }
+      g_park_overflow_total++;
+      if (c->backend)
+        finish_request(c->backend, 503, now_s() - c->t_start, c->feedback);
+      client_send(c, park_503_body("park_overflow", int(g_park_timeout_s)));
+    } else {
+      if (c->backend)
+        finish_request(c->backend, 503, now_s() - c->t_start, c->feedback);
+      char body[224];
+      snprintf(body, sizeof(body),
+               "{\"error\":\"upstream failed (%s) and failover budget "
+               "exhausted\",\"reason\":\"upstream_failed\","
+               "\"retry_after_s\":1}",
+               why);
+      client_send(c, http_response(503, "Service Unavailable",
+                                   "application/json", body,
+                                   "Retry-After: 1\r\n"));
+    }
+  } else {
+    if (c->backend)
+      finish_request(c->backend, 502, now_s() - c->t_start, c->feedback);
+    client_send(c, http_response(502, "Bad Gateway", "text/plain",
+                                 std::string(why) + "\n"));
   }
   c->req.reset();
   // A pipelined next request must still be answered (same contract as the
@@ -1374,14 +1771,14 @@ void relay_fallback(ClientConn* c, const char* why,
   (void)why;
   if (count_failure) g_kv_handoff_failures++;
   BackendPtr target = c->relay_decode ? c->relay_decode : g_state.pick();
-  if (target && target->weight > 0) {
+  if (target && backend_usable(*target)) {
     // The unified fallback prefills LOCALLY on the ring target, which
     // warms its radix cache — record that so the next repeat of this
     // prefix routes straight there as a hit instead of re-relaying.
     remember_prefix(target, c->relay_hash);
   }
   relay_clear(c);
-  if (!target || target->weight <= 0) target = g_state.pick();
+  if (!target || !backend_usable(*target)) target = g_state.pick();
   if (!target) {
     // Past the retry budget with NOTHING able to serve: typed 503.
     client_send(c, http_response(
@@ -1515,11 +1912,15 @@ void start_proxy(ClientConn* c) {
   if (!b) {
     if (g_park_max > 0) {
       if (int(g_parked.size()) < g_park_max) {
-        // Hold the fully-buffered request; released FIFO once a weight
-        // flips positive (the operator waking the CR), expired after
-        // --park-timeout-s.  c->req stays intact for the re-dispatch.
+        // Hold the fully-buffered request; released FIFO once capacity
+        // returns (a weight flips positive, or a half-open probe closes
+        // a circuit on a fully-tripped fleet), expired after
+        // --park-timeout-s.  c->req stays intact for the re-dispatch;
+        // park_first_t survives release/re-park cycles so the timeout
+        // bound is cumulative.
         c->parked = true;
         c->park_t = now_s();
+        if (c->park_first_t == 0) c->park_first_t = c->park_t;
         g_parked.push_back(c);
         g_parked_total++;
         return;
@@ -1527,6 +1928,23 @@ void start_proxy(ClientConn* c) {
       g_park_overflow_total++;
       client_send(c, park_503_body("park_overflow",
                                    int(g_park_timeout_s)));
+      c->req.reset();
+      return;
+    }
+    if (g_health_probes && any_weighted_client_backend()) {
+      // Weighted capacity exists but every circuit is open: a typed
+      // 503 with a Retry-After matched to the probe cadence (the
+      // fleet re-admits within ~2x the current probe interval).
+      int retry = int(g_probe_interval_s * 2.0) + 1;
+      char body[192];
+      snprintf(body, sizeof(body),
+               "{\"error\":\"every backend circuit is open\","
+               "\"reason\":\"no_healthy_backend\",\"retry_after_s\":%d}",
+               retry);
+      char hdr[64];
+      snprintf(hdr, sizeof(hdr), "Retry-After: %d\r\n", retry);
+      client_send(c, http_response(503, "Service Unavailable",
+                                   "application/json", body, hdr));
       c->req.reset();
       return;
     }
@@ -1547,14 +1965,21 @@ void release_parked() {
   if (g_parked.empty()) return;
   bool capacity = false;
   for (auto& b : g_state.backends)
-    if (b->weight > 0) capacity = true;
+    if (backend_usable(*b)) capacity = true;
   if (!capacity) return;
   std::vector<ClientConn*> waiting;
   waiting.swap(g_parked);
   for (ClientConn* c : waiting) {
     c->parked = false;
-    g_park_wait_seconds.observe(now_s() - c->park_t);
+    // CUMULATIVE wait (first park of this request): a release/re-park
+    // cycle must not report two short waits for one long hold.
+    g_park_wait_seconds.observe(now_s() - c->park_first_t);
     g_park_released_total++;
+    // Fresh failover budget for the re-dispatch: the backends that
+    // failed before the park are exactly the ones a probe may just
+    // have re-admitted.
+    c->failover_attempts = 0;
+    c->failover_tried.clear();
     start_proxy(c);
   }
 }
@@ -1566,8 +1991,12 @@ void expire_parked() {
   double now = now_s();
   std::vector<ClientConn*> keep;
   std::vector<ClientConn*> expired;
+  // Expiry counts from the FIRST park of the request: release/re-park
+  // cycles (a replica draining to weight 0 under the parked queue, a
+  // failover exhaustion re-parking) must not extend the bound — the
+  // client sheds typed at the advertised timeout, never hangs.
   for (ClientConn* c : g_parked)
-    (now - c->park_t >= g_park_timeout_s ? expired : keep).push_back(c);
+    (now - c->park_first_t >= g_park_timeout_s ? expired : keep).push_back(c);
   if (expired.empty()) return;
   g_parked.swap(keep);
   for (ClientConn* c : expired) {
@@ -1605,6 +2034,9 @@ bool retry_stale_upstream(UpstreamConn* u, ClientConn* c) {
 // Client request fully buffered: admin or proxy.
 void dispatch_request(ClientConn* c) {
   c->t_start = now_s();
+  c->park_first_t = 0;  // a NEW request gets its own cumulative bound
+  c->failover_attempts = 0;
+  c->failover_tried.clear();
   if (c->req.path.rfind("/router/", 0) == 0) {
     handle_admin(c);
     c->req.reset();
@@ -1744,6 +2176,12 @@ bool pool_or_close_upstream(UpstreamConn* u, bool eof) {
 }
 
 void on_upstream_event(UpstreamConn* u, uint32_t events) {
+  if (u->probe) {
+    // Half-open health probe: no client, never pooled — its own state
+    // machine entirely.
+    handle_probe_event(u, events);
+    return;
+  }
   if (events & (EPOLLERR | EPOLLHUP)) {
     if (!u->client) {
       // Idle pooled connection died (close_upstream scrubs the pool entry).
@@ -1753,10 +2191,11 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
     if (events & EPOLLERR) {
       ClientConn* c = u->client;
       if (retry_stale_upstream(u, c)) return;
+      bool first_byte = !u->resp.buf.empty();
       c->upstream = nullptr;
       u->client = nullptr;
       close_upstream(u);
-      fail_502(c, "backend connection error");
+      fail_502(c, "backend connection error", first_byte);
       return;
     }
     // EPOLLHUP with an active request: drain whatever the backend wrote
@@ -1776,10 +2215,11 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         ClientConn* c = u->client;
         if (c && retry_stale_upstream(u, c)) return;
+        bool first_byte = !u->resp.buf.empty();
         u->client = nullptr;
         if (c) {
           c->upstream = nullptr;
-          fail_502(c, "backend write failed");
+          fail_502(c, "backend write failed", first_byte);
         }
         close_upstream(u);
         return;
@@ -1812,7 +2252,7 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
     if (u->resp.buf.size() > kMaxMessageBytes) {
       u->client = nullptr;
       c->upstream = nullptr;
-      fail_502(c, "backend response too large");
+      fail_502(c, "backend response too large", /*first_byte_seen=*/true);
       close_upstream(u);
       return;
     }
@@ -1826,15 +2266,23 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
         // the normal path, then advance the relay state machine.
         int status = u->resp.status;
         std::string body = response_body(u->resp, eof);
+        BackendPtr leg_backend = u->backend;
         c->upstream = nullptr;
         u->client = nullptr;
         pool_or_close_upstream(u, eof);
+        // Relay legs feed passive health like any other response: a
+        // prefill replica answering 5xx exports is as tripped as one
+        // refusing connections.
+        if (status >= 500) note_backend_failure(leg_backend);
+        else note_backend_success(leg_backend);
         relay_on_response(c, status, std::move(body));
         return;
       }
       c->relay_stage = RelayStage::None;  // Forward leg completed
       double dt = now_s() - c->t_start;
       finish_request(u->backend, u->resp.status, dt, c->feedback);
+      if (u->resp.status >= 500) note_backend_failure(u->backend);
+      else note_backend_success(u->backend);
       client_send(c, u->resp.buf);
       c->req.reset();
       c->upstream = nullptr;
@@ -1854,9 +2302,10 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
     }
     if (eof) {  // EOF before the message completed
       if (retry_stale_upstream(u, c)) return;
+      bool first_byte = !u->resp.buf.empty();
       u->client = nullptr;
       c->upstream = nullptr;
-      fail_502(c, "backend EOF mid-response");
+      fail_502(c, "backend EOF mid-response", first_byte);
       close_upstream(u);
     }
   }
@@ -1870,7 +2319,9 @@ void usage() {
   die("usage: tpumlops-router --port N [--namespace ns] [--deployment name]\n"
       "       [--backend name=host:port:weight[:role]]...\n"
       "       [--park-buffer N] [--park-timeout-s S]\n"
-      "       [--affinity-tokens N] [--kv-handoff 0|1] [--handoff-retries N]");
+      "       [--affinity-tokens N] [--kv-handoff 0|1] [--handoff-retries N]\n"
+      "       [--health-probes 0|1] [--health-threshold N]\n"
+      "       [--probe-interval-s S] [--failover-retries N]");
 }
 
 }  // namespace
@@ -1892,6 +2343,10 @@ int main(int argc, char** argv) {
     else if (a == "--affinity-tokens") g_affinity_tokens = atoi(next().c_str());
     else if (a == "--kv-handoff") g_handoff_enabled = atoi(next().c_str());
     else if (a == "--handoff-retries") g_handoff_retries = atoi(next().c_str());
+    else if (a == "--health-probes") g_health_probes = atoi(next().c_str());
+    else if (a == "--health-threshold") g_health_threshold = atoi(next().c_str());
+    else if (a == "--probe-interval-s") g_probe_interval_s = atof(next().c_str());
+    else if (a == "--failover-retries") g_failover_retries = atoi(next().c_str());
     else if (a == "--backend") {
       // name=host:port:weight[:role]
       std::string v = next();
@@ -1943,14 +2398,19 @@ int main(int argc, char** argv) {
 
   epoll_event events[256];
   while (true) {
-    // Bounded wait while requests are parked so timeouts fire without
-    // needing traffic to tick the loop; -1 (block forever) otherwise.
-    int n = epoll_wait(g_epoll, events, 256, g_parked.empty() ? -1 : 250);
+    // Bounded wait while requests are parked (timeouts must fire
+    // without traffic) or circuits are open (half-open probes must
+    // fire on schedule); -1 (block forever) otherwise.
+    int timeout = -1;
+    if (!g_parked.empty()) timeout = 250;
+    if (any_circuit_open()) timeout = timeout < 0 ? 50 : std::min(timeout, 50);
+    int n = epoll_wait(g_epoll, events, 256, timeout);
     if (n < 0) {
       if (errno == EINTR) continue;
       die("epoll_wait: %s", strerror(errno));
     }
     expire_parked();
+    start_due_probes();
     for (int i = 0; i < n; i++) {
       uint64_t key = events[i].data.u64;
       int fd = int(uint32_t(key));

@@ -45,7 +45,7 @@ from ..utils.config import (
 from ..utils.logging import model_logger
 from .builder import build_deployment
 from .judge import should_promote
-from .rollout_recorder import GateRecord, TransitionRecord
+from .rollout_recorder import CrashLoopRecord, GateRecord, TransitionRecord
 from .state import Phase, PromotionState
 from .uri import artifact_uri
 
@@ -226,6 +226,10 @@ class Reconciler:
         # capacity is cheaper to sync centrally): one patch when the
         # spec-derived summary differs from what status carries.
         self._sync_capacity_status(outcome.state)
+        # Replica-churn audit runs centrally too (every path, ERROR-
+        # parked CRs included): restart counts are observation, not
+        # rollout logic, and must keep flowing while a canary is stuck.
+        outcome.state = self._sync_restart_audit(outcome.state)
         outcome.timings = self._timings
         outcome.scale = self._scale_record
         # Flush the step's journal records.  Gate records get the step's
@@ -268,6 +272,16 @@ class Reconciler:
         # summary still reflects the last VALID spec, and a transient
         # typo in an unrelated field must not wipe it.
         self._capacity_known = False
+        # Replica-churn audit (PR 13): container restart counts across
+        # this CR's pods surface as ``status.restarts`` when the rollout
+        # journal is enabled.  Same explicit-null contract; same
+        # config-error caution (an unparseable spec leaves the key
+        # untouched).
+        self._had_restarts_key = prior_status.get("restarts") is not None
+        self._prior_restarts = prior_status.get("restarts")
+        self._restarts_status = None
+        self._restarts_known = False
+        self._audit_config = None
         state = PromotionState.from_status(obj.get("status"))
         events: list[Event] = []
         try:
@@ -276,6 +290,7 @@ class Reconciler:
             return self._on_config_error(state, str(e), events)
         self._capacity_status = _capacity_summary(config)
         self._capacity_known = True
+        self._audit_config = config
 
         # 1. Resolve alias -> version (reference :57-62).
         try:
@@ -351,6 +366,124 @@ class Reconciler:
         if cap is None and not getattr(self, "_had_capacity_key", False):
             return
         self._patch_status(state)
+
+    # -- replica-churn audit (restart counts -> status.restarts) -------------
+
+    @property
+    def pods_ref(self) -> ObjectRef:
+        return ObjectRef(
+            namespace=self.namespace, name="", group="", version="v1",
+            plural="pods",
+        )
+
+    def _collect_restarts(self) -> dict | None:
+        """Summed container restart counts for this CR's pods (matched by
+        the builder's ``tpumlops/deployment`` label), as the
+        ``status.restarts`` block: ``{"total": N, "pods": {name: n}}``
+        with zero-restart pods omitted (steady state stays compact and a
+        fresh fleet reads ``{"total": 0, "pods": {}}``).  None = the pod
+        listing failed (RBAC, API hiccup) — leave status untouched
+        rather than publishing a fake zero."""
+        try:
+            pods = self.kube.list(self.pods_ref)
+        except Exception as e:  # NotFound / ApiError / transport
+            self.log.warning(f"pod listing for restart audit failed: {e}")
+            return None
+        total = 0
+        per_pod: dict[str, int] = {}
+        reasons: list[str] = []
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            if (meta.get("labels") or {}).get(
+                "tpumlops/deployment"
+            ) != self.name:
+                continue
+            n = 0
+            for cs in (pod.get("status") or {}).get(
+                "containerStatuses"
+            ) or []:
+                n += int(cs.get("restartCount") or 0)
+                term = (cs.get("lastState") or {}).get("terminated") or {}
+                if term.get("reason"):
+                    reasons.append(str(term["reason"]))
+            if n > 0:
+                per_pod[meta.get("name", "")] = n
+            total += n
+        return {
+            "total": total,
+            "pods": dict(sorted(per_pod.items())),
+            **({"lastReason": reasons[-1]} if reasons else {}),
+        }
+
+    def _sync_restart_audit(self, state: PromotionState) -> PromotionState:
+        """Surface replica churn next to the gate decisions.
+
+        Gated on ``spec.observability.historyLimit`` (the journal knob):
+        at the default 0 no pods are listed and every status patch is
+        byte-for-byte what it was.  When the summed restart count GROWS,
+        a ``ReplicaCrashLoop`` Warning fires (deduped: an unchanged
+        total never re-fires, across operator restarts too — the prior
+        total is read back from status) and a ``crashloop`` record joins
+        ``status.history``."""
+        config = self._audit_config
+        if config is None:
+            # The spec didn't parse this step: like the capacity summary,
+            # neither refresh nor clear — the block reflects the last
+            # VALID spec, and wiping it would reset the crash-loop dedupe
+            # baseline (a re-fired ReplicaCrashLoop for churn already
+            # announced once the typo is fixed).
+            return state
+        if config.observability.history_limit <= 0:
+            if getattr(self, "_had_restarts_key", False):
+                # Journal disabled with the key lingering: one explicit-
+                # null patch clears it, then steady state is patch-free.
+                self._restarts_known = True
+                self._restarts_status = None
+                self._patch_status(state)
+            return state
+        with self._op_timer("restart_audit"):
+            rs = self._collect_restarts()
+        if rs is None:
+            return state  # listing failed: neither refresh nor null
+        self._restarts_known = True
+        self._restarts_status = rs
+        prior = self._prior_restarts if isinstance(
+            self._prior_restarts, dict
+        ) else None
+        prior_total = int((prior or {}).get("total") or 0)
+        if rs["total"] > prior_total:
+            prior_pods = (prior or {}).get("pods") or {}
+            grown = tuple(
+                (pod, n)
+                for pod, n in rs["pods"].items()
+                if n > int(prior_pods.get(pod) or 0)
+            )
+            ev = Event(
+                "Warning",
+                "ReplicaCrashLoop",
+                f"Replica restarts {prior_total} -> {rs['total']} "
+                + ", ".join(f"{pod} x{n}" for pod, n in grown)
+                + (
+                    f" (last: {rs['lastReason']})"
+                    if rs.get("lastReason")
+                    else ""
+                ),
+            )
+            self.kube.emit_event(self.cr_ref, ev)
+            rec = CrashLoopRecord(
+                wall=self._wall(),
+                total=int(rs["total"]),
+                prior_total=prior_total,
+                pods=grown,
+                reason=str(rs.get("lastReason") or ""),
+            )
+            state = self._journal(config, state, rec)
+            self._patch_status(state)
+        elif rs != prior:
+            # Count shrank (pod replaced) or details shifted: refresh the
+            # block quietly — churn DOWN is not an alert.
+            self._patch_status(state)
+        return state
 
     def _shed_disabled_journal(
         self, config: OperatorConfig, state: PromotionState
@@ -1361,6 +1494,13 @@ class Reconciler:
             # Any patch carries the current summary (or its explicit
             # null), so the end-of-step sync knows nothing is left to do.
             self._prior_capacity = cap
+        if getattr(self, "_restarts_known", False):
+            rs = self._restarts_status
+            if rs is not None:
+                status["restarts"] = rs
+            elif getattr(self, "_had_restarts_key", False):
+                status.setdefault("restarts", None)
+            self._prior_restarts = rs
         status["conditions"] = state.conditions(
             getattr(self, "_prior_conditions", None), now_iso
         )

@@ -173,6 +173,36 @@ class TransitionRecord:
         }
 
 
+@dataclass(frozen=True)
+class CrashLoopRecord:
+    """Replica churn observed by the reconciler (``kind: "crashloop"``).
+
+    Journaled when the summed container restart count across a CR's
+    pods GROWS — one record per observed increase, beside the gate and
+    scale records, so "the canary gate refused while the new pod was
+    crash-looping" is reconstructable from ``status.history`` alone.
+    ``pods`` carries only the pods whose counts grew this observation."""
+
+    wall: float  # unix epoch seconds at observation time
+    total: int = 0  # summed restarts across all pods now
+    prior_total: int = 0  # what status.restarts carried before
+    pods: tuple = ()  # ((pod_name, restart_count), ...) for grown pods
+    reason: str = ""  # last terminated reason when one is visible
+
+    def as_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "kind": "crashloop",
+            "ts": self.wall,
+            "time": _iso(self.wall),
+            "total": self.total,
+            "priorTotal": self.prior_total,
+            "pods": {name: int(n) for name, n in self.pods},
+        }
+        if self.reason:
+            out["reason"] = self.reason
+        return out
+
+
 class RolloutRecorder:
     """Bounded per-CR journal of gate and transition records.
 

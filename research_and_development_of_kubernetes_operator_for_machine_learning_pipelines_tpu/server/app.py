@@ -35,7 +35,7 @@ from aiohttp import web
 from ..utils.config import ServerConfig, TpuSpec
 from .batching import DynamicBatcher
 from .engine import InferenceEngine
-from .generation import EngineOverloaded
+from .generation import EngineOverloaded, PoisonRequest
 from .loader import load_predictor
 from .metrics import ServerMetrics
 
@@ -295,6 +295,32 @@ class TpuInferenceServer:
         self.lifecycle = "ready"
         self.metrics.ready.labels(**self.metrics.identity).set(1)
         return True
+
+    def note_watchdog_stall(self, kind: str, age_s: float, inventory) -> None:
+        """Watchdog monitor-thread callback: a scheduler tick exceeded
+        the deadline (hung XLA dispatch / wedged device).  Flip
+        ``/readyz`` unready so balancers route elsewhere, count the
+        stall, and journal the in-flight picture — the flight-recorder
+        event is what lets an operator attribute the wedge to a tick
+        kind and slot set after the pod restarts."""
+        if self.lifecycle == "ready":
+            self.lifecycle = "stalled"
+            self.metrics.ready.labels(**self.metrics.identity).set(0)
+        self.metrics.inc_watchdog_stall()
+        if self.recorder is not None:
+            self.recorder.event(
+                "", "watchdog",
+                kind=kind, age_s=round(float(age_s), 3),
+                slots=list(inventory),
+            )
+
+    def note_watchdog_recover(self) -> None:
+        """The stalled tick completed after all (transient contention, a
+        pathological compile): re-ready — unless a drain/shutdown landed
+        meanwhile, whose state must win."""
+        if self.lifecycle == "stalled":
+            self.lifecycle = "ready"
+            self.metrics.ready.labels(**self.metrics.identity).set(1)
 
     async def wait_drained(self, grace_s: float | None = None) -> bool:
         """Await in-flight completion (bounded by ``grace_s``); True when
@@ -633,6 +659,20 @@ class TpuInferenceServer:
                 status=429,
                 headers={"Retry-After": str(e.retry_after_s)},
             )
+        except PoisonRequest as e:
+            # Quarantine contract: 422, NOT 4xx-retryable — the prompt
+            # itself crashes admission, so a retry (here or on any other
+            # replica) would crash it too.  No Retry-After on purpose.
+            code = 422
+            return web.json_response(
+                {
+                    "error": str(e),
+                    "reason": "poison_quarantined",
+                    "fingerprint": e.fingerprint,
+                    "crashes": e.crashes,
+                },
+                status=422,
+            )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             code = 400
             return web.json_response({"error": str(e)}, status=400)
@@ -697,13 +737,17 @@ class TpuInferenceServer:
                 await resp.write(f"data: {payload}\n\n".encode())
             if fut.cancelled():
                 codebox["code"] = 499
-                final = {"done": True, "error": "generation cancelled"}
+                await _write_sse_error(
+                    resp, request_id, "cancelled", "generation cancelled"
+                )
             elif fut.exception() is not None:
                 codebox["code"] = 500
-                final = {"done": True, "error": str(fut.exception())}
+                await _write_sse_error(
+                    resp, request_id, "engine_failed", str(fut.exception())
+                )
             else:
                 final = {"done": True, "output_ids": fut.result().tolist()}
-            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+                await resp.write(f"data: {json.dumps(final)}\n\n".encode())
         except (ConnectionError, OSError):
             # Client/transport went away mid-stream: free the engine slot
             # and end quietly (the outer handler must not try to write JSON
@@ -714,13 +758,20 @@ class TpuInferenceServer:
             fut.cancel()  # frees the slot at the next scheduler tick
             codebox["code"] = 499
             raise
-        except Exception:
+        except Exception as e:
             # Anything else: still cancel (or the slot decodes to
-            # max_new_tokens for nobody) and swallow — the status line is
-            # out, so a JSON error body can't be started.
+            # max_new_tokens for nobody) — the status line is out, so a
+            # JSON error body can't be started, but a terminal SSE
+            # ``error`` event usually still can: without it the client
+            # sees a dropped connection and cannot tell truncation from
+            # completion.
             _log.exception("stream failed mid-generation")
             fut.cancel()
             codebox["code"] = 500
+            with contextlib.suppress(Exception):
+                await _write_sse_error(
+                    resp, request_id, "stream_failed", str(e)
+                )
         finally:
             # A cancel frees the engine slot only at the NEXT scheduler
             # tick — finish the trace here (first writer wins: the
@@ -1317,6 +1368,10 @@ class TpuInferenceServer:
         # route above, so the manifest probe and the drain protocol read
         # one truth.
         app.router.add_get("/readyz", self.handle_ready)
+        # The router's half-open recovery probes GET /healthz; same
+        # handler as /readyz, so a draining/stalled replica (503) is
+        # never re-admitted by a probe.
+        app.router.add_get("/healthz", self.handle_ready)
         app.router.add_get("/livez", self.handle_live)
         app.router.add_post("/admin/drain", self.handle_admin_drain)
         app.router.add_post("/admin/attach", self.handle_admin_attach)
@@ -1349,6 +1404,25 @@ class TpuInferenceServer:
 
         app.on_shutdown.append(on_shutdown)
         return app
+
+
+async def _write_sse_error(
+    resp: web.StreamResponse, request_id: str, reason: str, message: str
+) -> None:
+    """Terminal SSE ``error`` event: a stream that dies mid-generation
+    must end with a typed event (request_id + reason) — a bare dropped
+    connection leaves the client unable to distinguish truncation from
+    completion.  ``done: true``/``error`` keys are kept so pre-existing
+    data-event consumers still terminate cleanly."""
+    payload = {
+        "done": True,
+        "error": message,
+        "request_id": request_id,
+        "reason": reason,
+    }
+    await resp.write(
+        f"event: error\ndata: {json.dumps(payload)}\n\n".encode()
+    )
 
 
 def _stamp_handoff(request: web.Request, traces) -> None:
@@ -1451,7 +1525,7 @@ def _to_v2_outputs(out: Any) -> list[dict]:
 
 def make_gen_engine(
     predictor, config: ServerConfig, channel=None, metrics=None,
-    recorder=None, telemetry=None,
+    recorder=None, telemetry=None, watchdog=None,
 ):
     """Construct the GenerationEngine for a causal-LM predictor.
 
@@ -1533,6 +1607,11 @@ def make_gen_engine(
         # Leader-side only, like the recorder: the ledger/observatory
         # describe the scheduling process; followers replay blind.
         telemetry=telemetry,
+        # Leader-side only: the scheduler heartbeat the watchdog
+        # monitors runs on the leader; followers block inside replayed
+        # collectives by design.
+        watchdog=watchdog,
+        on_poison=metrics.inc_poison if metrics else None,
     )
 
 
@@ -1646,6 +1725,18 @@ def build_server(
         from .flight_recorder import FlightRecorder
 
         recorder = FlightRecorder(config.tpu.observability.trace_ring)
+    watchdog = None
+    if config.watchdog_deadline_s > 0:
+        from .watchdog import EngineWatchdog
+
+        # Leader-side only, like the recorder: followers block inside
+        # replayed collectives by design, and the leader's escalation
+        # (process exit -> pod restart) tears the whole unit down.
+        watchdog = EngineWatchdog(
+            deadline_s=config.watchdog_deadline_s,
+            grace_s=config.watchdog_grace_s,
+            on_age=metrics.set_watchdog_tick_age,
+        )
 
     def _build_engines(predictor, channel=None):
         engine = InferenceEngine(
@@ -1664,7 +1755,7 @@ def build_server(
             # built in main()'s follower path, driven by follower_loop).
             gen_engine = make_gen_engine(
                 predictor, config, channel=channel, metrics=metrics,
-                recorder=recorder, telemetry=telemetry,
+                recorder=recorder, telemetry=telemetry, watchdog=watchdog,
             )
         return engine, gen_engine
 
@@ -1704,6 +1795,9 @@ def build_server(
             attach_fn=attach_fn,
             fleet_role=config.fleet_role,
         )
+        if watchdog is not None:
+            watchdog.on_stall = server.note_watchdog_stall
+            watchdog.on_recover = server.note_watchdog_recover
         if warmup:
             prewarm_from_snapshot(config)
         server.startup(warmup=False)  # lifecycle -> "warm-pool"
@@ -1735,7 +1829,7 @@ def build_server(
     if predictor.causal_lm is not None:
         gen_engine = make_gen_engine(
             predictor, config, channel=channel, metrics=metrics,
-            recorder=recorder, telemetry=telemetry,
+            recorder=recorder, telemetry=telemetry, watchdog=watchdog,
         )
     metrics.observe_model_load(load_stats)
     restored = load_stats.get("restore_s") is not None
@@ -1762,6 +1856,11 @@ def build_server(
         fleet_role=config.fleet_role,
     )
     server.predictor = predictor
+    if watchdog is not None:
+        # Wire the readiness/journal callbacks BEFORE startup arms the
+        # monitor — a stall must never fire into unassigned hooks.
+        watchdog.on_stall = server.note_watchdog_stall
+        watchdog.on_recover = server.note_watchdog_recover
     t_warm = time.time()
     server.startup(warmup=warmup)
     metrics.observe_cold_start("compile", time.time() - t_warm)
@@ -1783,6 +1882,7 @@ def _serve_follower_health(host: str, port: int) -> None:
         app = web.Application()
         app.router.add_get("/v2/health/live", ok)
         app.router.add_get("/v2/health/ready", ok)
+        app.router.add_get("/healthz", ok)
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         runner = web.AppRunner(app)
@@ -1990,6 +2090,23 @@ def main(argv: list[str] | None = None) -> None:
         "none of it",
     )
     ap.add_argument(
+        "--watchdog-deadline-s",
+        type=float,
+        default=0.0,
+        help="scheduler-tick watchdog deadline: a device dispatch "
+        "blocking past this flips /readyz unready and journals a "
+        "watchdog event (tpumlops_engine_watchdog_stalls_total); armed "
+        "only after warmup.  0 (default) disables the monitor entirely",
+    )
+    ap.add_argument(
+        "--watchdog-grace-s",
+        type=float,
+        default=30.0,
+        help="grace past the watchdog deadline before the process exits "
+        "non-zero so Kubernetes restarts the pod (a restart is the only "
+        "remedy for a wedged device)",
+    )
+    ap.add_argument(
         "--log-format",
         default="text",
         choices=["text", "json"],
@@ -2059,6 +2176,8 @@ def main(argv: list[str] | None = None) -> None:
         ),
         warm_pool=bool(args.warm_pool),
         fleet_role=args.fleet_role,
+        watchdog_deadline_s=args.watchdog_deadline_s,
+        watchdog_grace_s=args.watchdog_grace_s,
     )
     if config.warm_pool and not config.tpu.snapshot.enabled:
         ap.error("--warm-pool requires --snapshot-dir")

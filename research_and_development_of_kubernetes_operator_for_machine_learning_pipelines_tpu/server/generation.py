@@ -84,6 +84,29 @@ class EngineOverloaded(RuntimeError):
         self.retry_after_s = int(retry_after_s)
 
 
+class PoisonRequest(ValueError):
+    """The request's prompt fingerprint is quarantined.
+
+    A request whose admission/prefill crashed the engine twice (same
+    prompt hash both times) is rejected SYNCHRONOUSLY from
+    :meth:`GenerationEngine.submit` instead of being given a third shot
+    at crash-looping the replica — every crash fails ALL in-flight
+    sequences and reallocates device state, so one poison prompt
+    retried by a well-meaning client would take the whole replica's
+    traffic down with it on every attempt.  The HTTP layer maps this to
+    a typed ``422`` (the request is unprocessable HERE AND EVERYWHERE —
+    a retry on another replica would crash it too, so no Retry-After).
+    """
+
+    def __init__(self, fingerprint: str, crashes: int):
+        super().__init__(
+            f"prompt quarantined: admission crashed the engine {crashes} "
+            f"times (fingerprint {fingerprint})"
+        )
+        self.fingerprint = fingerprint
+        self.crashes = int(crashes)
+
+
 def _safe_resolve(fut: Future, value) -> None:
     """set_result tolerating a concurrent client-side cancel (TOCTOU: the
     cancelled() check and set_result are not atomic across threads)."""
@@ -278,6 +301,8 @@ class GenerationEngine:
         telemetry=None,  # device_telemetry.DeviceTelemetry | None
         decode_steps: int = 1,
         on_dispatch: Callable[[str], None] | None = None,
+        watchdog=None,  # watchdog.EngineWatchdog | None (leader-side)
+        on_poison: Callable[[str], None] | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -446,6 +471,39 @@ class GenerationEngine:
             )
         self._fused = self._decode_steps > 1
         self._on_dispatch = on_dispatch
+        # Scheduler-loop watchdog (server/watchdog.py): None — the
+        # default — keeps the loop byte-for-byte (every beat below is
+        # guarded).  Leader-side only, like the recorder: followers
+        # block inside replayed collectives by design and the leader's
+        # exit tears the unit down.
+        self._watchdog = watchdog
+        # An IDLE scheduler blocks in queue.get and beats only once per
+        # poll — a deadline below the poll interval would read every
+        # quiet second as a stall (readiness flapping, spurious journal
+        # events, and with a short grace an exit loop on a healthy idle
+        # pod).  Halve the idle poll under the deadline so idle beats
+        # always land in time.
+        self._idle_poll_s = (
+            min(1.0, watchdog.deadline_s / 2.0)
+            if watchdog is not None else 1.0
+        )
+        if watchdog is not None:
+            # The engine owns the slot truth; the server owns the
+            # readiness/metrics callbacks.  Unconditional: a warm-pool
+            # attach/replace hands the SAME watchdog to its new engine,
+            # and the inventory must follow.
+            watchdog.slot_inventory = self._slot_inventory
+        # Poison-request quarantine: prompt fingerprints whose
+        # admission/prefill crashed the engine, and the ones past the
+        # crash threshold that submit now refuses with a typed 422.
+        # Always on — it only changes behavior on the Nth crash of a
+        # prompt that already took every in-flight request down twice.
+        self._poison_counts: dict[str, int] = {}
+        self._quarantined: dict[str, int] = {}
+        self._poison_lock = threading.Lock()
+        self._on_poison = on_poison  # fed "quarantined" | "rejected"
+        self.poison_quarantined_total = 0
+        self.poison_rejected_total = 0
         # Engine device dispatches by tick kind (the amortization series:
         # a fused K-step tick is ONE dispatch where the plain loop paid
         # K) — mirrored to tpumlops_engine_dispatches_total{op} via
@@ -957,10 +1015,48 @@ class GenerationEngine:
     def start(self, warmup: bool = True) -> None:
         if warmup:
             self._warmup()
+        if self._watchdog is not None:
+            # Arm AFTER warmup: the compile sweep legitimately blocks
+            # far past any sane tick deadline.
+            self._watchdog.arm()
+            self._watchdog.start()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="generation-scheduler"
         )
         self._thread.start()
+
+    def _beat(self, kind: str | None = None) -> None:
+        """One scheduler heartbeat (no-op without a watchdog — the
+        default keeps the loop byte-for-byte)."""
+        if self._watchdog is not None:
+            self._watchdog.beat(kind)
+
+    def _slot_inventory(self) -> list:
+        """Best-effort in-flight snapshot for the watchdog's stall event
+        (called from the MONITOR thread while the scheduler is wedged —
+        reads race its last mutation by design; the watchdog tolerates
+        raises)."""
+        inv = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            inv.append({
+                "slot": i,
+                "request_id": slot.request_id,
+                "prompt_len": int(slot.prompt_len),
+                "generated": len(slot.generated),
+                "remaining": int(slot.remaining),
+            })
+        for prog in list(self._pending):
+            inv.append({
+                "slot": int(getattr(prog, "slot", -1)),
+                "request_id": prog.req.request_id,
+                "prompt_len": int(prog.req.prompt.size),
+                "generated": 0,
+                "remaining": int(prog.req.max_new_tokens),
+                "admitting": True,
+            })
+        return inv
 
     def _warmup(self) -> None:
         """Compile every decode program before readiness, so no live request
@@ -1117,6 +1213,12 @@ class GenerationEngine:
         _log.info("generation warmup in %.1fs", time.perf_counter() - t0)
 
     def shutdown(self) -> None:
+        if self._watchdog is not None:
+            # Disarm BEFORE the join: teardown legitimately stops
+            # beating, and an escalation mid-shutdown would turn a clean
+            # drain into an os._exit.
+            self._watchdog.disarm()
+            self._watchdog.stop()
         self._stop.set()
         self._queue.put(None)  # unblock the scheduler
         if self._thread is not None:
@@ -1330,7 +1432,75 @@ class GenerationEngine:
             # jax.random.key takes an int64; reject before admission so one
             # bad request can't poison the scheduler for everyone else.
             raise ValueError(f"seed must be in [0, 2**63), got {seed}")
+        # Poison quarantine: a prompt whose admission crashed the engine
+        # twice is refused at the door (typed 422 upstream) instead of
+        # getting a third shot at crash-looping the replica.  The dict
+        # gate keeps the hot path hash-free until a crash ever happens.
+        if self._quarantined:
+            fp = self._fingerprint(prompt)
+            with self._poison_lock:
+                crashes = self._quarantined.get(fp)
+            if crashes is not None:
+                self.poison_rejected_total += 1
+                if self._on_poison is not None:
+                    self._on_poison("rejected")
+                raise PoisonRequest(fp, crashes)
         return prompt
+
+    # -- poison-request quarantine -------------------------------------------
+
+    # Crashes of the same prompt fingerprint before submits refuse it:
+    # the first crash could be anything (device wedge, OOM race), the
+    # second with every OTHER request meanwhile fine is the prompt.
+    POISON_CRASH_THRESHOLD = 2
+
+    @staticmethod
+    def _fingerprint(prompt: np.ndarray) -> str:
+        import hashlib
+
+        return hashlib.sha256(
+            np.ascontiguousarray(prompt, np.int64).tobytes()
+        ).hexdigest()[:16]
+
+    def _note_admission_crash(self, reqs) -> None:
+        """Attribute an admission/prefill crash to the implicated
+        request(s) by prompt fingerprint; quarantine at the threshold.
+
+        Called from the scheduler thread's crash handlers only — decode
+        crashes are NOT attributed (every slot was in flight; blaming
+        any of them would quarantine innocents).  In packed mode all
+        batched admissions are implicated: the poison one accumulates
+        toward the threshold on every retry while innocents' counts
+        only grow if they keep co-batching with it."""
+        for req in reqs:
+            if req is None:
+                continue
+            try:
+                fp = self._fingerprint(req.prompt)
+            except Exception:
+                continue
+            newly = False
+            with self._poison_lock:
+                n = self._poison_counts.get(fp, 0) + 1
+                self._poison_counts[fp] = n
+                if n >= self.POISON_CRASH_THRESHOLD and fp not in self._quarantined:
+                    self._quarantined[fp] = n
+                    newly = True
+            if newly:
+                self.poison_quarantined_total += 1
+                _log.error(
+                    "poison quarantine: prompt fingerprint %s crashed "
+                    "admission %d times; further submits are refused "
+                    "with a typed 422",
+                    fp, n,
+                )
+                if self._on_poison is not None:
+                    self._on_poison("quarantined")
+                if self._recorder is not None:
+                    self._recorder.event(
+                        req.request_id or "", "poison-quarantine",
+                        fingerprint=fp, crashes=n,
+                    )
 
     def submit(
         self,
@@ -1433,6 +1603,7 @@ class GenerationEngine:
         # any user-specified jax.random.key(seed) stream (see _slot_key_for).
         slot_key = self._slot_key_for(req)
         t0 = time.perf_counter()
+        self._beat("admit")
         first = self._dispatch_admit(
             ids, slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p
         )
@@ -2046,6 +2217,7 @@ class GenerationEngine:
         knob caps the chunks packed per tick, Sarathi-style: decode ticks
         interleave every tick regardless, so bounding prefill work per
         tick bounds the decode-cadence jitter long prompts can inject."""
+        self._beat("packed-prefill")
         C = self._prefill_chunk_size
         max_chunks = self._prefill_batch
         if self._prefill_token_budget:
@@ -2323,6 +2495,7 @@ class GenerationEngine:
         (the batch-1 scratch cache serializes admissions); packed mode
         advances through :meth:`_packed_tick`."""
         assert self._pending
+        self._beat("prefill")
         prog = self._pending[0]
         if prog.cached_tokens and not prog.seeded:
             # Cached-prefix hit: one seed op copies the radix-cached K/V
@@ -2525,6 +2698,7 @@ class GenerationEngine:
             self._step_fused(active_np, sampling)
             return
         t0 = time.perf_counter()
+        self._beat("decode")
         self._dispatch_step(active_np, window, sampling)
         toks = np.asarray(self._tokens)[:, 0]
         self._note_tick(
@@ -2620,6 +2794,7 @@ class GenerationEngine:
                 min(needed_hi + K - 1, self.capacity), self.capacity
             )
             t0 = time.perf_counter()
+            self._beat("multistep")
             tok_block, valid = self._dispatch_multistep(
                 active_np if start else None,
                 remaining if start else None,
@@ -2829,6 +3004,7 @@ class GenerationEngine:
             toks[i, 1 : 1 + len(d)] = d
             draft_len[i] = len(d)
         t0 = time.perf_counter()
+        self._beat("verify")
         greedy, accepted = self._dispatch_verify(
             toks, active_np, draft_len, window
         )
@@ -2968,6 +3144,10 @@ class GenerationEngine:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            # Heartbeat: the idle stamp is overwritten by the dispatch
+            # sites below just before they block on a device call, so a
+            # wedged tick is attributed to its kind, not to "idle".
+            self._beat("idle")
             if not self._admit_phase():
                 return  # shutdown sentinel
             try:
@@ -2996,6 +3176,7 @@ class GenerationEngine:
                 self._chunk_tick()
             except Exception as exc:
                 _log.exception("chunked prefill failed")
+                self._note_admission_crash([prog.req])
                 self._pending = []
                 self._seq_state = None
                 if not prog.req.future.done():
@@ -3005,7 +3186,7 @@ class GenerationEngine:
         while self._free_slot() is not None:
             try:
                 idle = all(s is None for s in self._slots)
-                req = self._queue.get(block=idle, timeout=1.0)
+                req = self._queue.get(block=idle, timeout=self._idle_poll_s)
             except queue.Empty:
                 break
             if isinstance(req, _Wake):
@@ -3034,6 +3215,7 @@ class GenerationEngine:
                 self._admit(req)
             except Exception as exc:  # keep the scheduler alive
                 _log.exception("admit failed")
+                self._note_admission_crash([req])
                 if not req.future.done():
                     _safe_fail(req.future, exc)
                 self._fail_all_and_recover()
@@ -3050,7 +3232,9 @@ class GenerationEngine:
                 break
             idle = not self._pending and all(s is None for s in self._slots)
             try:
-                req = self._queue.get(block=idle and not popped, timeout=1.0)
+                req = self._queue.get(
+                    block=idle and not popped, timeout=self._idle_poll_s
+                )
             except queue.Empty:
                 break
             if isinstance(req, _Wake):
@@ -3080,6 +3264,7 @@ class GenerationEngine:
             self._packed_tick()
         except Exception as exc:
             _log.exception("packed prefill failed")
+            self._note_admission_crash([p.req for p in self._pending])
             for prog in self._pending:
                 if not prog.req.future.done():
                     _safe_fail(prog.req.future, exc)

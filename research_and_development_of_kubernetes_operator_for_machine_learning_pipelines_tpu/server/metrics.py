@@ -431,6 +431,38 @@ class ServerMetrics:
             ident_labels,
             registry=self.registry,
         )
+        # Failure containment (PR 13).  Watchdog families sit at 0 until
+        # --watchdog-deadline-s arms the monitor; the poison counters
+        # back the always-on quarantine (a prompt whose admission
+        # crashed the engine twice is refused with a typed 422).
+        self.watchdog_stalls = Counter(
+            "tpumlops_engine_watchdog_stalls_total",
+            "Scheduler ticks that exceeded the watchdog deadline "
+            "(each flips /readyz unready and journals a watchdog event)",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.watchdog_tick_age = Gauge(
+            "tpumlops_engine_watchdog_last_tick_age_seconds",
+            "Age of the scheduler's last heartbeat as seen by the "
+            "watchdog monitor (0 while disarmed; climbs during a stall)",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.poison_quarantined = Counter(
+            "tpumlops_engine_poison_quarantined_total",
+            "Prompt fingerprints quarantined after repeated "
+            "admission/prefill crashes",
+            ident_labels,
+            registry=self.registry,
+        )
+        self.poison_rejected = Counter(
+            "tpumlops_engine_poison_rejected_total",
+            "Submissions refused (typed 422) because their prompt "
+            "fingerprint is quarantined",
+            ident_labels,
+            registry=self.registry,
+        )
 
     # -- recording helpers ---------------------------------------------------
 
@@ -478,6 +510,20 @@ class ServerMetrics:
         self.engine_active_slots.labels(**self.identity).set(active_slots)
         self.engine_queue_depth.labels(**self.identity).set(queue_depth)
         self.engine_admitting.labels(**self.identity).set(admitting)
+
+    def inc_watchdog_stall(self):
+        self.watchdog_stalls.labels(**self.identity).inc()
+
+    def set_watchdog_tick_age(self, seconds: float):
+        self.watchdog_tick_age.labels(**self.identity).set(seconds)
+
+    def inc_poison(self, action: str):
+        """``action``: "quarantined" (fingerprint crossed the crash
+        threshold) or "rejected" (a submit refused with the typed 422)."""
+        if action == "quarantined":
+            self.poison_quarantined.labels(**self.identity).inc()
+        else:
+            self.poison_rejected.labels(**self.identity).inc()
 
     def inc_shed(self, reason: str):
         self.shed.labels(**self.identity, reason=reason).inc()

@@ -1028,6 +1028,13 @@ class ServerConfig:
     # advisory identity surfaced on /readyz and in logs; the KV
     # endpoints exist on every role (the router decides who does what).
     fleet_role: str = "unified"
+    # Scheduler-loop watchdog (server --watchdog-deadline-s): a tick
+    # exceeding the deadline flips /readyz unready and journals a
+    # ``watchdog`` flight-recorder event; if the stall persists past the
+    # grace the process exits so Kubernetes restarts the pod.  0 (the
+    # default) constructs no watchdog — the engine loop is byte-for-byte.
+    watchdog_deadline_s: float = 0.0
+    watchdog_grace_s: float = 30.0
 
 
 @dataclass(frozen=True)

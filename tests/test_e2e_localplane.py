@@ -1439,3 +1439,204 @@ def test_disaggregated_fleet_affinity_relay_and_trace(llm_models):
         router.stop()
         for h in handles:
             h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos e2e (PR 13): kill/restart a live replica under sustained load —
+# every client request resolves 200 or TYPED (never a bare 502, never a
+# hang), the dead backend is ejected within the failure threshold, and
+# half-open probing re-admits the restarted pod within a bounded window.
+# The whole story is reconstructable from /router/fleet + the flight
+# recorder + the new metric families alone.
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_replica_kill_and_restart_under_load(llm_models):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
+        parse_prometheus_text,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.config import (
+        TpuSpec,
+    )
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.chaos import (
+        ChaosProxy,
+    )
+
+    tpu = TpuSpec.from_spec(
+        {
+            "meshShape": {"tp": 1},
+            "maxBatchSize": 2,
+            "maxSlots": 2,
+            "observability": {"traceRing": 128},
+        }
+    )
+    pa, pb = free_port(), free_port()
+    ha = start_model_server(
+        llm_models["1"], "a", pa, model_name="llm", namespace="models",
+        tpu=tpu, warmup=False,
+    )
+    hb = start_model_server(
+        llm_models["1"], "b", pb, model_name="llm", namespace="models",
+        tpu=tpu, warmup=False,
+    )
+    # Replica b sits behind the data-plane chaos harness: proxy.stop()
+    # is the HARD kill (instant ECONNREFUSED, exactly the dead-pod
+    # shape — an in-process handle.stop() would drain gracefully and
+    # muddy the failure class), proxy.restart() the pod coming back on
+    # the same address.
+    chaos = ChaosProxy(pb)
+    probe_s = 0.3
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "a": ("127.0.0.1", pa, 50),
+            "b": ("127.0.0.1", chaos.port, 50),
+        },
+        namespace="models",
+        deployment="llm",
+        health_probes=True,
+        health_threshold=3,
+        probe_interval_s=probe_s,
+        failover_retries=2,
+    ).start()
+
+    results: list = []  # (code, body | None, exception_repr | None)
+    stop_load = threading.Event()
+
+    def client_loop():
+        body = _json.dumps(
+            {"prompt_ids": [5, 9, 2], "max_new_tokens": 2}
+        ).encode()
+        while not stop_load.is_set():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/v2/models/llm/generate",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    results.append((resp.status, _json.loads(resp.read()), None))
+            except urllib.error.HTTPError as e:
+                raw = e.read() or b"null"
+                try:
+                    parsed = _json.loads(raw)
+                except _json.JSONDecodeError:
+                    parsed = raw.decode(errors="replace")
+                results.append(
+                    (e.code, parsed, e.headers.get("Retry-After"))
+                )
+            except Exception as e:  # hang/transport failure = test FAIL
+                results.append((None, None, repr(e)))
+
+    def fleet_health():
+        return {
+            b["name"]: b["healthy"]
+            for b in router.admin.fleet()["backends"]
+        }
+
+    try:
+        # Prime both replicas' lazy compiles before the clock matters.
+        warm = _json.dumps(
+            {"prompt_ids": [5, 9, 2], "max_new_tokens": 2}
+        ).encode()
+        for _ in range(6):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/v2/models/llm/generate",
+                data=warm, headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                assert resp.status == 200
+        assert fleet_health() == {"a": True, "b": True}
+
+        loaders = [
+            threading.Thread(target=client_loop, daemon=True)
+            for _ in range(3)
+        ]
+        for t in loaders:
+            t.start()
+        time.sleep(1.0)
+
+        chaos.stop()  # the kill: port closed mid-load
+        # Ejected within the failure threshold: consecutive masked
+        # failures trip b's circuit while clients keep resolving.
+        wait_for(
+            lambda: not fleet_health()["b"],
+            timeout=15,
+            what="circuit trip on b",
+        )
+        fleet = router.admin.fleet()
+        b_rec = next(x for x in fleet["backends"] if x["name"] == "b")
+        assert b_rec["circuit_opened"] >= 1
+
+        time.sleep(0.5)  # a window of single-replica serving under load
+
+        # The restart: same address, and re-admission is bounded by the
+        # half-open probe cadence alone (< 2x the capped interval).
+        t_restart = time.monotonic()
+        chaos.restart()
+        wait_for(
+            lambda: fleet_health()["b"],
+            timeout=2 * probe_s * 8 + 5,
+            what="half-open re-admission of b",
+        )
+        readmit_s = time.monotonic() - t_restart
+        assert readmit_s < 2 * probe_s * 8, readmit_s
+
+        time.sleep(1.0)  # both replicas share load again
+        stop_load.set()
+        for t in loaders:
+            t.join(timeout=60)
+
+        # THE acceptance pin: zero bare 502s, zero hangs — every request
+        # resolved 200 or typed with Retry-After.
+        assert results, "load loop produced nothing"
+        hangs = [r for r in results if r[0] is None]
+        assert not hangs, hangs[:5]
+        bare = [r for r in results if r[0] == 502]
+        assert not bare, bare[:5]
+        for code, body, retry_after in results:
+            if code == 200:
+                continue
+            assert code in (503, 429), (code, body)
+            assert isinstance(body, dict) and body.get("reason"), body
+            assert retry_after is not None, (code, body)
+        assert sum(1 for r in results if r[0] == 200) > 10
+
+        # Story reconstruction: the router's fleet view + metric
+        # families carry the incident end to end...
+        mt = parse_prometheus_text(router.admin.metrics_text())
+        trips = sum(
+            v for (name, labels), v in mt.items()
+            if name == "tpumlops_router_circuit_open_total"
+        )
+        assert trips >= 1
+        healthy_now = {
+            dict(labels)["predictor_name"]: v
+            for (name, labels), v in mt.items()
+            if name == "tpumlops_router_backend_healthy"
+        }
+        assert healthy_now == {"a": 1.0, "b": 1.0}
+        assert any(
+            name == "tpumlops_router_probe_seconds_count"
+            for (name, _), _v in mt.items()
+        )
+        # ...and the surviving replica's flight recorder holds the tick
+        # journal for the single-replica window (decode ticks recorded).
+        eng = _json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{pa}/debug/engine", timeout=10
+            ).read()
+        )
+        assert eng["ticks_recorded"] > 0
+        assert {t["kind"] for t in eng["ticks"]} >= {"decode"}
+    finally:
+        stop_load.set()
+        router.stop()
+        chaos.stop()
+        ha.stop()
+        hb.stop()

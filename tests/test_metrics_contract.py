@@ -49,6 +49,15 @@ EXPECTED_SERVER = {
     # exported as tpumlops_engine_shed_total.  The autoscaler's alert
     # surface for "replica refusing load".
     "tpumlops_engine_shed": ("counter", _IDENT + ("reason",)),
+    # Failure containment (PR 13): scheduler-watchdog stalls + heartbeat
+    # age (0 while disarmed — the families exist so dashboards are
+    # uniform across fleets with and without --watchdog-deadline-s), and
+    # the always-on poison-request quarantine (fingerprints quarantined
+    # after repeated admission crashes; typed-422 refusals).
+    "tpumlops_engine_watchdog_stalls": ("counter", _IDENT),
+    "tpumlops_engine_watchdog_last_tick_age_seconds": ("gauge", _IDENT),
+    "tpumlops_engine_poison_quarantined": ("counter", _IDENT),
+    "tpumlops_engine_poison_rejected": ("counter", _IDENT),
     "tpumlops_feedback_reward_total": ("gauge", _IDENT),
     "tpumlops_generated_tokens": ("counter", _IDENT),
     "tpumlops_itl_seconds": ("histogram", _IDENT),
@@ -204,6 +213,12 @@ def test_router_fleet_series_pinned():
             "tpumlops_router_kv_handoff_bytes",
             "tpumlops_router_kv_handoff_failures",
             "tpumlops_router_kv_handoff_seconds",
+            # Failure containment: failover re-dispatches + half-open
+            # probe walls (deployment-scoped; backend_healthy /
+            # circuit_open_total are per-backend and pinned in
+            # tests/test_router.py).
+            "tpumlops_router_failover_total",
+            "tpumlops_router_probe_seconds",
         }
     finally:
         router.stop()

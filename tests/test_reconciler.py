@@ -390,3 +390,209 @@ def test_source_cache_cleared_when_alias_vanishes():
     registry.set_alias("iris", "champion", "1")
     rec.reconcile(kube.get(cr_ref()))
     assert "NEW" in kube.get(sd_ref())["spec"]["predictors"][0]["graph"]["modelUri"]
+
+
+# ---------------------------------------------------------------------------
+# Replica-churn audit (PR 13): restart counts -> status.restarts +
+# deduped ReplicaCrashLoop events + crashloop journal records.
+# ---------------------------------------------------------------------------
+
+
+def pod_ref(name):
+    return ObjectRef(
+        namespace=NS, name=name, group="", version="v1", plural="pods"
+    )
+
+
+def make_pod(kube, name, restarts=0, reason=None, deployment=NAME):
+    status = {
+        "containerStatuses": [
+            {
+                "name": "server",
+                "restartCount": restarts,
+                **(
+                    {"lastState": {"terminated": {"reason": reason}}}
+                    if reason
+                    else {}
+                ),
+            }
+        ]
+    }
+    body = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": NS,
+            "labels": {"tpumlops/deployment": deployment},
+        },
+        "status": status,
+    }
+    try:
+        kube.create(pod_ref(name), body)
+    except Exception:
+        # Pod exists: replace() preserves the status subresource
+        # (Kubernetes semantics), so restart-count updates go through
+        # patch_status.
+        kube.patch_status(pod_ref(name), status)
+
+
+def test_restart_audit_disabled_is_byte_for_byte():
+    """historyLimit 0 (the default): no pods are consulted, no
+    status.restarts key appears, no event — status patches are exactly
+    the pre-PR shape."""
+    kube, registry, metrics, clock, rec = make_world()
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    make_pod(kube, "iris-v1-abc", restarts=7, reason="Error")
+    for _ in range(3):
+        reconcile(kube, rec)
+    status = kube.get(cr_ref())["status"]
+    assert "restarts" not in status
+    assert "ReplicaCrashLoop" not in kube.event_reasons()
+
+
+def test_restart_audit_surfaces_counts_event_and_journal_deduped():
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    reconcile(kube, rec)
+    status = kube.get(cr_ref())["status"]
+    # The key appears as soon as the audit is on — zero is a statement.
+    assert status["restarts"] == {"total": 0, "pods": {}}
+
+    make_pod(kube, "iris-v1-abc", restarts=2, reason="Error")
+    reconcile(kube, rec)
+    status = kube.get(cr_ref())["status"]
+    assert status["restarts"]["total"] == 2
+    assert status["restarts"]["pods"] == {"iris-v1-abc": 2}
+    assert status["restarts"]["lastReason"] == "Error"
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1
+    crash = [
+        r for r in status["history"] if r.get("kind") == "crashloop"
+    ]
+    assert len(crash) == 1
+    assert crash[0]["total"] == 2 and crash[0]["priorTotal"] == 0
+    assert crash[0]["pods"] == {"iris-v1-abc": 2}
+    assert crash[0]["reason"] == "Error"
+
+    # Unchanged counts: NO new event, NO new record, NO status churn.
+    rv_before = kube.get(cr_ref())["metadata"]["resourceVersion"]
+    reconcile(kube, rec)
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1
+    status = kube.get(cr_ref())["status"]
+    assert len(
+        [r for r in status["history"] if r.get("kind") == "crashloop"]
+    ) == 1
+    assert kube.get(cr_ref())["metadata"]["resourceVersion"] == rv_before
+
+    # Growth fires again with the prior total attributed.
+    make_pod(kube, "iris-v1-abc", restarts=3, reason="OOMKilled")
+    reconcile(kube, rec)
+    status = kube.get(cr_ref())["status"]
+    assert status["restarts"]["total"] == 3
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 2
+    crash = [
+        r for r in status["history"] if r.get("kind") == "crashloop"
+    ]
+    assert crash[-1]["priorTotal"] == 2 and crash[-1]["total"] == 3
+    assert crash[-1]["reason"] == "OOMKilled"
+
+
+def test_restart_audit_dedupe_survives_operator_restart():
+    """The prior total is read back from status, so a fresh reconciler
+    (operator restart) does NOT re-announce old churn."""
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    make_pod(kube, "iris-v1-abc", restarts=2)
+    reconcile(kube, rec)
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1
+    rec2 = Reconciler(NAME, NS, kube, registry, metrics, FakeClock())
+    reconcile(kube, rec2)
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1
+
+
+def test_restart_audit_scopes_to_this_deployment_and_handles_shrink():
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    make_pod(kube, "other-pod", restarts=9, deployment="other")
+    reconcile(kube, rec)
+    status = kube.get(cr_ref())["status"]
+    assert status["restarts"] == {"total": 0, "pods": {}}
+
+    # A crash-looping pod gets REPLACED (fresh pod, count back to 0):
+    # the block refreshes quietly — churn down is not an alert.
+    make_pod(kube, "iris-v1-abc", restarts=4)
+    reconcile(kube, rec)
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1
+    kube.delete(pod_ref("iris-v1-abc"))
+    make_pod(kube, "iris-v1-def", restarts=0)
+    reconcile(kube, rec)
+    status = kube.get(cr_ref())["status"]
+    assert status["restarts"] == {"total": 0, "pods": {}}
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1  # no re-fire
+
+
+def test_restart_audit_clears_key_when_disabled_again():
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    make_pod(kube, "iris-v1-abc", restarts=1)
+    reconcile(kube, rec)
+    assert kube.get(cr_ref())["status"]["restarts"]["total"] == 1
+    # Flip the journal off: one explicit-null patch clears the key.
+    obj = kube.get(cr_ref())
+    obj["spec"]["observability"] = {"historyLimit": 0}
+    kube.replace(cr_ref(), obj)
+    reconcile(kube, rec)
+    status = kube.get(cr_ref())["status"]
+    assert status.get("restarts") is None
+
+
+def test_restart_audit_untouched_by_transient_config_error():
+    """A spec typo must not wipe status.restarts: wiping it resets the
+    dedupe baseline, so fixing the typo would re-fire ReplicaCrashLoop
+    (event + journal record) for churn that was already announced —
+    same leave-untouched contract as the capacity summary."""
+    kube, registry, metrics, clock, rec = make_world(
+        {"observability": {"historyLimit": 8}}
+    )
+    metrics.set_metrics(NAME, "v1", NS, GOOD)
+    make_pod(kube, "iris-v1-abc", restarts=3, reason="Error")
+    reconcile(kube, rec)
+    assert kube.get(cr_ref())["status"]["restarts"]["total"] == 3
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1
+
+    # Break the spec in place (unrelated field) for one reconcile.
+    ref = cr_ref()
+    obj = kube.get(ref)
+    good_backend = obj["spec"].get("backend")
+    obj["spec"]["backend"] = "gpu"
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(ref, obj)
+    reconcile(kube, rec)
+    status = kube.get(ref)["status"]
+    assert "invalid spec" in status["error"]
+    assert status["restarts"]["total"] == 3  # neither cleared nor refreshed
+
+    # Typo fixed: the audit resumes with its baseline intact — no
+    # re-announcement of the restarts it already journaled.
+    obj = kube.get(ref)
+    if good_backend is None:
+        obj["spec"].pop("backend", None)
+    else:
+        obj["spec"]["backend"] = good_backend
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(ref, obj)
+    reconcile(kube, rec)
+    status = kube.get(ref)["status"]
+    assert status["restarts"]["total"] == 3
+    assert kube.event_reasons().count("ReplicaCrashLoop") == 1
+    assert len(
+        [r for r in status["history"] if r.get("kind") == "crashloop"]
+    ) == 1

@@ -1064,3 +1064,495 @@ def test_router_sync_parks_zero_replica_predictors(binary):
     finally:
         router.stop()
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Failure containment (PR 13): circuit breaking, half-open probes,
+# before-first-byte failover, park composition, and the ChaosProxy
+# data-plane harness.
+# ---------------------------------------------------------------------------
+
+import time as _t
+
+from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.chaos import (
+    ChaosProxy,
+)
+
+
+def _collect_codes(port, n, path="/predict", timeout=10):
+    """Serial requests; returns [(code, parsed_body_or_none), ...] — an
+    exception other than HTTPError records (None, str)."""
+    out = []
+    for _ in range(n):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=b"{}"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out.append((resp.status, json.loads(resp.read())))
+        except urllib.error.HTTPError as e:
+            raw = e.read() or b""
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                body = raw.decode(errors="replace")  # bare 502 is text
+            out.append((e.code, body))
+        except Exception as e:
+            out.append((None, str(e)))
+    return out
+
+
+def _fleet_health(router) -> dict:
+    return {
+        b["name"]: b["healthy"] for b in router.admin.fleet()["backends"]
+    }
+
+
+def test_circuit_trips_ejects_and_half_open_probe_readmits(binary):
+    """The tentpole loop: consecutive failures against one backend trip
+    its circuit (ejected from the pick while the healthy peer serves
+    everything), /router/fleet + the metric families tell the story, and
+    a restart on the same port is re-admitted by half-open probing
+    within ~2x the probe interval."""
+    srv1, p1 = start_backend("a")
+    srv2, p2 = start_backend("b")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", p1, 50), "b": ("127.0.0.1", p2, 50)},
+        namespace="models",
+        deployment="chaos",
+        binary=binary,
+        health_probes=True,
+        health_threshold=2,
+        probe_interval_s=0.3,
+        failover_retries=2,
+    ).start()
+    try:
+        # Healthy split first (also fills the keep-alive pools).
+        codes = _collect_codes(router.port, 4)
+        assert [c for c, _ in codes] == [200] * 4
+        assert _fleet_health(router) == {"a": True, "b": True}
+
+        srv2.shutdown()
+        srv2.server_close()  # port closed: the dead-pod shape
+
+        # Every client request still resolves 200 (failover masks the
+        # deaths) while the failures trip b's circuit.
+        codes = _collect_codes(router.port, 10)
+        assert [c for c, _ in codes] == [200] * 10, codes
+        assert all(body["who"] == "a" for _, body in codes[-4:])
+        health = _fleet_health(router)
+        assert health == {"a": True, "b": False}, health
+        fleet = router.admin.fleet()
+        b_rec = next(
+            b for b in fleet["backends"] if b["name"] == "b"
+        )
+        assert b_rec["circuit_opened"] >= 1
+        assert fleet["failovers"] >= 1
+        mt = router.admin.metrics_text()
+        assert 'tpumlops_router_backend_healthy{deployment_name="chaos"' \
+            in mt
+        healthy_vals = {
+            ln.split("predictor_name=\"")[1].split("\"")[0]:
+                ln.rsplit(" ", 1)[1]
+            for ln in mt.splitlines()
+            if ln.startswith("tpumlops_router_backend_healthy{")
+        }
+        assert healthy_vals == {"a": "1", "b": "0"}
+        assert "tpumlops_router_circuit_open_total{" in mt
+        assert "tpumlops_router_failover_total{" in mt
+        assert "tpumlops_router_probe_seconds_bucket" in mt
+
+        # While b is ejected, traffic never touches it: the SWRR pick
+        # skips open circuits entirely.
+        codes = _collect_codes(router.port, 6)
+        assert all(body["who"] == "a" for _, body in codes)
+
+        # Restart b on the SAME port; the half-open probe re-admits it
+        # within ~2x the probe interval (bounded re-admission pin).
+        t0 = _t.monotonic()
+        srv2b = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", p2), type("B2", (_Echo,), {"tag": "b"})
+        )
+        threading.Thread(target=srv2b.serve_forever, daemon=True).start()
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            if _fleet_health(router)["b"]:
+                break
+            _t.sleep(0.02)
+        readmit_s = _t.monotonic() - t0
+        assert _fleet_health(router)["b"], "b was never re-admitted"
+        # Backoff was capped at 8x base (2.4s); one interval of slack
+        # for the listener coming up mid-interval.
+        assert readmit_s < 2 * (0.3 * 8), readmit_s
+        # And b serves again.
+        codes = _collect_codes(router.port, 8)
+        assert [c for c, _ in codes] == [200] * 8
+        assert {body["who"] for _, body in codes} == {"a", "b"}
+        srv2b.shutdown()
+        srv2b.server_close()
+    finally:
+        router.stop()
+        srv1.shutdown()
+
+
+def test_failover_exhaustion_is_typed_503_never_bare_502(binary):
+    """Both backends dead, budget 1: the attempt chain exhausts and the
+    client gets 503 {reason: upstream_failed} + Retry-After — the bare
+    502 is reserved for the containment-off default (pinned by
+    test_dead_backend_gives_502_and_metric above)."""
+    srv1, p1 = start_backend("a")
+    srv2, p2 = start_backend("b")
+    srv1.shutdown(); srv1.server_close()
+    srv2.shutdown(); srv2.server_close()
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", p1, 50), "b": ("127.0.0.1", p2, 50)},
+        binary=binary,
+        failover_retries=1,
+    ).start()
+    try:
+        for _ in range(3):
+            code, body = _collect_codes(router.port, 1)[0]
+            assert code == 503, (code, body)
+            assert body["reason"] == "upstream_failed"
+            assert body["retry_after_s"] == 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            ask(router.port)
+        assert err.value.code == 503
+        assert err.value.headers["Retry-After"] == "1"
+        assert router.admin.fleet()["failovers"] >= 3
+    finally:
+        router.stop()
+
+
+def test_tripped_everywhere_parks_then_probe_releases(binary):
+    """Park composition: a fleet whose every circuit is open PARKS new
+    requests (parking on) instead of shedding; the half-open probe that
+    re-admits capacity releases them and they complete 200."""
+    srv, port = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", port, 100)},
+        binary=binary,
+        health_probes=True,
+        health_threshold=1,
+        probe_interval_s=0.2,
+        failover_retries=1,
+        park_buffer=4,
+        park_timeout_s=15.0,
+    ).start()
+    try:
+        assert _collect_codes(router.port, 1)[0][0] == 200
+        srv.shutdown()
+        srv.server_close()
+        results: list = []
+        t1 = threading.Thread(
+            target=_send_collect, args=(router.port, results, 0, 20)
+        )
+        t1.start()  # fails on the dead backend -> circuit opens -> parks
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            if router.admin.parked()["parked"] == 1:
+                break
+            _t.sleep(0.02)
+        assert router.admin.parked()["parked"] == 1
+        assert _fleet_health(router) == {"v1": False}
+        # Fresh requests park too (no typed shed while parking has room).
+        t2 = threading.Thread(
+            target=_send_collect, args=(router.port, results, 1, 20)
+        )
+        t2.start()
+        # Capacity returns; the probe closes the circuit and releases.
+        srv2 = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), type("V1", (_Echo,), {"tag": "v1"})
+        )
+        threading.Thread(target=srv2.serve_forever, daemon=True).start()
+        t1.join(timeout=20)
+        t2.join(timeout=20)
+        assert sorted(r[1] for r in results) == [200, 200], results
+        assert router.admin.parked()["parked"] == 0
+        srv2.shutdown()
+        srv2.server_close()
+    finally:
+        router.stop()
+
+
+def test_drain_to_zero_sheds_parked_typed_on_cumulative_timeout(binary):
+    """Park/drain interaction (satellite): a parked request that gets
+    released to a dying replica and re-parks must shed typed at the
+    CUMULATIVE --park-timeout-s bound from its FIRST park — never hang,
+    and never restart the clock on each release/re-park cycle."""
+    srv, port = start_backend("v1")
+    srv.shutdown()
+    srv.server_close()  # dead from the start; weight 0 = draining
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", port, 0)},
+        binary=binary,
+        health_probes=True,
+        health_threshold=1,
+        probe_interval_s=0.2,
+        failover_retries=1,
+        park_buffer=4,
+        park_timeout_s=1.5,
+    ).start()
+    try:
+        results: list = []
+        t0 = _t.monotonic()
+        t1 = threading.Thread(
+            target=_send_collect, args=(router.port, results, 0, 20)
+        )
+        t1.start()
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            if router.admin.parked()["parked"] == 1:
+                break
+            _t.sleep(0.02)
+        assert router.admin.parked()["parked"] == 1
+        # Mid-hold, the weight flips positive (an operator wake) onto a
+        # replica that is DEAD: release -> failure -> circuit -> re-park.
+        _t.sleep(0.6)
+        router.admin.set_weights({"v1": 100})
+        t1.join(timeout=20)
+        elapsed = _t.monotonic() - t0
+        assert results and results[0][1] == 503, results
+        assert results[0][3]["reason"] == "park_timeout", results
+        # Cumulative bound: ~1.5s + release/expiry polling slack.  A
+        # restarted clock would be >= 0.6 + 1.5 = 2.1s.
+        assert elapsed < 2.05, elapsed
+        assert router.admin.parked()["timeout_total"] == 1
+    finally:
+        router.stop()
+
+
+# -- ChaosProxy: the data-plane chaos harness -------------------------------
+
+
+def test_chaos_refuse_mode_drives_circuit_and_recovery(binary):
+    """ChaosProxy connection-refusal mode exercises the same trip/
+    re-admit loop without killing the real backend: scripted refusals
+    trip the circuit; the unscripted pass-through lets the probe close
+    it again."""
+    srv, port = start_backend("real")
+    proxy = ChaosProxy(port)
+    router = RouterProcess(
+        port=free_port(),
+        backends={"real": ("127.0.0.1", proxy.port, 100)},
+        binary=binary,
+        health_probes=True,
+        health_threshold=1,
+        probe_interval_s=0.2,
+        failover_retries=1,
+        park_buffer=4,
+        park_timeout_s=10.0,
+    ).start()
+    try:
+        assert _collect_codes(router.port, 1)[0][0] == 200
+        # One refusal = the threshold: the single request's failure trips
+        # the circuit, the sole-backend fleet is tripped-everywhere, and
+        # the request PARKS (composition) instead of shedding.
+        proxy.inject_refuse(times=1)
+        results: list = []
+        t1 = threading.Thread(
+            target=_send_collect, args=(router.port, results, 0, 20)
+        )
+        t1.start()
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            if not _fleet_health(router)["real"]:
+                break
+            _t.sleep(0.02)
+        assert not _fleet_health(router)["real"]
+        # Probe passes through the now-clean proxy and re-admits; the
+        # parked request completes.
+        t1.join(timeout=20)
+        assert results and results[0][1] == 200, results
+        assert _fleet_health(router)["real"]
+        assert proxy.faults_fired == 1
+    finally:
+        router.stop()
+        proxy.stop()
+        srv.shutdown()
+
+
+def test_chaos_midstream_kill_is_typed_503_not_failover(binary):
+    """A response cut after its first bytes is NOT failover-eligible
+    (generation may have started): with containment on the client gets
+    the typed 503, never a silent retry and never a bare 502."""
+    srv, port = start_backend("real")
+    proxy = ChaosProxy(port)
+    router = RouterProcess(
+        port=free_port(),
+        backends={"real": ("127.0.0.1", proxy.port, 100)},
+        binary=binary,
+        failover_retries=2,
+    ).start()
+    try:
+        assert _collect_codes(router.port, 1)[0][0] == 200
+        proxy.inject_kill_midstream(times=1, after_bytes=20)
+        code, body = _collect_codes(router.port, 1)[0]
+        assert code == 503, (code, body)
+        assert body["reason"] == "upstream_failed"
+        # No failover happened for the poisoned-response request.
+        assert router.admin.fleet()["failovers"] == 0
+        # And the proxy is transparent again.
+        assert _collect_codes(router.port, 1)[0][0] == 200
+    finally:
+        router.stop()
+        proxy.stop()
+        srv.shutdown()
+
+
+def test_chaos_slow_mode_delays_but_completes(binary):
+    """Slow-response mode: the deadline-exceeded shape for client/probe
+    timeout tests — held for delay_s, then byte-for-byte intact."""
+    srv, port = start_backend("real")
+    proxy = ChaosProxy(port)
+    router = RouterProcess(
+        port=free_port(),
+        backends={"real": ("127.0.0.1", proxy.port, 100)},
+        binary=binary,
+    ).start()
+    try:
+        proxy.inject_slow(0.5, times=1)
+        t0 = _t.monotonic()
+        code, body = _collect_codes(router.port, 1)[0]
+        assert code == 200 and body["who"] == "real"
+        assert _t.monotonic() - t0 >= 0.5
+        t0 = _t.monotonic()
+        assert _collect_codes(router.port, 1)[0][0] == 200
+        assert _t.monotonic() - t0 < 0.4  # unscripted = transparent
+    finally:
+        router.stop()
+        proxy.stop()
+        srv.shutdown()
+
+
+def test_containment_defaults_keep_bare_502_and_no_new_knob_output(binary):
+    """Defaults pin: without --health-probes/--failover-retries the dead-
+    backend contract is the classic bare 502 (the containment layer is
+    byte-for-byte absent), while /router/fleet reports the knobs off."""
+    srv, port = start_backend("v1")
+    srv.shutdown()
+    srv.server_close()
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", port, 100)},
+        binary=binary,
+    ).start()
+    try:
+        code, body = _collect_codes(router.port, 1)[0]
+        assert code == 502
+        fleet = router.admin.fleet()
+        assert fleet["health_probes"] == 0
+        assert fleet["failovers"] == 0
+        # Circuits never trip with probing off: the backend still reads
+        # healthy (there is no passive-health state to consult).
+        assert _fleet_health(router) == {"v1": True}
+    finally:
+        router.stop()
+
+
+def test_feedback_upstream_death_typed_503_no_replay(binary):
+    """Feedback posts never REPLAY (a reward recorded before the death
+    would double-count on retry or park-release), but with containment
+    on they still shed the typed 503 — the bare 502 belongs to the
+    defaults-off contract only."""
+    srv, port = start_backend("v1")
+    srv.shutdown()
+    srv.server_close()  # dead from the start
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", port, 100)},
+        binary=binary,
+        health_probes=True,
+        health_threshold=1,
+        probe_interval_s=0.2,
+        failover_retries=2,
+        park_buffer=4,       # parking on: feedback must STILL not park
+        park_timeout_s=10.0,
+    ).start()
+    try:
+        code, body = _collect_codes(
+            router.port, 1, path="/api/v1.0/feedback"
+        )[0]
+        assert code == 503, (code, body)
+        assert body["reason"] == "upstream_failed"
+        assert router.admin.fleet()["failovers"] == 0  # no silent retry
+        assert router.admin.parked()["parked"] == 0    # and no replay-park
+    finally:
+        router.stop()
+
+
+def test_midstream_kill_with_parking_sheds_typed_not_parks(binary):
+    """A response that had started is not idempotent: even when the
+    failure trips the only circuit and parking is on, the request sheds
+    typed 503 instead of parking — a park release would re-dispatch the
+    generation that already ran."""
+    srv, port = start_backend("real")
+    proxy = ChaosProxy(port)
+    router = RouterProcess(
+        port=free_port(),
+        backends={"real": ("127.0.0.1", proxy.port, 100)},
+        binary=binary,
+        health_probes=True,
+        health_threshold=1,
+        probe_interval_s=0.2,
+        failover_retries=2,
+        park_buffer=4,
+        park_timeout_s=10.0,
+    ).start()
+    try:
+        assert _collect_codes(router.port, 1)[0][0] == 200
+        proxy.inject_kill_midstream(times=1, after_bytes=20)
+        code, body = _collect_codes(router.port, 1)[0]
+        assert code == 503, (code, body)
+        assert body["reason"] == "upstream_failed"
+        assert router.admin.parked()["parked"] == 0
+        assert router.admin.fleet()["failovers"] == 0
+    finally:
+        router.stop()
+        proxy.stop()
+        srv.shutdown()
+
+
+def test_wedged_probe_times_out_and_readmission_recovers(binary):
+    """A half-open probe whose backend accepts the connect but never
+    answers (inject_slow holds /healthz) must time out and count as a
+    failed probe — otherwise probe_inflight pins forever and the
+    backend stays ejected past recovery, with no live request able to
+    close the circuit either."""
+    srv, port = start_backend("real")
+    proxy = ChaosProxy(port)
+    router = RouterProcess(
+        port=free_port(),
+        backends={"real": ("127.0.0.1", proxy.port, 100)},
+        binary=binary,
+        health_probes=True,
+        health_threshold=1,
+        probe_interval_s=0.2,
+        failover_retries=1,
+    ).start()
+    try:
+        assert _collect_codes(router.port, 1)[0][0] == 200
+        # Trip the circuit, then wedge the FIRST probe: held far past
+        # the probe timeout (max(2x interval, 1s) = 1s).
+        proxy.inject_refuse(times=1)
+        proxy.inject_slow(30.0, times=1)
+        code, body = _collect_codes(router.port, 1)[0]
+        assert code == 503, (code, body)
+        assert not _fleet_health(router)["real"]
+        # The wedged probe times out, backs off, and the NEXT (clean)
+        # probe re-admits — bounded, not stuck-forever.
+        deadline = _t.monotonic() + 8
+        while _t.monotonic() < deadline:
+            if _fleet_health(router)["real"]:
+                break
+            _t.sleep(0.05)
+        assert _fleet_health(router)["real"], "wedged probe pinned ejection"
+        assert _collect_codes(router.port, 1)[0][0] == 200
+    finally:
+        router.stop()
+        proxy.stop()
+        srv.shutdown()

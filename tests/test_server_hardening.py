@@ -504,3 +504,156 @@ def test_sse_stream_survives_drain_and_new_requests_shed(tmp_path):
         assert ok.status_code == 200, ok.text
     finally:
         handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# Failure containment (PR 13): SSE terminal error events + poison 422
+# ---------------------------------------------------------------------------
+
+
+def test_sse_mid_generation_death_emits_terminal_error_event(tmp_path):
+    """An SSE stream whose engine dies mid-generation must NOT just drop
+    the connection: it ends with a terminal SSE ``error`` event carrying
+    the request_id and a typed reason, so clients can distinguish
+    truncation from completion."""
+    server = _build_llm_server(tmp_path, budget=0)
+    _SHED_PORT[0] += 1
+    handle = _HttpHandle(server, _SHED_PORT[0])
+    eng = server.gen_engine
+    try:
+        real_step = eng._dispatch_step
+        armed = {"tokens_seen": 0}
+
+        def dying_step(*a, **kw):
+            if armed["tokens_seen"] >= 2:
+                raise RuntimeError("device wedged mid-generation")
+            armed["tokens_seen"] += 1
+            return real_step(*a, **kw)
+
+        eng._dispatch_step = dying_step
+        tokens = []
+        events = []  # (sse_event_name, payload)
+        current_event = [""]
+        with httpx.stream(
+            "POST",
+            handle.base + "/v2/models/llm/generate",
+            json={"prompt_ids": [5, 9, 2], "max_new_tokens": 24,
+                  "stream": True},
+            headers={"X-Request-Id": "sse-death-1"},
+            timeout=120,
+        ) as resp:
+            assert resp.status_code == 200
+            for line in resp.iter_lines():
+                if line.startswith("event: "):
+                    current_event[0] = line[len("event: "):]
+                    continue
+                if not line.startswith("data: "):
+                    continue
+                payload = json.loads(line[len("data: "):])
+                events.append((current_event[0], payload))
+                current_event[0] = ""
+                if payload.get("done"):
+                    break
+                tokens.append(payload["token"])
+        assert tokens  # generation genuinely started
+        name, final = events[-1]
+        assert name == "error"  # a TYPED terminal event, not a bare drop
+        assert final["done"] is True
+        assert final["request_id"] == "sse-death-1"
+        assert final["reason"] == "engine_failed"
+        assert "error" in final
+    finally:
+        handle.stop()
+
+
+def test_sse_completion_has_no_error_event(tmp_path):
+    """Control: a stream that completes normally ends with the plain
+    ``data:`` final event — no ``event: error`` framing anywhere."""
+    server = _build_llm_server(tmp_path, budget=0)
+    _SHED_PORT[0] += 1
+    handle = _HttpHandle(server, _SHED_PORT[0])
+    try:
+        lines = []
+        with httpx.stream(
+            "POST",
+            handle.base + "/v2/models/llm/generate",
+            json={"prompt_ids": [5, 9, 2], "max_new_tokens": 4,
+                  "stream": True},
+            timeout=120,
+        ) as resp:
+            assert resp.status_code == 200
+            for line in resp.iter_lines():
+                lines.append(line)
+                if line.startswith("data: ") and json.loads(
+                    line[len("data: "):]
+                ).get("done"):
+                    break
+        assert not any(ln.startswith("event: ") for ln in lines)
+        final = json.loads(lines[-1][len("data: "):])
+        assert final["done"] is True and "output_ids" in final
+    finally:
+        handle.stop()
+
+
+def test_poison_quarantine_http_422_contract(tmp_path):
+    """The HTTP shape of the quarantine: two admission crashes (500s),
+    then the SAME prompt gets a typed 422 {reason: poison_quarantined}
+    with the fingerprint, while other prompts keep serving 200 — and the
+    poison counters move."""
+    server = _build_llm_server(tmp_path, budget=0)
+    _SHED_PORT[0] += 1
+    handle = _HttpHandle(server, _SHED_PORT[0])
+    eng = server.gen_engine
+    try:
+        real_admit = eng._dispatch_admit
+        crashes = [0]
+
+        def crashing_admit(*a, **kw):
+            if crashes[0] < 2:
+                crashes[0] += 1
+                raise RuntimeError("injected admission crash")
+            return real_admit(*a, **kw)
+
+        eng._dispatch_admit = crashing_admit
+        body = {"prompt_ids": [7, 7, 7, 7], "max_new_tokens": 3}
+        for _ in range(2):
+            r = httpx.post(
+                handle.base + "/v2/models/llm/generate", json=body,
+                timeout=120,
+            )
+            assert r.status_code == 500  # the crash itself: a plain 500
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+            eng.poison_quarantined_total < 1
+        ):
+            time.sleep(0.02)
+        r = httpx.post(
+            handle.base + "/v2/models/llm/generate", json=body, timeout=30
+        )
+        assert r.status_code == 422, r.text
+        payload = r.json()
+        assert payload["reason"] == "poison_quarantined"
+        assert payload["crashes"] == 2
+        assert len(payload["fingerprint"]) == 16
+        assert "Retry-After" not in r.headers  # unprocessable EVERYWHERE
+        # Innocent prompts serve normally on the recovered engine.
+        ok = httpx.post(
+            handle.base + "/v2/models/llm/generate",
+            json={"prompt_ids": [5, 9, 2], "max_new_tokens": 2},
+            timeout=120,
+        )
+        assert ok.status_code == 200, ok.text
+        metrics = httpx.get(handle.base + "/metrics", timeout=10).text
+        assert "tpumlops_engine_poison_quarantined_total" in metrics
+        q = [
+            ln for ln in metrics.splitlines()
+            if ln.startswith("tpumlops_engine_poison_quarantined_total{")
+        ]
+        rj = [
+            ln for ln in metrics.splitlines()
+            if ln.startswith("tpumlops_engine_poison_rejected_total{")
+        ]
+        assert float(q[0].rsplit(" ", 1)[1]) == 1.0
+        assert float(rj[0].rsplit(" ", 1)[1]) == 1.0
+    finally:
+        handle.stop()
