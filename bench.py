@@ -2989,6 +2989,152 @@ def bench_chaos() -> dict:
         hb.stop()
 
 
+def bench_fleet_trace() -> dict:
+    """Fleet trace plane overhead + stitched-trace validity gate.
+
+    One live tiny-llama server behind the compiled router.  The same
+    request mix runs twice — journey ring OFF (the byte-for-byte
+    default) then ON via the runtime /router/config knob — and the
+    scenario reports the tok/s delta (acceptance: within noise) plus a
+    HARD gate on trace coherence: every traced request id must appear
+    in BOTH the router journey chrome track and the replica's
+    flight-recorder track once stitched onto one timeline, with
+    token-for-token identical outputs between the two phases."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    from tpumlops.clients.localplane import free_port, start_model_server
+    from tpumlops.clients.router import RouterProcess
+    from tpumlops.models import llama
+    from tpumlops.server.loader import save_native_model
+    from tpumlops.utils.config import TpuSpec
+    from tpumlops.utils.trace_stitch import (
+        fetch_source,
+        request_ids_by_pid,
+        stitch_chrome_traces,
+    )
+
+    jax = _setup_jax()
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    art = tempfile.mkdtemp() + "/llm"
+    save_native_model(
+        art,
+        "llama-generate",
+        llama.init(jax.random.key(3), cfg),
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+    )
+    tpu = TpuSpec.from_spec(
+        {
+            "meshShape": {"tp": 1},
+            "maxBatchSize": 2,
+            "maxSlots": 2,
+            "observability": {"traceRing": 1024},
+        }
+    )
+    RING = 256
+    N_REQ = 48
+    NEW_TOKENS = 16
+    port = free_port()
+    handle = start_model_server(
+        art, "v1", port, model_name="llm", namespace="bench", tpu=tpu,
+        warmup=False,
+    )
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", port, 100)},
+        namespace="bench",
+        deployment="llm",
+    ).start()
+    url = f"http://127.0.0.1:{router.port}/v2/models/llm/generate"
+
+    def one(i: int, rid: "str | None" = None, timeout=300.0):
+        body = json.dumps(
+            {
+                "prompt_ids": [5, 9, 2, (i % 7) + 1],
+                "max_new_tokens": NEW_TOKENS,
+            }
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if rid is not None:
+            headers["X-Request-Id"] = rid
+        req = urllib.request.Request(url, data=body, headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())["outputs"][0]["data"]
+
+    def phase(tag: str):
+        outs, t0 = [], time.perf_counter()
+        for i in range(N_REQ):
+            outs.append(one(i, rid=f"{tag}-{i}" if tag == "on" else None))
+        wall = time.perf_counter() - t0
+        tokens = sum(len(o) for o in outs)
+        return outs, tokens / wall
+
+    try:
+        for _ in range(4):  # prime lazy compiles off the clock
+            one(0)
+        outs_off, tps_off = phase("off")
+        # Flip the trace plane on at RUNTIME — the same knob RouterSync
+        # drives from the manifest annotation.
+        router.admin.set_config(
+            [{"name": "v1", "host": "127.0.0.1", "port": port,
+              "weight": 100}],
+            journey_ring=RING,
+        )
+        outs_on, tps_on = phase("on")
+
+        journeys = router.admin.journeys()
+        merged = stitch_chrome_traces(
+            [
+                fetch_source(
+                    "router", f"http://127.0.0.1:{router.port}", "router"
+                ),
+                fetch_source("v1", f"http://127.0.0.1:{port}", "replica"),
+            ]
+        )
+        by_pid = request_ids_by_pid(merged)
+        traced = {f"on-{i}" for i in range(N_REQ)}
+        shared = traced & by_pid.get(1, set()) & by_pid.get(2, set())
+        # HARD gates: coherent stitching + token parity.
+        assert shared == traced, (
+            f"only {len(shared)}/{len(traced)} ids shared across tracks"
+        )
+        agreement = float(outs_off == outs_on)
+        assert agreement == 1.0, "journey ring changed generated tokens"
+        overhead_pct = 100.0 * (tps_off - tps_on) / max(tps_off, 1e-9)
+        return {
+            "requests": 2 * N_REQ,
+            "new_tokens_per_request": NEW_TOKENS,
+            "journey_ring": RING,
+            "tok_per_s_off": round(tps_off, 1),
+            "tok_per_s_on": round(tps_on, 1),
+            "overhead_pct": round(overhead_pct, 2),
+            "journeys_recorded": journeys["recorded"],
+            "stitched_events": len(merged["traceEvents"]),
+            "stitched_components": len(by_pid),
+            "stitched_shared_ids": len(shared),
+            "token_agreement": agreement,
+            "note": "overhead = same mix through the router with the "
+                    "journey ring off vs on (headers minted + "
+                    "propagated, ring append per request); stitched "
+                    "gate = every traced id present in BOTH the router "
+                    "journey track and the replica flight-recorder "
+                    "track on one timeline.",
+        }
+    finally:
+        router.stop()
+        handle.stop()
+
+
 SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("time_to_100pct_traffic", "bench_time_to_100"),
     ("iris_sklearn_linear", "bench_iris"),
@@ -3004,6 +3150,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("cold_start_serving", "bench_cold_start"),
     ("disaggregated_serving", "bench_disaggregated"),
     ("chaos_serving", "bench_chaos"),
+    ("fleet_trace_serving", "bench_fleet_trace"),
     ("llama_1p35b_decode", "bench_llama_decode"),
     ("serve_path_http", "bench_serve_path"),
     ("llama_7b_decode", "bench_llama_7b_decode"),
@@ -3081,6 +3228,12 @@ SCENARIO_SCHEMAS: dict = {
         "availability_pct", "eject_s", "readmit_s",
         "probe_interval_s", "health_threshold",
         "failover_total", "circuit_open_total",
+    ),
+    "fleet_trace_serving": (
+        "requests", "new_tokens_per_request", "journey_ring",
+        "tok_per_s_off", "tok_per_s_on", "overhead_pct",
+        "journeys_recorded", "stitched_events", "stitched_components",
+        "stitched_shared_ids", "token_agreement",
     ),
 }
 
@@ -3189,6 +3342,9 @@ _COMPACT_KEYS = {
     "chaos_serving": (
         "availability_pct", "bare_502", "hangs",
         "eject_s", "readmit_s", "failover_total"),
+    "fleet_trace_serving": (
+        "tok_per_s_off", "tok_per_s_on", "overhead_pct",
+        "stitched_shared_ids", "token_agreement"),
     "serve_path_http": (
         "server_queue_mean_ms", "server_device_run_mean_ms",
         "server_pipeline_wait_mean_ms", "server_observed_mean_ms",

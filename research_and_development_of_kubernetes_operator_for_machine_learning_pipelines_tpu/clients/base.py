@@ -130,14 +130,25 @@ class EngineMetrics:
     # request is a user already waiting, so the autoscaler wakes
     # immediately on parked > 0.  None = no parking-capable source.
     parked: float | None = None
+    # SLO tails (spec.slo): p99 of tpumlops_ttft_seconds /
+    # tpumlops_itl_seconds over the window.  Filled only by sources
+    # asked to serve the SLO tracker; as_dict omits them when None so
+    # pre-SLO journal records (ScaleRecord.observed) stay byte-for-byte.
+    ttft_p99_s: float | None = None
+    itl_p99_s: float | None = None
 
     def as_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "queue_depth": self.queue_depth,
             "admission_wait_p95_ms": self.admission_wait_p95_ms,
             "ttft_p95_s": self.ttft_p95_s,
             "parked": self.parked,
         }
+        if self.ttft_p99_s is not None:
+            out["ttft_p99_s"] = self.ttft_p99_s
+        if self.itl_p99_s is not None:
+            out["itl_p99_s"] = self.itl_p99_s
+        return out
 
 
 @dataclass(frozen=True)

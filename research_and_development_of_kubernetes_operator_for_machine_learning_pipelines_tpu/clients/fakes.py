@@ -321,7 +321,11 @@ class FakeMetrics:
         predictor_name: str,
         namespace: str,
         window_s: int = 60,
+        slo_tails: bool = False,
     ) -> EngineMetrics:
+        # ``slo_tails`` is accepted for interface parity (real sources
+        # gate the p99 work on it); scripted readings carry whatever the
+        # test set regardless.
         self.engine_query_log.append(
             (deployment_name, predictor_name, namespace)
         )

@@ -351,14 +351,26 @@ class ReplicaSetMetrics:
         return ModelMetrics()
 
     def engine_metrics(
-        self, deployment_name, predictor_name, namespace, window_s=60
+        self, deployment_name, predictor_name, namespace, window_s=60,
+        slo_tails=False,
     ) -> EngineMetrics:
+        from .router import _histogram_quantile
+
         ident = {
             ("deployment_name", deployment_name),
             ("predictor_name", predictor_name),
             ("namespace", namespace),
         }
         total: float | None = None
+        # Cumulative bucket sums across replicas for the SLO tails,
+        # accumulated ONLY when the caller serves the SLO tracker
+        # (local source: lifetime quantile, the PromQL rate() window is
+        # Prometheus's job in-cluster).
+        buckets: dict[str, dict[float, float]] = (
+            {"tpumlops_ttft_seconds": {}, "tpumlops_itl_seconds": {}}
+            if slo_tails
+            else {}
+        )
         for port in list(self._ports()):
             try:
                 text = (
@@ -374,13 +386,34 @@ class ReplicaSetMetrics:
             for (name, labels), value in parse_prometheus_text(text).items():
                 if name == self._FAMILY and ident <= labels:
                     total = (total or 0.0) + value
+                elif buckets and name.endswith("_bucket"):
+                    fam = name[: -len("_bucket")]
+                    if fam in buckets and ident <= labels:
+                        le = dict(labels).get("le")
+                        if le is not None:
+                            b = buckets[fam]
+                            b[float(le)] = b.get(float(le), 0.0) + value
         parked = None
         if self._router_admin is not None:
             try:
                 parked = float(self._router_admin.parked().get("parked", 0))
             except Exception:
                 parked = None  # router unreachable: park signal unknown
-        return EngineMetrics(queue_depth=total, parked=parked)
+
+        def p99(fam: str) -> float | None:
+            b = buckets.get(fam) or {}
+            if not b:
+                return None
+            return _histogram_quantile(
+                0.99, sorted(b.items(), key=lambda x: x[0])
+            )
+
+        return EngineMetrics(
+            queue_depth=total,
+            parked=parked,
+            ttft_p99_s=p99("tpumlops_ttft_seconds"),
+            itl_p99_s=p99("tpumlops_itl_seconds"),
+        )
 
 
 class TrafficGenerator:

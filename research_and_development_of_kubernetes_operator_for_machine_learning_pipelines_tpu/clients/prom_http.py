@@ -123,6 +123,7 @@ class PrometheusSource:
         predictor_name: str,
         namespace: str,
         window_s: int = 60,
+        slo_tails: bool = False,
     ) -> EngineMetrics:
         """Engine-saturation signals for the replica autoscaler.
 
@@ -164,9 +165,28 @@ class PrometheusSource:
             f'deployment_name="{deployment_name}", '
             f'namespace="{namespace}"}})'
         )
+        # SLO tails (spec.slo): p99 of the same TTFT histogram plus the
+        # inter-token-latency one.  Queried ONLY when the caller serves
+        # the SLO tracker — autoscale-only CRs keep the 4-query shape.
+        # Same no-vector(0) discipline: an unobservable tail contributes
+        # NO sample to the error budget.
+        ttft_p99 = itl_p99 = None
+        if slo_tails:
+            ttft_p99 = self._query(
+                "histogram_quantile(0.99, sum(rate("
+                f"tpumlops_ttft_seconds_bucket{{{sel}}}[{w}]"
+                ")) by (le))"
+            )
+            itl_p99 = self._query(
+                "histogram_quantile(0.99, sum(rate("
+                f"tpumlops_itl_seconds_bucket{{{sel}}}[{w}]"
+                ")) by (le))"
+            )
         return EngineMetrics(
             queue_depth=queue_depth,
             admission_wait_p95_ms=wait_p95,
             ttft_p95_s=ttft_p95,
             parked=parked,
+            ttft_p99_s=ttft_p99,
+            itl_p99_s=itl_p99,
         )

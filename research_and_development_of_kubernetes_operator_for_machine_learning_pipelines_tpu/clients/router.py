@@ -146,12 +146,17 @@ class RouterAdmin:
         backends: list[dict],
         namespace: str | None = None,
         deployment: str | None = None,
+        journey_ring: int | None = None,
     ) -> dict:
         body: dict = {"backends": backends}
         if namespace:
             body["namespace"] = namespace
         if deployment:
             body["deployment"] = deployment
+        if journey_ring is not None:
+            # Fleet trace plane sizing (0 disables; omitted = keep the
+            # router's running ring).
+            body["journeyRing"] = int(journey_ring)
         return json.loads(self._req("/router/config", "PUT", body))
 
     def metrics_text(self) -> str:
@@ -169,6 +174,20 @@ class RouterAdmin:
         hit/miss tallies, KV handoff counts/bytes/failures, ring size,
         and per-backend role + known-prefix counts."""
         return json.loads(self._req("/router/fleet"))
+
+    def journeys(self) -> dict:
+        """The journey ring (``GET /router/debug/requests``): per-request
+        JourneyRecords — identity, affinity decision, per-leg backend/
+        bytes/wall, park hold spans, failover attempts, final outcome —
+        plus the ``started_unix`` clock anchor the fleet-trace stitcher
+        uses.  404 (HTTPError) while ``--journey-ring`` is 0."""
+        return json.loads(self._req("/router/debug/requests"))
+
+    def journey_trace(self, fmt: str = "chrome") -> dict:
+        """The journey ring as Chrome trace-event JSON
+        (``GET /router/debug/trace?format=chrome``): one track per
+        backend, async request spans keyed by request id."""
+        return json.loads(self._req(f"/router/debug/trace?format={fmt}"))
 
 
 def parse_prometheus_text(text: str) -> dict[tuple[str, frozenset], float]:
@@ -358,6 +377,16 @@ class RouterSync:
     def sync_manifest(self, manifest: dict) -> None:
         spec = manifest.get("spec") or {}
         meta = manifest.get("metadata") or {}
+        # Fleet trace plane: the builder stamps spec.fleet.observability.
+        # journeyRing as a manifest annotation; the sync ALWAYS sends it
+        # (absent = 0) so the manifest stays the source of truth — the
+        # same keep-survivor trap the role field had (an omitted value
+        # would pin a previously-enabled ring on forever after the CR
+        # disables it).
+        annotations = meta.get("annotations") or {}
+        journey_ring = int(
+            annotations.get("tpumlops.dev/fleet-journey-ring") or 0
+        )
         backends = []
         for pred in spec.get("predictors") or []:
             name = pred.get("name")
@@ -401,6 +430,7 @@ class RouterSync:
                 backends,
                 namespace=meta.get("namespace"),
                 deployment=meta.get("name"),
+                journey_ring=journey_ring,
             )
 
 
@@ -429,6 +459,8 @@ class RouterProcess:
         health_threshold: int = 3,
         probe_interval_s: float = 0.5,
         failover_retries: int = 0,
+        journey_ring: int = 0,
+        access_log: bool = False,
     ):
         self.port = port
         # Values are (host, port, weight) or (host, port, weight, role)
@@ -466,6 +498,19 @@ class RouterProcess:
         self.health_threshold = int(health_threshold)
         self.probe_interval_s = float(probe_interval_s)
         self.failover_retries = int(failover_retries)
+        # Fleet trace plane (both default off = old router byte-for-
+        # byte).  journey_ring: adopt-or-mint X-Request-Id/traceparent,
+        # propagate on every leg, keep a bounded JourneyRecord ring
+        # served at /router/debug/requests + /router/debug/trace.
+        # access_log: one JSON line per completed/shed request on
+        # stderr (the server's tpumlops.request contract).  With the log
+        # on, stderr goes to a FILE (access_log_path) — a supervised
+        # PIPE nobody drains would fill and block the router's event
+        # loop mid-request under sustained traffic.
+        self.journey_ring = int(journey_ring)
+        self.access_log = bool(access_log)
+        self.access_log_path: pathlib.Path | None = None
+        self._stderr_file = None
         self.proc: subprocess.Popen | None = None
         self.admin = RouterAdmin(port)
 
@@ -495,6 +540,10 @@ class RouterProcess:
             ]
         if self.failover_retries > 0:
             argv += ["--failover-retries", str(self.failover_retries)]
+        if self.journey_ring > 0:
+            argv += ["--journey-ring", str(self.journey_ring)]
+        if self.access_log:
+            argv += ["--access-log", "1"]
         for name, spec in self.backends.items():
             host, port, weight = spec[0], spec[1], spec[2]
             role = spec[3] if len(spec) > 3 else None
@@ -502,19 +551,50 @@ class RouterProcess:
             if role:
                 arg += f":{role}"
             argv += ["--backend", arg]
+        if self.access_log:
+            import tempfile
+
+            fd, path = tempfile.mkstemp(
+                prefix="tpumlops-router-access-", suffix=".log"
+            )
+            self.access_log_path = pathlib.Path(path)
+            self._stderr_file = os.fdopen(fd, "wb")
+            stderr_target = self._stderr_file
+        else:
+            stderr_target = subprocess.PIPE
         self.proc = subprocess.Popen(
-            argv, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
+            argv, stdout=subprocess.DEVNULL, stderr=stderr_target
         )
         deadline = time.monotonic() + wait_s
         while time.monotonic() < deadline:
             if self.admin.healthy():
                 return self
             if self.proc.poll() is not None:
-                err = self.proc.stderr.read().decode() if self.proc.stderr else ""
+                if self.proc.stderr is not None:
+                    err = self.proc.stderr.read().decode()
+                elif self.access_log_path is not None:
+                    err = self.access_log_path.read_text()
+                else:
+                    err = ""
                 raise RuntimeError(f"router exited at startup: {err}")
             time.sleep(0.02)
         self.stop()
         raise TimeoutError("router did not become healthy")
+
+    def access_log_lines(self) -> list[dict]:
+        """Parsed ``tpumlops.router.access`` JSON lines written so far
+        (requires ``access_log=True``)."""
+        if self.access_log_path is None or not self.access_log_path.exists():
+            return []
+        out = []
+        for line in self.access_log_path.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # startup banner / circuit logs ride stderr too
+            if rec.get("logger") == "tpumlops.router.access":
+                out.append(rec)
+        return out
 
     def stop(self) -> None:
         if self.proc is not None:
@@ -527,6 +607,17 @@ class RouterProcess:
             if self.proc.stderr:
                 self.proc.stderr.close()
             self.proc = None
+        if self._stderr_file is not None:
+            self._stderr_file.close()
+            self._stderr_file = None
+        if self.access_log_path is not None:
+            # Temp-file hygiene: repeated test/bench runs must not
+            # litter the temp dir (read access_log_lines BEFORE stop).
+            import contextlib
+
+            with contextlib.suppress(OSError):
+                self.access_log_path.unlink()
+            self.access_log_path = None
 
     def __enter__(self) -> "RouterProcess":
         return self.start()

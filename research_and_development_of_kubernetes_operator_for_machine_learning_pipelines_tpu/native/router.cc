@@ -48,6 +48,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <deque>
 #include <map>
 #include <memory>
 #include <set>
@@ -65,6 +66,70 @@ double now_s() {
   timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+double wall_s() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+// Process clock anchors (set once in main): journey timestamps export as
+// microseconds since g_t0_mono, and started_unix lets the fleet-trace
+// stitcher shift router journeys and replica flight-recorder tracks onto
+// one unix-epoch timeline.
+double g_t0_mono = 0.0;
+double g_t0_unix = 0.0;
+
+// splitmix64: mints trace/span ids.  Not cryptographic — the ids only
+// need to be collision-unlikely within one trace retention window.
+uint64_t g_rng_state = 0;
+uint64_t rng_next() {
+  uint64_t z = (g_rng_state += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+// n random bytes as 2n lowercase hex chars (8 -> a W3C span id,
+// 16 -> a trace id).
+std::string hex_id(int nbytes) {
+  static const char* kHex = "0123456789abcdef";
+  std::string out;
+  out.reserve(size_t(nbytes) * 2);
+  for (int i = 0; i < nbytes; i += 8) {
+    uint64_t v = rng_next();
+    for (int b = 0; b < 8 && i + b < nbytes; b++) {
+      out += kHex[(v >> 60) & 0xf];
+      out += kHex[(v >> 56) & 0xf];
+      v <<= 8;
+    }
+  }
+  return out;
+}
+
+// JSON string escaping for values that originate outside this process
+// (client-supplied request ids and request paths land in /router/debug
+// payloads and the access log).  Bytes >= 0x7f are \u-escaped as their
+// latin-1 code points: the raw request line can carry arbitrary bytes,
+// and one lone UTF-8 continuation byte emitted verbatim would make
+// every consumer's json.loads fail for the whole ring.
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += char(c);
+    } else if (c < 0x20 || c >= 0x7f) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out += char(c);
+    }
+  }
+  return out;
 }
 
 void die(const char* fmt, ...) {
@@ -276,6 +341,109 @@ struct RouterState {
 };
 
 RouterState g_state;
+
+// ---------------------------------------------------------------------------
+// Fleet trace plane: per-request journey records (--journey-ring N)
+//
+// With the ring enabled the router becomes a first-class trace
+// participant: it adopts (or mints) X-Request-Id + a W3C traceparent on
+// every inbound request, propagates both on EVERY outbound leg (client
+// forward, kv export/import relay legs, failover retries, park-release
+// forwards), echoes the id on every response including typed sheds, and
+// keeps a bounded ring of JourneyRecords — arrival, affinity decision,
+// per-leg backend/bytes/wall, park hold spans, failover attempts,
+// circuit state consulted, final outcome — served as
+// GET /router/debug/requests (JSON) and GET /router/debug/trace?format=
+// chrome (Perfetto: one track per backend, async request spans keyed by
+// request id).  --journey-ring 0 (the default) keeps the router
+// byte-for-byte: no header minting, no injection, no new metric
+// families, 404 on the debug endpoints.
+//
+// --access-log (independent of the ring) emits one JSON line per
+// completed/shed request on stderr, mirroring the server's
+// ``tpumlops.request`` logger contract.
+// ---------------------------------------------------------------------------
+
+int g_journey_ring = 0;  // --journey-ring (0 = trace plane off)
+int g_access_log = 0;    // --access-log 0|1
+// Hard cap: a /router/debug scrape serializes the whole ring into one
+// response ON the single-threaded event loop, so the ring bound is
+// also the bound on how long a debug scrape can stall the data plane
+// (64Ki records * ~0.5 KiB ≈ tens of MB worst case, sub-second).
+constexpr int kMaxJourneyRing = 1 << 16;
+
+struct JourneyLeg {
+  std::string kind;  // forward | export | import | relay-forward
+  std::string backend;
+  int status = 0;        // 0 = transport failure / never completed
+  double t0 = 0.0, t1 = 0.0;  // monotonic; t1 == 0 while in flight
+  size_t bytes = 0;      // response bytes observed on this leg
+};
+
+struct Journey {
+  std::string request_id;
+  std::string trace_id;
+  double t_arrival = 0.0;    // monotonic
+  double wall_arrival = 0.0; // unix epoch
+  std::string method, path;
+  std::string affinity = "none";  // none | hit | miss | fallback
+  int failovers = 0;
+  int circuits_open = 0;  // open circuits at dispatch time
+  std::string backend;    // backend that produced the final response
+  std::string role;
+  std::string outcome;    // ok | client_error | upstream_error | shed_* |
+                          // bare_502 | abandoned
+  int status = 0;
+  double handoff_ms = -1.0;  // router-measured KV handoff (-1 = none)
+  double park_ms = 0.0;      // cumulative park hold
+  double park_t0 = 0.0;      // current park span start (0 = not parked)
+  double t_finish = 0.0;
+  std::vector<JourneyLeg> legs;
+  std::vector<std::pair<double, double>> parks;  // completed hold spans
+};
+
+std::deque<Journey> g_journeys;   // bounded by g_journey_ring
+uint64_t g_journeys_total = 0;    // lifetime completions (rotation visible)
+// tpumlops_router_request_seconds{outcome=...}: per-outcome wall from
+// request receipt to final byte handed to the client.  Families appear
+// in /router/metrics only with the journey ring on.
+std::map<std::string, Histogram> g_request_seconds;
+
+bool journey_tracking() { return g_journey_ring > 0 || g_access_log; }
+
+// Inbound identity, mirroring server/app.py request_id_from_headers:
+// X-Request-Id verbatim (printable ASCII, <= 128 chars), else the
+// traceparent trace id, else minted.  Bytes >= 0x80 are dropped, not
+// kept: the id lands in JSON exports that must stay valid UTF-8, and a
+// lone continuation byte would make json.loads on /router/debug/*
+// (and the fleet stitcher behind it) fail for the whole ring.
+std::string sanitize_rid(const std::string& raw) {
+  std::string out;
+  for (char c : raw) {
+    if (out.size() >= 128) break;
+    // Space included — the server's rule keeps it, and the router's
+    // access log must record the same id the replica journals.
+    if ((unsigned char)c >= 0x20 && (unsigned char)c < 0x7f) out += c;
+  }
+  return out;
+}
+
+bool is_hex(const std::string& s) {
+  for (char c : s)
+    if (!isxdigit((unsigned char)c)) return false;
+  return !s.empty();
+}
+
+// version-traceid-spanid-flags; returns false unless every field has the
+// exact W3C width.
+bool parse_traceparent(const std::string& tp, std::string* trace_id) {
+  if (tp.size() < 55 || tp[2] != '-' || tp[35] != '-' || tp[52] != '-')
+    return false;
+  std::string tid = lower(tp.substr(3, 32));
+  if (!is_hex(tid) || tid == std::string(32, '0')) return false;
+  *trace_id = tid;
+  return true;
+}
 
 // ---------------------------------------------------------------------------
 // Prefix affinity: consistent-hash ring over decode-role backends
@@ -490,7 +658,8 @@ struct BackendSpec {
 };
 
 bool parse_config(const std::string& body, std::string* ns, std::string* dep,
-                  std::vector<BackendSpec>* specs) {
+                  std::vector<BackendSpec>* specs,
+                  int* journey_ring = nullptr) {
   JsonParser j(body);
   if (!j.consume('{')) return false;
   while (j.ok && !j.peek('}')) {
@@ -498,6 +667,15 @@ bool parse_config(const std::string& body, std::string* ns, std::string* dep,
     if (!j.consume(':')) return false;
     if (key == "namespace") *ns = j.parse_string();
     else if (key == "deployment") *dep = j.parse_string();
+    else if (key == "journeyRing") {
+      // Range-check as a DOUBLE before casting: int(out-of-range
+      // double) is UB, and a negative/overflowing value must become a
+      // visible 400 (-2 sentinel), never a silent no-op 200.
+      double v = j.parse_number();
+      if (journey_ring)
+        *journey_ring =
+            (v < 0 || v > double(kMaxJourneyRing)) ? -2 : int(v);
+    }
     else if (key == "backends") {
       if (!j.consume('[')) return false;
       while (j.ok && !j.peek(']')) {
@@ -650,7 +828,7 @@ std::string http_response(int code, const std::string& reason,
                           const std::string& content_type,
                           const std::string& body,
                           const std::string& extra_headers = "") {
-  char head[384];
+  char head[768];
   snprintf(head, sizeof(head),
            "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\n"
            "Connection: keep-alive\r\n%s\r\n",
@@ -732,7 +910,178 @@ struct ClientConn {
                                 // the blob itself lives in relay_out;
                                 // a second copy would hold multi-MB
                                 // handoffs 3x per in-flight relay)
+  // Fleet trace plane: the current request's journey record (null when
+  // tracking is off or the request is a /router/* admin call).  Owned
+  // here until journey_finish moves it into the ring.
+  Journey* journey = nullptr;
 };
+
+// ---------------------------------------------------------------------------
+// Journey lifecycle (trace-plane hooks on the proxy state machine)
+// ---------------------------------------------------------------------------
+
+// Start tracking a (non-admin) request: adopt or mint identity, note
+// the circuit state consulted by this dispatch.
+void journey_begin(ClientConn* c, double t_start) {
+  delete c->journey;
+  c->journey = nullptr;
+  if (!journey_tracking()) return;
+  auto* j = new Journey();
+  j->t_arrival = t_start;
+  j->wall_arrival = g_t0_unix + (t_start - g_t0_mono);
+  // Bounded copies: the header cap admits ~1 MiB request lines, and a
+  // ring of journeys must not pin that per record.
+  j->method = c->req.method.substr(0, 16);
+  j->path = c->req.path.substr(0, 512);
+  auto it = c->req.headers.find("x-request-id");
+  std::string rid = it != c->req.headers.end() ? sanitize_rid(it->second) : "";
+  std::string tid;
+  auto tp = c->req.headers.find("traceparent");
+  if (tp != c->req.headers.end()) parse_traceparent(tp->second, &tid);
+  if (tid.empty()) tid = hex_id(16);
+  if (rid.empty()) rid = tid;
+  j->request_id = rid;
+  j->trace_id = tid;
+  for (auto& b : g_state.backends)
+    if (b->circuit_open) j->circuits_open++;
+  c->journey = j;
+}
+
+// Outbound trace context for one upstream leg: the adopted/minted id
+// plus a traceparent carrying the journey's trace id and a FRESH span id
+// per leg.  Empty (no wire change) unless the journey ring is on.
+std::string trace_headers(const ClientConn* c) {
+  if (g_journey_ring <= 0 || !c->journey) return "";
+  return "x-request-id: " + c->journey->request_id +
+         "\r\ntraceparent: 00-" + c->journey->trace_id + "-" + hex_id(8) +
+         "-01\r\n";
+}
+
+// "X-Request-Id: <rid>\r\n" for router-generated responses (typed
+// sheds, 502s) — empty with the plane off, so those responses stay
+// byte-for-byte.
+std::string echo_header(const ClientConn* c) {
+  if (g_journey_ring <= 0 || !c->journey) return "";
+  return "X-Request-Id: " + c->journey->request_id + "\r\n";
+}
+
+// ``,"request_id":"<rid>"`` for typed JSON shed bodies (empty = plane
+// off).  Spliced before the closing brace by callers.
+std::string rid_json_field(const ClientConn* c) {
+  if (g_journey_ring <= 0 || !c->journey) return "";
+  return ",\"request_id\":\"" + json_escape(c->journey->request_id) + "\"";
+}
+
+void journey_leg_start(ClientConn* c, const BackendPtr& b) {
+  if (!c->journey) return;
+  JourneyLeg leg;
+  switch (c->relay_stage) {
+    case RelayStage::Export:
+      leg.kind = "export";
+      break;
+    case RelayStage::Import:
+      leg.kind = "import";
+      break;
+    case RelayStage::Forward:
+      leg.kind = "relay-forward";
+      break;
+    default:
+      leg.kind = "forward";
+      break;
+  }
+  leg.backend = b ? b->name : "";
+  leg.t0 = now_s();
+  c->journey->legs.push_back(std::move(leg));
+}
+
+// Close the newest open leg (status 0 = transport failure).
+void journey_leg_done(ClientConn* c, int status, size_t bytes) {
+  if (!c->journey) return;
+  for (auto it = c->journey->legs.rbegin(); it != c->journey->legs.rend();
+       ++it) {
+    if (it->t1 == 0.0) {
+      it->status = status;
+      it->bytes = bytes;
+      it->t1 = now_s();
+      return;
+    }
+  }
+}
+
+void journey_park_begin(ClientConn* c) {
+  if (c->journey && c->journey->park_t0 == 0.0)
+    c->journey->park_t0 = now_s();
+}
+
+void journey_park_end(ClientConn* c) {
+  if (!c->journey || c->journey->park_t0 == 0.0) return;
+  double t1 = now_s();
+  c->journey->parks.push_back({c->journey->park_t0, t1});
+  c->journey->park_ms += (t1 - c->journey->park_t0) * 1000.0;
+  c->journey->park_t0 = 0.0;
+}
+
+// One journey is over: classify, observe, retain, log, free.
+void journey_finish(ClientConn* c, int status, const char* outcome) {
+  if (!c->journey) return;
+  Journey* j = c->journey;
+  c->journey = nullptr;
+  if (j->park_t0 != 0.0) {
+    double t1 = now_s();
+    j->parks.push_back({j->park_t0, t1});
+    j->park_ms += (t1 - j->park_t0) * 1000.0;
+    j->park_t0 = 0.0;
+  }
+  j->status = status;
+  j->outcome = outcome;
+  j->t_finish = now_s();
+  double dur = j->t_finish - j->t_arrival;
+  if (g_journey_ring > 0) {
+    g_request_seconds[j->outcome].observe(dur);
+    g_journeys_total++;
+    g_journeys.push_back(*j);
+    while (int(g_journeys.size()) > g_journey_ring) g_journeys.pop_front();
+  }
+  if (g_access_log) {
+    // One JSON object per line on stderr — the same field contract as
+    // the server's ``tpumlops.request`` completion line.
+    fprintf(stderr,
+            "{\"logger\":\"tpumlops.router.access\","
+            "\"request_id\":\"%s\",\"trace_id\":\"%s\","
+            "\"method\":\"%s\",\"path\":\"%s\","
+            "\"backend\":\"%s\",\"role\":\"%s\","
+            "\"outcome\":\"%s\",\"code\":%d,"
+            "\"duration_ms\":%.3f,\"handoff_ms\":%.3f,"
+            "\"park_ms\":%.3f,\"failover_count\":%d,"
+            "\"affinity\":\"%s\"}\n",
+            json_escape(j->request_id).c_str(),
+            json_escape(j->trace_id).c_str(),
+            json_escape(j->method).c_str(), json_escape(j->path).c_str(),
+            json_escape(j->backend).c_str(), json_escape(j->role).c_str(),
+            j->outcome.c_str(), j->status, dur * 1000.0,
+            j->handoff_ms < 0 ? 0.0 : j->handoff_ms, j->park_ms,
+            j->failovers, j->affinity.c_str());
+  }
+  delete j;
+}
+
+const char* outcome_for_status(int status) {
+  if (status >= 200 && status < 400) return "ok";
+  if (status >= 400 && status < 500) return "client_error";
+  return "upstream_error";
+}
+
+// Inject "x-request-id: <rid>" into a fully-buffered upstream response
+// whose headers lack it, so every byte the client sees carries the
+// correlatable id even when the backend does not echo.
+void ensure_response_request_id(std::string* resp, const std::string& rid) {
+  size_t hdr_end = resp->find("\r\n\r\n");
+  size_t line_end = resp->find("\r\n");
+  if (hdr_end == std::string::npos || line_end == std::string::npos) return;
+  std::string head = lower(resp->substr(0, hdr_end + 2));
+  if (head.find("\r\nx-request-id:") != std::string::npos) return;
+  resp->insert(line_end + 2, "x-request-id: " + rid + "\r\n");
+}
 
 // ---------------------------------------------------------------------------
 // Scale-to-zero request parking
@@ -756,14 +1105,17 @@ uint64_t g_park_overflow_total = 0; // 503'd: buffer full
 uint64_t g_park_timeout_total = 0;  // 503'd: waited past the timeout
 Histogram g_park_wait_seconds;      // park duration of released requests
 
-std::string park_503_body(const char* why, int retry_after_s) {
-  char body[160];
-  snprintf(body, sizeof(body),
-           "{\"error\":\"no live backend\",\"reason\":\"%s\","
-           "\"retry_after_s\":%d}",
-           why, retry_after_s);
-  char hdr[64];
-  snprintf(hdr, sizeof(hdr), "Retry-After: %d\r\n", retry_after_s);
+std::string park_503_body(const char* why, int retry_after_s,
+                          const ClientConn* c = nullptr) {
+  // std::string assembly: the escaped request id can reach ~256 bytes
+  // (128 chars of '"'/'\\'), which would truncate a fixed buffer into
+  // an unparseable typed body.
+  std::string body = "{\"error\":\"no live backend\",\"reason\":\"" +
+                     std::string(why) + "\",\"retry_after_s\":" +
+                     std::to_string(retry_after_s) +
+                     (c ? rid_json_field(c) : "") + "}";
+  std::string hdr = "Retry-After: " + std::to_string(retry_after_s) +
+                    "\r\n" + (c ? echo_header(c) : "");
   return http_response(503, "Service Unavailable", "application/json", body,
                        hdr);
 }
@@ -898,6 +1250,9 @@ void close_upstream(UpstreamConn* u) {
 
 void close_client(ClientConn* c) {
   if (!c) return;
+  // A journey still open here means the client vanished mid-flight
+  // (disconnect, EPOLLERR): record the abandonment rather than leak it.
+  journey_finish(c, 499, "abandoned");
   if (c->parked) unpark(c);  // a gone client must not be "released" later
   if (c->upstream) {
     c->upstream->client = nullptr;
@@ -1215,12 +1570,31 @@ std::string metrics_text() {
   out += "# TYPE tpumlops_router_probe_seconds histogram\n";
   emit_histogram(&out, "tpumlops_router_probe_seconds", plabels,
                  g_probe_seconds);
+  if (g_journey_ring > 0) {
+    // Fleet trace plane: per-outcome request walls.  The family exists
+    // only with the journey ring on — byte-for-byte exposition at
+    // --journey-ring 0.  The "ok" child is touched eagerly so the
+    // family is visible (and pinnable) before the first request.
+    g_request_seconds["ok"];
+    out += "# TYPE tpumlops_router_request_seconds histogram\n";
+    for (auto& [outcome, hist] : g_request_seconds) {
+      char labels[320];
+      snprintf(labels, sizeof(labels), "%s,outcome=\"%s\"", plabels,
+               outcome.c_str());
+      emit_histogram(&out, "tpumlops_router_request_seconds", labels, hist);
+    }
+  }
   return out;
 }
 
 std::string config_json() {
   std::string out = "{\"namespace\":\"" + g_state.ns + "\",\"deployment\":\"" +
-                    g_state.deployment + "\",\"backends\":[";
+                    g_state.deployment + "\",";
+  if (g_journey_ring > 0)
+    // Emitted only when enabled so the default config shape stays
+    // byte-for-byte what callers have pinned.
+    out += "\"journeyRing\":" + std::to_string(g_journey_ring) + ",";
+  out += "\"backends\":[";
   bool first = true;
   for (auto& b : g_state.backends) {
     if (!first) out += ",";
@@ -1232,6 +1606,180 @@ std::string config_json() {
              b->name.c_str(), b->host.c_str(), b->port, b->weight,
              b->role.c_str());
     out += item;
+  }
+  out += "]}";
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Journey ring exposition (/router/debug/requests, /router/debug/trace)
+// ---------------------------------------------------------------------------
+
+int64_t journey_us(double t_mono) {
+  return int64_t((t_mono - g_t0_mono) * 1e6);
+}
+
+// Journey JSON assembly: every client-controlled string (request id,
+// path, backend names) concatenates through std::string — a fixed
+// snprintf buffer here would TRUNCATE mid-JSON-string on a long path
+// (the header cap admits ~1 MiB) and corrupt the whole export.  Fixed
+// buffers are used for numbers only.
+std::string journey_json(const Journey& j) {
+  char num[192];
+  std::string out = "{\"request_id\":\"" + json_escape(j.request_id) +
+                    "\",\"trace_id\":\"" + json_escape(j.trace_id) + "\",";
+  snprintf(num, sizeof(num), "\"ts_us\":%lld,\"wall\":%.6f,",
+           (long long)journey_us(j.t_arrival), j.wall_arrival);
+  out += num;
+  out += "\"method\":\"" + json_escape(j.method) + "\",\"path\":\"" +
+         json_escape(j.path) + "\",\"affinity\":\"" + j.affinity +
+         "\",\"backend\":\"" + json_escape(j.backend) + "\",\"role\":\"" +
+         json_escape(j.role) + "\",\"outcome\":\"" + j.outcome + "\",";
+  snprintf(num, sizeof(num),
+           "\"status\":%d,\"failovers\":%d,\"circuits_open\":%d,",
+           j.status, j.failovers, j.circuits_open);
+  out += num;
+  if (j.handoff_ms >= 0)
+    snprintf(num, sizeof(num), "\"handoff_ms\":%.3f,", j.handoff_ms);
+  else
+    snprintf(num, sizeof(num), "\"handoff_ms\":null,");
+  out += num;
+  snprintf(num, sizeof(num), "\"park_ms\":%.3f,\"duration_ms\":%.3f,",
+           j.park_ms, (j.t_finish - j.t_arrival) * 1000.0);
+  out += num;
+  out += "\"legs\":[";
+  for (size_t i = 0; i < j.legs.size(); i++) {
+    const JourneyLeg& leg = j.legs[i];
+    if (i) out += ",";
+    double t1 = leg.t1 > 0 ? leg.t1 : leg.t0;
+    out += "{\"kind\":\"" + leg.kind + "\",\"backend\":\"" +
+           json_escape(leg.backend) + "\",";
+    snprintf(num, sizeof(num),
+             "\"status\":%d,\"ts_us\":%lld,\"dur_us\":%lld,\"bytes\":%zu}",
+             leg.status, (long long)journey_us(leg.t0),
+             (long long)std::max<int64_t>(0, int64_t((t1 - leg.t0) * 1e6)),
+             leg.bytes);
+    out += num;
+  }
+  out += "],\"parks\":[";
+  for (size_t i = 0; i < j.parks.size(); i++) {
+    if (i) out += ",";
+    snprintf(num, sizeof(num), "{\"ts_us\":%lld,\"dur_us\":%lld}",
+             (long long)journey_us(j.parks[i].first),
+             (long long)std::max<int64_t>(
+                 0, int64_t((j.parks[i].second - j.parks[i].first) * 1e6)));
+    out += num;
+  }
+  out += "]}";
+  return out;
+}
+
+std::string journeys_json() {
+  char buf[192];
+  snprintf(buf, sizeof(buf),
+           "{\"capacity\":%d,\"recorded\":%llu,\"started_unix\":%.6f,"
+           "\"requests\":[",
+           g_journey_ring, (unsigned long long)g_journeys_total, g_t0_unix);
+  std::string out = buf;
+  bool first = true;
+  for (const Journey& j : g_journeys) {
+    if (!first) out += ",";
+    first = false;
+    out += journey_json(j);
+  }
+  out += "]}";
+  return out;
+}
+
+// Chrome trace-event JSON over the journey ring: tid 0 is the router
+// track (async request spans keyed by request id + park hold spans),
+// tid N >= 1 one track per backend carrying that backend's legs —
+// the same conventions as the server's /debug/trace, so the fleet
+// stitcher (scripts/stitch_trace.py) merges both into one timeline.
+std::string journeys_chrome() {
+  // started_unix rides top-level so the fleet stitcher reads its clock
+  // anchor from THIS payload instead of downloading the whole raw ring
+  // a second time.
+  char anchor[64];
+  snprintf(anchor, sizeof(anchor), "{\"started_unix\":%.6f,", g_t0_unix);
+  std::string out = std::string(anchor) +
+      "\"displayTimeUnit\":\"ms\",\"traceEvents\":["
+      "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":1,\"tid\":0,"
+      "\"args\":{\"name\":\"tpumlops-router\"}},"
+      "{\"name\":\"thread_name\",\"ph\":\"M\",\"pid\":1,\"tid\":0,"
+      "\"args\":{\"name\":\"router\"}}";
+  // One track per backend: current config order first, then any name a
+  // retained journey still references (removed backends keep their
+  // history readable).
+  std::vector<std::string> names;
+  std::map<std::string, int> tid_of;
+  auto track = [&](const std::string& name) {
+    if (name.empty() || tid_of.count(name)) return;
+    tid_of[name] = int(names.size()) + 1;
+    names.push_back(name);
+  };
+  for (auto& b : g_state.backends) track(b->name);
+  for (const Journey& j : g_journeys)
+    for (const JourneyLeg& leg : j.legs) track(leg.backend);
+  // Client-controlled strings concatenate through std::string (a fixed
+  // buffer would truncate on long paths/ids and corrupt the JSON);
+  // fixed buffers carry numbers only.
+  char num[192];
+  for (const std::string& name : names) {
+    snprintf(num, sizeof(num),
+             ",{\"name\":\"thread_name\",\"ph\":\"M\",\"pid\":1,"
+             "\"tid\":%d,\"args\":{\"name\":\"backend ",
+             tid_of[name]);
+    out += num;
+    out += json_escape(name) + "\"}}";
+  }
+  for (const Journey& j : g_journeys) {
+    long long b_ts = journey_us(j.t_arrival);
+    long long e_ts = std::max(b_ts, (long long)journey_us(j.t_finish));
+    std::string rid = json_escape(j.request_id);
+    out += ",{\"name\":\"request\",\"cat\":\"request\",\"ph\":\"b\","
+           "\"id\":\"" + rid + "\",";
+    snprintf(num, sizeof(num), "\"ts\":%lld,\"pid\":1,\"tid\":0,", b_ts);
+    out += num;
+    out += "\"args\":{\"trace_id\":\"" + json_escape(j.trace_id) +
+           "\",\"path\":\"" + json_escape(j.path) + "\"}}";
+    for (const JourneyLeg& leg : j.legs) {
+      double t1 = leg.t1 > 0 ? leg.t1 : leg.t0;
+      int tid = leg.backend.empty() ? 0 : tid_of[leg.backend];
+      out += ",{\"name\":\"" + leg.kind + "\",\"cat\":\"leg\",\"ph\":\"X\",";
+      snprintf(num, sizeof(num), "\"ts\":%lld,\"dur\":%lld,\"pid\":1,"
+               "\"tid\":%d,",
+               (long long)journey_us(leg.t0),
+               (long long)std::max<int64_t>(0, int64_t((t1 - leg.t0) * 1e6)),
+               tid);
+      out += num;
+      out += "\"args\":{\"request_id\":\"" + rid + "\",";
+      snprintf(num, sizeof(num), "\"status\":%d,\"bytes\":%zu}}",
+               leg.status, leg.bytes);
+      out += num;
+    }
+    for (const auto& span : j.parks) {
+      out += ",{\"name\":\"parked\",\"cat\":\"park\",\"ph\":\"X\",";
+      snprintf(num, sizeof(num), "\"ts\":%lld,\"dur\":%lld,\"pid\":1,"
+               "\"tid\":0,",
+               (long long)journey_us(span.first),
+               (long long)std::max<int64_t>(
+                   0, int64_t((span.second - span.first) * 1e6)));
+      out += num;
+      out += "\"args\":{\"request_id\":\"" + rid + "\"}}";
+    }
+    out += ",{\"name\":\"request\",\"cat\":\"request\",\"ph\":\"e\","
+           "\"id\":\"" + rid + "\",";
+    snprintf(num, sizeof(num), "\"ts\":%lld,\"pid\":1,\"tid\":0,", e_ts);
+    out += num;
+    out += "\"args\":{\"outcome\":\"" + j.outcome + "\",";
+    snprintf(num, sizeof(num), "\"status\":%d,", j.status);
+    out += num;
+    out += "\"affinity\":\"" + j.affinity + "\",";
+    snprintf(num, sizeof(num), "\"failovers\":%d,\"park_ms\":%.3f,",
+             j.failovers, j.park_ms);
+    out += num;
+    out += "\"backend\":\"" + json_escape(j.backend) + "\"}}";
   }
   out += "]}";
   return out;
@@ -1259,7 +1807,11 @@ void drain_pool(Backend* b) {
 // 400 as "nothing changed"; a half-applied weight table would silently
 // shift live traffic).
 std::string apply_config(const std::string& ns, const std::string& dep,
-                         const std::vector<BackendSpec>& specs) {
+                         const std::vector<BackendSpec>& specs,
+                         int journey_ring = -1) {
+  if (journey_ring == -2 || journey_ring > kMaxJourneyRing)
+    return "journeyRing out of range (0.." +
+           std::to_string(kMaxJourneyRing) + ")";
   struct Staged {
     BackendPtr survivor;  // null for new backends
     BackendSpec spec;
@@ -1349,6 +1901,18 @@ std::string apply_config(const std::string& ns, const std::string& dep,
   g_state.backends = std::move(next);
   for (auto& b : removed) drain_pool(b.get());
   rebuild_ring();  // membership/roles may have changed
+  if (journey_ring >= 0 && journey_ring != g_journey_ring) {
+    // Operator-driven trace plane (RouterSync sends the manifest's
+    // tpumlops.dev/fleet-journey-ring annotation).  Shrinking trims the
+    // oldest records; 0 drops the ring and stops header minting.
+    g_journey_ring = journey_ring;
+    if (g_journey_ring == 0) {
+      g_journeys.clear();
+      g_request_seconds.clear();
+      g_journeys_total = 0;
+    }
+    while (int(g_journeys.size()) > g_journey_ring) g_journeys.pop_front();
+  }
   return "";
 }
 
@@ -1427,6 +1991,38 @@ void handle_admin(ClientConn* c) {
     out += "]}";
     g_recent_us.clear();
     client_send(c, http_response(200, "OK", "application/json", out));
+  } else if (path == "/router/debug/requests" ||
+             path.rfind("/router/debug/trace", 0) == 0) {
+    // Fleet trace plane introspection: the journey ring as raw JSON or
+    // a Chrome trace (one track per backend, async request spans keyed
+    // by request id).  404 names the knob when the ring is off, same
+    // contract as the server's /debug/device.
+    if (g_journey_ring <= 0) {
+      client_send(c, http_response(
+          404, "Not Found", "application/json",
+          "{\"error\":\"journey ring disabled; enable --journey-ring N "
+          "(spec.fleet.observability.journeyRing)\"}"));
+    } else if (path == "/router/debug/requests") {
+      client_send(c, http_response(200, "OK", "application/json",
+                                   journeys_json()));
+    } else {
+      std::string fmt = "chrome";
+      size_t q = path.find("format=");
+      if (q != std::string::npos) {
+        fmt = path.substr(q + 7);
+        size_t amp = fmt.find('&');
+        if (amp != std::string::npos) fmt = fmt.substr(0, amp);
+      }
+      if (fmt == "chrome")
+        client_send(c, http_response(200, "OK", "application/json",
+                                     journeys_chrome()));
+      else if (fmt == "json")
+        client_send(c, http_response(200, "OK", "application/json",
+                                     journeys_json()));
+      else
+        client_send(c, http_response(400, "Bad Request", "text/plain",
+                                     "unknown format '" + fmt + "'\n"));
+    }
   } else if (path == "/router/metrics") {
     client_send(c, http_response(200, "OK", "text/plain; version=0.0.4",
                                  metrics_text()));
@@ -1435,8 +2031,9 @@ void handle_admin(ClientConn* c) {
   } else if (path == "/router/config") {  // PUT/POST replace
     std::string ns, dep;
     std::vector<BackendSpec> specs;
-    if (parse_config(body, &ns, &dep, &specs)) {
-      std::string bad = apply_config(ns, dep, specs);
+    int journey_ring = -1;  // absent = keep the running ring
+    if (parse_config(body, &ns, &dep, &specs, &journey_ring)) {
+      std::string bad = apply_config(ns, dep, specs, journey_ring);
       if (bad.empty()) {
         client_send(c, http_response(200, "OK", "application/json", config_json()));
         // Capacity may just have returned (a replica came back / the
@@ -1522,6 +2119,7 @@ bool any_usable_client_backend() {
 // default) every path below collapses to the classic bare 502,
 // byte-for-byte.
 void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
+  journey_leg_done(c, 0, 0);  // the in-flight leg died at the transport
   if (c->relay_stage == RelayStage::Export ||
       c->relay_stage == RelayStage::Import) {
     // A relay SUB-request failed (prefill replica died mid-handoff,
@@ -1558,6 +2156,7 @@ void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
       if (next) {
         c->failover_attempts++;
         g_failover_total++;
+        if (c->journey) c->journey->failovers++;
         c->backend = next;
         c->retries = 0;
         connect_upstream(c, /*allow_pool=*/true);
@@ -1574,6 +2173,7 @@ void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
         c->parked = true;
         c->park_t = now_s();
         if (c->park_first_t == 0) c->park_first_t = c->park_t;
+        journey_park_begin(c);
         g_parked.push_back(c);
         g_parked_total++;
         return;
@@ -1581,25 +2181,30 @@ void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
       g_park_overflow_total++;
       if (c->backend)
         finish_request(c->backend, 503, now_s() - c->t_start, c->feedback);
-      client_send(c, park_503_body("park_overflow", int(g_park_timeout_s)));
+      client_send(c, park_503_body("park_overflow", int(g_park_timeout_s),
+                                   c));
+      journey_finish(c, 503, "shed_park_overflow");
     } else {
       if (c->backend)
         finish_request(c->backend, 503, now_s() - c->t_start, c->feedback);
-      char body[224];
-      snprintf(body, sizeof(body),
-               "{\"error\":\"upstream failed (%s) and failover budget "
-               "exhausted\",\"reason\":\"upstream_failed\","
-               "\"retry_after_s\":1}",
-               why);
+      // std::string assembly — the escaped request id alone can reach
+      // ~256 bytes, past any comfortable fixed buffer.
+      std::string body =
+          "{\"error\":\"upstream failed (" + std::string(why) +
+          ") and failover budget exhausted\","
+          "\"reason\":\"upstream_failed\",\"retry_after_s\":1" +
+          rid_json_field(c) + "}";
+      std::string hdrs = "Retry-After: 1\r\n" + echo_header(c);
       client_send(c, http_response(503, "Service Unavailable",
-                                   "application/json", body,
-                                   "Retry-After: 1\r\n"));
+                                   "application/json", body, hdrs));
+      journey_finish(c, 503, "shed_upstream_failed");
     }
   } else {
     if (c->backend)
       finish_request(c->backend, 502, now_s() - c->t_start, c->feedback);
     client_send(c, http_response(502, "Bad Gateway", "text/plain",
                                  std::string(why) + "\n"));
+    journey_finish(c, 502, "bare_502");
   }
   c->req.reset();
   // A pipelined next request must still be answered (same contract as the
@@ -1634,9 +2239,14 @@ std::string dechunk(const std::string& framed) {
 // Transfer-Encoding and Content-Length verbatim invites request-smuggling
 // desync on the pooled backend connection if the backend frames by the
 // other header than we did.  ``extra_headers`` rides complete "k: v\r\n"
-// lines (the relay's x-tpumlops-handoff stamp).
+// lines (the relay's x-tpumlops-handoff stamp, the trace plane's
+// x-request-id/traceparent).  ``replace_trace_ids`` drops the client's
+// OWN x-request-id/traceparent — the journey's adopted/minted context
+// in ``extra_headers`` replaces them, so every leg of one request
+// carries one consistent identity.
 std::string build_upstream_request(const HttpMsg& req,
-                                   const std::string& extra_headers = "") {
+                                   const std::string& extra_headers = "",
+                                   bool replace_trace_ids = false) {
   std::string body = req.buf.substr(req.body_start);
   if (req.chunked) body = dechunk(body);
   std::string out = req.method + " " + req.path + " HTTP/1.1\r\n";
@@ -1646,6 +2256,8 @@ std::string build_upstream_request(const HttpMsg& req,
         k == "content-length" || k == "transfer-encoding" ||
         k == "x-tpumlops-handoff")  // router-asserted only: a client
       continue;                     // must not forge relay stamps
+    if (replace_trace_ids && (k == "x-request-id" || k == "traceparent"))
+      continue;
     out += k + ": " + v + "\r\n";
   }
   out += extra_headers;
@@ -1655,12 +2267,17 @@ std::string build_upstream_request(const HttpMsg& req,
   return out;
 }
 
-// A synthesized relay sub-request (export/import legs).
+// A synthesized relay sub-request (export/import legs).  ``trace_hdrs``
+// carries the journey's propagated context so the prefill/decode
+// replicas' flight recorders journal the SAME request id the client
+// forward will carry.
 std::string relay_request(const std::string& path,
                           const std::string& content_type,
-                          const std::string& body) {
+                          const std::string& body,
+                          const std::string& trace_hdrs = "") {
   std::string out = "POST " + path + " HTTP/1.1\r\n";
   out += "host: tpumlops-router\r\n";
+  out += trace_hdrs;
   out += "content-type: " + content_type + "\r\n";
   out += "content-length: " + std::to_string(body.size()) + "\r\n";
   out += "connection: keep-alive\r\n\r\n";
@@ -1682,6 +2299,7 @@ std::string response_body(const HttpMsg& resp, bool eof) {
 // fresh).  Assumes c->backend is set.  On fresh-connect failure → 502.
 void connect_upstream(ClientConn* c, bool allow_pool) {
   BackendPtr b = c->backend;
+  journey_leg_start(c, b);
   UpstreamConn* u = nullptr;
   // Reuse a pooled keep-alive connection when available.
   while (allow_pool && !b->idle_conns.empty()) {
@@ -1725,7 +2343,8 @@ void connect_upstream(ClientConn* c, bool allow_pool) {
     u->out = c->relay_out;
   } else {
     u->resp.request_method = c->req.method;  // HEAD: no response body
-    u->out = build_upstream_request(c->req);
+    std::string th = trace_headers(c);
+    u->out = build_upstream_request(c->req, th, !th.empty());
   }
   u->out_off = 0;
   c->upstream = u;
@@ -1756,7 +2375,8 @@ void start_relay_export(ClientConn* c, const BackendPtr& prefill) {
   c->relay_attempts++;
   c->relay_tried.push_back(prefill);
   c->relay_out = relay_request(
-      "/admin/kv/export", "application/json", client_body(c));
+      "/admin/kv/export", "application/json", client_body(c),
+      trace_headers(c));
   c->backend = prefill;
   c->retries = 0;
   connect_upstream(c, /*allow_pool=*/true);
@@ -1770,6 +2390,7 @@ void relay_fallback(ClientConn* c, const char* why,
                     bool count_failure = true) {
   (void)why;
   if (count_failure) g_kv_handoff_failures++;
+  if (c->journey) c->journey->affinity = "fallback";
   BackendPtr target = c->relay_decode ? c->relay_decode : g_state.pick();
   if (target && backend_usable(*target)) {
     // The unified fallback prefills LOCALLY on the ring target, which
@@ -1785,8 +2406,9 @@ void relay_fallback(ClientConn* c, const char* why,
         503, "Service Unavailable", "application/json",
         "{\"error\":\"kv handoff failed and no decode backend has "
         "positive weight\",\"reason\":\"no_decode_backend\","
-        "\"retry_after_s\":1}",
-        "Retry-After: 1\r\n"));
+        "\"retry_after_s\":1" + rid_json_field(c) + "}",
+        "Retry-After: 1\r\n" + echo_header(c)));
+    journey_finish(c, 503, "shed_no_decode_backend");
     c->req.reset();
     if (!c->pending.empty()) {
       c->req.buf = std::move(c->pending);
@@ -1836,7 +2458,8 @@ void relay_on_response(ClientConn* c, int status, std::string body) {
     c->relay_blob_bytes = body.size();
     c->relay_stage = RelayStage::Import;
     c->relay_out = relay_request(
-        "/admin/kv/import", "application/octet-stream", body);
+        "/admin/kv/import", "application/octet-stream", body,
+        trace_headers(c));
     c->backend = c->relay_decode;
     c->retries = 0;
     connect_upstream(c, /*allow_pool=*/true);
@@ -1851,13 +2474,16 @@ void relay_on_response(ClientConn* c, int status, std::string body) {
   g_kv_handoff_seconds.observe(handoff_s);
   g_kv_handoff_bytes += c->relay_blob_bytes;
   remember_prefix(c->relay_decode, c->relay_hash);
+  if (c->journey) c->journey->handoff_ms = handoff_s * 1000.0;
   // Final leg: the original request, stamped so the server's request
   // trace carries the router-measured handoff wall.
   char hdr[64];
   snprintf(hdr, sizeof(hdr), "x-tpumlops-handoff: %.3f\r\n",
            handoff_s * 1000.0);
   c->relay_stage = RelayStage::Forward;
-  c->relay_out = build_upstream_request(c->req, hdr);
+  std::string th = trace_headers(c);
+  c->relay_out = build_upstream_request(c->req, std::string(hdr) + th,
+                                        !th.empty());
   c->backend = c->relay_decode;
   c->retries = 0;
   connect_upstream(c, /*allow_pool=*/true);
@@ -1880,12 +2506,14 @@ bool try_affinity_route(ClientConn* c) {
   c->relay_hash = h;
   if (d->known_prefixes.count(h)) {
     g_affinity_hits++;
+    if (c->journey) c->journey->affinity = "hit";
     c->backend = d;
     c->retries = 0;
     connect_upstream(c, /*allow_pool=*/true);
     return true;
   }
   g_affinity_misses++;
+  if (c->journey) c->journey->affinity = "miss";
   if (g_handoff_enabled) {
     BackendPtr prefill = g_state.pick_prefill({});
     if (prefill) {
@@ -1921,13 +2549,15 @@ void start_proxy(ClientConn* c) {
         c->parked = true;
         c->park_t = now_s();
         if (c->park_first_t == 0) c->park_first_t = c->park_t;
+        journey_park_begin(c);
         g_parked.push_back(c);
         g_parked_total++;
         return;
       }
       g_park_overflow_total++;
       client_send(c, park_503_body("park_overflow",
-                                   int(g_park_timeout_s)));
+                                   int(g_park_timeout_s), c));
+      journey_finish(c, 503, "shed_park_overflow");
       c->req.reset();
       return;
     }
@@ -1936,20 +2566,22 @@ void start_proxy(ClientConn* c) {
       // 503 with a Retry-After matched to the probe cadence (the
       // fleet re-admits within ~2x the current probe interval).
       int retry = int(g_probe_interval_s * 2.0) + 1;
-      char body[192];
-      snprintf(body, sizeof(body),
-               "{\"error\":\"every backend circuit is open\","
-               "\"reason\":\"no_healthy_backend\",\"retry_after_s\":%d}",
-               retry);
-      char hdr[64];
-      snprintf(hdr, sizeof(hdr), "Retry-After: %d\r\n", retry);
+      std::string body =
+          "{\"error\":\"every backend circuit is open\","
+          "\"reason\":\"no_healthy_backend\",\"retry_after_s\":" +
+          std::to_string(retry) + rid_json_field(c) + "}";
+      std::string hdr = "Retry-After: " + std::to_string(retry) + "\r\n" +
+                        echo_header(c);
       client_send(c, http_response(503, "Service Unavailable",
                                    "application/json", body, hdr));
+      journey_finish(c, 503, "shed_no_healthy_backend");
       c->req.reset();
       return;
     }
     client_send(c, http_response(503, "Service Unavailable", "text/plain",
-                                 "no backend with positive weight\n"));
+                                 "no backend with positive weight\n",
+                                 echo_header(c)));
+    journey_finish(c, 503, "shed_no_backend");
     c->req.reset();
     return;
   }
@@ -1975,6 +2607,7 @@ void release_parked() {
     // cycle must not report two short waits for one long hold.
     g_park_wait_seconds.observe(now_s() - c->park_first_t);
     g_park_released_total++;
+    journey_park_end(c);  // the hold span closes; a re-park opens a new one
     // Fresh failover budget for the re-dispatch: the backends that
     // failed before the park are exactly the ones a probe may just
     // have re-admitted.
@@ -2002,7 +2635,8 @@ void expire_parked() {
   for (ClientConn* c : expired) {
     c->parked = false;
     g_park_timeout_total++;
-    client_send(c, park_503_body("park_timeout", int(g_park_timeout_s)));
+    client_send(c, park_503_body("park_timeout", int(g_park_timeout_s), c));
+    journey_finish(c, 503, "shed_park_timeout");
     c->req.reset();
     // Same contract as fail_502: a pipelined next request buffered
     // while parked must still be answered, not hang until the client
@@ -2027,6 +2661,7 @@ bool retry_stale_upstream(UpstreamConn* u, ClientConn* c) {
   c->upstream = nullptr;
   u->client = nullptr;
   close_upstream(u);
+  journey_leg_done(c, 0, 0);  // the stale pooled attempt, closed as failed
   connect_upstream(c, /*allow_pool=*/false);
   return true;
 }
@@ -2042,6 +2677,7 @@ void dispatch_request(ClientConn* c) {
     c->req.reset();
   } else {
     c->feedback = c->req.path == "/api/v1.0/feedback";
+    journey_begin(c, c->t_start);
     start_proxy(c);
   }
 }
@@ -2267,6 +2903,7 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
         int status = u->resp.status;
         std::string body = response_body(u->resp, eof);
         BackendPtr leg_backend = u->backend;
+        journey_leg_done(c, status, body.size());
         c->upstream = nullptr;
         u->client = nullptr;
         pool_or_close_upstream(u, eof);
@@ -2283,7 +2920,18 @@ void on_upstream_event(UpstreamConn* u, uint32_t events) {
       finish_request(u->backend, u->resp.status, dt, c->feedback);
       if (u->resp.status >= 500) note_backend_failure(u->backend);
       else note_backend_success(u->backend);
+      journey_leg_done(c, u->resp.status, u->resp.buf.size());
+      if (c->journey) {
+        c->journey->backend = u->backend ? u->backend->name : "";
+        c->journey->role = u->backend ? u->backend->role : "";
+        if (g_journey_ring > 0)
+          // Every byte the client sees carries the correlatable id,
+          // even when the upstream did not echo it.
+          ensure_response_request_id(&u->resp.buf,
+                                     c->journey->request_id);
+      }
       client_send(c, u->resp.buf);
+      journey_finish(c, u->resp.status, outcome_for_status(u->resp.status));
       c->req.reset();
       c->upstream = nullptr;
       u->client = nullptr;
@@ -2321,7 +2969,8 @@ void usage() {
       "       [--park-buffer N] [--park-timeout-s S]\n"
       "       [--affinity-tokens N] [--kv-handoff 0|1] [--handoff-retries N]\n"
       "       [--health-probes 0|1] [--health-threshold N]\n"
-      "       [--probe-interval-s S] [--failover-retries N]");
+      "       [--probe-interval-s S] [--failover-retries N]\n"
+      "       [--journey-ring N] [--access-log 0|1]");
 }
 
 }  // namespace
@@ -2347,6 +2996,8 @@ int main(int argc, char** argv) {
     else if (a == "--health-threshold") g_health_threshold = atoi(next().c_str());
     else if (a == "--probe-interval-s") g_probe_interval_s = atof(next().c_str());
     else if (a == "--failover-retries") g_failover_retries = atoi(next().c_str());
+    else if (a == "--journey-ring") g_journey_ring = atoi(next().c_str());
+    else if (a == "--access-log") g_access_log = atoi(next().c_str());
     else if (a == "--backend") {
       // name=host:port:weight[:role]
       std::string v = next();
@@ -2371,6 +3022,12 @@ int main(int argc, char** argv) {
     } else usage();
   }
   if (!port) usage();
+  if (g_journey_ring < 0 || g_journey_ring > kMaxJourneyRing)
+    die("--journey-ring must be in [0, %d]", kMaxJourneyRing);
+  // Trace-plane clock anchors + id-minting seed.
+  g_t0_mono = now_s();
+  g_t0_unix = wall_s();
+  g_rng_state = uint64_t(g_t0_unix * 1e6) ^ (uint64_t(getpid()) << 32);
   std::string bad = apply_config("", "", specs);
   if (!bad.empty()) die("%s", bad.c_str());
 

@@ -73,6 +73,17 @@ def main(argv: list[str] | None = None) -> None:
         "/debug/rollouts/trace on the metrics listener); 0 disables — "
         "no recorder object is constructed at all",
     )
+    ap.add_argument(
+        "--fleet-trace-sources",
+        default=None,
+        help="wire GET /debug/fleet-trace on the metrics listener: inline "
+        'JSON or a file path of [{"name", "base_url", "kind": '
+        '"router"|"replica"}, ...] naming the fleet\'s trace endpoints '
+        "(the native router runs in local/router mode today — an "
+        "in-cluster router controller that would make these "
+        "auto-discoverable from the routing manifest is ROADMAP item "
+        "2's open end); unset = the endpoint 404s",
+    )
     args = ap.parse_args(argv)
 
     from ..utils.logging import configure as configure_logging
@@ -102,8 +113,33 @@ def main(argv: list[str] | None = None) -> None:
         if args.rollout_ring > 0
         else None
     )
+    fleet_trace_sources = None
+    if args.fleet_trace_sources:
+        import json as _json
+        import os as _os
+
+        raw = args.fleet_trace_sources
+        if _os.path.exists(raw):
+            with open(raw) as f:
+                raw = f.read()
+        try:
+            specs = _json.loads(raw)
+        except _json.JSONDecodeError as e:
+            raise SystemExit(
+                f"--fleet-trace-sources is not valid JSON: {e}"
+            ) from e
+        if not isinstance(specs, list):
+            raise SystemExit(
+                "--fleet-trace-sources must be a JSON list of "
+                '{"name", "base_url", "kind"} objects'
+            )
+        fleet_trace_sources = lambda: specs  # noqa: E731
     if args.metrics_port:
-        telemetry.serve(args.metrics_port, recorder=recorder)
+        telemetry.serve(
+            args.metrics_port,
+            recorder=recorder,
+            fleet_trace_sources=fleet_trace_sources,
+        )
 
     sources: dict[str, PrometheusSource] = {}
 

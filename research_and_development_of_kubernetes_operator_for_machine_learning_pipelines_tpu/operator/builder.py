@@ -671,6 +671,14 @@ def build_deployment(
             annotations["tpumlops.dev/fleet-kv-retries"] = str(
                 fleet.kv_transfer.retries
             )
+    if config.backend == "tpu" and config.fleet.observability.journey_ring > 0:
+        # Fleet trace plane (absent = byte-for-byte): RouterSync reads
+        # this annotation and sizes the router's journey ring — valid
+        # with or without disaggregation, same handoff contract as the
+        # affinity/kv knobs above.
+        annotations["tpumlops.dev/fleet-journey-ring"] = str(
+            config.fleet.observability.journey_ring
+        )
 
     return {
         "apiVersion": SELDON_API_VERSION,

@@ -118,6 +118,9 @@ class ReconcileOutcome:
     # (None otherwise — including every step with autoscaling disabled);
     # OperatorTelemetry reads it for tpumlops_operator_autoscale_*.
     scale: Any = None
+    # {slo_name: SloEval} when spec.slo is configured (None otherwise);
+    # OperatorTelemetry reads it for the tpumlops_operator_slo_* gauges.
+    slo: Any = None
 
 
 class Reconciler:
@@ -185,6 +188,17 @@ class Reconciler:
         self._last_scale_hold: tuple | None = None
         # The step's ScaleRecord (telemetry feed), set by _autoscale_step.
         self._scale_record = None
+        # SLO error-budget accounting (operator/slo.py): rolling sample
+        # windows live in operator memory (a restart restarts the
+        # window), budget-state transitions journal beside gate/scale
+        # records, and the latest evals feed tpumlops_operator_slo_*.
+        self._slo_tracker = None
+        self._slo_last_state: dict = {}
+        self._slo_evals = None
+        # The step's engine-metrics reading, stashed by _autoscale_step
+        # so _slo_step reuses it instead of issuing a second identical
+        # fetch (False = no fetch ran this step; None = fetched blind).
+        self._step_engine_obs: object = False
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -214,6 +228,11 @@ class Reconciler:
         self._timings = {}
         self._pending_records = []
         self._scale_record = None
+        self._step_engine_obs = False
+        # Reset per step: an early-returning _slo_step (spec didn't
+        # parse, nothing serving) must export NO evals, not re-export
+        # the previous step's numbers as if live accounting ran.
+        self._slo_evals = None
         # Per-CR log identity: metadata.generation on every line of this
         # step (the control-plane analogue of the server's request_id).
         if hasattr(self.log, "set_generation"):
@@ -230,6 +249,11 @@ class Reconciler:
         # parked CRs included): restart counts are observation, not
         # rollout logic, and must keep flowing while a canary is stuck.
         outcome.state = self._sync_restart_audit(outcome.state)
+        # SLO accounting is observation too: it samples every step —
+        # canary steps included (an SLO breach DURING a rollout is
+        # exactly what the journal must be able to show).
+        outcome.state = self._slo_step(outcome.state, outcome.events)
+        outcome.slo = self._slo_evals
         outcome.timings = self._timings
         outcome.scale = self._scale_record
         # Flush the step's journal records.  Gate records get the step's
@@ -485,6 +509,129 @@ class Reconciler:
             self._patch_status(state)
         return state
 
+    def _engine_fetch(self, fetch, predictor: str, window_s, slo_tails: bool):
+        """engine_metrics with the ``slo_tails`` hint, falling back to
+        the 4-argument shape for duck-typed sources that predate it."""
+        try:
+            return fetch(
+                self.name, predictor, self.namespace, window_s,
+                slo_tails=slo_tails,
+            )
+        except TypeError:
+            return fetch(self.name, predictor, self.namespace, window_s)
+
+    def _slo_step(
+        self, state: PromotionState, events: list[Event]
+    ) -> PromotionState:
+        """One SLO accounting pass (``spec.slo``; operator/slo.py).
+
+        Samples the metrics already scraped for this CR — TTFT/ITL p99
+        from the engine series, availability from the gate histograms —
+        into rolling per-SLO windows, computes attainment / burn rate /
+        budget remaining, and journals an ``SloRecord`` (plus a
+        ``SloBudgetExhausted`` Warning) whenever an SLO's budget state
+        changes.  Absent ``spec.slo`` (the default): no tracker object,
+        no reads, no status writes — byte-for-byte."""
+        config = self._audit_config
+        if config is None:
+            return state  # spec didn't parse: leave everything alone
+        if not config.slo.enabled:
+            if self._slo_tracker is not None:
+                # spec.slo removed: drop the window and state so a
+                # re-enable starts a fresh budget, not a stale one.
+                self._slo_tracker = None
+                self._slo_last_state = {}
+            self._slo_evals = None
+            return state
+        if state.current_version is None:
+            return state  # nothing serving yet: nothing to attain
+        from . import slo as _slo
+
+        if self._slo_tracker is None:
+            self._slo_tracker = _slo.SloTracker()
+        spec = config.slo
+        source = self._metrics_source(config)
+        predictor = f"v{state.current_version}"
+        model = engine = None
+        with self._op_timer("slo_read"):
+            try:
+                model = source.model_metrics(
+                    self.name, predictor, self.namespace,
+                    config.canary.metrics_window_s,
+                )
+            except Exception as e:
+                self.log.warning(f"slo model metrics read failed: {e}")
+            if self._step_engine_obs is not False:
+                # The autoscale pass already read this predictor's
+                # engine metrics this step (tails included, since
+                # spec.slo is on): reuse instead of a second fetch.
+                engine = self._step_engine_obs
+            else:
+                fetch = getattr(source, "engine_metrics", None)
+                if fetch is not None:
+                    try:
+                        engine = self._engine_fetch(
+                            fetch, predictor,
+                            config.canary.metrics_window_s,
+                            slo_tails=True,
+                        )
+                    except Exception as e:
+                        self.log.warning(
+                            f"slo engine metrics read failed: {e}"
+                        )
+        wall = self._wall()
+        samples = _slo.collect_samples(spec, model, engine)
+        window_s = spec.window_minutes * 60.0
+        evals: dict = {}
+        recs: list = []
+        for name in spec.slo_names:
+            if name in samples:
+                good, observed = samples[name]
+                self._slo_tracker.observe(name, wall, good, observed)
+            ev = self._slo_tracker.evaluate(
+                name, wall, window_s, spec.availability_pct,
+                _slo.target_of(spec, name),
+            )
+            evals[name] = ev
+            st = ev.state
+            if st is not None and st != self._slo_last_state.get(name):
+                recs.append(
+                    _slo.SloRecord(
+                        wall=wall,
+                        slo=name,
+                        state=st,
+                        prior_state=self._slo_last_state.get(name),
+                        attainment=ev.attainment,
+                        burn_rate=ev.burn_rate,
+                        budget_remaining=ev.budget_remaining,
+                        target=ev.target,
+                        objective_pct=spec.availability_pct,
+                        window_minutes=spec.window_minutes,
+                        observed=ev.observed,
+                        samples=ev.samples,
+                    )
+                )
+                self._slo_last_state[name] = st
+        self._slo_evals = evals
+        if recs:
+            for rec in recs:
+                if rec.state == _slo.STATE_EXHAUSTED:
+                    ev = Event(
+                        "Warning",
+                        "SloBudgetExhausted",
+                        f"SLO {rec.slo} error budget exhausted: "
+                        f"attainment {rec.attainment:.4f} vs objective "
+                        f"{rec.objective_pct}% over "
+                        f"{rec.window_minutes:g}m (burn rate "
+                        f"{rec.burn_rate:.2f}).",
+                    )
+                    events.append(ev)
+                    self.kube.emit_event(self.cr_ref, ev)
+                    self.log.warning(ev.message)
+            state = self._journal(config, state, *recs)
+            self._patch_status(state)
+        return state
+
     def _shed_disabled_journal(
         self, config: OperatorConfig, state: PromotionState
     ) -> PromotionState:
@@ -547,17 +694,21 @@ class Reconciler:
         if fetch is not None:
             try:
                 with self._op_timer("scale_read"):
-                    observed = fetch(
-                        self.name,
+                    # slo_tails rides along when spec.slo is on, so the
+                    # SLO step can reuse THIS reading instead of a
+                    # second identical fetch.
+                    observed = self._engine_fetch(
+                        fetch,
                         f"v{state.current_version}",
-                        self.namespace,
                         config.canary.metrics_window_s,
+                        slo_tails=config.slo.enabled,
                     )
             except Exception as e:
                 # Blind = hold (decide() treats None as metrics-missing);
                 # a Prometheus blip must never read as "no load".
                 self.log.warning(f"engine metrics read failed: {e}")
                 observed = None
+            self._step_engine_obs = observed
 
         decision = _scaling.decide(
             auto,

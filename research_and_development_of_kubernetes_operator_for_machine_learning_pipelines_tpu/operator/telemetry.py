@@ -177,6 +177,29 @@ class OperatorTelemetry:
             ident + ["reason"],
             registry=self.registry,
         )
+        # SLO error-budget accounting (spec.slo; operator/slo.py) — no
+        # samples until a CR configures spec.slo.
+        self.slo_attainment = Gauge(
+            "tpumlops_operator_slo_attainment",
+            "Rolling fraction of in-window samples meeting the SLO "
+            "target (spec.slo)",
+            ident + ["slo"],
+            registry=self.registry,
+        )
+        self.slo_budget_remaining = Gauge(
+            "tpumlops_operator_slo_error_budget_remaining",
+            "Rolling error budget remaining (1 = untouched, 0 = "
+            "exhausted) per SLO over spec.slo.windowMinutes",
+            ident + ["slo"],
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "tpumlops_operator_slo_burn_rate",
+            "Error-budget burn rate per SLO (1.0 = consuming the "
+            "budget exactly as fast as the objective allows)",
+            ident + ["slo"],
+            registry=self.registry,
+        )
         self.rollout_seconds = Histogram(
             "tpumlops_operator_rollout_duration_seconds",
             "Wall time from NEW_VERSION detection to a terminal phase "
@@ -191,6 +214,9 @@ class OperatorTelemetry:
         # forget() can prune with the public remove() API only (no reaching
         # into prometheus_client internals).
         self._series: dict[tuple[str, str], set] = {}
+        # slo-label children currently exported per CR (pruned when an
+        # SLO vanishes from the spec or spec.slo is removed).
+        self._slo_children: dict[tuple[str, str], set] = {}
 
     def _child(self, metric, namespace: str, name: str, *extra: str):
         values = (namespace, name, *extra)
@@ -268,6 +294,36 @@ class OperatorTelemetry:
                 self._child(
                     self.autoscale_holds, namespace, name, scale.hold
                 ).inc()
+        slo = getattr(outcome, "slo", None)
+        slo_gauges = (
+            self.slo_attainment, self.slo_budget_remaining,
+            self.slo_burn_rate,
+        )
+        if slo:
+            stale = self._slo_children.get((namespace, name), set()) - set(
+                slo
+            )
+            for slo_name, ev in slo.items():
+                values = (
+                    (ev.attainment, self.slo_attainment),
+                    (ev.budget_remaining, self.slo_budget_remaining),
+                    (ev.burn_rate, self.slo_burn_rate),
+                )
+                for value, gauge in values:
+                    if value is not None:
+                        self._child(gauge, namespace, name, slo_name).set(
+                            value
+                        )
+            self._slo_children[(namespace, name)] = set(slo)
+        else:
+            # spec.slo removed: stop exporting stale budget numbers.
+            stale = self._slo_children.pop((namespace, name), set())
+        for slo_name in stale:
+            for gauge in slo_gauges:
+                try:
+                    gauge.remove(namespace, name, slo_name)
+                except KeyError:
+                    pass
         # Rollout duration: arm on canary start, observe on terminal.
         key = (namespace, name)
         if "NewModelVersionDetected" in reasons and state.phase == Phase.CANARY:
@@ -298,11 +354,13 @@ class OperatorTelemetry:
             except KeyError:
                 pass
         self._rollout_t0.pop((namespace, name), None)
+        self._slo_children.pop((namespace, name), None)
 
     def exposition(self) -> bytes:
         return generate_latest(self.registry)
 
-    def serve(self, port: int, addr: str = "0.0.0.0", recorder=None):
+    def serve(self, port: int, addr: str = "0.0.0.0", recorder=None,
+              fleet_trace_sources=None):
         """Expose /metrics, /debug/spans, and (with a RolloutRecorder
         attached) /debug/rollouts + /debug/rollouts/trace on a
         daemon-thread listener.
@@ -313,7 +371,16 @@ class OperatorTelemetry:
         /debug/rollouts is the live per-CR gate/phase journal;
         /debug/rollouts/trace?format=chrome renders it as Chrome
         trace-event JSON (Perfetto), mirroring the server's
-        /debug/engine + /debug/trace pair."""
+        /debug/engine + /debug/trace pair.
+
+        ``fleet_trace_sources`` — a zero-arg callable returning
+        ``[{"name", "base_url", "kind": "router"|"replica"}, ...]``
+        (typically derived from the routing manifest: the router admin
+        address plus every live replica) — additionally serves ``GET
+        /debug/fleet-trace``: the sources' chrome traces fetched,
+        shifted onto one clock, and merged into ONE Perfetto trace whose
+        request spans share the propagated request ids
+        (``utils/trace_stitch.py``).  404 when not wired."""
         import json
         import threading
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -334,6 +401,29 @@ class OperatorTelemetry:
                     body = json.dumps(
                         {"spans": GLOBAL_TRACER.as_dict()}
                     ).encode()
+                    ctype = "application/json"
+                elif path == "/debug/fleet-trace":
+                    if fleet_trace_sources is None:
+                        self.send_error(
+                            404,
+                            "fleet trace sources not wired (pass "
+                            "fleet_trace_sources to telemetry.serve)",
+                        )
+                        return
+                    from ..utils.trace_stitch import fleet_trace
+
+                    try:
+                        specs = list(fleet_trace_sources())
+                        merged = fleet_trace(specs)
+                    except Exception as e:  # a dark component is a 502,
+                        self.send_error(502, f"fleet trace fetch: {e}")
+                        return  # not a silent partial story
+                    q = parse_qs(parsed.query).get("request_id", [None])[0]
+                    if q:
+                        from ..utils.trace_stitch import filter_request
+
+                        merged = filter_request(merged, q)
+                    body = json.dumps(merged).encode()
                     ctype = "application/json"
                 elif path == "/debug/rollouts":
                     if recorder is None:

@@ -45,10 +45,23 @@ _log = logging.getLogger(__name__)
 _req_log = logging.getLogger("tpumlops.request")
 
 # W3C traceparent: version-traceid-spanid-flags; the 32-hex trace id is
-# the request identity we adopt (so spans correlate across the mesh).
+# the request identity we adopt (so spans correlate across the mesh) and
+# the 16-hex span id is the immediate parent (with the router's journey
+# ring on: the router's per-leg span).
 _TRACEPARENT = re.compile(
-    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$"
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
 )
+
+
+def trace_context_from_headers(headers) -> tuple[str, str]:
+    """``(trace_id, parent_span)`` from a well-formed ``traceparent``
+    header, or ``("", "")`` — the engine ``RequestTrace`` then carries
+    the propagated context so a fleet stitcher can join this replica's
+    spans to the router journey that produced them."""
+    m = _TRACEPARENT.match(headers.get("traceparent", "").strip().lower())
+    if m:
+        return m.group(1), m.group(2)
+    return "", ""
 
 
 def request_id_from_headers(headers) -> str:
@@ -76,6 +89,9 @@ def request_id_from_headers(headers) -> str:
 @web.middleware
 async def request_id_middleware(request: web.Request, handler):
     rid = request["request_id"] = request_id_from_headers(request.headers)
+    request["trace_id"], request["parent_span"] = trace_context_from_headers(
+        request.headers
+    )
     try:
         resp = await handler(request)
     except web.HTTPException as exc:
@@ -203,9 +219,11 @@ class TpuInferenceServer:
             max_inflight=max_inflight,
         )
 
-    def _not_attached(self) -> web.Response | None:
+    def _not_attached(self, request: web.Request) -> web.Response | None:
         """Typed 503 while a warm-pool replica holds no model (clients
-        retry after the operator attaches one)."""
+        retry after the operator attaches one).  Carries the request id
+        like every typed error body — a shed must stay correlatable
+        with the router journey when client stacks drop headers."""
         if self.engine is not None:
             return None
         return web.json_response(
@@ -213,6 +231,7 @@ class TpuInferenceServer:
                 "error": "no model attached to this warm-pool replica",
                 "reason": "warm_pool_empty",
                 "retry_after_s": 5,
+                "request_id": request.get("request_id", ""),
             },
             status=503,
             headers={"Retry-After": "5"},
@@ -393,7 +412,7 @@ class TpuInferenceServer:
         return _concat_batches(chunks_out)
 
     async def handle_v2_infer(self, request: web.Request) -> web.Response:
-        err = self._not_attached()
+        err = self._not_attached(request)
         if err is not None:
             return err
         t0 = time.perf_counter()
@@ -420,17 +439,23 @@ class TpuInferenceServer:
             )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             code = 400
-            return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=400,
+            )
         except Exception as e:  # model/runtime failure
             _log.exception("inference failed")
             code = 500
-            return web.json_response({"error": str(e)}, status=500)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=500,
+            )
         finally:
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
 
     async def handle_seldon_predict(self, request: web.Request) -> web.Response:
         """Seldon-protocol compatibility (``{"data": {"ndarray": ...}}``)."""
-        err = self._not_attached()
+        err = self._not_attached(request)
         if err is not None:
             return err
         t0 = time.perf_counter()
@@ -452,11 +477,17 @@ class TpuInferenceServer:
             )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             code = 400
-            return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=400,
+            )
         except Exception as e:
             _log.exception("inference failed")
             code = 500
-            return web.json_response({"error": str(e)}, status=500)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=500,
+            )
         finally:
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
 
@@ -484,11 +515,17 @@ class TpuInferenceServer:
             return web.json_response({"meta": {}})
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             code = 400
-            return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=400,
+            )
         except Exception as e:
             _log.exception("feedback handling failed")
             code = 500
-            return web.json_response({"error": str(e)}, status=500)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=500,
+            )
         finally:
             self.metrics.observe_request(
                 time.perf_counter() - t0, code=code, service="feedback"
@@ -504,7 +541,7 @@ class TpuInferenceServer:
         request are scheduled independently — they share decode steps with
         every other in-flight request, not just each other.
         """
-        err = self._not_attached()
+        err = self._not_attached(request)
         if err is not None:
             return err
         t0 = time.perf_counter()
@@ -607,7 +644,9 @@ class TpuInferenceServer:
             )
             traces = [
                 RequestTrace(
-                    request_id=rid if len(prompts) == 1 else f"{rid}/{i}"
+                    request_id=rid if len(prompts) == 1 else f"{rid}/{i}",
+                    trace_id=request.get("trace_id", ""),
+                    parent_span=request.get("parent_span", ""),
                 )
                 for i in range(len(prompts))
             ]
@@ -647,7 +686,10 @@ class TpuInferenceServer:
         except EngineOverloaded as e:
             # Shed contract: 429 + Retry-After, body naming the typed
             # reason ("budget" under load, "draining" during scale-down
-            # / shutdown).  Nothing reached the engine — clients retry
+            # / shutdown) AND the request id — a shed body must be
+            # correlatable with the router journey / access-log line
+            # without header access (many client stacks drop headers on
+            # error paths).  Nothing reached the engine — clients retry
             # verbatim on another replica.
             code = 429
             return web.json_response(
@@ -655,6 +697,7 @@ class TpuInferenceServer:
                     "error": str(e),
                     "reason": e.reason,
                     "retry_after_s": e.retry_after_s,
+                    "request_id": request.get("request_id", ""),
                 },
                 status=429,
                 headers={"Retry-After": str(e.retry_after_s)},
@@ -670,16 +713,23 @@ class TpuInferenceServer:
                     "reason": "poison_quarantined",
                     "fingerprint": e.fingerprint,
                     "crashes": e.crashes,
+                    "request_id": request.get("request_id", ""),
                 },
                 status=422,
             )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             code = 400
-            return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=400,
+            )
         except Exception as e:
             _log.exception("generation failed")
             code = 500
-            return web.json_response({"error": str(e)}, status=500)
+            return web.json_response(
+                {"error": str(e), "request_id": request.get("request_id", "")},
+                status=500,
+            )
         finally:
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
 
@@ -704,7 +754,11 @@ class TpuInferenceServer:
         def on_token(t: int) -> None:  # scheduler thread -> event loop
             loop.call_soon_threadsafe(tokens.put_nowait, int(t))
 
-        trace = RequestTrace(request_id=request_id)
+        trace = RequestTrace(
+            request_id=request_id,
+            trace_id=request.get("trace_id", ""),
+            parent_span=request.get("parent_span", ""),
+        )
         _stamp_handoff(request, [trace])
         fut = self.gen_engine.submit(
             prompt, max_new, eos_id, **sampling, on_token=on_token,
@@ -1127,10 +1181,12 @@ class TpuInferenceServer:
 
     # -- KV handoff (disaggregated prefill/decode fleets) --------------------
 
-    def _kv_engine_or_error(self) -> tuple[object | None, web.Response | None]:
+    def _kv_engine_or_error(
+        self, request: web.Request
+    ) -> tuple[object | None, web.Response | None]:
         """Common gating for the KV endpoints: attached causal-LM engine
         with the radix prefix cache on (the handoff unit IS its chunk)."""
-        err = self._not_attached()
+        err = self._not_attached(request)
         if err is not None:
             return None, err
         if self.gen_engine is None:
@@ -1163,7 +1219,7 @@ class TpuInferenceServer:
         from . import kv_transfer
         from .flight_recorder import RequestTrace
 
-        engine, err = self._kv_engine_or_error()
+        engine, err = self._kv_engine_or_error(request)
         if err is not None:
             return err
         t0 = time.perf_counter()
@@ -1205,7 +1261,11 @@ class TpuInferenceServer:
                 rid = request.get("request_id") or request_id_from_headers(
                     request.headers
                 )
-                trace = RequestTrace(request_id=rid)
+                trace = RequestTrace(
+                    request_id=rid,
+                    trace_id=request.get("trace_id", ""),
+                    parent_span=request.get("parent_span", ""),
+                )
                 fut = engine.submit(
                     prompt, 1, request_id=rid, trace=trace
                 )
@@ -1270,7 +1330,7 @@ class TpuInferenceServer:
         request that follows is reconstructable from ``/debug/trace``."""
         from . import kv_transfer
 
-        engine, err = self._kv_engine_or_error()
+        engine, err = self._kv_engine_or_error(request)
         if err is not None:
             return err
         t0 = time.perf_counter()
@@ -1340,7 +1400,7 @@ class TpuInferenceServer:
             )
 
     async def handle_model_metadata(self, request: web.Request) -> web.Response:
-        err = self._not_attached()
+        err = self._not_attached(request)
         if err is not None:
             return err
         p = self.engine.predictor

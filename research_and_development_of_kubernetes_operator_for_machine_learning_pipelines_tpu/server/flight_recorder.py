@@ -58,6 +58,14 @@ class RequestTrace:
     exposed."""
 
     request_id: str = ""
+    # W3C trace context joined from the inbound traceparent (the router
+    # mints/propagates one when its journey ring is on): the 32-hex
+    # trace id shared by every component this request touched, and the
+    # 16-hex span id of the immediate parent (the router's leg span).
+    # Empty = the request arrived without a traceparent; the timing
+    # block and chrome export then stay byte-for-byte what they were.
+    trace_id: str = ""
+    parent_span: str = ""
     prompt_tokens: int = 0
     slot: int = -1
     t_submit: float = 0.0
@@ -93,7 +101,7 @@ class RequestTrace:
         """The JSON shape returned by ``"debug": true`` and logged on
         completion.  Totals here agree with the Prometheus counters the
         same request incremented (asserted in tests/test_server.py)."""
-        return {
+        out = {
             "request_id": self.request_id,
             "prompt_tokens": self.prompt_tokens,
             "queue_ms": _ms(self.t_submit, self.t_admit),
@@ -110,6 +118,13 @@ class RequestTrace:
             "handoff_ms": self.handoff_ms,
             "finish_reason": self.finish_reason or "in-flight",
         }
+        if self.trace_id:
+            # Present only for requests that arrived with a traceparent
+            # (fleet trace plane on): pre-trace-plane blocks stay
+            # byte-for-byte.
+            out["trace_id"] = self.trace_id
+            out["parent_span"] = self.parent_span
+        return out
 
 
 class FlightRecorder:
@@ -405,4 +420,11 @@ class FlightRecorder:
                     "args": tr.timing_block(),
                 }
             )
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        # started_unix rides top-level so the fleet stitcher
+        # (utils/trace_stitch.py) reads its clock anchor from this
+        # payload instead of fetching /debug/engine separately.
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "started_unix": self._t0_unix,
+        }

@@ -669,6 +669,44 @@ class KvTransferSpec:
 
 
 @dataclass(frozen=True)
+class FleetObservabilitySpec:
+    """``spec.fleet.observability``: the router's fleet trace plane.
+
+    ``journey_ring`` sizes the router's bounded per-request
+    JourneyRecord ring (``--journey-ring`` via the
+    ``tpumlops.dev/fleet-journey-ring`` manifest annotation and
+    RouterSync).  With the ring on, the router adopts-or-mints
+    ``X-Request-Id`` + W3C ``traceparent`` on every inbound request,
+    propagates them on every outbound leg (forwards, KV relay legs,
+    failover retries, park releases), echoes the id on every response,
+    and serves the ring at ``/router/debug/requests`` +
+    ``/router/debug/trace``.  0 — the default — keeps the router
+    byte-for-byte: no header minting, no new metric families, 404 on
+    the debug endpoints."""
+
+    journey_ring: int = 0
+
+    @classmethod
+    def from_spec(
+        cls, spec: Mapping[str, Any] | None
+    ) -> "FleetObservabilitySpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec, frozenset({"journeyRing"}), "spec.fleet.observability"
+        )
+        return cls(journey_ring=int(spec.get("journeyRing", 0)))
+
+    def __post_init__(self):
+        # The router serializes the whole ring per debug scrape on its
+        # single-threaded event loop; the cap bounds that stall.
+        if not (0 <= self.journey_ring <= 1 << 16):
+            raise ValueError(
+                "fleet.observability.journeyRing must be in "
+                f"[0, {1 << 16}], got {self.journey_ring}"
+            )
+
+
+@dataclass(frozen=True)
 class FleetSpec:
     """``spec.fleet``: disaggregated prefill/decode replica pools.
 
@@ -698,6 +736,11 @@ class FleetSpec:
         default_factory=PrefixAffinitySpec
     )
     kv_transfer: KvTransferSpec = field(default_factory=KvTransferSpec)
+    # Router trace plane: valid WITHOUT disaggregation (a plain canary
+    # router benefits from request journeys just as much as a fleet).
+    observability: FleetObservabilitySpec = field(
+        default_factory=FleetObservabilitySpec
+    )
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "FleetSpec":
@@ -710,7 +753,7 @@ class FleetSpec:
                     "prefillMinReplicas", "prefillMaxReplicas",
                     "decodeMinReplicas", "decodeMaxReplicas",
                     "prefillTargetAdmissionWaitMs",
-                    "prefixAffinity", "kvTransfer",
+                    "prefixAffinity", "kvTransfer", "observability",
                 }
             ),
             "spec.fleet",
@@ -752,6 +795,9 @@ class FleetSpec:
                 spec.get("prefixAffinity")
             ),
             kv_transfer=KvTransferSpec.from_spec(spec.get("kvTransfer")),
+            observability=FleetObservabilitySpec.from_spec(
+                spec.get("observability")
+            ),
         )
 
     def __post_init__(self):
@@ -834,6 +880,90 @@ class RolloutObservability:
                 "observability.historyLimit must be in [0, 64], got "
                 f"{self.history_limit}"
             )
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """``spec.slo``: serving objectives the operator accounts against.
+
+    Each configured target becomes one SLO the operator evaluates per
+    reconcile step from the metrics it already scrapes — TTFT p99 and
+    ITL p99 from the engine series, availability from the router's
+    gate histograms — over a rolling ``window_minutes`` window:
+
+    - attainment: fraction of in-window samples meeting the target;
+    - burn rate: (1 − attainment) / (1 − objective), where the shared
+      objective is ``availability_pct`` (burn 1.0 = consuming the error
+      budget exactly as fast as the objective allows);
+    - error budget remaining: max(0, 1 − burn rate).
+
+    Exported as ``tpumlops_operator_slo_{attainment,
+    error_budget_remaining,burn_rate}{slo=...}`` and journaled as
+    ``SloRecord``s beside gate/scale records when budget state changes.
+    Absent (the default) — no tracker, no series, no status writes:
+    byte-for-byte.
+    """
+
+    enabled: bool = False
+    ttft_p99_ms: float = 0.0  # 0 = latency target not tracked
+    itl_p99_ms: float = 0.0   # 0 = not tracked
+    availability_pct: float = 99.0  # the objective percent (all SLOs)
+    window_minutes: float = 60.0
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "SloSpec":
+        if spec is None:
+            return cls()
+        _reject_unknown_keys(
+            spec,
+            frozenset(
+                {
+                    "ttftP99Ms", "itlP99Ms", "availabilityPct",
+                    "windowMinutes",
+                }
+            ),
+            "spec.slo",
+        )
+        return cls(
+            enabled=True,
+            ttft_p99_ms=float(spec.get("ttftP99Ms", 0.0)),
+            itl_p99_ms=float(spec.get("itlP99Ms", 0.0)),
+            availability_pct=float(spec.get("availabilityPct", 99.0)),
+            window_minutes=float(spec.get("windowMinutes", 60.0)),
+        )
+
+    def __post_init__(self):
+        if not self.enabled:
+            return
+        if self.ttft_p99_ms < 0 or self.itl_p99_ms < 0:
+            raise ValueError(
+                "slo.ttftP99Ms / slo.itlP99Ms must be >= 0, got "
+                f"{self.ttft_p99_ms} / {self.itl_p99_ms}"
+            )
+        if not (50.0 <= self.availability_pct < 100.0):
+            # 100% leaves a zero error budget (division by zero in the
+            # burn rate) and below 50% is a typo, not an objective.
+            raise ValueError(
+                "slo.availabilityPct must be in [50, 100), got "
+                f"{self.availability_pct}"
+            )
+        if not (1.0 <= self.window_minutes <= 1440.0):
+            raise ValueError(
+                "slo.windowMinutes must be in [1, 1440], got "
+                f"{self.window_minutes}"
+            )
+
+    @property
+    def slo_names(self) -> tuple:
+        """The SLOs this spec tracks, in evaluation order (values of the
+        ``slo`` metric label and ``SloRecord.slo``)."""
+        names = []
+        if self.ttft_p99_ms > 0:
+            names.append("ttft_p99")
+        if self.itl_p99_ms > 0:
+            names.append("itl_p99")
+        names.append("availability")  # always tracked when enabled
+        return tuple(names)
 
 
 def _parse_quantize(value) -> str:
@@ -1069,6 +1199,9 @@ class OperatorConfig:
     # Disaggregated prefill/decode pools with KV handoff and prefix-
     # affinity routing; disabled default = byte-for-byte.
     fleet: FleetSpec = field(default_factory=FleetSpec)
+    # Serving objectives (error-budget accounting in operator/slo.py);
+    # absent default = no tracker, no series, byte-for-byte.
+    slo: SloSpec = field(default_factory=SloSpec)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
@@ -1201,4 +1334,5 @@ class OperatorConfig:
             ),
             autoscaling=autoscaling,
             fleet=fleet,
+            slo=SloSpec.from_spec(spec.get("slo")),
         )

@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Stitch router journeys + replica flight-recorder tracks into ONE
+Perfetto trace.
+
+The fleet trace plane (spec.fleet.observability.journeyRing /
+--journey-ring) propagates one X-Request-Id + W3C traceparent across
+every leg of a request's life — router forward, KV export/import
+relays, failover retries, park releases, and the replica engine spans —
+so the per-component Chrome traces share request ids.  This tool fetches
+each component's trace and its started_unix clock anchor, shifts them
+onto one timeline, and writes a single chrome trace JSON (load it at
+https://ui.perfetto.dev or chrome://tracing).
+
+Examples:
+
+    # one router + two replicas, full ring
+    python scripts/stitch_trace.py \
+        --router http://127.0.0.1:9000 \
+        --replica http://127.0.0.1:8001 --replica http://127.0.0.1:8002 \
+        -o fleet_trace.json
+
+    # just one request's span tree
+    python scripts/stitch_trace.py --router http://127.0.0.1:9000 \
+        --replica http://127.0.0.1:8001 --request-id my-id-123
+
+The operator's telemetry listener serves the same merge live at
+``GET /debug/fleet-trace`` when wired with the fleet's endpoints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.trace_stitch import (  # noqa: E501
+    fetch_source,
+    filter_request,
+    stitch_chrome_traces,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "stitch_trace",
+        description="Merge router + replica chrome traces into one "
+        "Perfetto timeline (shared request ids across tracks).",
+    )
+    ap.add_argument(
+        "--router", action="append", default=[], metavar="URL",
+        help="router base URL (e.g. http://127.0.0.1:9000); repeatable",
+    )
+    ap.add_argument(
+        "--replica", action="append", default=[], metavar="URL",
+        help="replica base URL (server /debug endpoints); repeatable",
+    )
+    ap.add_argument(
+        "--request-id", default=None,
+        help="keep only this request's span tree",
+    )
+    ap.add_argument(
+        "-o", "--output", default="-",
+        help="output path (default '-' = stdout)",
+    )
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    if not args.router and not args.replica:
+        ap.error("need at least one --router or --replica URL")
+
+    sources = []
+    for i, url in enumerate(args.router):
+        label = "router" if len(args.router) == 1 else f"router-{i}"
+        sources.append(fetch_source(label, url, "router", args.timeout))
+    for i, url in enumerate(args.replica):
+        label = f"replica-{i}" if len(args.replica) > 1 else "replica"
+        sources.append(fetch_source(label, url, "replica", args.timeout))
+
+    trace = stitch_chrome_traces(sources)
+    if args.request_id:
+        trace = filter_request(trace, args.request_id)
+    text = json.dumps(trace)
+    if args.output == "-":
+        print(text)
+    else:
+        Path(args.output).write_text(text)
+        n = len(trace["traceEvents"])
+        print(f"wrote {args.output}: {n} events from "
+              f"{len(sources)} components", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -326,6 +326,9 @@ def test_prometheus_engine_metrics_queries_and_none_semantics():
         base_url="http://prom", transport=httpx.MockTransport(handler)
     )
     em = src.engine_metrics("iris", "v2", "models", 30)
+    # The autoscale shape stays EXACTLY 4 queries — the SLO tails ride
+    # only when slo_tails=True (below), so autoscale-only CRs add no
+    # Prometheus load.
     assert len(queries) == 4
     assert queries[0].startswith("sum(tpumlops_engine_queue_depth{")
     assert 'deployment_name="iris"' in queries[0]
@@ -342,6 +345,19 @@ def test_prometheus_engine_metrics_queries_and_none_semantics():
     assert em.admission_wait_p95_ms == 42.5
     assert em.ttft_p95_s == 1.25
     assert em.parked == 7.0
+    assert em.ttft_p99_s is None and em.itl_p99_s is None
+
+    # SLO tails (spec.slo): slo_tails=True adds exactly the two p99
+    # histogram_quantile queries.
+    queries.clear()
+    em = src.engine_metrics("iris", "v2", "models", 30, slo_tails=True)
+    assert len(queries) == 6
+    assert "histogram_quantile(0.99" in queries[4]
+    assert "tpumlops_ttft_seconds_bucket" in queries[4]
+    assert "histogram_quantile(0.99" in queries[5]
+    assert "tpumlops_itl_seconds_bucket" in queries[5]
+    assert em.ttft_p99_s == 1.25
+    assert em.itl_p99_s == 7.0
 
     def empty(request):
         return httpx.Response(200, json={"data": {"result": []}})
@@ -352,3 +368,4 @@ def test_prometheus_engine_metrics_queries_and_none_semantics():
     em = src.engine_metrics("iris", "v2", "models")
     assert em.queue_depth is None  # unavailable, NOT zero load
     assert em.ttft_p95_s is None
+    assert em.ttft_p99_s is None and em.itl_p99_s is None

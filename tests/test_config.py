@@ -557,3 +557,81 @@ def test_fleet_band_and_unknown_key_validation():
                 fleet={"disaggregation": True, "kvTransfer": {"retries": 9}}
             )
         )
+
+
+# ---------------------------------------------------------------------------
+# spec.fleet.observability (journey ring) + spec.slo
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_observability_journey_ring_parses_without_disaggregation():
+    cfg = OperatorConfig.from_spec(
+        _fleet_spec(fleet={"observability": {"journeyRing": 64}})
+    )
+    # Valid WITHOUT disaggregation: a plain canary router gets request
+    # journeys too.
+    assert cfg.fleet.disaggregation is False
+    assert cfg.fleet.observability.journey_ring == 64
+    # Default: off, byte-for-byte.
+    assert (
+        OperatorConfig.from_spec(_fleet_spec()).fleet.observability
+        .journey_ring == 0
+    )
+
+
+def test_fleet_observability_validation():
+    with pytest.raises(ValueError, match="journeyRing"):
+        OperatorConfig.from_spec(
+            _fleet_spec(fleet={"observability": {"journeyRing": -1}})
+        )
+    with pytest.raises(ValueError, match="journeyRing"):
+        OperatorConfig.from_spec(
+            _fleet_spec(fleet={"observability": {"journeyRing": (1 << 20) + 1}})
+        )
+    with pytest.raises(ValueError, match="unknown key"):
+        OperatorConfig.from_spec(
+            _fleet_spec(fleet={"observability": {"journeyring": 8}})
+        )
+
+
+def test_slo_spec_absent_is_disabled():
+    cfg = OperatorConfig.from_spec(minimal_spec())
+    assert cfg.slo.enabled is False
+    assert cfg.slo.slo_names == ("availability",)  # were it enabled
+
+
+def test_slo_spec_parses_targets_and_names():
+    cfg = OperatorConfig.from_spec(
+        minimal_spec(
+            slo={
+                "ttftP99Ms": 250,
+                "itlP99Ms": 20,
+                "availabilityPct": 99.5,
+                "windowMinutes": 30,
+            }
+        )
+    )
+    assert cfg.slo.enabled is True
+    assert cfg.slo.ttft_p99_ms == 250.0
+    assert cfg.slo.itl_p99_ms == 20.0
+    assert cfg.slo.availability_pct == 99.5
+    assert cfg.slo.window_minutes == 30.0
+    assert cfg.slo.slo_names == ("ttft_p99", "itl_p99", "availability")
+    # An empty block still enables availability accounting at defaults.
+    cfg = OperatorConfig.from_spec(minimal_spec(slo={}))
+    assert cfg.slo.enabled is True
+    assert cfg.slo.slo_names == ("availability",)
+
+
+def test_slo_spec_validation():
+    # 100% leaves a zero error budget: the burn rate would divide by 0.
+    with pytest.raises(ValueError, match="availabilityPct"):
+        OperatorConfig.from_spec(minimal_spec(slo={"availabilityPct": 100}))
+    with pytest.raises(ValueError, match="availabilityPct"):
+        OperatorConfig.from_spec(minimal_spec(slo={"availabilityPct": 10}))
+    with pytest.raises(ValueError, match="windowMinutes"):
+        OperatorConfig.from_spec(minimal_spec(slo={"windowMinutes": 0}))
+    with pytest.raises(ValueError, match="ttftP99Ms"):
+        OperatorConfig.from_spec(minimal_spec(slo={"ttftP99Ms": -1}))
+    with pytest.raises(ValueError, match="unknown key"):
+        OperatorConfig.from_spec(minimal_spec(slo={"ttftp99ms": 10}))

@@ -1640,3 +1640,241 @@ def test_chaos_replica_kill_and_restart_under_load(llm_models):
         chaos.stop()
         ha.stop()
         hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet trace plane e2e (PR 14): ONE chaos-driven request that parks
+# during a wake, relays prefill -> decode, and survives a failover must
+# reconstruct as ONE chrome trace — the router journey plus both live
+# replicas' flight-recorder tracks sharing the propagated request id /
+# trace id.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_trace_park_relay_failover_stitches_to_one_trace(llm_models):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.chaos import (
+        ChaosProxy,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.config import (
+        TpuSpec,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.trace_stitch import (
+        fetch_source,
+        request_ids_by_pid,
+        stitch_chrome_traces,
+    )
+
+    tpu = TpuSpec.from_spec(
+        {
+            "meshShape": {"tp": 1},
+            "maxBatchSize": 2,
+            "maxSlots": 2,
+            "prefixCache": {"enabled": True, "chunkTokens": 8},
+            "observability": {"traceRing": 512},
+        }
+    )
+    handles, ports = [], {}
+    for name in ("p1", "d2"):
+        port = free_port()
+        handles.append(
+            start_model_server(
+                llm_models["1"], name, port, model_name="llm",
+                namespace="models", tpu=tpu,
+            )
+        )
+        ports[name] = port
+    # "d1" is the chaos decode replica: a wire-level proxy that will be
+    # HARD-killed (dead-pod ECONNREFUSED) while the request is parked.
+    # Its upstream target is irrelevant once dead.
+    chaos = ChaosProxy(ports["d2"])
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "p1": ("127.0.0.1", ports["p1"], 100, "prefill"),
+            # The ONLY decode-role backend: the affinity ring target is
+            # deterministic — and dead at release time.
+            "d1": ("127.0.0.1", chaos.port, 50, "decode"),
+            "d2": ("127.0.0.1", ports["d2"], 50, "unified"),
+        },
+        namespace="models",
+        deployment="llm",
+        affinity_tokens=8,
+        journey_ring=64,
+        failover_retries=2,
+        park_buffer=4,
+        park_timeout_s=60.0,
+        access_log=True,
+    ).start()
+
+    rid = "chaos-journey-1"
+    result: list = []
+
+    def send_chaos_request():
+        body = _json.dumps(
+            {"prompt_ids": [11] * 8 + [3, 4], "max_new_tokens": 4}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/v2/models/llm/generate",
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "X-Request-Id": rid,
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                result.append((resp.status, _json.loads(resp.read()),
+                               resp.headers.get("X-Request-Id")))
+        except urllib.error.HTTPError as e:
+            result.append((e.code, e.read().decode(), None))
+        except Exception as e:
+            result.append((None, repr(e), None))
+
+    try:
+        # Park phase: the CR is "at zero" — every weight 0.
+        router.admin.set_weights({"p1": 0, "d1": 0, "d2": 0})
+        t = threading.Thread(target=send_chaos_request, daemon=True)
+        t.start()
+        wait_for(
+            lambda: router.admin.parked()["parked"] == 1,
+            timeout=15,
+            what="request parked",
+        )
+        time.sleep(0.2)  # a measurable hold span
+        chaos.stop()  # the decode target dies while the request waits
+
+        # The wake: weights return, the parked request releases and runs
+        # the whole gauntlet — affinity miss -> export on p1 -> import
+        # to the DEAD d1 -> unified fallback to d1 -> connect refused ->
+        # before-first-byte failover -> served on d2.
+        router.admin.set_weights({"p1": 100, "d1": 50, "d2": 50})
+        t.join(timeout=180)
+        assert result, "request never resolved"
+        status, body, echoed = result[0]
+        assert status == 200, result
+        assert echoed == rid  # the id survived the whole gauntlet
+
+        # The router journey alone tells the story.
+        journeys = router.admin.journeys()
+        rec = next(
+            r for r in journeys["requests"] if r["request_id"] == rid
+        )
+        assert rec["outcome"] == "ok" and rec["status"] == 200
+        assert len(rec["parks"]) == 1 and rec["park_ms"] >= 100
+        assert rec["failovers"] == 1
+        assert rec["affinity"] == "fallback"  # relay died, served unified
+        leg_kinds = [(leg["kind"], leg["backend"], leg["status"])
+                     for leg in rec["legs"]]
+        assert ("export", "p1", 200) in leg_kinds  # the relay happened
+        assert ("import", "d1", 0) in leg_kinds    # and died at d1
+        assert ("forward", "d2", 200) in leg_kinds  # failover target won
+        assert rec["backend"] == "d2"
+        trace_id = rec["trace_id"]
+        assert len(trace_id) == 32
+
+        # Both replicas journaled the SAME propagated identity: p1's
+        # flight recorder holds the export-side admission, d2 the final
+        # generation with the joined W3C context.
+        p1_eng = _json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ports['p1']}/debug/engine", timeout=10
+            ).read()
+        )
+        assert any(r["request_id"] == rid for r in p1_eng["requests"])
+        d2_eng = _json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ports['d2']}/debug/engine", timeout=10
+            ).read()
+        )
+        d2_rec = next(
+            r for r in d2_eng["requests"] if r["request_id"] == rid
+        )
+        assert d2_rec["trace_id"] == trace_id  # context joined, not minted
+
+        # THE acceptance pin: stitched into ONE chrome trace, the
+        # propagated id appears under the router's pid AND both
+        # replicas' pids, on one common timeline.
+        merged = stitch_chrome_traces(
+            [
+                fetch_source(
+                    "router", f"http://127.0.0.1:{router.port}", "router"
+                ),
+                fetch_source(
+                    "p1", f"http://127.0.0.1:{ports['p1']}", "replica"
+                ),
+                fetch_source(
+                    "d2", f"http://127.0.0.1:{ports['d2']}", "replica"
+                ),
+            ]
+        )
+        by_pid = request_ids_by_pid(merged)
+        assert all(rid in ids for ids in by_pid.values()), by_pid
+        assert set(by_pid) == {1, 2, 3}
+        # Async request spans balance per component (a valid trace, not
+        # just matching ids) and the park span is on the router track.
+        for pid in (1, 2, 3):
+            b = [e for e in merged["traceEvents"]
+                 if e.get("ph") == "b" and e.get("id") == rid
+                 and e["pid"] == pid]
+            e_ = [e for e in merged["traceEvents"]
+                  if e.get("ph") == "e" and e.get("id") == rid
+                  and e["pid"] == pid]
+            assert len(b) == len(e_) >= 1, (pid, b, e_)
+        parked_spans = [
+            e for e in merged["traceEvents"]
+            if e.get("name") == "parked"
+            and (e.get("args") or {}).get("request_id") == rid
+        ]
+        assert len(parked_spans) == 1 and parked_spans[0]["pid"] == 1
+
+        # The operator telemetry listener serves the same stitch live at
+        # GET /debug/fleet-trace when wired with the fleet's endpoints.
+        from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.telemetry import (
+            OperatorTelemetry,
+        )
+
+        sources = [
+            {"name": "router", "kind": "router",
+             "base_url": f"http://127.0.0.1:{router.port}"},
+            {"name": "p1", "base_url": f"http://127.0.0.1:{ports['p1']}"},
+            {"name": "d2", "base_url": f"http://127.0.0.1:{ports['d2']}"},
+        ]
+        tel_port = free_port()
+        httpd = OperatorTelemetry().serve(
+            tel_port, addr="127.0.0.1",
+            fleet_trace_sources=lambda: sources,
+        )
+        try:
+            served = _json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{tel_port}/debug/fleet-trace"
+                    f"?request_id={rid}",
+                    timeout=30,
+                ).read()
+            )
+            ids = {
+                e.get("id")
+                for e in served["traceEvents"]
+                if e.get("ph") in ("b", "e")
+            }
+            assert ids == {rid}
+        finally:
+            httpd.shutdown()
+
+        # The access log carries the same correlatable line.
+        access = [
+            line for line in router.access_log_lines()
+            if line["request_id"] == rid
+        ]
+        assert access and access[0]["failover_count"] == 1
+        assert access[0]["park_ms"] >= 100
+        assert access[0]["outcome"] == "ok"
+    finally:
+        router.stop()
+        chaos.stop()
+        for h in handles:
+            h.stop()

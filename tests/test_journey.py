@@ -1,0 +1,899 @@
+"""Fleet trace plane: router journey ring, W3C context propagation,
+access log, trace stitching, and SLO error-budget accounting.
+
+Drives the real compiled router binary against in-process HTTP backends
+(the tests/test_router.py harness) plus the pure-Python stitcher and the
+reconciler SLO step against the fakes.  The chaos-driven LIVE e2e
+(relay → failover → park reconstructed as one chrome trace) lives in
+tests/test_e2e_localplane.py.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpumlops.clients.base import MLFLOWMODEL, ModelMetrics, ObjectRef
+from tpumlops.clients.chaos import ChaosProxy
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.clients.router import RouterProcess, RouterSync, build_router
+from tpumlops.operator.reconciler import Reconciler
+from tpumlops.utils.clock import FakeClock
+from tpumlops.utils.trace_stitch import (
+    filter_request,
+    request_ids_by_pid,
+    stitch_chrome_traces,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Echo(http.server.BaseHTTPRequestHandler):
+    """Replies with the trace headers it saw; tallies them per class."""
+
+    tag = "?"
+    seen: list  # class-level, set per subclass
+
+    def _reply(self, code=200):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        type(self).seen.append(
+            {
+                "rid": self.headers.get("X-Request-Id"),
+                "tp": self.headers.get("traceparent"),
+                "path": self.path,
+            }
+        )
+        payload = json.dumps(
+            {
+                "who": self.tag,
+                "rid": self.headers.get("X-Request-Id"),
+                "tp": self.headers.get("traceparent"),
+                "echo": body.decode() or None,
+            }
+        ).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    do_GET = _reply
+    do_POST = _reply
+
+    def log_message(self, *a):  # noqa: N802
+        pass
+
+
+class _FleetEcho(_Echo):
+    """Stub fleet replica: /admin/kv/export serves a blob, /admin/kv/
+    import acknowledges — both tallying the trace headers they saw."""
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        type(self).seen.append(
+            {
+                "rid": self.headers.get("X-Request-Id"),
+                "tp": self.headers.get("traceparent"),
+                "path": self.path,
+            }
+        )
+        if self.path == "/admin/kv/export":
+            payload = b"KVBLOB-" + self.tag.encode()
+            ctype = "application/octet-stream"
+        elif self.path == "/admin/kv/import":
+            payload = b'{"imported_tokens":8}'
+            ctype = "application/json"
+        else:
+            payload = json.dumps(
+                {
+                    "who": self.tag,
+                    "rid": self.headers.get("X-Request-Id"),
+                    "handoff": self.headers.get("X-Tpumlops-Handoff"),
+                }
+            ).encode()
+            ctype = "application/json"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+def start_backend(tag: str, handler=_Echo):
+    cls = type(f"Journey_{tag}", (handler,), {"tag": tag, "seen": []})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1], cls
+
+
+def ask(port: int, path="/predict", body=None, headers=None):
+    data = json.dumps(body).encode() if body is not None else b"{}"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, headers=headers or {}
+    )
+    resp = urllib.request.urlopen(req, timeout=10)
+    return resp, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def binary():
+    return build_router()
+
+
+@pytest.fixture()
+def traced(binary):
+    srv, bport, cls = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", bport, 100)},
+        namespace="models",
+        deployment="llm",
+        binary=binary,
+        journey_ring=8,
+        access_log=True,
+    ).start()
+    yield router, cls
+    router.stop()
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Identity: adopt-or-mint + propagation + echo
+# ---------------------------------------------------------------------------
+
+
+def test_mints_identity_and_propagates_when_absent(traced):
+    router, cls = traced
+    resp, body = ask(router.port)
+    rid = resp.headers.get("X-Request-Id")
+    # Minted: 32-hex trace id doubles as the request id (the server's
+    # own adoption rule), echoed to the client AND sent upstream.
+    assert rid and len(rid) == 32 and int(rid, 16) >= 0
+    assert body["rid"] == rid
+    assert body["tp"].startswith("00-" + rid + "-")
+    assert body["tp"].endswith("-01")
+
+
+def test_adopts_client_identity_verbatim(traced):
+    router, cls = traced
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    resp, body = ask(
+        router.port,
+        headers={"X-Request-Id": "my-req-7", "traceparent": tp},
+    )
+    assert resp.headers.get("X-Request-Id") == "my-req-7"
+    assert body["rid"] == "my-req-7"
+    # Trace id adopted from the traceparent; span id is the ROUTER's
+    # fresh leg span, not the client's.
+    assert body["tp"].startswith("00-" + "ab" * 16 + "-")
+    assert ("cd" * 8) not in body["tp"]
+
+
+def test_fresh_span_id_per_leg(traced):
+    router, cls = traced
+    ask(router.port)
+    ask(router.port)
+    spans = {rec["tp"].split("-")[2] for rec in cls.seen}
+    assert len(spans) == len(cls.seen)  # never reused
+
+
+# ---------------------------------------------------------------------------
+# Ring bounds, eviction, journey shape
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounds_and_eviction(traced):
+    router, cls = traced
+    rids = []
+    for i in range(12):
+        resp, _ = ask(router.port, headers={"X-Request-Id": f"req-{i}"})
+        rids.append(f"req-{i}")
+    j = router.admin.journeys()
+    assert j["capacity"] == 8
+    assert j["recorded"] == 12
+    kept = [r["request_id"] for r in j["requests"]]
+    assert kept == rids[-8:]  # FIFO eviction, arrival order preserved
+    rec = j["requests"][-1]
+    assert rec["outcome"] == "ok" and rec["status"] == 200
+    assert rec["backend"] == "v1" and rec["role"] == "unified"
+    assert rec["legs"][0]["kind"] == "forward"
+    assert rec["legs"][0]["backend"] == "v1"
+    assert rec["legs"][0]["status"] == 200
+    assert rec["legs"][0]["bytes"] > 0
+    assert rec["duration_ms"] >= 0
+    assert rec["handoff_ms"] is None and rec["parks"] == []
+    assert "started_unix" in j
+
+
+def test_chrome_export_validity_over_live_http(traced):
+    router, cls = traced
+    for i in range(3):
+        ask(router.port, headers={"X-Request-Id": f"c-{i}"})
+    trace = router.admin.journey_trace()
+    evs = trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    for ev in evs:
+        assert {"name", "ph", "pid"} <= set(ev)
+        if ev["ph"] != "M":
+            assert ev["ts"] >= 0
+    # Async b/e pairs balance per request id.
+    b = [e["id"] for e in evs if e["ph"] == "b"]
+    e = [e["id"] for e in evs if e["ph"] == "e"]
+    assert sorted(b) == sorted(e) and set(b) >= {"c-0", "c-1", "c-2"}
+    # One thread per backend, legs land on it.
+    names = {
+        e["args"]["name"] for e in evs if e["name"] == "thread_name"
+    }
+    assert {"router", "backend v1"} <= names
+    legs = [e for e in evs if e.get("cat") == "leg"]
+    assert legs and all(ev["tid"] == 1 for ev in legs)
+    # ?format=json returns the raw ring; unknown formats are a 400.
+    assert router.admin.journey_trace("json")["requests"]
+    with pytest.raises(urllib.error.HTTPError) as err:
+        router.admin.journey_trace("perfetto")
+    assert err.value.code == 400
+
+
+def test_access_log_contract(traced):
+    router, cls = traced
+    ask(router.port, headers={"X-Request-Id": "logged-1"})
+    deadline = time.monotonic() + 5
+    lines = []
+    while time.monotonic() < deadline:
+        lines = [
+            rec for rec in router.access_log_lines()
+            if rec["request_id"] == "logged-1"
+        ]
+        if lines:
+            break
+        time.sleep(0.05)
+    assert lines, "access log line never appeared"
+    rec = lines[0]
+    # The satellite contract: mirrors the server's tpumlops.request line.
+    for key in (
+        "request_id", "backend", "role", "outcome", "code",
+        "handoff_ms", "park_ms", "failover_count", "duration_ms",
+    ):
+        assert key in rec, key
+    assert rec["backend"] == "v1" and rec["outcome"] == "ok"
+    assert rec["code"] == 200 and rec["failover_count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Defaults off = byte-for-byte
+# ---------------------------------------------------------------------------
+
+
+def test_journey_ring_zero_is_byte_for_byte(binary):
+    srv, bport, cls = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", bport, 100)},
+        binary=binary,
+    ).start()
+    try:
+        resp, body = ask(router.port)
+        # No minting, no injection, no echo: the wire is the old router.
+        assert body["rid"] is None and body["tp"] is None
+        assert resp.headers.get("X-Request-Id") is None
+        # Client-supplied ids pass through verbatim (old passthrough).
+        resp, body = ask(router.port, headers={"X-Request-Id": "keep-me"})
+        assert body["rid"] == "keep-me"
+        # Debug endpoints 404 naming the knob.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            router.admin.journeys()
+        assert err.value.code == 404
+        assert b"journey-ring" in err.value.read()
+        # No new metric family, not even a header line.
+        assert "tpumlops_router_request_seconds" not in (
+            router.admin.metrics_text()
+        )
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_router_sync_threads_journey_ring_annotation(binary):
+    """spec.fleet.observability.journeyRing -> builder annotation ->
+    RouterSync -> live router ring (and back to 0 when the annotation
+    goes away — the manifest is the source of truth)."""
+    srv, bport, cls = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", bport, 100)},
+        binary=binary,
+    ).start()
+    try:
+        sync = RouterSync(
+            router.admin, resolve=lambda name: ("127.0.0.1", bport)
+        )
+        manifest = {
+            "metadata": {
+                "name": "llm",
+                "namespace": "models",
+                "annotations": {"tpumlops.dev/fleet-journey-ring": "32"},
+            },
+            "spec": {"predictors": [{"name": "v1", "traffic": 100}]},
+        }
+        sync.sync_manifest(manifest)
+        assert router.admin.get_config().get("journeyRing") == 32
+        ask(router.port, headers={"X-Request-Id": "synced"})
+        assert router.admin.journeys()["requests"][0]["request_id"] == (
+            "synced"
+        )
+        # Annotation removed: the next sync disables the plane.
+        manifest["metadata"]["annotations"] = {}
+        sync.sync_manifest(manifest)
+        assert "journeyRing" not in router.admin.get_config()
+        with pytest.raises(urllib.error.HTTPError):
+            router.admin.journeys()
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Propagation through relay / failover / park (ChaosProxy-driven)
+# ---------------------------------------------------------------------------
+
+
+def test_relay_legs_carry_one_identity(binary):
+    servers, classes, ports = {}, {}, {}
+    for tag in ("p1", "d1"):
+        servers[tag], ports[tag], classes[tag] = start_backend(
+            tag, _FleetEcho
+        )
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "p1": ("127.0.0.1", ports["p1"], 100, "prefill"),
+            "d1": ("127.0.0.1", ports["d1"], 100, "decode"),
+        },
+        namespace="models",
+        deployment="fleet",
+        binary=binary,
+        affinity_tokens=4,
+        journey_ring=8,
+    ).start()
+    try:
+        resp, body = ask(
+            router.port,
+            path="/v2/models/m/generate",
+            body={"prompt_ids": [7, 7, 7, 7, 1], "max_new_tokens": 2},
+            headers={"X-Request-Id": "relay-1"},
+        )
+        assert body["who"] == "d1" and body["handoff"] is not None
+        assert resp.headers.get("X-Request-Id") == "relay-1"
+        # Every leg — export on p1, import + forward on d1 — carried the
+        # SAME propagated id with per-leg span ids.
+        p1 = [r for r in classes["p1"].seen if r["path"].endswith("export")]
+        d1_paths = {r["path"]: r for r in classes["d1"].seen}
+        assert p1 and p1[0]["rid"] == "relay-1"
+        assert d1_paths["/admin/kv/import"]["rid"] == "relay-1"
+        assert d1_paths["/v2/models/m/generate"]["rid"] == "relay-1"
+        spans = {
+            r["tp"].split("-")[2]
+            for r in classes["p1"].seen + classes["d1"].seen
+        }
+        assert len(spans) == 3  # one fresh span per leg
+        # The journey records all three legs in order.
+        rec = router.admin.journeys()["requests"][-1]
+        assert [leg["kind"] for leg in rec["legs"]] == [
+            "export", "import", "relay-forward",
+        ]
+        assert [leg["backend"] for leg in rec["legs"]] == ["p1", "d1", "d1"]
+        assert rec["affinity"] == "miss"
+        assert rec["handoff_ms"] >= 0
+        assert rec["outcome"] == "ok"
+    finally:
+        router.stop()
+        for srv in servers.values():
+            srv.shutdown()
+
+
+def test_failover_retry_propagates_same_identity(binary):
+    srv_b, bport, cls_b = start_backend("b")
+    chaos = ChaosProxy(free_port())  # nothing behind it: dead upstream
+    chaos.stop()
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "a": ("127.0.0.1", chaos.port, 50),
+            "b": ("127.0.0.1", bport, 50),
+        },
+        binary=build_router(),
+        failover_retries=2,
+        journey_ring=8,
+    ).start()
+    try:
+        # Drive until a request lands on the dead 'a' first and fails
+        # over to 'b' (SWRR alternates, so at most a few tries).
+        for i in range(6):
+            resp, body = ask(
+                router.port, headers={"X-Request-Id": f"fo-{i}"}
+            )
+            assert body["who"] == "b"
+        journeys = router.admin.journeys()["requests"]
+        failed_over = [r for r in journeys if r["failovers"] > 0]
+        assert failed_over, journeys
+        rec = failed_over[0]
+        assert rec["outcome"] == "ok" and rec["backend"] == "b"
+        # Two forward legs: the dead attempt (status 0) + the retry.
+        kinds = [(leg["kind"], leg["status"]) for leg in rec["legs"]]
+        assert ("forward", 0) in kinds and ("forward", 200) in kinds
+        # The retry carried the SAME request id.
+        assert rec["request_id"] in {r["rid"] for r in cls_b.seen}
+        # The per-outcome histogram saw the ok outcome.
+        mt = router.admin.metrics_text()
+        assert 'tpumlops_router_request_seconds_count{' in mt
+        assert 'outcome="ok"' in mt
+    finally:
+        router.stop()
+        srv_b.shutdown()
+
+
+def test_park_hold_span_recorded_and_shed_typed_carries_id(binary):
+    srv, bport, cls = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", bport, 0)},  # weight 0: parks
+        binary=binary,
+        park_buffer=4,
+        park_timeout_s=30.0,
+        journey_ring=8,
+    ).start()
+    results = []
+
+    def send():
+        try:
+            resp, body = ask(
+                router.port, headers={"X-Request-Id": "parked-1"}
+            )
+            results.append((resp.status, body))
+        except urllib.error.HTTPError as e:
+            results.append((e.code, json.loads(e.read())))
+
+    try:
+        t = threading.Thread(target=send, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if router.admin.parked()["parked"] == 1:
+                break
+            time.sleep(0.02)
+        assert router.admin.parked()["parked"] == 1
+        time.sleep(0.15)  # measurable hold
+        router.admin.set_weights({"v1": 100})  # the wake
+        t.join(timeout=10)
+        assert results and results[0][0] == 200
+        rec = router.admin.journeys()["requests"][-1]
+        assert rec["request_id"] == "parked-1"
+        assert rec["outcome"] == "ok"
+        assert len(rec["parks"]) == 1
+        assert rec["park_ms"] >= 100
+        # The park span renders on the router track in the chrome view.
+        evs = router.admin.journey_trace()["traceEvents"]
+        parked = [e for e in evs if e["name"] == "parked"]
+        assert parked and parked[0]["tid"] == 0
+        assert parked[0]["args"]["request_id"] == "parked-1"
+
+        # Park OVERFLOW sheds typed WITH the id (body + header): fill
+        # the buffer, then one more must shed.
+        router.admin.set_weights({"v1": 0})
+        results.clear()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/predict", data=b"{}",
+            headers={"X-Request-Id": "filler"},
+        )
+        threads = []
+        for i in range(4):
+            th = threading.Thread(
+                target=lambda: urllib.request.urlopen(req, timeout=3),
+                daemon=True,
+            )
+            th.start()
+            threads.append(th)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if router.admin.parked()["parked"] == 4:
+                break
+            time.sleep(0.02)
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{router.port}/predict", data=b"{}",
+                    headers={"X-Request-Id": "overflowed"},
+                ),
+                timeout=5,
+            )
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers.get("X-Request-Id") == "overflowed"
+            shed = json.loads(e.read())
+            assert shed["reason"] == "park_overflow"
+            assert shed["request_id"] == "overflowed"
+        shed_rec = [
+            r for r in router.admin.journeys()["requests"]
+            if r["request_id"] == "overflowed"
+        ]
+        assert shed_rec and shed_rec[0]["outcome"] == "shed_park_overflow"
+    finally:
+        router.admin.set_weights({"v1": 100})  # release before teardown
+        time.sleep(0.1)
+        router.stop()
+        srv.shutdown()
+
+
+def test_failover_exhaustion_shed_carries_id(binary):
+    chaos = ChaosProxy(free_port())
+    chaos.stop()  # dead from the start
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", chaos.port, 100)},
+        binary=binary,
+        failover_retries=1,
+        journey_ring=8,
+    ).start()
+    try:
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{router.port}/predict", data=b"{}",
+                    headers={"X-Request-Id": "exhausted-1"},
+                ),
+                timeout=5,
+            )
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["reason"] == "upstream_failed"
+            assert body["request_id"] == "exhausted-1"
+            assert e.headers.get("X-Request-Id") == "exhausted-1"
+        rec = router.admin.journeys()["requests"][-1]
+        assert rec["outcome"] == "shed_upstream_failed"
+        assert rec["status"] == 503
+        mt = router.admin.metrics_text()
+        assert 'outcome="shed_upstream_failed"' in mt
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Stitching (pure)
+# ---------------------------------------------------------------------------
+
+
+def _mini_source(name, started, rid, ts=10):
+    return {
+        "name": name,
+        "started_unix": started,
+        "trace": {
+            "traceEvents": [
+                {
+                    "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                    "args": {"name": "original"},
+                },
+                {
+                    "name": "request", "cat": "request", "ph": "b",
+                    "id": rid, "ts": ts, "pid": 1, "tid": 0,
+                },
+                {
+                    "name": "request", "cat": "request", "ph": "e",
+                    "id": rid, "ts": ts + 5, "pid": 1, "tid": 0,
+                },
+            ]
+        },
+    }
+
+
+def test_stitch_shifts_onto_common_clock_and_renames_pids():
+    merged = stitch_chrome_traces(
+        [
+            _mini_source("router", 100.0, "r1", ts=10),
+            _mini_source("replica-0", 100.5, "r1", ts=10),
+        ]
+    )
+    evs = merged["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {1, 2}
+    names = {
+        e["pid"]: e["args"]["name"]
+        for e in evs
+        if e["name"] == "process_name"
+    }
+    assert names == {1: "router", 2: "replica-0"}
+    # The later-started source's events shifted by the anchor delta.
+    b_ts = {e["pid"]: e["ts"] for e in evs if e["ph"] == "b"}
+    assert b_ts[1] == 10 and b_ts[2] == 10 + 500_000
+    assert request_ids_by_pid(merged) == {1: {"r1"}, 2: {"r1"}}
+
+
+def test_filter_request_keeps_one_span_tree_plus_metadata():
+    merged = stitch_chrome_traces(
+        [
+            _mini_source("router", 100.0, "keep"),
+            _mini_source("replica", 100.0, "drop"),
+        ]
+    )
+    only = filter_request(merged, "keep")
+    ids = {e.get("id") for e in only["traceEvents"] if e["ph"] != "M"}
+    assert ids == {"keep"}
+    assert any(e["ph"] == "M" for e in only["traceEvents"])
+
+
+def test_stitched_live_router_trace_parses(traced):
+    """A live router journey trace round-trips through the stitcher."""
+    router, cls = traced
+    ask(router.port, headers={"X-Request-Id": "stitch-live"})
+    j = router.admin.journeys()
+    merged = stitch_chrome_traces(
+        [
+            {
+                "name": "router",
+                "started_unix": j["started_unix"],
+                "trace": router.admin.journey_trace(),
+            }
+        ]
+    )
+    assert "stitch-live" in request_ids_by_pid(merged)[1]
+
+
+# ---------------------------------------------------------------------------
+# SLO accounting (operator/slo.py through the reconciler)
+# ---------------------------------------------------------------------------
+
+NS, NAME = "models", "llm"
+
+
+def _slo_world(slo_spec, engine_metrics=None, model_metrics=None):
+    from tpumlops.clients.base import EngineMetrics
+
+    kube = FakeKube()
+    registry = FakeRegistry()
+    metrics = FakeMetrics()
+    spec = {
+        "modelName": NAME,
+        "modelAlias": "champion",
+        "minioSecret": "m",
+        "observability": {"historyLimit": 16},
+    }
+    if slo_spec is not None:
+        spec["slo"] = slo_spec
+    kube.create(
+        ObjectRef(namespace=NS, name=NAME, **MLFLOWMODEL),
+        {
+            "apiVersion": "mlflow.nizepart.com/v1alpha1",
+            "kind": "MlflowModel",
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": spec,
+        },
+    )
+    registry.register(NAME, "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias(NAME, "champion", "1")
+    if model_metrics is not None:
+        metrics.set_metrics(NAME, "v1", NS, model_metrics)
+    if engine_metrics is not None:
+        metrics.set_engine_metrics(NAME, "v1", NS, engine_metrics)
+    rec = Reconciler(NAME, NS, kube, registry, metrics, FakeClock())
+    return kube, metrics, rec
+
+
+def _cr(kube):
+    return kube.get(ObjectRef(namespace=NS, name=NAME, **MLFLOWMODEL))
+
+
+def test_slo_absent_is_byte_for_byte(monkeypatch):
+    kube, metrics, rec = _slo_world(None)
+    out = rec.reconcile(_cr(kube))
+    assert out.slo is None
+    status = _cr(kube)["status"]
+    assert "slo" not in json.dumps(status)
+    # No engine/model scrapes beyond what the rollout machinery does.
+    assert rec._slo_tracker is None
+
+
+def test_slo_attainment_and_gauges_within_budget():
+    from tpumlops.clients.base import EngineMetrics
+
+    kube, metrics, rec = _slo_world(
+        {"ttftP99Ms": 500, "availabilityPct": 99.0, "windowMinutes": 10},
+        engine_metrics=EngineMetrics(ttft_p99_s=0.2),
+        model_metrics=ModelMetrics(
+            latency_p95=0.1, error_rate=0.0, latency_avg=0.05,
+            request_count=100,
+        ),
+    )
+    out = rec.reconcile(_cr(kube))
+    assert set(out.slo) == {"ttft_p99", "availability"}
+    ev = out.slo["ttft_p99"]
+    assert ev.attainment == 1.0
+    assert ev.burn_rate == 0.0
+    assert ev.budget_remaining == 1.0
+    assert ev.observed == pytest.approx(200.0)
+    assert ev.target == 500.0
+    # First evaluation journals the armed within_budget state.
+    history = _cr(kube)["status"]["history"]
+    slo_recs = [r for r in history if r["kind"] == "slo"]
+    assert {r["slo"] for r in slo_recs} == {"ttft_p99", "availability"}
+    assert all(r["state"] == "within_budget" for r in slo_recs)
+
+
+def test_slo_budget_exhaustion_journals_and_warns():
+    from tpumlops.clients.base import EngineMetrics
+
+    kube, metrics, rec = _slo_world(
+        {"ttftP99Ms": 100, "availabilityPct": 99.0, "windowMinutes": 10},
+        engine_metrics=EngineMetrics(ttft_p99_s=0.5),  # 500ms >> 100ms
+        model_metrics=ModelMetrics(
+            latency_p95=0.1, error_rate=0.0, latency_avg=0.05,
+            request_count=100,
+        ),
+    )
+    out = rec.reconcile(_cr(kube))
+    ev = out.slo["ttft_p99"]
+    assert ev.attainment == 0.0
+    assert ev.burn_rate == pytest.approx(100.0)
+    assert ev.budget_remaining == 0.0
+    history = _cr(kube)["status"]["history"]
+    exhausted = [
+        r for r in history
+        if r["kind"] == "slo" and r["state"] == "budget_exhausted"
+    ]
+    assert exhausted and exhausted[0]["slo"] == "ttft_p99"
+    assert exhausted[0]["burnRate"] == pytest.approx(100.0)
+    assert "SloBudgetExhausted" in kube.event_reasons()
+    # A second identical step journals nothing new (state unchanged).
+    n = len(_cr(kube)["status"]["history"])
+    rec.reconcile(_cr(kube))
+    assert len(_cr(kube)["status"]["history"]) == n
+
+
+def test_slo_unobservable_signal_contributes_no_sample():
+    kube, metrics, rec = _slo_world(
+        {"ttftP99Ms": 100, "availabilityPct": 99.0, "windowMinutes": 10},
+        # No engine metrics scripted, no traffic: every signal dark.
+    )
+    out = rec.reconcile(_cr(kube))
+    ev = out.slo["ttft_p99"]
+    assert ev.samples == 0
+    assert ev.attainment is None and ev.burn_rate is None
+    assert ev.state is None  # no budget claim either way
+    history = (_cr(kube)["status"] or {}).get("history") or []
+    assert not [r for r in history if r["kind"] == "slo"]
+
+
+def test_slo_recovery_journals_transition_back():
+    from tpumlops.clients.base import EngineMetrics
+
+    kube, metrics, rec = _slo_world(
+        {"ttftP99Ms": 100, "availabilityPct": 90.0, "windowMinutes": 10},
+        engine_metrics=EngineMetrics(ttft_p99_s=0.5),
+    )
+    rec.reconcile(_cr(kube))  # exhausted
+    # Recovery: fast TTFT for enough steps to climb back over 90%.
+    metrics.set_engine_metrics(
+        NAME, "v1", NS, EngineMetrics(ttft_p99_s=0.01)
+    )
+    for _ in range(12):
+        rec.reconcile(_cr(kube))
+    history = _cr(kube)["status"]["history"]
+    states = [
+        (r["slo"], r["state"]) for r in history if r["kind"] == "slo"
+    ]
+    assert ("ttft_p99", "budget_exhausted") in states
+    assert states[-1] == ("ttft_p99", "within_budget")
+
+
+# ---------------------------------------------------------------------------
+# Builder threading: spec.fleet.observability.journeyRing -> annotation
+# ---------------------------------------------------------------------------
+
+
+def test_builder_stamps_journey_ring_annotation_only_when_set():
+    from tpumlops.operator.builder import build_deployment
+    from tpumlops.utils.config import OperatorConfig
+
+    def build(fleet=None):
+        spec = {
+            "modelName": "llm",
+            "modelAlias": "champion",
+            "backend": "tpu",
+            "tpu": {"meshShape": {"dp": 1, "tp": 1}, "tpuTopology": "v5e-1"},
+        }
+        if fleet is not None:
+            spec["fleet"] = fleet
+        cfg = OperatorConfig.from_spec(spec)
+        return build_deployment(
+            "llm", NS, "uid-1", cfg, "1", "s3://m/1", 100
+        )
+
+    # Default: the annotation is ABSENT — manifests byte-for-byte.
+    base = build()
+    assert "tpumlops.dev/fleet-journey-ring" not in (
+        base["metadata"]["annotations"]
+    )
+    assert build(fleet={"observability": {"journeyRing": 0}}) == base
+    # Set: stamped, with or without disaggregation.
+    on = build(fleet={"observability": {"journeyRing": 128}})
+    assert on["metadata"]["annotations"][
+        "tpumlops.dev/fleet-journey-ring"
+    ] == "128"
+
+
+def test_adversarial_ids_and_paths_never_corrupt_the_export(binary):
+    """Review regression: client-controlled strings (long paths, ids
+    full of JSON metacharacters) must neither truncate the journey
+    export mid-string nor produce an unparseable typed shed body."""
+    srv, bport, cls = start_backend("v1")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", bport, 100)},
+        binary=binary,
+        journey_ring=8,
+    ).start()
+    evil_rid = "\\" * 64 + '"' * 64  # 128 chars, escapes to ~256 bytes
+    long_path = "/predict/" + "x" * 800
+    try:
+        ask(router.port, path=long_path,
+            headers={"X-Request-Id": evil_rid})
+        j = router.admin.journeys()  # json.loads inside: must parse
+        rec = j["requests"][-1]
+        assert rec["request_id"] == evil_rid
+        assert rec["path"].startswith("/predict/x")
+        assert len(rec["path"]) == 512  # bounded copy, not the full URL
+        trace = router.admin.journey_trace()  # chrome export parses too
+        assert any(
+            e.get("id") == evil_rid for e in trace["traceEvents"]
+        )
+        assert trace["started_unix"] > 0  # the stitcher's clock anchor
+        # Hostile bytes (raw socket: stdlib clients refuse to send
+        # them): a lone UTF-8 continuation byte in the id is DROPPED at
+        # adoption (ASCII-only), and raw high bytes in the PATH are
+        # \u-escaped — json.loads above would have failed on either
+        # leaking through verbatim.
+        with socket.create_connection(("127.0.0.1", router.port)) as sk:
+            sk.sendall(
+                b"POST /predict/\xc3( HTTP/1.1\r\n"
+                b"host: x\r\nx-request-id: ok-prefix\xc3suffix\r\n"
+                b"content-length: 2\r\nconnection: close\r\n\r\n{}"
+            )
+            sk.settimeout(5)
+            assert b"200" in sk.recv(65536).split(b"\r\n", 1)[0]
+        rec = router.admin.journeys()["requests"][-1]
+        assert rec["request_id"] == "ok-prefixsuffix"
+        assert "\xc3" in rec["path"]  # \u00c3-escaped on the wire, so
+        # json.loads round-trips it as U+00C3 instead of failing
+    finally:
+        router.stop()
+        srv.shutdown()
+    # Typed shed with the same id: the JSON body must survive escaping.
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", free_port(), 100)},  # dead
+        binary=binary,
+        journey_ring=8,
+        failover_retries=1,
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            ask(router.port, headers={"X-Request-Id": evil_rid})
+        body = json.loads(err.value.read())
+        assert body["reason"] == "upstream_failed"
+        assert body["request_id"] == evil_rid
+    finally:
+        router.stop()

@@ -125,6 +125,12 @@ EXPECTED_OPERATOR = {
     "tpumlops_operator_reconcile_seconds": ("histogram", _OP_IDENT),
     "tpumlops_operator_resources": ("gauge", ()),
     "tpumlops_operator_rollout_duration_seconds": ("histogram", _OP_IDENT),
+    # SLO error-budget accounting (spec.slo; operator/slo.py) — no
+    # samples until a CR configures spec.slo.
+    "tpumlops_operator_slo_attainment": ("gauge", _OP_IDENT + ("slo",)),
+    "tpumlops_operator_slo_burn_rate": ("gauge", _OP_IDENT + ("slo",)),
+    "tpumlops_operator_slo_error_budget_remaining": (
+        "gauge", _OP_IDENT + ("slo",)),
     "tpumlops_operator_step_component_seconds": (
         "histogram", _OP_IDENT + ("component",)),
     "tpumlops_operator_traffic_percent": ("gauge", _OP_IDENT),
@@ -220,6 +226,67 @@ def test_router_fleet_series_pinned():
             "tpumlops_router_failover_total",
             "tpumlops_router_probe_seconds",
         }
+        # With the default config the fleet trace plane's family must be
+        # absent even as a header — byte-for-byte exposition at
+        # --journey-ring 0.
+        assert "tpumlops_router_request_seconds" not in (
+            router.admin.metrics_text()
+        )
+    finally:
+        router.stop()
+
+
+def test_router_journey_family_pinned_when_ring_on():
+    """--journey-ring N adds exactly ONE new family —
+    tpumlops_router_request_seconds{outcome} — visible before any
+    traffic (docs/OBSERVABILITY.md catalogs it by this name)."""
+    import socket
+    import time
+
+    from tpumlops.clients.router import RouterProcess, parse_prometheus_text
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    router = RouterProcess(port=port, backends={}, deployment="d",
+                           namespace="n", journey_ring=16).start()
+    try:
+        names = set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not names:
+            parsed = parse_prometheus_text(router.admin.metrics_text())
+            names = {
+                name.replace("_bucket", "").replace("_sum", "")
+                .replace("_count", "")
+                for name, _ in parsed
+            }
+        base = {
+            "tpumlops_router_proxied_total",
+            "tpumlops_router_parked_requests",
+            "tpumlops_router_parked_total",
+            "tpumlops_router_park_released_total",
+            "tpumlops_router_park_overflow_total",
+            "tpumlops_router_park_timeouts_total",
+            "tpumlops_router_park_wait_seconds",
+            "tpumlops_router_affinity_hits",
+            "tpumlops_router_affinity_misses",
+            "tpumlops_router_kv_handoff_bytes",
+            "tpumlops_router_kv_handoff_failures",
+            "tpumlops_router_kv_handoff_seconds",
+            "tpumlops_router_failover_total",
+            "tpumlops_router_probe_seconds",
+        }
+        assert names == base | {"tpumlops_router_request_seconds"}
+        # The outcome label rides every sample of the new family.
+        parsed = parse_prometheus_text(router.admin.metrics_text())
+        outcome_series = [
+            dict(labels)
+            for name, labels in parsed
+            if name.startswith("tpumlops_router_request_seconds")
+        ]
+        assert outcome_series and all(
+            "outcome" in labels for labels in outcome_series
+        )
     finally:
         router.stop()
 

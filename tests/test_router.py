@@ -1556,3 +1556,56 @@ def test_wedged_probe_times_out_and_readmission_recovers(binary):
         router.stop()
         proxy.stop()
         srv.shutdown()
+
+
+def test_typed_sheds_carry_request_id_with_journey_ring_on(binary):
+    """PR-14 audit satellite: every typed router shed carries the
+    request id in BODY and header once the trace plane is on — and
+    stays byte-for-byte without it (the pre-journey body shape)."""
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", free_port(), 100)},  # dead addr
+        binary=binary,
+        failover_retries=1,
+        journey_ring=8,
+    ).start()
+    try:
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/predict", data=b"{}",
+                headers={"X-Request-Id": "shed-journey-1"},
+            )
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["reason"] == "upstream_failed"
+            assert body["request_id"] == "shed-journey-1"
+            assert e.headers.get("X-Request-Id") == "shed-journey-1"
+    finally:
+        router.stop()
+    # Ring off: the typed body has NO request_id key and no echo header
+    # (wire byte-for-byte with PR 13).
+    router = RouterProcess(
+        port=free_port(),
+        backends={"v1": ("127.0.0.1", free_port(), 100)},
+        binary=binary,
+        failover_retries=1,
+    ).start()
+    try:
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/predict", data=b"{}",
+                headers={"X-Request-Id": "shed-plain-1"},
+            )
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["reason"] == "upstream_failed"
+            assert "request_id" not in body
+            assert e.headers.get("X-Request-Id") is None
+    finally:
+        router.stop()

@@ -657,3 +657,56 @@ def test_poison_quarantine_http_422_contract(tmp_path):
         assert float(rj[0].rsplit(" ", 1)[1]) == 1.0
     finally:
         handle.stop()
+
+
+def test_typed_error_bodies_carry_request_id(shed_server):
+    """Every typed error BODY carries the request id (the trace-plane
+    audit): a client stack that drops headers on error paths must still
+    be able to correlate the shed/refusal with the router journey and
+    the server's completion log line."""
+    eng = shed_server.server.gen_engine
+    futs = _saturate(eng)
+    try:
+        # 429 shed.
+        resp = httpx.post(
+            shed_server.base + "/v2/models/llm/generate",
+            json={"prompt_ids": [5, 9, 2, 7], "max_new_tokens": 56},
+            headers={"X-Request-Id": "shed-rid-1"},
+            timeout=30,
+        )
+        assert resp.status_code == 429
+        assert resp.json()["request_id"] == "shed-rid-1"
+        assert resp.headers["X-Request-Id"] == "shed-rid-1"
+    finally:
+        for f in futs:
+            f.result(timeout=120)
+    # 400 (unknown generate parameter).
+    resp = httpx.post(
+        shed_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_token": 4},
+        headers={"X-Request-Id": "bad-param-1"},
+        timeout=30,
+    )
+    assert resp.status_code == 400
+    assert resp.json()["request_id"] == "bad-param-1"
+    assert resp.headers["X-Request-Id"] == "bad-param-1"
+    # The id joins the W3C context when a traceparent rides along: the
+    # engine trace adopts trace id + parent span (stitching contract).
+    tp = "00-" + "ef" * 16 + "-" + "12" * 8 + "-01"
+    ok = httpx.post(
+        shed_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 2, "debug": True},
+        headers={"X-Request-Id": "traced-1", "traceparent": tp},
+        timeout=60,
+    )
+    assert ok.status_code == 200
+    timing = ok.json()["timing"]["rows"][0]
+    assert timing["trace_id"] == "ef" * 16
+    assert timing["parent_span"] == "12" * 8
+    # Without a traceparent the block stays byte-for-byte (no keys).
+    ok = httpx.post(
+        shed_server.base + "/v2/models/llm/generate",
+        json={"prompt_ids": [5, 9, 2], "max_new_tokens": 2, "debug": True},
+        timeout=60,
+    )
+    assert "trace_id" not in ok.json()["timing"]["rows"][0]
