@@ -72,6 +72,10 @@ def _capacity_summary(config: OperatorConfig) -> "dict | None":
         "chips": info.chips,
         "hosts": info.hosts,
         "meshShape": dict(config.tpu.mesh_shape),
+        # The tp axis pulled out of the mesh for dashboards/selectors:
+        # > 1 means one replica spans tensorParallel chips and the HBM
+        # numbers below divide across them.
+        "tensorParallel": int(dict(config.tpu.mesh_shape).get("tp", 1)),
         "quantize": config.tpu.quantize,
         "deviceTelemetry": True,
     }

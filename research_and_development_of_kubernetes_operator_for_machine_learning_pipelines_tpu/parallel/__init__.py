@@ -26,10 +26,12 @@ from .sharding import (
     LOGICAL_MLP,
     LOGICAL_SEQ,
     LOGICAL_VOCAB,
+    PartitionRuleError,
     ShardingRules,
     TRANSFORMER_RULES,
     logical_sharding,
     logical_spec,
+    match_partition_rules,
     shard_pytree,
 )
 from .collectives import ring_shift, shard_map_compat
@@ -55,6 +57,8 @@ __all__ = [
     "LOGICAL_VOCAB",
     "logical_spec",
     "logical_sharding",
+    "match_partition_rules",
+    "PartitionRuleError",
     "shard_pytree",
     "ring_shift",
     "shard_map_compat",

@@ -10,8 +10,9 @@ collectives.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
-from typing import Any, Mapping
+from typing import Any, Mapping, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -82,6 +83,69 @@ def logical_sharding(
     rules: ShardingRules | None = None,
 ) -> NamedSharding:
     return NamedSharding(mesh, logical_spec(logical_axes, rules))
+
+
+class PartitionRuleError(ValueError):
+    """Typed failure of regex rule matching: a rule produced a
+    PartitionSpec whose rank does not match the leaf it matched.  Raised
+    at match time — BEFORE any device_put — so a bad rule table fails
+    with the leaf path and both ranks in the message instead of an
+    opaque XLA shape error at the first sharded dispatch."""
+
+
+def _leaf_path(path) -> str:
+    """jax key-path -> "a/b/0" (the regex namespace rule tables match)."""
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:  # pragma: no cover - future key types
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def match_partition_rules(
+    rules: Sequence[tuple[str, PartitionSpec]], tree: Any
+) -> Any:
+    """Regex rule table -> PartitionSpec pytree (the SNIPPETS [2] shape).
+
+    Each leaf's path (``/``-joined dict keys / sequence indices) is
+    matched with ``re.search`` against the rules IN ORDER — the first
+    match wins, so put specific rules above general ones.  Leaves no
+    rule matches fall back to fully REPLICATED (``PartitionSpec()``):
+    an unmatched auxiliary leaf (a norm, a scalar) must never silently
+    shard, and must never fail the whole tree either.  Scalar leaves
+    are always replicated regardless of rules.
+
+    A matched NON-EMPTY spec whose rank differs from the leaf's raises
+    :class:`PartitionRuleError` naming the path, the rule, and both
+    ranks — rank drift between a rule table and the param tree it
+    describes is a bug, not a fallback case, in BOTH directions: an
+    under-rank spec would silently shard the wrong (leading) axis,
+    which is worse than the over-rank crash.  ``PartitionSpec()`` (an
+    explicit fully-replicated rule) is valid for any rank.
+    """
+    compiled = [(re.compile(pat), pat, spec) for pat, spec in rules]
+
+    def _match(path, leaf):
+        name = _leaf_path(path)
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0:
+            return PartitionSpec()
+        for creg, pat, spec in compiled:
+            if creg.search(name) is None:
+                continue
+            if len(spec) != 0 and len(spec) != ndim:
+                raise PartitionRuleError(
+                    f"partition rule {pat!r} produced rank-{len(spec)} "
+                    f"spec {spec} for rank-{ndim} leaf {name!r}"
+                )
+            return spec
+        return PartitionSpec()
+
+    return jax.tree_util.tree_map_with_path(_match, tree)
 
 
 def shard_pytree(

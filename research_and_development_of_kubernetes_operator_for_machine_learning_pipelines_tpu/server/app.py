@@ -1672,6 +1672,12 @@ def make_gen_engine(
         # collectives by design.
         watchdog=watchdog,
         on_poison=metrics.inc_poison if metrics else None,
+        # Tensor-parallel mesh: same shape on leader and followers (this
+        # one construction site) — sharded programs must agree for
+        # lockstep replay.  {"dp": 1, "tp": 1} (the default) arms
+        # nothing; the loader already sharded the params over the same
+        # device prefix the engine's mesh covers.
+        mesh_shape=dict(config.tpu.mesh_shape),
     )
 
 

@@ -84,6 +84,11 @@ class DevicePeaks:
     hbm_bytes: int  # HBM capacity
     source: str  # "detected" | "assumed"
     chips: int = 1
+    # Per-chip ICI bandwidth the collective-wall estimates divide by
+    # (rough order-of-magnitude constants, marked per ``source`` like
+    # the rooflines; stays PER-CHIP under scaled() — a ring all-reduce's
+    # wall is set by one link, not the aggregate).
+    ici_bytes_per_s: float = 2e11
 
     def scaled(self, chips: int) -> "DevicePeaks":
         import dataclasses
@@ -172,22 +177,40 @@ def measured_memory() -> dict | None:
 # ---------------------------------------------------------------------------
 
 
-def weights_bytes_by_dtype(params) -> dict[str, int]:
+def weights_bytes_by_dtype(params, per_chip: bool = False) -> dict[str, int]:
     """Parameter bytes grouped by dtype as stored (int8 leaves count
-    1 byte/elem; their f32 scale planes land under float32)."""
+    1 byte/elem; their f32 scale planes land under float32).
+
+    ``per_chip=True`` counts what ONE device holds — exact, via each
+    leaf's shard shape: a tp-sharded matrix counts 1/tp of its bytes,
+    a replicated norm counts whole on every chip."""
     import jax
 
     out: dict[str, int] = {}
     for leaf in jax.tree.leaves(params):
         name = str(leaf.dtype)
-        out[name] = out.get(name, 0) + int(leaf.size) * leaf.dtype.itemsize
+        if per_chip:
+            try:
+                from ..models.partition import shard_bytes
+
+                nbytes = shard_bytes(leaf)
+            except Exception:  # host arrays / exotic shardings
+                nbytes = int(leaf.size) * leaf.dtype.itemsize
+        else:
+            nbytes = int(leaf.size) * leaf.dtype.itemsize
+        out[name] = out.get(name, 0) + nbytes
     return out
 
 
-def kv_cache_bytes_per_row(cfg, kv_quant: bool, dtype_bytes: int = 2) -> int:
+def kv_cache_bytes_per_row(
+    cfg, kv_quant: bool, dtype_bytes: int = 2, tp: int = 1
+) -> int:
     """Bytes one cache row (slot at full ``max_seq``) holds: k + v across
-    all layers, plus the int8kv layout's per-(pos, head) f32 scales."""
-    elems = cfg.num_layers * cfg.num_kv_heads * cfg.max_seq * cfg.head_dim
+    all layers, plus the int8kv layout's per-(pos, head) f32 scales.
+    ``tp`` > 1 gives the PER-CHIP row (the heads axis is what shards, so
+    each chip holds num_kv_heads/tp of every row)."""
+    heads = cfg.num_kv_heads // max(1, int(tp))
+    elems = cfg.num_layers * heads * cfg.max_seq * cfg.head_dim
     if kv_quant:
         # int8 values + f32 scale per head_dim group, for k and v each.
         return 2 * (elems + (elems // cfg.head_dim) * 4)
@@ -211,6 +234,11 @@ class HbmLedger:
     host_components: dict[str, int] = field(default_factory=dict)
     kv_bytes_per_row: int = 0
     max_slots: int = 0
+    # tp > 1: what ONE chip holds of each component (weights exact via
+    # shard shapes, kv/sampling analytic) — the per-chip view the
+    # tpumlops_device_hbm_bytes{component="*_per_chip"} gauges export.
+    per_chip: dict[str, int] = field(default_factory=dict)
+    chips: int = 1
 
     def device_total(self) -> int:
         return sum(self.components.values())
@@ -239,6 +267,9 @@ class HbmLedger:
             "max_cache_rows": self.max_cache_rows(peaks.hbm_bytes),
             "measured": measured,
         }
+        if self.per_chip:
+            out["per_chip"] = dict(self.per_chip)
+            out["chips"] = self.chips
         if measured and measured.get("bytes_in_use"):
             # Multi-host: this process addresses only its local chips,
             # which hold addressable/total of the sharded model — scale
@@ -261,10 +292,12 @@ def build_hbm_ledger(
     kv_quant: bool = False,
     dtype_bytes: int = 2,
     prefix_cache_budget_bytes: int = 0,
+    tp: int = 1,
 ) -> HbmLedger:
     ledger = HbmLedger(
         kv_bytes_per_row=kv_cache_bytes_per_row(cfg, kv_quant, dtype_bytes),
         max_slots=int(max_slots),
+        chips=max(1, int(tp)),
     )
     for dtype, nbytes in weights_bytes_by_dtype(params).items():
         ledger.components[f"weights_{dtype}"] = nbytes
@@ -274,6 +307,20 @@ def build_hbm_ledger(
         ledger.host_components["prefix_cache_budget"] = int(
             prefix_cache_budget_bytes
         )
+    if tp > 1:
+        for dtype, nbytes in weights_bytes_by_dtype(
+            params, per_chip=True
+        ).items():
+            ledger.per_chip[f"weights_{dtype}"] = nbytes
+        row_chip = kv_cache_bytes_per_row(cfg, kv_quant, dtype_bytes, tp=tp)
+        ledger.per_chip["kv_bytes_per_row"] = row_chip
+        ledger.per_chip["kv_cache"] = row_chip * int(max_slots)
+        # Sampling state replicates: every chip holds the whole thing.
+        ledger.per_chip["sampling_state"] = sampling_state_bytes(max_slots)
+        ledger.per_chip["total"] = sum(
+            v for k, v in ledger.per_chip.items()
+            if k != "kv_bytes_per_row"
+        )
     return ledger
 
 
@@ -281,7 +328,8 @@ def capacity_log_line(params, cfg, kv_quant: bool) -> str:
     """The model-capacity startup line ``server/loader.py`` stamps (even
     with telemetry off): weights by dtype, KV bytes/row, max cache rows.
     HBM covers the device set the params are sharded over."""
-    peaks = detect_peaks().scaled(param_device_count(params))
+    n_chips = param_device_count(params)
+    peaks = detect_peaks().scaled(n_chips)
     by_dtype = weights_bytes_by_dtype(params)
     total = sum(by_dtype.values())
     per_row = kv_cache_bytes_per_row(cfg, kv_quant)
@@ -291,13 +339,24 @@ def capacity_log_line(params, cfg, kv_quant: bool) -> str:
         f"{k}={v / 2**20:.1f}MiB" for k, v in sorted(by_dtype.items())
     )
     chips = f" x{peaks.chips}" if peaks.chips > 1 else ""
+    per_chip = ""
+    if n_chips > 1:
+        # The tp view: what ONE chip actually holds (weights exact via
+        # shard shapes, KV row = heads/tp) — the number that fits or
+        # OOMs on the hardware.
+        chip_w = sum(weights_bytes_by_dtype(params, per_chip=True).values())
+        chip_row = kv_cache_bytes_per_row(cfg, kv_quant, tp=n_chips)
+        per_chip = (
+            f", per-chip weights {chip_w / 2**20:.1f} MiB "
+            f"kv {chip_row} B/row"
+        )
     return (
         f"model capacity: weights {total / 2**20:.1f} MiB ({dtypes}), "
         f"kv {per_row} B/row (max_seq {cfg.max_seq}"
         f"{', int8kv' if kv_quant else ''}), "
         f"max cache rows {rows} "
         f"(hbm {peaks.hbm_bytes / 2**30:.1f} GiB "
-        f"{peaks.source} {peaks.kind}{chips})"
+        f"{peaks.source} {peaks.kind}{chips}){per_chip}"
     )
 
 
@@ -476,6 +535,13 @@ class LlamaCostModel:
     num_kv_heads: int
     head_dim: int
     kv_elem_bytes: float  # bytes per cache element incl. scale overhead
+    # Tensor-parallel collective geometry (tp == 1 -> no collectives):
+    # hidden/vocab size the per-layer all-reduces and the logits
+    # all-gather move, in the serving activation dtype.
+    tp: int = 1
+    hidden_size: int = 0
+    vocab_size: int = 0
+    act_bytes: int = 2
 
     @classmethod
     def for_model(cls, params, cfg, kv_quant: bool = False,
@@ -498,7 +564,31 @@ class LlamaCostModel:
             num_kv_heads=cfg.num_kv_heads,
             head_dim=hd,
             kv_elem_bytes=kv_eb,
+            tp=param_device_count(params),
+            hidden_size=int(getattr(cfg, "hidden_size", 0)),
+            vocab_size=int(getattr(cfg, "vocab_size", 0)),
+            act_bytes=int(dtype_bytes),
         )
+
+    def collective_bytes(self, rows: int, s: int = 1) -> dict[str, float]:
+        """Per-device ICI bytes one forward dispatch moves at tp > 1:
+
+        - ``all_reduce`` — the Megatron pair: 2 psums per layer (after
+          the o and down projections) of the ``[rows*s, hidden]``
+          activation block; a ring all-reduce moves ``2(tp-1)/tp`` of
+          the block per device;
+        - ``all_gather`` — the vocab-sharded lm_head product gathered
+          for replicated token/logit outputs: ``(tp-1)/tp`` of
+          ``[rows*s, vocab]`` f32 once per dispatch.
+
+        Empty at tp == 1 (no collectives exist to estimate)."""
+        if self.tp <= 1:
+            return {}
+        tokens = float(rows) * float(s)
+        block = tokens * self.hidden_size * self.act_bytes
+        ar = 2.0 * self.num_layers * block * 2.0 * (self.tp - 1) / self.tp
+        ag = tokens * self.vocab_size * 4.0 * (self.tp - 1) / self.tp
+        return {"all_reduce": ar, "all_gather": ag}
 
     def _kv_bytes(self, rows: int, positions: float) -> float:
         """k+v cache traffic for ``rows`` rows over ``positions`` each."""
@@ -587,11 +677,13 @@ class DeviceTelemetry:
         known; exports the per-component HBM gauges.  Peaks scale to the
         device set actually holding the params (the cost model and
         ledger count the whole sharded model)."""
-        self.peaks = self._chip_peaks.scaled(param_device_count(params))
+        chips = param_device_count(params)
+        self.peaks = self._chip_peaks.scaled(chips)
         self.ledger = build_hbm_ledger(
             params, cfg, max_slots, kv_quant=kv_quant,
             dtype_bytes=dtype_bytes,
             prefix_cache_budget_bytes=prefix_cache_budget_bytes,
+            tp=chips,
         )
         self.cost = LlamaCostModel.for_model(
             params, cfg, kv_quant=kv_quant, dtype_bytes=dtype_bytes
@@ -602,6 +694,15 @@ class DeviceTelemetry:
             self._metrics.observe_hbm_component(
                 "total", self.ledger.device_total()
             )
+            # tp > 1: the per-chip view rides the same family under
+            # ``<component>_per_chip`` label values — what ONE chip
+            # holds, which is what fits-or-OOMs on the hardware.
+            for comp, nbytes in self.ledger.per_chip.items():
+                if comp == "kv_bytes_per_row":
+                    continue
+                self._metrics.observe_hbm_component(
+                    f"{comp}_per_chip", nbytes
+                )
 
     def tick_util(self, kind: str, wall_s: float, flops: float,
                   hbm_bytes: float) -> dict:
@@ -618,6 +719,28 @@ class DeviceTelemetry:
             "mfu": float(f"{mfu:.3g}") if flops > 0 else 0.0,
             "hbm_bw_util": float(f"{bw:.3g}"),
         }
+        if (
+            self.cost is not None
+            and self.cost.tp > 1
+            and kind in ("decode", "verify", "multistep", "prefill",
+                         "packed-prefill")
+        ):
+            # Analytic collective walls at tp > 1: one dispatch's ICI
+            # traffic over the per-chip link rate, split by op — the
+            # tpumlops_engine_collective_seconds{op} feed.  The token
+            # count is recovered from the tick's own flops (flops ~=
+            # 2 x matmul_params x tokens), so a fused K-step scan, an
+            # S-position verify, and a packed chunk call all count
+            # their full per-dispatch traffic, not one token-row's.
+            tokens = flops / max(1.0, 2.0 * self.cost.matmul_params)
+            coll = self.cost.collective_bytes(tokens)
+            total_coll = 0.0
+            for op, nbytes in coll.items():
+                secs = nbytes / self.peaks.ici_bytes_per_s
+                total_coll += secs
+                if self._metrics is not None:
+                    self._metrics.observe_collective(op, secs)
+            util["collective_s"] = float(f"{total_coll:.3g}")
         with self._util_lock:
             self.last_util[kind] = util
         if self._metrics is not None:

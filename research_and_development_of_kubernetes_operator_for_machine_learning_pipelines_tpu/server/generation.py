@@ -303,6 +303,7 @@ class GenerationEngine:
         on_dispatch: Callable[[str], None] | None = None,
         watchdog=None,  # watchdog.EngineWatchdog | None (leader-side)
         on_poison: Callable[[str], None] | None = None,
+        mesh_shape=None,  # {"dp": 1, "tp": N} | None (tensor parallel)
     ):
         import jax
         import jax.numpy as jnp
@@ -326,6 +327,44 @@ class GenerationEngine:
         dtype = dtype or jnp.bfloat16
         self._dtype = dtype
         self._kv_quant = bool(kv_quant)
+        # Tensor-parallel serving mesh (spec.tpu.meshShape).  None or a
+        # product-1 shape — the default — arms NOTHING: no mesh object,
+        # no sharding handles, and every jit below compiles exactly the
+        # single-device program it always did (pinned byte-for-byte in
+        # tests/test_tensor_parallel.py).  With tp > 1 the params arrive
+        # pre-sharded (loader) over the same device prefix this mesh
+        # covers, the KV cache shards its heads axis, sampling state
+        # replicates, and every program compiles with EXPLICIT output
+        # shardings so K/V commits, the on-device sampling chain, and
+        # donated buffers stay sharded across ticks — no per-tick gather.
+        self._mesh = None
+        self._shard_rep = self._shard_kv = self._shard_seq = None
+        if mesh_shape:
+            from ..models import partition
+
+            if partition.mesh_device_count(mesh_shape) > 1:
+                bad = {
+                    a: int(n) for a, n in dict(mesh_shape).items()
+                    if a != "tp" and int(n) > 1
+                }
+                if bad:
+                    raise ValueError(
+                        "the generation engine shards over tp only; "
+                        f"meshShape axes {bad} must be 1 (slots are the "
+                        "batch dimension — scale replicas, not dp)"
+                    )
+                # Typed reject BEFORE any device state: an indivisible
+                # axis would otherwise surface as an opaque XLA shape
+                # error at the first warmup dispatch.
+                partition.validate_llama_mesh(cfg, mesh_shape)
+                self._mesh = partition.build_serving_mesh(mesh_shape)
+                (
+                    self._shard_rep,
+                    self._shard_kv,
+                    self._shard_seq,
+                ) = partition.engine_state_shardings(
+                    self._mesh, self._kv_quant
+                )
         # Chunked prefill: split prompts into fixed-size chunks so (a) one
         # compiled program serves every prompt length and (b) the scheduler
         # interleaves a decode tick between chunks — a long prompt no
@@ -511,6 +550,32 @@ class GenerationEngine:
         self.dispatches_total: dict[str, int] = {}
         self._reset_device_state()
 
+        # Sharding handles for the program signatures below: ``rep`` =
+        # replicated (tokens, lengths, keys, sampling params, logits
+        # read-backs), ``kvsh`` = the ragged cache repr (heads axis on
+        # tp; a (values, scales) pair under int8kv), ``seqsh`` = the
+        # batch-1 prefill scratch.  All None without a mesh.
+        rep, kvsh, seqsh = self._shard_rep, self._shard_kv, self._shard_seq
+
+        def jit_sharded(fn, donate_argnums=(), static_argnums=(),
+                        out_shardings=None):
+            """``jax.jit`` with EXPLICIT output shardings when the tp
+            mesh is armed — jax.jit with out_shardings IS pjit on every
+            jax this repo supports (shard_map_compat stays the escape
+            hatch for manually-partitioned kernels; the engine programs
+            are GSPMD-partitioned, input shardings propagate from the
+            committed param/cache arrays).  Without a mesh this is
+            byte-for-byte the plain jax.jit call it replaces: no
+            out_shardings kwarg is even passed."""
+            kw = {}
+            if donate_argnums:
+                kw["donate_argnums"] = donate_argnums
+            if static_argnums:
+                kw["static_argnums"] = static_argnums
+            if self._mesh is not None and out_shardings is not None:
+                kw["out_shardings"] = out_shardings
+            return jax.jit(fn, **kw)
+
         def make_cache(k, v, lengths):
             """k/v are arrays (bf16 cache) or (values, scales) pairs."""
             if self._kv_quant:
@@ -542,8 +607,9 @@ class GenerationEngine:
         # ``window`` is static: one compiled program per power-of-two bucket
         # of the longest active sequence (short traffic stops paying
         # full-capacity cache reads — decode's dominant HBM term).
-        self._decode = jax.jit(
-            _decode, donate_argnums=(2, 3), static_argnums=(10,)
+        self._decode = jit_sharded(
+            _decode, donate_argnums=(2, 3), static_argnums=(10,),
+            out_shardings=(rep, kvsh, kvsh, rep, rep) if rep else None,
         )
 
         def _decode_greedy(params, toks, k, v, lengths, active, window):
@@ -559,8 +625,9 @@ class GenerationEngine:
             ck, cv = cache_repr(cache)
             return toks2, ck, cv, cache.lengths
 
-        self._decode_greedy = jax.jit(
-            _decode_greedy, donate_argnums=(2, 3), static_argnums=(6,)
+        self._decode_greedy = jit_sharded(
+            _decode_greedy, donate_argnums=(2, 3), static_argnums=(6,),
+            out_shardings=(rep, kvsh, kvsh, rep) if rep else None,
         )
 
         def _verify(params, toks, k, v, lengths, active, draft_len, window):
@@ -589,8 +656,9 @@ class GenerationEngine:
             ck, cv = cache_repr(cache)
             return toks2, ck, cv, cache.lengths + advance, greedy, accepted
 
-        self._verify = jax.jit(
-            _verify, donate_argnums=(2, 3), static_argnums=(7,)
+        self._verify = jit_sharded(
+            _verify, donate_argnums=(2, 3), static_argnums=(7,),
+            out_shardings=(rep, kvsh, kvsh, rep, rep, rep) if rep else None,
         )
 
         def _multistep_sampling(
@@ -650,13 +718,21 @@ class GenerationEngine:
             # One compiled variant per (K, window) pair, like _verify's
             # (S, window) grid; K is fixed per deployment so the warmup
             # sweep is |window buckets| x 2 variants.
-            self._multistep = jax.jit(
+            self._multistep = jit_sharded(
                 _multistep_sampling, donate_argnums=(2, 3),
                 static_argnums=(12, 13),
+                out_shardings=(
+                    (rep, rep, rep, kvsh, kvsh, rep, rep, rep, rep)
+                    if rep else None
+                ),
             )
-            self._multistep_greedy = jax.jit(
+            self._multistep_greedy = jit_sharded(
                 _multistep_greedy, donate_argnums=(2, 3),
                 static_argnums=(8, 9),
+                out_shardings=(
+                    (rep, rep, rep, kvsh, kvsh, rep, rep, rep)
+                    if rep else None
+                ),
             )
 
         def _prefill_insert(
@@ -688,15 +764,22 @@ class GenerationEngine:
             )
 
         # One compiled program per prompt bucket (jit caches by ids shape).
-        self._prefill_insert = jax.jit(_prefill_insert, donate_argnums=(2, 3))
+        self._prefill_insert = jit_sharded(
+            _prefill_insert, donate_argnums=(2, 3),
+            out_shardings=(
+                (kvsh, kvsh, rep, rep, rep, rep, rep, rep, rep)
+                if rep else None
+            ),
+        )
 
         def _prefill_one_chunk(params, ids, sk, sv, slen):
             seq = llama.KVCache(sk, sv, slen)
             logits, seq = llama.forward(params, ids, seq, cfg, dtype=dtype)
             return logits[0], seq.k, seq.v, seq.length
 
-        self._prefill_one_chunk = jax.jit(
-            _prefill_one_chunk, donate_argnums=(2, 3)
+        self._prefill_one_chunk = jit_sharded(
+            _prefill_one_chunk, donate_argnums=(2, 3),
+            out_shardings=(rep, seqsh, seqsh, rep) if rep else None,
         )
 
         from jax.lax import dynamic_slice as lax_ds
@@ -713,7 +796,10 @@ class GenerationEngine:
             sv = lax_dus(sv, cv.astype(sv.dtype), (z, z, start, z, z))
             return sk, sv
 
-        self._seed_chunk = jax.jit(_seed_chunk, donate_argnums=(0, 1))
+        self._seed_chunk = jit_sharded(
+            _seed_chunk, donate_argnums=(0, 1),
+            out_shardings=(seqsh, seqsh) if rep else None,
+        )
 
         def _read_chunk(sk, sv, start):
             # Prefix-cache write-back: pull one freshly prefilled chunk's
@@ -726,7 +812,11 @@ class GenerationEngine:
                 lax_ds(sv, (z, z, start, z, z), size),
             )
 
-        self._read_chunk = jax.jit(_read_chunk)
+        # Chunk read-backs feed the HOST radix cache: replicated outputs
+        # (one all-gather at prefill rate, never per tick).
+        self._read_chunk = jit_sharded(
+            _read_chunk, out_shardings=(rep, rep) if rep else None
+        )
 
         def _insert_only(
             last_logits, k, v, lengths, toks, slot, actual_len,
@@ -754,7 +844,13 @@ class GenerationEngine:
                 keys2, temps2, tks2, tps2, first,
             )
 
-        self._insert_only = jax.jit(_insert_only, donate_argnums=(1, 2))
+        self._insert_only = jit_sharded(
+            _insert_only, donate_argnums=(1, 2),
+            out_shardings=(
+                (kvsh, kvsh, rep, rep, rep, rep, rep, rep, rep)
+                if rep else None
+            ),
+        )
 
         max_slots_static = self.max_slots
 
@@ -796,8 +892,12 @@ class GenerationEngine:
             ck, cv = cache_repr(cache)
             return ck, cv, lengths2, toks2, keys2, temps2, tks2, tps2, firsts
 
-        self._prefill_chunks = jax.jit(
-            _prefill_chunks_batched, donate_argnums=(2, 3)
+        self._prefill_chunks = jit_sharded(
+            _prefill_chunks_batched, donate_argnums=(2, 3),
+            out_shardings=(
+                (kvsh, kvsh, rep, rep, rep, rep, rep, rep, rep)
+                if rep else None
+            ),
         )
 
         def _seed_chunk_slot(k, v, ck, cv, slot, start):
@@ -828,7 +928,10 @@ class GenerationEngine:
                 lax_dus(v, cvh.astype(v.dtype), at),
             )
 
-        self._seed_slot = jax.jit(_seed_chunk_slot, donate_argnums=(0, 1))
+        self._seed_slot = jit_sharded(
+            _seed_chunk_slot, donate_argnums=(0, 1),
+            out_shardings=(kvsh, kvsh) if rep else None,
+        )
 
         def _read_chunk_slot(k, v, slot, start):
             # Packed-mode prefix-cache write-back: pull one freshly
@@ -858,7 +961,9 @@ class GenerationEngine:
                 jnp.swapaxes(cv, 2, 3).astype(dtype),
             )
 
-        self._read_slot = jax.jit(_read_chunk_slot)
+        self._read_slot = jit_sharded(
+            _read_chunk_slot, out_shardings=(rep, rep) if rep else None
+        )
 
         if telemetry is not None:
             # Compile observatory: every engine jit dispatch is wrapped so
@@ -1002,6 +1107,20 @@ class GenerationEngine:
         self._temps = jnp.zeros((self.max_slots,), jnp.float32)
         self._topk = jnp.zeros((self.max_slots,), jnp.int32)
         self._topp = jnp.ones((self.max_slots,), jnp.float32)
+        if getattr(self, "_mesh", None) is not None:
+            # Commit the state to its mesh shardings up front (cache
+            # heads on tp, everything else replicated): the programs'
+            # explicit out shardings keep them there, so donation reuses
+            # the sharded buffers and no tick ever re-lays-out.
+            self._cache_k = jax.device_put(self._cache_k, self._shard_kv)
+            self._cache_v = jax.device_put(self._cache_v, self._shard_kv)
+            put = lambda x: jax.device_put(x, self._shard_rep)
+            self._lengths = put(self._lengths)
+            self._tokens = put(self._tokens)
+            self._keys = put(self._keys)
+            self._temps = put(self._temps)
+            self._topk = put(self._topk)
+            self._topp = put(self._topp)
         # Fused-decode chain state (device-resident active mask / budgets
         # / EOS ids): valid only WITHIN one fused burst — every burst
         # re-seeds it from host slot truth, so a recovery reset needs no
@@ -1009,6 +1128,15 @@ class GenerationEngine:
         self._ms_active = None
         self._ms_remaining = None
         self._ms_eos = None
+
+    def _put_seq(self, buf):
+        """Commit a fresh batch-1 prefill scratch buffer to the seq-cache
+        sharding (no-op without a mesh)."""
+        if self._mesh is None:
+            return buf
+        import jax
+
+        return jax.device_put(buf, self._shard_seq)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -2034,7 +2162,8 @@ class GenerationEngine:
 
         if fresh:
             seq = llama.KVCache.create(self._cfg, 1, self._dtype)
-            self._seq_state = (None, seq.k, seq.v, seq.length)
+            sk0, sv0 = self._put_seq(seq.k), self._put_seq(seq.v)
+            self._seq_state = (None, sk0, sv0, seq.length)
         _, sk, sv, slen = self._seq_state
         logits0, sk, sv, slen = self._prefill_one_chunk(
             self._params, jnp.asarray(ids), sk, sv, slen
@@ -2076,7 +2205,7 @@ class GenerationEngine:
         from ..models import llama
 
         seq = llama.KVCache.create(self._cfg, 1, self._dtype)
-        sk, sv = seq.k, seq.v
+        sk, sv = self._put_seq(seq.k), self._put_seq(seq.v)
         C = self._prefill_chunk_size
         off = 0
         for ck, cv in cached_kv:

@@ -75,16 +75,21 @@ def resolve_uri(model_uri: str) -> Path:
 _SEP = "|"
 
 
-def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+def _flatten(
+    tree: Any, prefix: str = "", convert: bool = True
+) -> dict[str, np.ndarray]:
+    """Flatten a pytree to ``{joined-key: leaf}``.  ``convert=False``
+    keeps device arrays as-is (the snapshot writer needs their SHARDING,
+    which ``np.asarray`` would collapse by gathering to host)."""
     out: dict[str, np.ndarray] = {}
     if isinstance(tree, dict):
         for k, v in tree.items():
-            out.update(_flatten(v, f"{prefix}{k}{_SEP}"))
+            out.update(_flatten(v, f"{prefix}{k}{_SEP}", convert))
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            out.update(_flatten(v, f"{prefix}#{i}{_SEP}"))
+            out.update(_flatten(v, f"{prefix}#{i}{_SEP}", convert))
     else:
-        out[prefix.rstrip(_SEP)] = np.asarray(tree)
+        out[prefix.rstrip(_SEP)] = np.asarray(tree) if convert else tree
     return out
 
 
@@ -194,15 +199,32 @@ def _build_config(flavor: str, config_dict: dict) -> Any:
 
 
 def _shard_for_flavor(flavor: str, params: Any, cfg: Any, mesh_shape: dict) -> Any:
-    """Place params on a device mesh using the family's logical axes."""
-    from ..parallel import build_mesh, shard_pytree
+    """Place params on a device mesh.
 
-    mesh = build_mesh(mesh_shape)
+    The mesh covers the first ``prod(mesh_shape)`` visible devices (the
+    reconcile-time topology check pins prod == chip count in-cluster;
+    dev environments with more devices shard over a prefix).  llama goes
+    through the ``models/partition.py`` regex rule table — the same
+    table the engine's cache/state shardings and per-shard snapshots
+    key off — with the meshShape/geometry divisibility check applied
+    FIRST so a bad tp fails typed here, not as an XLA shape error at
+    the first warmup dispatch.  Other flavors keep their logical-axes
+    tables."""
+    from ..models.partition import build_serving_mesh
+    from ..parallel import shard_pytree
+
     if flavor == "llama-generate":
-        from ..models import llama
+        from ..models import partition
 
-        axes = llama.param_logical_axes(cfg)
-    elif flavor == "bert-classifier":
+        try:
+            partition.validate_llama_mesh(cfg, mesh_shape)
+        except ValueError as e:
+            raise ModelLoadError(str(e)) from None
+        mesh = build_serving_mesh(mesh_shape)
+        _log.info("sharding %s params over mesh %s", flavor, mesh_shape)
+        return partition.shard_llama_params(params, mesh)
+    mesh = build_serving_mesh(mesh_shape)
+    if flavor == "bert-classifier":
         from ..models import bert
 
         axes = bert.param_logical_axes(params)
@@ -289,6 +311,16 @@ def _finish_native(
                 f"quantize={quantize!r} is not supported for flavor "
                 f"{flavor!r} (supported: llama-generate, bert-classifier)"
             )
+        if mesh_shape and n_devices > 1 and flavor == "llama-generate":
+            # Re-pin the quantized tree to the rule table's canonical
+            # shardings: the jitted quantizer keeps everything ON the
+            # mesh but XLA may pick its own layout for the new q8/scale
+            # planes, and the per-shard snapshot (plus the engine's
+            # explicit output shardings) key off the canonical one.
+            from ..models import partition
+
+            mesh = partition.build_serving_mesh(mesh_shape)
+            params = partition.shard_llama_params(params, mesh)
         _log.info("quantized %s weights to int8 (mode=%s)", flavor, quantize)
         if stats is not None:
             stats["quantize_s"] = round(
@@ -745,23 +777,33 @@ def _maybe_write_snapshot(
     """Bake (or re-bake) the snapshot after a successful cold load.
 
     Write-once: a snapshot already valid for this identity is left
-    alone.  Multi-device meshes are skipped — the device tree is
-    distributed and scale-to-zero is rejected for multi-host CRs at
-    reconcile time anyway.  A write failure warns and never fails the
-    load."""
+    alone.  Multi-device (tp > 1) trees bake PER-SHARD: each device's
+    bytes are indexed separately in the manifest, so restore streams
+    shard->device without ever assembling the full tree on host (the
+    identity folds the mesh in, so a meshShape change misses, warns
+    once, and re-bakes here).  A write failure warns and never fails
+    the load."""
     from . import snapshot as _snap
 
     lm = getattr(pred, "causal_lm", None)
     if not lm:
         return  # only causal-LM trees are snapshot-restorable today
-    n_devices = 1
-    for v in (mesh_shape or {}).values():
-        n_devices *= int(v)
-    if n_devices > 1:
+    import jax
+
+    if any(
+        not getattr(leaf, "sharding", None) is None
+        and not leaf.sharding.is_fully_addressable
+        for leaf in jax.tree.leaves(lm["params"])
+    ):
+        # Multi-HOST mesh: this process holds only its local shards, so
+        # a bake here would index a partial tree the restore could never
+        # place ("has no shard at offset" -> quarantine -> re-bake loop,
+        # one model-sized .corrupt-* copy per boot).  Per-shard
+        # snapshots cover multi-DEVICE single-host; multi-host restore
+        # needs a per-process manifest — future work.
         _log.info(
-            "snapshot skipped: multi-device mesh %s (scale-to-zero "
-            "restore is single-device)",
-            dict(mesh_shape or {}),
+            "snapshot skipped: params span non-addressable devices "
+            "(multi-host unit); per-shard bake is single-host only"
         )
         return
     ident = _snap.snapshot_identity(model_uri, quantize, mesh_shape)

@@ -368,6 +368,7 @@ class ServerMetrics:
         self.device_hbm_bytes = None
         self.device_mfu = None
         self.device_hbm_bw_util = None
+        self.engine_collective_seconds = None
         self.compile_seconds = None
         self.compile_cache_hits = None
         self.compile_cache_misses = None
@@ -391,6 +392,15 @@ class ServerMetrics:
                 "HBM bandwidth utilization of the most recent engine "
                 "tick of each kind (analytic bytes / device peak)",
                 ident_labels + ["kind"],
+                registry=self.registry,
+            )
+            self.engine_collective_seconds = Counter(
+                "tpumlops_engine_collective_seconds",
+                "Estimated ICI collective wall seconds per engine "
+                "dispatch at tp > 1, by op (all_reduce = the Megatron "
+                "o/down psum pair per layer, all_gather = the vocab-"
+                "sharded logits gather), from the analytic cost model",
+                ident_labels + ["op"],
                 registry=self.registry,
             )
             self.compile_seconds = Counter(
@@ -583,6 +593,12 @@ class ServerMetrics:
             self.device_hbm_bytes.labels(
                 **self.identity, component=component
             ).set(nbytes)
+
+    def observe_collective(self, op: str, seconds: float):
+        if self.engine_collective_seconds is not None:
+            self.engine_collective_seconds.labels(
+                **self.identity, op=op
+            ).inc(seconds)
 
     def observe_device_util(self, kind: str, mfu: float, bw_util: float):
         if self.device_mfu is not None:

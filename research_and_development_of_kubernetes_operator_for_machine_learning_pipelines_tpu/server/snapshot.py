@@ -25,6 +25,18 @@ stream file-by-file with a reader thread while the consumer transfers the
 previous leaves to the device (same overlap discipline as
 ``loader._stream_native_params``, minus the transform work).
 
+Tensor-parallel trees (``meshShape`` tp > 1) extend a leaf entry with a
+SHARD axis: a partitioned leaf carries ``spec`` (its PartitionSpec as
+data) and ``shards`` — one ``(file, offset, nbytes, crc32, start,
+shape)`` record per device shard, each written from that device's own
+buffer.  A restore rebuilds the mesh from the manifest identity's
+``mesh_shape`` and device-puts each shard straight onto its device
+(``jax.make_array_from_single_device_arrays``), so at no point does the
+full tree — or even a full sharded leaf, beyond the one being assembled
+— materialize on one host.  Replicated leaves (norms, scales of
+row-split matrices) keep the flat single-copy layout, so a ``tp: 1``
+snapshot's manifest and chunks are byte-for-byte the pre-tp format.
+
 Identity and invalidation: the snapshot is keyed by a content hash of
 ``(model version/uri, quantize mode, mesh shape, format version)``.  Any
 mismatch — a new model version, a different quantize mode, a resharded
@@ -133,6 +145,53 @@ def _leaf_to_numpy(leaf: Any) -> np.ndarray:
     return np.ascontiguousarray(arr)
 
 
+def _spec_to_data(spec) -> list:
+    """PartitionSpec -> JSON-serializable form (axis name, list of
+    names, or None per dimension)."""
+    out = []
+    for p in spec:
+        if p is None:
+            out.append(None)
+        elif isinstance(p, (tuple, list)):
+            out.append([str(a) for a in p])
+        else:
+            out.append(str(p))
+    return out
+
+
+def _spec_from_data(data) -> "Any":
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(
+        *[tuple(p) if isinstance(p, list) else p for p in data]
+    )
+
+
+def _shard_plan(leaf: Any):
+    """``None`` for a single-device/replicated leaf (flat layout), else
+    ``(spec_data, [(starts, shard_ndarray), ...])`` for a partitioned
+    one — each shard the bytes ONE device holds, deduplicated by slice
+    start (partial replication writes each distinct block once)."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None:
+        return None
+    try:
+        from jax.sharding import NamedSharding
+
+        if not isinstance(sharding, NamedSharding):
+            return None
+        if len(sharding.device_set) <= 1 or sharding.is_fully_replicated:
+            return None
+    except Exception:  # pragma: no cover - exotic sharding types
+        return None
+    seen: dict[tuple, np.ndarray] = {}
+    for s in leaf.addressable_shards:
+        starts = tuple(int(sl.start or 0) for sl in s.index)
+        if starts not in seen:
+            seen[starts] = np.ascontiguousarray(np.asarray(s.data))
+    return _spec_to_data(sharding.spec), sorted(seen.items())
+
+
 # ---------------------------------------------------------------------------
 # Writing
 # ---------------------------------------------------------------------------
@@ -161,43 +220,86 @@ def write_snapshot(
     target = snapshot_path_for(snapshot_dir, identity["model_uri"])
     target.parent.mkdir(parents=True, exist_ok=True)
     t0 = time.perf_counter()
-    flat = _flatten(params)
+    # convert=False: leaves keep their device placement so _shard_plan
+    # can see a partitioned leaf's sharding and write it per-shard.
+    flat = _flatten(params, convert=False)
     staging = Path(
         tempfile.mkdtemp(prefix=".snapshot-", dir=str(target.parent))
     )
     try:
         leaves = []
-        chunk_idx = -1
-        chunk_f = None
-        chunk_used = chunk_bytes + 1  # force a fresh chunk on first leaf
-        total = 0
+        state = {
+            "idx": -1,
+            "f": None,
+            # force a fresh chunk on first blob
+            "used": chunk_bytes + 1,
+            "total": 0,
+        }
+
+        def emit(raw: bytes) -> tuple[str, int]:
+            """Append one blob to the current (or a fresh) chunk file;
+            returns its (file, offset)."""
+            if state["used"] + len(raw) > chunk_bytes and state["used"] > 0:
+                if state["f"] is not None:
+                    state["f"].close()
+                state["idx"] += 1
+                state["f"] = open(
+                    staging / f"chunk-{state['idx']:05d}.bin", "wb"
+                )
+                state["used"] = 0
+            off = state["used"]
+            state["f"].write(raw)
+            state["used"] += len(raw)
+            state["total"] += len(raw)
+            return f"chunk-{state['idx']:05d}.bin", off
+
         try:
             for key in sorted(flat):
-                arr = _leaf_to_numpy(flat[key])
-                raw = arr.tobytes()
-                if chunk_used + len(raw) > chunk_bytes and chunk_used > 0:
-                    if chunk_f is not None:
-                        chunk_f.close()
-                    chunk_idx += 1
-                    chunk_f = open(staging / f"chunk-{chunk_idx:05d}.bin", "wb")
-                    chunk_used = 0
-                leaves.append(
-                    {
-                        "key": key,
-                        "dtype": arr.dtype.name,
-                        "shape": list(arr.shape),
-                        "file": f"chunk-{chunk_idx:05d}.bin",
-                        "offset": chunk_used,
-                        "nbytes": len(raw),
-                        "crc32": binascii.crc32(raw) & 0xFFFFFFFF,
-                    }
-                )
-                chunk_f.write(raw)
-                chunk_used += len(raw)
-                total += len(raw)
+                plan = _shard_plan(flat[key])
+                if plan is None:
+                    # Flat layout — byte-for-byte the pre-tp format for
+                    # every single-device/replicated leaf.
+                    arr = _leaf_to_numpy(flat[key])
+                    raw = arr.tobytes()
+                    fname, off = emit(raw)
+                    leaves.append(
+                        {
+                            "key": key,
+                            "dtype": arr.dtype.name,
+                            "shape": list(arr.shape),
+                            "file": fname,
+                            "offset": off,
+                            "nbytes": len(raw),
+                            "crc32": binascii.crc32(raw) & 0xFFFFFFFF,
+                        }
+                    )
+                    continue
+                spec_data, shards = plan
+                entry = {
+                    "key": key,
+                    "dtype": shards[0][1].dtype.name,
+                    "shape": list(flat[key].shape),
+                    "spec": spec_data,
+                    "shards": [],
+                }
+                for starts, sarr in shards:
+                    raw = sarr.tobytes()
+                    fname, off = emit(raw)
+                    entry["shards"].append(
+                        {
+                            "file": fname,
+                            "offset": off,
+                            "nbytes": len(raw),
+                            "crc32": binascii.crc32(raw) & 0xFFFFFFFF,
+                            "start": list(starts),
+                            "shape": list(sarr.shape),
+                        }
+                    )
+                leaves.append(entry)
         finally:
-            if chunk_f is not None:
-                chunk_f.close()
+            if state["f"] is not None:
+                state["f"].close()
+        total = state["total"]
         manifest = {
             "format_version": FORMAT_VERSION,
             "identity": identity,
@@ -281,6 +383,13 @@ def load_snapshot(
     ``stats`` (optional dict) is filled with ``restore_s`` / ``disk_s`` /
     ``transfer_s`` / ``read_gib`` so a slow restore says which stage was
     slow — same shape the cold path's ``load_stats`` uses.
+
+    Per-shard leaves (a tp > 1 bake) restore WITHOUT ever assembling the
+    full leaf on host: the mesh is rebuilt from the manifest identity's
+    ``mesh_shape`` and each shard device-puts straight onto its device
+    (``jax.make_array_from_single_device_arrays``).  Restoring a
+    sharded snapshot onto a process with too few devices raises
+    :class:`SnapshotError` (the caller cold-loads).
     """
     import queue as _queue
     import threading
@@ -292,6 +401,45 @@ def load_snapshot(
     if identity is not None:
         check_identity(manifest, identity)
 
+    # Flatten leaves into one read plan: a flat leaf is one record, a
+    # sharded leaf one record per shard (written contiguously, so the
+    # reader stays sequential per chunk file).
+    records: list[dict] = []
+    sharded = False
+    for leaf in manifest["leaves"]:
+        if "shards" in leaf:
+            sharded = True
+            for i, srec in enumerate(leaf["shards"]):
+                records.append(
+                    {
+                        **srec,
+                        "key": leaf["key"],
+                        "dtype": leaf["dtype"],
+                        "leaf": leaf,
+                        "last_shard": i == len(leaf["shards"]) - 1,
+                    }
+                )
+        else:
+            records.append({**leaf, "leaf": None})
+
+    mesh = None
+    if sharded and to_device:
+        mesh_shape = (manifest.get("identity") or {}).get("mesh_shape") or {}
+        try:
+            from ..models.partition import build_serving_mesh
+
+            mesh = build_serving_mesh(mesh_shape)
+        except Exception as e:
+            # MISMATCH, not corruption: the snapshot is valid, THIS
+            # process just cannot host its mesh (fewer visible devices —
+            # a CPU debug run, a degraded slice).  SnapshotError here
+            # would make the loader quarantine a perfectly good bake
+            # over an environmental condition.
+            raise SnapshotMismatch(
+                f"sharded snapshot needs mesh {mesh_shape}, which this "
+                f"process cannot build: {e}"
+            ) from e
+
     t_wall = time.perf_counter()
     timing = {"disk_s": 0.0, "transfer_s": 0.0, "read_bytes": 0}
     q: _queue.Queue = _queue.Queue(maxsize=4)
@@ -302,39 +450,39 @@ def load_snapshot(
         open_file = None
         open_name = None
         try:
-            for leaf in manifest["leaves"]:
+            for rec in records:
                 if abort.is_set():
                     return
                 t0 = time.perf_counter()
-                if leaf["file"] != open_name:
+                if rec["file"] != open_name:
                     if open_file is not None:
                         open_file.close()
-                    fpath = path / leaf["file"]
+                    fpath = path / rec["file"]
                     if not fpath.exists():
                         raise SnapshotError(
-                            f"snapshot chunk {leaf['file']} missing in {path}"
+                            f"snapshot chunk {rec['file']} missing in {path}"
                         )
                     open_file = open(fpath, "rb")
-                    open_name = leaf["file"]
-                open_file.seek(leaf["offset"])
-                raw = open_file.read(leaf["nbytes"])
-                if len(raw) != leaf["nbytes"]:
+                    open_name = rec["file"]
+                open_file.seek(rec["offset"])
+                raw = open_file.read(rec["nbytes"])
+                if len(raw) != rec["nbytes"]:
                     raise SnapshotError(
-                        f"snapshot chunk {leaf['file']} truncated at leaf "
-                        f"{leaf['key']!r}: wanted {leaf['nbytes']} bytes, "
+                        f"snapshot chunk {rec['file']} truncated at leaf "
+                        f"{rec['key']!r}: wanted {rec['nbytes']} bytes, "
                         f"got {len(raw)}"
                     )
-                if (binascii.crc32(raw) & 0xFFFFFFFF) != leaf["crc32"]:
+                if (binascii.crc32(raw) & 0xFFFFFFFF) != rec["crc32"]:
                     raise SnapshotError(
-                        f"snapshot leaf {leaf['key']!r} failed CRC in "
-                        f"{leaf['file']}"
+                        f"snapshot leaf {rec['key']!r} failed CRC in "
+                        f"{rec['file']}"
                     )
                 arr = np.frombuffer(
-                    raw, dtype=_dtype_from_name(leaf["dtype"])
-                ).reshape(leaf["shape"])
+                    raw, dtype=_dtype_from_name(rec["dtype"])
+                ).reshape(rec["shape"])
                 timing["disk_s"] += time.perf_counter() - t0
-                timing["read_bytes"] += leaf["nbytes"]
-                q.put((leaf["key"], arr))
+                timing["read_bytes"] += rec["nbytes"]
+                q.put((rec, arr))
         except BaseException as e:
             reader_error.append(e)
         finally:
@@ -348,16 +496,67 @@ def load_snapshot(
     rthread.start()
 
     leaves: dict[str, Any] = {}
+    pending: dict[str, dict[tuple, np.ndarray]] = {}
     try:
         if to_device:
+            import jax
             import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = (
+                NamedSharding(mesh, PartitionSpec())
+                if mesh is not None else None
+            )
+
+        def place_flat(arr):
+            if not to_device:
+                return arr
+            # Replicated leaves of a sharded tree commit to the mesh so
+            # the engine programs see one consistent device set.
+            return jnp.asarray(arr) if rep is None else jax.device_put(
+                arr, rep
+            )
+
+        def assemble(leaf, shard_map):
+            shape = tuple(leaf["shape"])
+            if not to_device:
+                full = np.zeros(shape, _dtype_from_name(leaf["dtype"]))
+                for starts, arr in shard_map.items():
+                    idx = tuple(
+                        slice(st, st + n)
+                        for st, n in zip(starts, arr.shape)
+                    )
+                    full[idx] = arr
+                return full
+            sh = NamedSharding(mesh, _spec_from_data(leaf["spec"]))
+            bufs = []
+            for dev, idx in sh.devices_indices_map(shape).items():
+                starts = tuple(int(sl.start or 0) for sl in idx)
+                arr = shard_map.get(starts)
+                if arr is None:
+                    raise SnapshotError(
+                        f"snapshot leaf {leaf['key']!r} has no shard at "
+                        f"offset {starts} for mesh placement"
+                    )
+                bufs.append(jax.device_put(arr, dev))
+            return jax.make_array_from_single_device_arrays(
+                shape, sh, bufs
+            )
+
         while True:
             item = q.get()
             if item is None:
                 break
-            key, arr = item
+            rec, arr = item
             t0 = time.perf_counter()
-            leaves[key] = jnp.asarray(arr) if to_device else arr
+            if rec["leaf"] is None:
+                leaves[rec["key"]] = place_flat(arr)
+            else:
+                acc = pending.setdefault(rec["key"], {})
+                acc[tuple(rec["start"])] = arr
+                if rec["last_shard"]:
+                    leaves[rec["key"]] = assemble(rec["leaf"], acc)
+                    del pending[rec["key"]]
             timing["transfer_s"] += time.perf_counter() - t0
     except BaseException:
         # Same reader-unwedging contract as _stream_native_params: a

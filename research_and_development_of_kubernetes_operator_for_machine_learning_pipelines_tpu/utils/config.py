@@ -966,6 +966,80 @@ class SloSpec:
         return tuple(names)
 
 
+# Mirrors parallel.mesh.MESH_AXIS_ORDER without importing jax into the
+# operator process (tests pin the two tuples equal).
+MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+def _parse_mesh_shape(value) -> dict:
+    """Structural meshShape validation at reconcile time: unknown axis
+    names and non-positive sizes must land in CR status, not as a pod
+    CrashLoopBackOff at the server's build_mesh."""
+    mesh = dict(value or {"dp": 1, "tp": 8})
+    unknown = set(mesh) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(
+            f"spec.tpu.meshShape has unknown axes {sorted(unknown)}; "
+            f"known: {list(MESH_AXES)}"
+        )
+    out = {}
+    for axis, size in mesh.items():
+        try:
+            n = int(size)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"spec.tpu.meshShape.{axis} must be a positive integer, "
+                f"got {size!r}"
+            ) from None
+        if n < 1:
+            raise ValueError(
+                f"spec.tpu.meshShape.{axis} must be >= 1, got {n}"
+            )
+        out[axis] = n
+    return out
+
+
+def validate_mesh_for_model(
+    mesh_shape: Mapping[str, int] | None,
+    *,
+    num_kv_heads: int,
+    num_heads: int | None = None,
+    intermediate_size: int | None = None,
+    vocab_size: int | None = None,
+) -> None:
+    """Reject a ``meshShape`` whose ``tp`` axis the model geometry cannot
+    shard — typed, naming the knob and the offending count.
+
+    Without this the mismatch surfaces as an opaque XLA shape error at
+    the first warmup dispatch (after the weights already streamed).  The
+    KV-head count is the binding constraint (the cache's heads axis is
+    what decode shards); heads/mlp/vocab ride along so every sharded
+    matrix is covered by one message shape.  Called by the server loader
+    and the generation engine with the artifact's geometry in hand; the
+    operator applies the structural half (:func:`_parse_mesh_shape`) at
+    reconcile, where the artifact is not yet readable.
+    """
+    tp = int((mesh_shape or {}).get("tp", 1))
+    if tp <= 1:
+        return
+    checks = (
+        ("KV-head count (num_kv_heads)", num_kv_heads),
+        ("attention-head count (num_heads)", num_heads),
+        ("MLP width (intermediate_size)", intermediate_size),
+        ("vocab size (vocab_size)", vocab_size),
+    )
+    for label, count in checks:
+        if count is None:
+            continue
+        if int(count) % tp != 0:
+            raise ValueError(
+                f"spec.tpu.meshShape tp={tp} does not divide the model's "
+                f"{label} = {int(count)}; pick a tp that divides it (or "
+                "tp: 1) — an indivisible axis cannot shard and would "
+                "fail as an XLA shape error at first dispatch"
+            )
+
+
 def _parse_quantize(value) -> str:
     """Reject bad quantize values at reconcile time — a typo'd CR field must
     surface in status, not as a pod CrashLoopBackOff at argparse."""
@@ -1076,7 +1150,7 @@ class TpuSpec:
             ),
             "spec.tpu",
         )
-        mesh = dict(spec.get("meshShape") or {"dp": 1, "tp": 8})
+        mesh = _parse_mesh_shape(spec.get("meshShape"))
         prefill_chunk = _parse_prefill_chunk(spec.get("prefillChunk"))
         prefill_batch = _parse_prefill_batch(spec.get("prefillBatch"))
         prefix_cache = PrefixCacheSpec.from_spec(

@@ -635,3 +635,59 @@ def test_slo_spec_validation():
         OperatorConfig.from_spec(minimal_spec(slo={"ttftP99Ms": -1}))
     with pytest.raises(ValueError, match="unknown key"):
         OperatorConfig.from_spec(minimal_spec(slo={"ttftp99ms": 10}))
+
+
+# ---------------------------------------------------------------------------
+# meshShape validation (tensor-parallel serving)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_shape_unknown_axis_rejected_at_reconcile():
+    with pytest.raises(ValueError, match="meshShape.*unknown axes"):
+        TpuSpec.from_spec({"meshShape": {"tq": 8}})
+
+
+def test_mesh_shape_bad_sizes_rejected_at_reconcile():
+    with pytest.raises(ValueError, match="meshShape.tp"):
+        TpuSpec.from_spec({"meshShape": {"tp": 0}})
+    with pytest.raises(ValueError, match="meshShape.tp"):
+        TpuSpec.from_spec({"meshShape": {"tp": -2}})
+    with pytest.raises(ValueError, match="meshShape.dp"):
+        TpuSpec.from_spec({"meshShape": {"dp": "four", "tp": 1}})
+
+
+def test_mesh_shape_valid_axes_normalize_to_ints():
+    tpu = TpuSpec.from_spec({"meshShape": {"dp": "1", "tp": "8"}})
+    assert dict(tpu.mesh_shape) == {"dp": 1, "tp": 8}
+    assert tpu.num_devices == 8
+
+
+def test_validate_mesh_for_model_kv_head_divisibility():
+    """The typed rejection that replaces the opaque XLA shape error at
+    first warmup dispatch: tp must divide the model's KV-head count —
+    and the message must NAME the knob and the count."""
+    from tpumlops.utils.config import validate_mesh_for_model
+
+    with pytest.raises(ValueError, match=r"meshShape tp=4.*num_kv_heads.*= 2"):
+        validate_mesh_for_model({"dp": 1, "tp": 4}, num_kv_heads=2)
+    # Dividing geometry passes, including the other sharded axes.
+    validate_mesh_for_model(
+        {"dp": 1, "tp": 4},
+        num_kv_heads=8, num_heads=32, intermediate_size=11008,
+        vocab_size=32000,
+    )
+    with pytest.raises(ValueError, match="intermediate_size"):
+        validate_mesh_for_model(
+            {"tp": 4}, num_kv_heads=8, intermediate_size=11007
+        )
+    with pytest.raises(ValueError, match="vocab_size"):
+        validate_mesh_for_model({"tp": 3}, num_kv_heads=9, vocab_size=32000)
+
+
+def test_validate_mesh_for_model_tp1_never_rejects():
+    from tpumlops.utils.config import validate_mesh_for_model
+
+    # tp=1 (or no tp axis at all) shards nothing: any geometry passes.
+    validate_mesh_for_model({"dp": 1, "tp": 1}, num_kv_heads=3)
+    validate_mesh_for_model(None, num_kv_heads=3)
+    validate_mesh_for_model({}, num_kv_heads=3)

@@ -356,6 +356,7 @@ def test_capacity_status_summary_gated_on_device_telemetry():
         "chips": 8,
         "hosts": 1,
         "meshShape": {"tp": 8},
+        "tensorParallel": 8,
         "quantize": "none",
         "deviceTelemetry": True,
         "hbmGiBPerChip": 16,

@@ -98,6 +98,10 @@ EXPECTED_SERVER_DEVICE = {
     "tpumlops_device_hbm_bytes": ("gauge", _IDENT + ("component",)),
     "tpumlops_device_mfu": ("gauge", _IDENT + ("kind",)),
     "tpumlops_device_hbm_bw_util": ("gauge", _IDENT + ("kind",)),
+    # Tensor-parallel serving: analytic ICI collective walls per engine
+    # dispatch (op = all_reduce | all_gather); exported as
+    # tpumlops_engine_collective_seconds_total.  No samples at tp == 1.
+    "tpumlops_engine_collective_seconds": ("counter", _IDENT + ("op",)),
     "tpumlops_compile_seconds": ("counter", _IDENT + ("op",)),
     "tpumlops_compile_cache_hits": ("counter", _IDENT),
     "tpumlops_compile_cache_misses": ("counter", _IDENT),

@@ -169,6 +169,114 @@ def test_all_to_all_swap_reshards_heads_to_sequence():
     np.testing.assert_array_equal(out, np.arange(64.0).reshape(8, 8))
 
 
+# ---------------------------------------------------------------------------
+# Regex partition-rule matching (tensor-parallel rule tables)
+# ---------------------------------------------------------------------------
+
+
+def test_match_partition_rules_unmatched_leaf_falls_back_replicated():
+    from tpumlops.parallel import match_partition_rules
+
+    rules = [(r"wq$", PartitionSpec(None, "tp"))]
+    tree = {
+        "wq": jnp.zeros((4, 8)),
+        "mystery_aux": jnp.zeros((3, 3)),  # no rule: must replicate
+    }
+    specs = match_partition_rules(rules, tree)
+    assert specs["wq"] == PartitionSpec(None, "tp")
+    assert specs["mystery_aux"] == PartitionSpec()
+
+
+def test_match_partition_rules_order_precedence():
+    from tpumlops.parallel import match_partition_rules
+
+    # Both rules match "layers/q/scale"; the FIRST must win.
+    rules = [
+        (r"q/scale$", PartitionSpec()),
+        (r"layers/q", PartitionSpec(None, "tp")),
+    ]
+    tree = {"layers": {"q": {"scale": jnp.zeros((1, 8)),
+                             "q8": jnp.zeros((4, 8))}}}
+    specs = match_partition_rules(rules, tree)
+    assert specs["layers"]["q"]["scale"] == PartitionSpec()
+    assert specs["layers"]["q"]["q8"] == PartitionSpec(None, "tp")
+
+
+def test_match_partition_rules_rank_mismatch_is_typed():
+    from tpumlops.parallel import PartitionRuleError, match_partition_rules
+
+    rules = [(r"wq$", PartitionSpec(None, None, "tp"))]  # rank 3 vs rank 2
+    with pytest.raises(PartitionRuleError, match="rank-3.*rank-2|wq"):
+        match_partition_rules(rules, {"wq": jnp.zeros((4, 8))})
+    # Under-rank is typed too: P("tp") on a rank-2 leaf would silently
+    # shard the LEADING axis — the wrong-axis drift the guard exists
+    # to catch.  An explicit P() (fully replicated) stays valid.
+    with pytest.raises(PartitionRuleError, match="rank-1"):
+        match_partition_rules(
+            [(r"wq$", PartitionSpec("tp"))], {"wq": jnp.zeros((4, 8))}
+        )
+    specs = match_partition_rules(
+        [(r"wq$", PartitionSpec())], {"wq": jnp.zeros((4, 8))}
+    )
+    assert specs["wq"] == PartitionSpec()
+
+
+def test_match_partition_rules_scalars_always_replicate():
+    from tpumlops.parallel import match_partition_rules
+
+    rules = [(r".", PartitionSpec("tp"))]  # matches everything
+    specs = match_partition_rules(rules, {"step": jnp.zeros(())})
+    assert specs["step"] == PartitionSpec()
+
+
+def test_llama_rule_table_covers_bf16_and_int8_trees():
+    """Every leaf of both llama layouts must land on a spec whose rank
+    matches, with the Megatron split where expected — the table the
+    loader, engine, and per-shard snapshots all key off."""
+    import jax
+
+    from tpumlops.models import llama
+    from tpumlops.models.partition import llama_param_specs
+    from tpumlops.models.quantization import quantize_llama
+
+    cfg = llama.LlamaConfig.tiny(num_heads=4, num_kv_heads=4)
+    params = llama.init(jax.random.key(0), cfg)
+    specs = llama_param_specs(params)
+    assert specs["layers"]["q"] == PartitionSpec(None, None, "tp")
+    assert specs["layers"]["down"] == PartitionSpec(None, "tp", None)
+    assert specs["layers"]["attn_norm"] == PartitionSpec()
+    assert specs["embed"] == PartitionSpec("tp", None)
+    assert specs["lm_head"] == PartitionSpec(None, "tp")
+
+    q = quantize_llama(params)
+    qspecs = llama_param_specs(q)
+    assert qspecs["layers"]["q"]["q8"] == PartitionSpec(None, None, "tp")
+    assert qspecs["layers"]["q"]["scale"] == PartitionSpec(None, None, "tp")
+    # Row-split matrices: the scale's reduced axis is size 1 — it must
+    # replicate or device_put fails on an indivisible axis.
+    assert qspecs["layers"]["down"]["q8"] == PartitionSpec(None, "tp", None)
+    assert qspecs["layers"]["down"]["scale"] == PartitionSpec()
+    assert qspecs["layers"]["o"]["scale"] == PartitionSpec()
+
+    # The whole int8 tree device-puts cleanly at tp=4 (rank + divisibility).
+    from tpumlops.models.partition import build_serving_mesh, shard_llama_params
+
+    mesh = build_serving_mesh({"dp": 1, "tp": 4})
+    sharded = shard_llama_params(q, mesh)
+    q8 = sharded["layers"]["down"]["q8"]
+    assert q8.sharding.spec == PartitionSpec(None, "tp", None)
+    assert q8.addressable_shards[0].data.shape[1] == q8.shape[1] // 4
+
+
+def test_config_mesh_axes_mirror_parallel_mesh():
+    """utils.config.MESH_AXES must stay in lockstep with the jax-side
+    axis table (config cannot import jax; this test can)."""
+    from tpumlops.parallel import MESH_AXIS_ORDER
+    from tpumlops.utils.config import MESH_AXES
+
+    assert tuple(MESH_AXES) == tuple(MESH_AXIS_ORDER)
+
+
 def test_dp_mean_loss_matches_single_device():
     mesh = build_mesh({"dp": 8})
     x = jnp.arange(32.0).reshape(8, 4)

@@ -255,3 +255,149 @@ def test_write_is_atomic_no_partial_dir_on_failure(tmp_path):
     assert not target.exists()
     leftovers = list((tmp_path / "snaps").glob(".snapshot-*"))
     assert leftovers == [], leftovers
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel (per-shard) snapshots
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tp_artifact(tmp_path_factory):
+    # Geometry every tp in {2, 4} divides (heads, kv-heads, mlp, vocab).
+    cfg = llama.LlamaConfig.tiny(num_heads=4, num_kv_heads=4, max_seq=64)
+    root = tmp_path_factory.mktemp("snap-tp-artifact")
+    art = root / "model"
+    save_native_model(
+        art,
+        "llama-generate",
+        llama.init(jax.random.key(11), cfg, dtype=jnp.bfloat16),
+        config={
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_seq": cfg.max_seq,
+        },
+        builder_kwargs={"eos_id": 2},
+    )
+    return str(art)
+
+
+def test_sharded_snapshot_round_trip_preserves_values_and_shardings(
+    tp_artifact, tmp_path
+):
+    """A tp=2 bake writes PER-SHARD leaf records; the restore rebuilds
+    the mesh from the manifest identity and lands every shard on its
+    device — bit-identical values, identical PartitionSpecs."""
+    from tpumlops.models.partition import (
+        build_serving_mesh,
+        shard_llama_params,
+    )
+
+    mesh = build_serving_mesh({"dp": 1, "tp": 2})
+    params = shard_llama_params(
+        llama.init(jax.random.key(3), llama.LlamaConfig.tiny(
+            num_heads=4, num_kv_heads=4
+        ), dtype=jnp.bfloat16),
+        mesh,
+    )
+    ident = snap.snapshot_identity("model://tp", "none", {"dp": 1, "tp": 2})
+    path = snap.write_snapshot(
+        tmp_path, params, identity=ident, flavor="llama-generate"
+    )
+    manifest = snap.read_manifest(path)
+    sharded = [l for l in manifest["leaves"] if "shards" in l]
+    assert sharded, "no per-shard leaf records written"
+    for leaf in sharded:
+        assert len(leaf["shards"]) == 2
+        assert leaf["spec"], leaf
+    # Replicated leaves (norms) keep the flat pre-tp record shape.
+    flat = [l for l in manifest["leaves"] if "shards" not in l]
+    assert flat and all("spec" not in l for l in flat)
+
+    restored, _ = snap.load_snapshot(path, identity=ident)
+    _trees_bit_identical(params, restored)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        assert a.sharding.spec == b.sharding.spec
+
+
+def test_tp1_snapshot_never_restores_onto_tp4_mesh(tp_artifact, tmp_path):
+    """The pinned invalidation: a tp=1 bake must MISS (one structured
+    warning, ordinary cold load, re-bake) when the CR moves to tp=4 —
+    never restore a single-device tree onto a sharded mesh."""
+    snap_dir = tmp_path / "snaps"
+    load_predictor(tp_artifact, snapshot_dir=str(snap_dir))  # bakes tp=1
+    spath = snap.snapshot_path_for(snap_dir, tp_artifact)
+    baked = snap.read_manifest(spath)
+    assert baked["identity"]["mesh_shape"] in ({}, {"dp": 1, "tp": 1})
+
+    from tpumlops.server import loader as loader_mod
+
+    logger = loader_mod._log
+    records: list[logging.LogRecord] = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = _Capture()
+    logger.addHandler(handler)
+    try:
+        pred = load_predictor(
+            tp_artifact,
+            mesh_shape={"dp": 1, "tp": 4},
+            snapshot_dir=str(snap_dir),
+        )
+    finally:
+        logger.removeHandler(handler)
+    invalidations = [
+        r for r in records if "snapshot invalidated" in r.getMessage()
+    ]
+    assert len(invalidations) == 1, [r.getMessage() for r in records]
+    assert invalidations[0].levelno == logging.WARNING
+    # The restored-nothing path cold-loaded a SHARDED tree...
+    leaf = jax.tree.leaves(pred.causal_lm["params"])[0]
+    assert len(leaf.sharding.device_set) == 4
+    # ...and re-baked in place for the tp=4 identity (per-shard records).
+    rebaked = snap.read_manifest(spath)
+    assert rebaked["identity"]["mesh_shape"] == {"dp": 1, "tp": 4}
+    assert any("shards" in l for l in rebaked["leaves"])
+
+
+def test_tp4_snapshot_restores_sharded_without_warning(
+    tp_artifact, tmp_path, caplog
+):
+    """Second boot at tp=4: the per-shard snapshot restores straight to
+    the mesh (restore_s set, no invalidation warning) and the served
+    tree is bit-identical to the cold-loaded one."""
+    snap_dir = tmp_path / "snaps"
+    cold = load_predictor(
+        tp_artifact, mesh_shape={"dp": 1, "tp": 4},
+        snapshot_dir=str(snap_dir),
+    )
+    stats: dict = {}
+    with caplog.at_level(logging.WARNING):
+        warm = load_predictor(
+            tp_artifact, mesh_shape={"dp": 1, "tp": 4},
+            snapshot_dir=str(snap_dir), load_stats=stats,
+        )
+    assert "snapshot invalidated" not in caplog.text
+    assert stats.get("restore_s") is not None
+    _trees_bit_identical(cold.causal_lm["params"], warm.causal_lm["params"])
+    for a, b in zip(
+        jax.tree.leaves(cold.causal_lm["params"]),
+        jax.tree.leaves(warm.causal_lm["params"]),
+    ):
+        assert a.sharding.spec == b.sharding.spec
+
+
+def test_indivisible_mesh_rejected_typed_at_load(tp_artifact):
+    """tp that does not divide the artifact's KV-head count fails as a
+    typed ModelLoadError naming the knob — not an XLA shape error."""
+    from tpumlops.server.loader import ModelLoadError
+
+    with pytest.raises(ModelLoadError, match="meshShape tp=3"):
+        load_predictor(tp_artifact, mesh_shape={"dp": 1, "tp": 3})
