@@ -13,7 +13,7 @@ DOCKER   ?= docker
 
 .PHONY: images operator-image server-image router-image router-bin \
         install uninstall test test-fast test-e2e test-all lint \
-        bench-contract metrics-contract verify bench
+        bench-contract metrics-contract compile-budget verify bench
 
 images: operator-image server-image router-image
 
@@ -94,7 +94,16 @@ metrics-contract:
 # gate) chained behind lint + the bench contract: not-slow tranche,
 # collection errors tolerated, 870 s wall cap, DOTS_PASSED echoed from
 # the captured dot lines.
-verify: lint bench-contract metrics-contract
+# Compile-budget regression gate (ISSUE 16): the unified super-step
+# engine's whole point is a small program space.  Runs both warmup
+# sweeps on the tiny model with the compile observatory attached and
+# fails if the unified jit-variant count, the legacy/unified collapse
+# ratio, or the compile-seconds total regresses past the committed
+# budget in COMPILE_BUDGET.json.
+compile-budget:
+	env JAX_PLATFORMS=cpu python scripts/check_compile_budget.py
+
+verify: lint bench-contract metrics-contract compile-budget
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1371,6 +1371,244 @@ def _commit_chunk_at(cache, k_news, v_news, slots, offsets, quant):
     )
 
 
+# Per-row roles for the unified super-step (super_step_ragged): what each
+# batch row is doing inside ONE dispatch.  IDLE rows park every write.
+ROLE_IDLE = 0
+ROLE_DECODE = 1
+ROLE_VERIFY = 2
+ROLE_PREFILL = 3
+
+
+def _commit_block_at(cache, k_news, v_news, base, counts, quant):
+    """Commit a super-step chunk's K/V with PER-POSITION parking: row
+    ``b``'s token ``j`` lands at ``base[b] + j`` when ``j < counts[b]``
+    and parks past capacity otherwise — :func:`_commit_chunk` whose park
+    granularity is a column, not a whole row, because one super-step row
+    commits 1 (decode), ``draft_len+1`` (verify) or ``C`` (prefill)
+    columns out of the same static-width block.
+
+    ``unique_indices`` contract: rows are pairwise distinct, a row's
+    valid positions ``base[b]..base[b]+counts[b]-1`` are strictly
+    increasing and bounded by ``capacity + S - 1`` (drop-scatter spill),
+    and its parked positions start at ``capacity + S`` — the two ranges
+    cannot collide, so every (row, position) tuple stays distinct."""
+    s = k_news.shape[2]
+    capacity = (cache.k8 if quant else cache.k).shape[3]
+
+    def commit(buf, vals):
+        b = buf.shape[1]
+        rows = jnp.arange(b)[:, None]
+        j = jnp.arange(s)[None, :]
+        pos = jnp.where(
+            j < counts[:, None],
+            base[:, None] + j,
+            jnp.int32(capacity + s) + j,
+        )
+        v = jnp.moveaxis(vals, (1, 2), (0, 1)).astype(buf.dtype)
+        return buf.at[:, rows, :, pos].set(
+            v, mode="drop", unique_indices=True
+        )
+
+    if quant:
+        kq, kqs = _quant_kv(k_news)
+        vq, vqs = _quant_kv(v_news)
+        return QuantRaggedKVCache(
+            commit(cache.k8, kq),
+            commit(cache.k_scale, kqs),
+            commit(cache.v8, vq),
+            commit(cache.v_scale, vqs),
+            cache.lengths,
+        )
+    return RaggedKVCache(
+        commit(cache.k, k_news), commit(cache.v, v_news), cache.lengths
+    )
+
+
+def super_step_ragged(
+    params: dict,
+    token_block: jax.Array,
+    cache: "RaggedKVCache | QuantRaggedKVCache",
+    cfg: LlamaConfig,
+    *,
+    roles: jax.Array,
+    offsets: jax.Array,
+    counts: jax.Array,
+    draft_len: jax.Array,
+    active: jax.Array,
+    remaining: jax.Array,
+    eos_ids: jax.Array,
+    steps: int,
+    sample_fn,
+    sample_carry=None,
+    dtype=jnp.bfloat16,
+    window: int | None = None,
+):
+    """ONE dispatch advancing a ragged batch of MIXED roles: per row,
+    a packed-prefill chunk commit (``ROLE_PREFILL``), a fused-K decode
+    step with the on-device sampling chain (``ROLE_DECODE``), or a
+    speculative verify (``ROLE_VERIFY``) — the engine's whole tick as a
+    single program, so the compile/warmup space collapses from the
+    (decode + verify-chain + multistep + packed-B_p) cross-product to
+    one variant per (window, sampling-mode).
+
+    ``token_block`` int32 ``[B, S]``: column 0 is a decode/verify row's
+    pending token (last emitted, unfed) or a prefill row's first chunk
+    token; verify rows carry their draft in columns ``1..draft_len``;
+    prefill rows carry their chunk in columns ``0..C-1``; everything
+    past ``counts[b]`` is padding.  ``offsets`` is a prefill row's
+    absolute chunk write base (other roles read their cache length);
+    ``counts`` is how many leading block columns really commit (0 parks
+    the row — see :func:`_commit_block_at`); ``active`` gates emission
+    and length advance exactly like the split programs.
+
+    The wide forward IS :func:`verify_ragged`'s: a strict cache mask
+    (``key_pos < base[b]``) joined with the exact in-chunk causal term
+    in one softmax, so column 0 of a decode row is the same class of
+    computation as a plain decode step (int8kv included — see
+    :func:`_block_verify_deferred`), and a verify row's columns match
+    :func:`verify_ragged` column-for-column.  After the wide step,
+    decode rows run ``steps - 1`` more fused iterations through
+    :func:`decode_multistep` — same EOS/budget latch, same per-step key
+    split, so seeded sampling stays token-for-token reproducible
+    against the split programs.
+
+    ``window`` (STATIC) must cover every row's worst case: a decode
+    row's ``length + steps - 1``, a verify row's ``length``, a prefill
+    row's ``offset`` (see the engine's ``superstep_window`` pre-pick).
+
+    Returns ``(logits [B, S, vocab] f32, tok_block [B, steps], valid
+    [B], greedy [B, S], accepted [B], toks [B, 1], cache, active_out,
+    remaining_out, carry_out)``: ``logits``/``greedy``/``accepted``
+    serve the verify and prefill-finalize consumers; ``tok_block`` /
+    ``valid`` are the decode rows' emissions (column layout of
+    :func:`decode_multistep`); ``lengths`` advance on-device by each
+    decode row's emitted count and each verify row's ``accepted + 1``
+    (prefill rows advance at finalize, engine-side, exactly like the
+    packed path)."""
+    from .sampling import speculative_accept
+
+    b, s = token_block.shape
+    quant = isinstance(cache, QuantRaggedKVCache)
+    lengths = cache.lengths
+    capacity = (cache.k8 if quant else cache.k).shape[3]
+    if window is None:
+        window = capacity
+    window = min(int(window), capacity)
+
+    is_dec = roles == ROLE_DECODE
+    is_ver = roles == ROLE_VERIFY
+    is_pre = roles == ROLE_PREFILL
+    # Write/read base per row: a prefill row sits at its chunk offset
+    # (its length stays 0 until finalize), every other role at its
+    # cache length — the one indirection that lets three programs share
+    # a forward.
+    base = jnp.where(is_pre, offsets, lengths).astype(jnp.int32)
+
+    x = jnp.take(params["embed"], token_block, axis=0).astype(dtype)
+    positions = base[:, None] + jnp.arange(s)[None, :]  # [B, S]
+    cos, sin = rope_cos_sin(positions, cfg, jnp.float32)
+
+    key_pos = jnp.arange(window)
+    # STRICT cache mask (verify_ragged's): no block position has been
+    # written yet, so every query sees exactly key_pos < base[b]; the
+    # block's own earlier columns are attended via the exact in-chunk
+    # causal term.
+    valid_mask = key_pos[None, :] < base[:, None]  # [B, W]
+    mask_bias = jnp.where(valid_mask, 0.0, -1e9).astype(jnp.float32)[
+        :, None, None
+    ]
+    qpos = jnp.arange(s)
+    chunk_causal = qpos[:, None] >= qpos[None, :]
+    chunk_bias = jnp.where(chunk_causal, 0.0, -1e9).astype(jnp.float32)[
+        None, None, None
+    ]
+
+    nlayers = cfg.num_layers
+    kv_dtype = x.dtype
+    acc_k = jnp.zeros((nlayers, b, s, cfg.num_kv_heads, cfg.head_dim), kv_dtype)
+    acc_v = jnp.zeros_like(acc_k)
+
+    def idx(tree, l):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, l, axis=0, keepdims=False),
+            tree,
+        )
+
+    def layer_body(l, carry):
+        x, acc_k, acc_v = carry
+        if quant:
+            ck = (
+                lax.dynamic_index_in_dim(cache.k8, l, 0, keepdims=False),
+                lax.dynamic_index_in_dim(cache.k_scale, l, 0, keepdims=False),
+            )
+            cv = (
+                lax.dynamic_index_in_dim(cache.v8, l, 0, keepdims=False),
+                lax.dynamic_index_in_dim(cache.v_scale, l, 0, keepdims=False),
+            )
+        else:
+            ck = lax.dynamic_index_in_dim(cache.k, l, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cache.v, l, 0, keepdims=False)
+        y, k_new, v_new = _block_verify_deferred(
+            x, idx(params["layers"], l), ck, cv, cos, sin, mask_bias,
+            chunk_bias, cfg, window=window,
+        )
+        acc_k = lax.dynamic_update_slice_in_dim(
+            acc_k, k_new[None].astype(kv_dtype), l, axis=0
+        )
+        acc_v = lax.dynamic_update_slice_in_dim(
+            acc_v, v_new[None].astype(kv_dtype), l, axis=0
+        )
+        return y, acc_k, acc_v
+
+    x, k_news, v_news = lax.fori_loop(0, nlayers, layer_body, (x, acc_k, acc_v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = _qmatmul(x, params["lm_head"])  # [B, S, vocab] f32
+
+    cache = _commit_block_at(cache, k_news, v_news, base, counts, quant)
+
+    # Verify consumers: exact greedy acceptance over the wide logits —
+    # columns past a row's draft_len are capped out by the per-row
+    # budget inside speculative_accept, so the static S padding never
+    # changes the accepted count.
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+    accepted, nxt_v = speculative_accept(token_block, greedy, draft_len)
+    ver_act = is_ver & active
+    accepted = jnp.where(ver_act, accepted, 0)
+
+    # Decode rows' step 1 of K: sample column 0 under the same rule and
+    # latch order as decode_multistep's scan body.
+    act_dec = active & is_dec
+    carry, sampled = sample_fn(logits[:, 0, :], sample_carry)
+    nxt_d = jnp.where(act_dec, sampled.astype(jnp.int32), token_block[:, 0])
+    valid0 = act_dec.astype(jnp.int32)
+    remaining1 = remaining - valid0
+    act1 = act_dec & (nxt_d != eos_ids) & (remaining1 > 0)
+
+    lengths1 = lengths + valid0 + jnp.where(ver_act, accepted + 1, 0)
+    cache = cache._replace(lengths=lengths1)
+
+    toks1 = jnp.where(ver_act, nxt_v, nxt_d)[:, None]
+    if steps > 1:
+        (
+            tok_rest, valid_rest, toks2, cache, act2, rem2, carry,
+        ) = decode_multistep(
+            params, toks1, cache, cfg, act1, remaining1, eos_ids,
+            steps - 1, sample_fn, sample_carry=carry, dtype=dtype,
+            window=window,
+        )
+        tok_block_out = jnp.concatenate([nxt_d[:, None], tok_rest], axis=1)
+        valid = valid0 + valid_rest
+    else:
+        tok_block_out = nxt_d[:, None]
+        valid = valid0
+        toks2, act2, rem2 = toks1, act1, remaining1
+
+    return (
+        logits, tok_block_out, valid, greedy, accepted,
+        toks2, cache, act2, rem2, carry,
+    )
+
+
 def _finish_decode(params, x, k_news, v_news, cache, lengths, active, quant, cfg):
     """Shared decode tail: final norm, lm_head, and the cache commit.
 

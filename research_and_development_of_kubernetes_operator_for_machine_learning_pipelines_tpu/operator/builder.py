@@ -161,6 +161,12 @@ def _tpu_pod_spec(
         # contract as the admission/drain flags): an unannotated CR's
         # manifest must stay byte-for-byte what it was.
         container["args"] += ["--decode-steps", str(tpu.decode_steps)]
+    if tpu.unified_step:
+        # Unified ragged super-step engine. Emitted only when true —
+        # same byte-identity contract: an unannotated CR's manifest (and
+        # the unifiedStep: false default) keeps the legacy split-program
+        # engine byte-for-byte.
+        container["args"] += ["--unified-step", "1"]
     if tpu.admission_queue_budget > 0:
         container["args"] += [
             "--admission-queue-budget", str(tpu.admission_queue_budget),

@@ -1645,6 +1645,10 @@ def make_gen_engine(
         # one construction site) — the compiled (K, window) variants
         # must agree for lockstep replay.  1 = single-step loop.
         decode_steps=config.tpu.decode_steps,
+        # Unified ragged super-step: same engine kind on leader and
+        # followers (this one construction site) — the one-per-tick
+        # superstep program must exist on both for lockstep replay.
+        unified_step=config.tpu.unified_step,
         on_dispatch=metrics.inc_dispatch if metrics else None,
         # Packed multi-admission prefill: same batch geometry on leader
         # and followers (this one construction site) — the compiled B_p
@@ -2108,6 +2112,16 @@ def main(argv: list[str] | None = None) -> None:
         "pending).  1 = the single-step tick loop; max 16",
     )
     ap.add_argument(
+        "--unified-step",
+        type=int,
+        default=0,
+        help="1: unified ragged super-step engine — ONE jit program per "
+        "tick covers packed-prefill chunks, fused-K decode, and "
+        "speculative verify via per-row role tensors, collapsing the "
+        "warmup sweep to (window-bucket x sampling-mode) variants; "
+        "0 (default) keeps the split-program engine byte-for-byte",
+    )
+    ap.add_argument(
         "--quantize",
         default="none",
         choices=["none", "int8", "int8kv"],
@@ -2224,6 +2238,7 @@ def main(argv: list[str] | None = None) -> None:
                     "adaptive": bool(args.speculative_adaptive),
                 },
                 "decodeSteps": args.decode_steps,
+                "unifiedStep": bool(args.unified_step),
                 "observability": {
                     "traceRing": args.trace_ring,
                     "deviceTelemetry": bool(args.device_telemetry),

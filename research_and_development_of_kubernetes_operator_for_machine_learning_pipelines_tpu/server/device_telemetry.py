@@ -463,6 +463,12 @@ class CompileObservatory:
         with self._lock:
             self._in_warmup = True
             self.warmup = {"compiles": 0, "seconds": 0.0}
+            # Per-op compile counts at sweep start, so end_warmup can
+            # report the variant INVENTORY the sweep itself compiled —
+            # not lifetime totals polluted by pre-warmup seeds.
+            self._warmup_baseline = {
+                op: rec["compiles"] for op, rec in self.ops.items()
+            }
             self._t_warmup = time.perf_counter()
 
     def end_warmup(self) -> dict:
@@ -471,20 +477,37 @@ class CompileObservatory:
             self.warmup["wall_s"] = round(
                 time.perf_counter() - getattr(self, "_t_warmup", 0.0), 2
             )
+            baseline = getattr(self, "_warmup_baseline", {})
+            inventory = {
+                op: rec["compiles"] - baseline.get(op, 0)
+                for op, rec in sorted(self.ops.items())
+                if rec["compiles"] - baseline.get(op, 0) > 0
+            }
+            self.warmup["ops"] = inventory
             report = dict(self.warmup)
+            report["ops"] = dict(inventory)
+        inv = (
+            " ".join(f"{op}={n}" for op, n in report["ops"].items()) or "-"
+        )
         if report["wall_s"] > self.readiness_budget_s:
             _log.warning(
                 "warmup sweep took %.1fs (> readiness budget %.0fs): "
-                "%d compiles, %.1fs of XLA work — the kubelet may kill "
-                "this pod mid-compile; pre-seed the persistent compile "
-                "cache or raise the readiness window",
+                "%d compiles [%s], %.1fs of XLA work — the kubelet may "
+                "kill this pod mid-compile; pre-seed the persistent "
+                "compile cache or raise the readiness window",
                 report["wall_s"], self.readiness_budget_s,
-                report["compiles"], report["seconds"],
+                report["compiles"], inv, report["seconds"],
             )
         else:
+            # The variant inventory in one structured line: the op ×
+            # count breakdown makes a program-space regression (or the
+            # unified engine's K-fold collapse) visible without diffing
+            # gauge snapshots.
             _compile_log.info(
-                "warmup sweep done compiles=%d compile_s=%.2f wall_s=%.2f",
-                report["compiles"], report["seconds"], report["wall_s"],
+                "warmup sweep done compiles=%d compile_s=%.2f "
+                "wall_s=%.2f ops=[%s]",
+                report["compiles"], report["seconds"],
+                report["wall_s"], inv,
             )
         return report
 
@@ -620,6 +643,21 @@ class LlamaCostModel:
         nbytes += self._kv_bytes(rows, max(0.0, attended - chunk / 2.0))
         return flops, nbytes
 
+    def superstep(self, rows: int, window: int, s: int, steps: int
+                  ) -> tuple[float, float]:
+        """One unified super-step dispatch: the wide ragged forward
+        (``s`` positions/row — the verify-chain / prefill-chunk width)
+        plus ``steps - 1`` chained single-position decode iterations
+        under the same dispatch.  A composition of :meth:`decode`, so
+        the unified engine's cost stays consistent with the split
+        programs it replaces."""
+        flops, nbytes = self.decode(rows, window, s)
+        if steps > 1:
+            f1, b1 = self.decode(rows, window, 1)
+            flops += (steps - 1) * f1
+            nbytes += (steps - 1) * b1
+        return flops, nbytes
+
     def seed(self, tokens: int) -> tuple[float, float]:
         """Prefix-cache seed: a pure K/V copy — read + write, no flops."""
         return 0.0, 2.0 * self._kv_bytes(1, tokens)
@@ -723,7 +761,7 @@ class DeviceTelemetry:
             self.cost is not None
             and self.cost.tp > 1
             and kind in ("decode", "verify", "multistep", "prefill",
-                         "packed-prefill")
+                         "packed-prefill", "superstep")
         ):
             # Analytic collective walls at tp > 1: one dispatch's ICI
             # traffic over the per-chip link rate, split by op — the

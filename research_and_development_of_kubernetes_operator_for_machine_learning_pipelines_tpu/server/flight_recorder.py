@@ -9,12 +9,15 @@ material for that question in three bounded rings:
 - **ticks** — one record per engine device dispatch (kind: ``decode`` /
   ``verify`` / ``multistep`` / ``packed-prefill`` / ``prefill`` /
   ``seed`` / ``kv-import`` — the last is a handed-off prefix landing in
-  the radix cache, host-side) with wall time, batch fill, active slots,
-  queue depth,
+  the radix cache, host-side — / ``superstep``, the unified engine's
+  one-dispatch-per-tick program) with wall time, batch fill, active
+  slots, queue depth,
   tokens emitted, and accepted speculative drafts; fused multi-step
   ticks additionally carry ``steps`` (K scan iterations per dispatch),
   and their per-token instants in the request traces are reconstructed
-  across the tick wall, not stacked on the harvest instant;
+  across the tick wall, not stacked on the harvest instant; superstep
+  ticks carry both ``steps`` and ``roles`` (the {prefill, decode,
+  verify} row mix of the dispatch);
 - **events** — per-request lifecycle points (``enqueued``, ``admission``,
   ``seed``, ``prefill_chunk``, ``first_token``, ``finish``) with the
   cache row they happened on;
@@ -179,6 +182,7 @@ class FlightRecorder:
         spec_accepted: int = 0,
         util: dict | None = None,
         steps: int = 0,
+        roles: dict | None = None,
     ) -> None:
         rec = {
             "ts_us": self._us(t0),
@@ -195,6 +199,12 @@ class FlightRecorder:
             # one dispatch); absent otherwise so single-step tick
             # records stay byte-for-byte what they were.
             rec["steps"] = int(steps)
+        if roles:
+            # Unified super-step ticks only: the per-row role breakdown
+            # ({prefill, decode, verify} counts) of this one dispatch.
+            # Absent for every split-engine tick kind, so the
+            # unified-off record shapes stay byte-for-byte.
+            rec["roles"] = {k: int(v) for k, v in roles.items()}
         if util:
             # Device telemetry only (spec.tpu.observability.
             # deviceTelemetry): mfu / hbm_bw_util from the analytic cost
@@ -320,11 +330,28 @@ class FlightRecorder:
                             "tokens",
                             "spec_accepted",
                             "steps",
+                            "roles",
                         )
                         if k in t
                     },
                 }
             )
+            if "roles" in t:
+                # Role-fill counter track: Perfetto renders one series
+                # per args key, so each unified dispatch's
+                # prefill/decode/verify mix reads as a stacked
+                # staircase next to the tick track.  Superstep ticks
+                # only — the legacy export stays byte-for-byte.
+                out.append(
+                    {
+                        "name": "role_fill",
+                        "cat": "roles",
+                        "ph": "C",
+                        "ts": t["ts_us"],
+                        "pid": 1,
+                        "args": dict(t["roles"]),
+                    }
+                )
             if "mfu" in t:
                 # Device-telemetry counter tracks: Perfetto renders one
                 # counter per name, one series per args key (tick kind)

@@ -186,6 +186,25 @@ def decode_window_buckets(capacity: int) -> list[int]:
     return sorted(out)
 
 
+def superstep_window(
+    decode_hi: int, other_hi: int, steps: int, capacity: int
+) -> int:
+    """Window pre-pick for a MIXED-role unified super-step dispatch.
+
+    One static window serves every row of the tick, so it must cover
+    each role's WORST case: a decode row at next-write position
+    ``decode_hi`` attends up to ``decode_hi + steps - 1`` by the last
+    fused iteration (the scan cannot grow the window mid-flight —
+    exactly ``_step_fused``'s bound), while a verify row at length L or
+    a prefill row committing at offset O attends strictly below L / O
+    (``other_hi`` is the max of those).  Taking the bucket of the max
+    keeps a K-step decode row and a long verify/prefill row sharing one
+    dispatch both inside their worst-case window (pinned exhaustively
+    in tests/test_multistep.py)."""
+    need = max(1, decode_hi + (steps - 1) if decode_hi > 0 else 1, other_hi)
+    return decode_window_bucket(min(need, capacity), capacity)
+
+
 @dataclass
 class _Slot:
     future: Future
@@ -300,6 +319,7 @@ class GenerationEngine:
         on_shed: Callable[[str], None] | None = None,
         telemetry=None,  # device_telemetry.DeviceTelemetry | None
         decode_steps: int = 1,
+        unified_step: bool = False,
         on_dispatch: Callable[[str], None] | None = None,
         watchdog=None,  # watchdog.EngineWatchdog | None (leader-side)
         on_poison: Callable[[str], None] | None = None,
@@ -509,6 +529,24 @@ class GenerationEngine:
                 f"decode_steps must be in [1, 16], got {decode_steps}"
             )
         self._fused = self._decode_steps > 1
+        # Unified ragged super-step (spec.tpu.unifiedStep): ONE program
+        # per tick processes a mixed batch of packed-prefill chunk
+        # commits, fused-K decode rows, and speculative verify rows —
+        # driven by per-row role/offset/budget tensors — so the warmup
+        # sweep compiles one variant per (window-bucket x sampling-mode)
+        # instead of the decode x verify-chain x multistep x packed-B_p
+        # cross-product.  False — the default — builds nothing and keeps
+        # the legacy split-program engine byte-for-byte.
+        self._unified = bool(unified_step)
+        # Static block width of the unified program: wide enough for the
+        # largest verify chain (draft_tokens + 1) and the prefill chunk,
+        # 1 when neither feature is on.  One width -> one compiled shape.
+        sw = 1
+        if self._spec is not None:
+            sw = max(sw, int(self._spec.draft_tokens) + 1)
+        if self._packed:
+            sw = max(sw, int(self._prefill_chunk_size))
+        self._super_width = sw
         self._on_dispatch = on_dispatch
         # Scheduler-loop watchdog (server/watchdog.py): None — the
         # default — keeps the loop byte-for-byte (every beat below is
@@ -714,10 +752,12 @@ class GenerationEngine:
                 active2, remaining2,
             )
 
-        if self._fused:
+        if self._fused and not self._unified:
             # One compiled variant per (K, window) pair, like _verify's
             # (S, window) grid; K is fixed per deployment so the warmup
-            # sweep is |window buckets| x 2 variants.
+            # sweep is |window buckets| x 2 variants.  The unified
+            # engine never builds these: its K steps run inside the
+            # super-step program.
             self._multistep = jit_sharded(
                 _multistep_sampling, donate_argnums=(2, 3),
                 static_argnums=(12, 13),
@@ -965,6 +1005,85 @@ class GenerationEngine:
             _read_chunk_slot, out_shardings=(rep, rep) if rep else None
         )
 
+        def _superstep(
+            params, ids, k, v, lengths, toks, keys, temps, tks, tps,
+            roles, offsets, counts, draft_len, act_in, remaining, eos_in,
+            last_pos, final_lens, slot_keys, r_temps, r_tks, r_tps,
+            window, steps, sampling,
+        ):
+            # The whole tick as ONE program: mixed decode/verify/prefill
+            # rows through llama.super_step_ragged, then the packed
+            # finalize step (rows whose chunk completes the prompt
+            # install their sampling state and sample the first token —
+            # _prefill_chunks_batched's tail, reading the same wide
+            # logits).  ``sampling`` is static like window/steps: the
+            # greedy variant compiles without the chain-sampling work
+            # but keeps the full signature (finalize still installs
+            # per-request sampling state), so the warmup sweep is
+            # |window buckets| x 2 — full stop.
+            from ..models.sampling import (
+                sample_chain_step, sample_logits, split_keys,
+            )
+
+            cache = make_cache(k, v, lengths)
+            if sampling:
+                def sample(lg, carry):
+                    return sample_chain_step(lg, carry, temps, tks, tps)
+
+                carry0 = keys
+            else:
+                def sample(lg, carry):
+                    return carry, jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+                carry0 = None
+            (
+                logits, tok_block, valid, greedy, accepted,
+                toks2, cache, _act2, _rem2, carry2,
+            ) = llama.super_step_ragged(
+                params, ids, cache, cfg,
+                roles=roles, offsets=offsets, counts=counts,
+                draft_len=draft_len, active=act_in, remaining=remaining,
+                eos_ids=eos_in, steps=steps, sample_fn=sample,
+                sample_carry=carry0, dtype=dtype, window=window,
+            )
+            keys_run = carry2 if sampling else keys
+            is_final = last_pos >= 0
+            row = jnp.take_along_axis(
+                logits, jnp.maximum(last_pos, 0)[:, None, None], axis=1
+            )[:, 0]  # [B, vocab]
+            f_carry, use = split_keys(slot_keys)
+            firsts = sample_logits(row, use, r_temps, r_tks, r_tps)
+            tgt = jnp.where(
+                is_final,
+                jnp.arange(max_slots_static, dtype=jnp.int32),
+                jnp.int32(max_slots_static),
+            )
+            kd = jax.random.key_data(keys_run)
+            keys2 = jax.random.wrap_key_data(
+                kd.at[tgt].set(jax.random.key_data(f_carry), mode="drop")
+            )
+            temps2 = temps.at[tgt].set(r_temps, mode="drop")
+            tks2 = tks.at[tgt].set(r_tks, mode="drop")
+            tps2 = tps.at[tgt].set(r_tps, mode="drop")
+            lengths2 = cache.lengths.at[tgt].set(final_lens, mode="drop")
+            toks3 = toks2.at[tgt, 0].set(firsts, mode="drop")
+            ck, cv = cache_repr(cache)
+            return (
+                tok_block, valid, greedy, accepted, firsts,
+                toks3, ck, cv, lengths2, keys2, temps2, tks2, tps2,
+            )
+
+        if self._unified:
+            self._superstep = jit_sharded(
+                _superstep, donate_argnums=(2, 3),
+                static_argnums=(23, 24, 25),
+                out_shardings=(
+                    (rep, rep, rep, rep, rep, rep, kvsh, kvsh,
+                     rep, rep, rep, rep, rep)
+                    if rep else None
+                ),
+            )
+
         if telemetry is not None:
             # Compile observatory: every engine jit dispatch is wrapped so
             # XLA compilations attribute to the op that triggered them
@@ -975,11 +1094,13 @@ class GenerationEngine:
             self._decode = obs.wrap_jit("decode", self._decode)
             self._decode_greedy = obs.wrap_jit("decode", self._decode_greedy)
             self._verify = obs.wrap_jit("verify", self._verify)
-            if self._fused:
+            if self._fused and not self._unified:
                 self._multistep = obs.wrap_jit("multistep", self._multistep)
                 self._multistep_greedy = obs.wrap_jit(
                     "multistep", self._multistep_greedy
                 )
+            if self._unified:
+                self._superstep = obs.wrap_jit("superstep", self._superstep)
             self._prefill_insert = obs.wrap_jit("prefill", self._prefill_insert)
             self._prefill_one_chunk = obs.wrap_jit(
                 "prefill", self._prefill_one_chunk
@@ -1235,13 +1356,15 @@ class GenerationEngine:
                     self._dispatch_seed([(zk, zk)], C)
                     _, sk, sv, _slen = self._seq_state
                     self._read_chunk(sk, sv, jnp.int32(0))
-            if self._packed:
+            if self._packed and not self._unified:
                 # Packed-prefill variants: one executable per B_p bucket
                 # (the ids shape is what jit caches on).  Dispatched, not
                 # raw: followers of a multihost unit must compile the
                 # same buckets.  The fully parked batch shares the live
                 # path's construction site, so warmed shapes cannot
-                # drift from what _packed_tick dispatches.
+                # drift from what _packed_tick dispatches.  The unified
+                # engine has no packed program: chunks ride the
+                # super-step variants swept below.
                 for bucket in self._pack_buckets():
                     self._dispatch_chunks(*self._parked_batch(bucket))
             self._admit_now(
@@ -1272,12 +1395,31 @@ class GenerationEngine:
             # stalls the whole slice.
             inactive = np.zeros((self.max_slots,), bool)
             smallest = decode_window_bucket(1, self.capacity)
-            for window in decode_window_buckets(self.capacity):
-                if window == smallest:
-                    continue  # both variants already compiled above
-                self._dispatch_step(inactive, window, False)
-                self._dispatch_step(inactive, window, True)
-            if self._spec is not None:
+            if self._unified:
+                # THE K-fold collapse: one super-step variant per
+                # (window bucket x sampling mode) covers what the split
+                # engine sweeps as decode x 2 + verify x |chain| +
+                # multistep x 2 + packed B_p buckets.  Every window is
+                # swept (the dummy admits above may land on a larger
+                # bucket when decode_steps pushes length + K - 1 over
+                # the smallest); re-dispatching a compiled variant is a
+                # jit cache hit.  Parked batches (all-idle roles, zero
+                # counts) advance nothing, exactly like the inactive
+                # decode sweeps.
+                for window in decode_window_buckets(self.capacity):
+                    self._dispatch_superstep(
+                        *self._parked_superstep(), window, False
+                    )
+                    self._dispatch_superstep(
+                        *self._parked_superstep(), window, True
+                    )
+            else:
+                for window in decode_window_buckets(self.capacity):
+                    if window == smallest:
+                        continue  # both variants already compiled above
+                    self._dispatch_step(inactive, window, False)
+                    self._dispatch_step(inactive, window, True)
+            if self._spec is not None and not self._unified:
                 # Verify variants: one executable per (draft length,
                 # window) pair — draft lengths are capped to the halving
                 # chain so this sweep stays |chain| x |buckets|, not
@@ -1293,7 +1435,7 @@ class GenerationEngine:
                         self._dispatch_verify(
                             toks, inactive, zero_draft, window
                         )
-            if self._fused:
+            if self._fused and not self._unified:
                 # Fused multi-step variants: one executable per
                 # (K, window) pair, both token rules — K is fixed per
                 # deployment so the sweep is |buckets| x 2.  Dispatched,
@@ -1777,6 +1919,7 @@ class GenerationEngine:
         self, kind: str, t0: float, wall_s: float, *,
         active_slots: int = 0, batch_fill: int = 0, tokens: int = 0,
         spec_accepted: int = 0, cost=None, steps: int = 0,
+        roles: dict | None = None,
     ) -> None:
         """Journal one engine device dispatch (tick-kind metric + flight
         recorder + the dispatches-by-op counter).  Callers skip warmup
@@ -1787,7 +1930,9 @@ class GenerationEngine:
         telemetry only, None otherwise): joined with the wall into MFU /
         bandwidth utilization — gauges plus extra recorder-tick fields.
         ``steps`` > 0 marks a fused multi-step tick (K scan iterations
-        in the one dispatch this record covers)."""
+        in the one dispatch this record covers); ``roles`` is a unified
+        super-step tick's per-row role breakdown ({prefill, decode,
+        verify} counts in the one dispatch)."""
         self.dispatches_total[kind] = self.dispatches_total.get(kind, 0) + 1
         if self._on_dispatch is not None:
             self._on_dispatch(kind)
@@ -1806,6 +1951,7 @@ class GenerationEngine:
                 spec_accepted=spec_accepted,
                 util=util,
                 steps=steps,
+                roles=roles,
             )
 
     def _cost_decode(self, window: int, s: int = 1, steps: int = 1):
@@ -1821,6 +1967,16 @@ class GenerationEngine:
         if steps > 1:
             flops, nbytes = flops * steps, nbytes * steps
         return flops, nbytes
+
+    def _cost_superstep(self, window: int, s: int, steps: int):
+        """Analytic (flops, bytes) of one unified super-step dispatch:
+        the S-wide forward plus ``steps - 1`` single-token fused
+        iterations, all at the pre-picked window."""
+        if self._telemetry is None or self._telemetry.cost is None:
+            return None
+        return self._telemetry.cost.superstep(
+            self.max_slots, window, s, steps
+        )
 
     def _cost_prefill(self, rows: int, chunk: int, attended=None):
         if self._telemetry is None or self._telemetry.cost is None:
@@ -1895,7 +2051,12 @@ class GenerationEngine:
         self._pending.append(prog)
         while prog in self._pending:
             if self._packed:
-                self._packed_tick()
+                # Unified engine: packed chunks ride the super-step
+                # dispatch — there is no separate packed program to run.
+                if self._unified:
+                    self._super_tick()
+                else:
+                    self._packed_tick()
             else:
                 self._chunk_tick()
 
@@ -2787,7 +2948,14 @@ class GenerationEngine:
         With speculation enabled and every occupied slot greedy, the tick
         tries a draft+verify (multi-token) pass first; a tick with no
         drafts anywhere — or any sampling slot — runs the original
-        single-token step unchanged."""
+        single-token step unchanged.
+
+        The unified engine routes EVERY tick through the super-step
+        assembler instead: one dispatch carries the tick's decode,
+        verify, and packed-prefill work together."""
+        if self._unified:
+            self._super_tick()
+            return
         active_np = np.array([s is not None for s in self._slots])
         if not active_np.any():
             # Still report occupancy: without this the gauges freeze at
@@ -3083,6 +3251,382 @@ class GenerationEngine:
             None if remaining is None else np.asarray(remaining),
             None if eos_ids is None else np.asarray(eos_ids),
             int(window), bool(sampling),
+        )
+
+    # -- unified ragged super-step (unifiedStep) -----------------------------
+
+    def _parked_superstep(self) -> tuple:
+        """A fully PARKED unified-dispatch argument set: every row idle
+        (zero counts park all K/V writes, inactive rows emit nothing,
+        ``last_pos == -1`` finalizes nothing) with neutral sampling
+        params.  The warmup window sweep dispatches it as-is;
+        :meth:`_super_tick` overwrites rows with the tick's real roles —
+        ONE construction site, so warmed shapes can never drift from
+        the live call's (the `_parked_batch` discipline)."""
+        B, S = self.max_slots, self._super_width
+        return (
+            np.zeros((B, S), np.int32),   # ids
+            np.zeros((B,), np.int32),     # roles (all ROLE_IDLE)
+            np.zeros((B,), np.int32),     # offsets
+            np.zeros((B,), np.int32),     # counts
+            np.zeros((B,), np.int32),     # draft_len
+            np.zeros((B,), bool),         # active
+            np.zeros((B,), np.int32),     # remaining
+            np.full((B,), -1, np.int32),  # eos_ids
+            np.full((B,), -1, np.int32),  # last_pos
+            np.zeros((B,), np.int32),     # final_lens
+            np.broadcast_to(
+                self._zero_kd, (B,) + self._zero_kd.shape
+            ).copy(),                     # key_data
+            np.zeros((B,), np.float32),   # r_temps
+            np.zeros((B,), np.int32),     # r_tks
+            np.ones((B,), np.float32),    # r_tps
+        )
+
+    def _super_tick(self) -> None:
+        """ONE dispatch per tick: assemble every occupied slot (decode
+        or, on an all-greedy tick with drafts in hand, verify) and up to
+        the packed budget of pending admissions' next chunks (prefill)
+        into per-row role/offset/budget tensors, run the unified
+        super-step program, and harvest all three roles' results from
+        the one readback.  This is `_step` + `_verify_tick` +
+        `_packed_tick` + `_step_fused` collapsed: the split engine's
+        per-tick-kind programs (and their warmup cross-product)
+        disappear, and prefill chunks interleave with decode inside the
+        dispatch instead of between dispatches."""
+        import jax
+
+        from ..models import llama
+
+        B = self.max_slots
+        occupied = np.array([s is not None for s in self._slots])
+        # Packed-admission chunk work riding this tick (seeds stay their
+        # own op: a radix copy is not a forward).
+        chunk_progs: list = []
+        if self._packed and self._pending:
+            C = self._prefill_chunk_size
+            max_chunks = self._prefill_batch
+            if self._prefill_token_budget:
+                max_chunks = min(
+                    max_chunks, max(1, self._prefill_token_budget // C)
+                )
+            for prog in self._pending[:max_chunks]:
+                if prog.cached_tokens and not prog.seeded:
+                    ts = time.perf_counter()
+                    self._dispatch_seed_slot(
+                        prog.cached_kv, prog.slot, prog.cached_tokens
+                    )
+                    prog.seeded = True
+                    prog.cached_kv = []
+                    self.prefix_hits += 1
+                    self.prefix_cached_tokens += prog.cached_tokens
+                    if not self._in_warmup:
+                        if self._on_prefix_hit is not None:
+                            self._on_prefix_hit(prog.cached_tokens)
+                        if self._sync_ticks:
+                            jax.block_until_ready(self._cache_k)
+                        self._record_tick(
+                            "seed", ts, time.perf_counter() - ts,
+                            active_slots=int(occupied.sum()),
+                            batch_fill=1,
+                            cost=self._cost_seed(prog.cached_tokens),
+                        )
+                        self._trace_event(
+                            prog.req.trace, "seed", slot=prog.slot
+                        )
+                else:
+                    chunk_progs.append(prog)
+        if not occupied.any() and not chunk_progs:
+            # Still report occupancy: without this the gauges freeze at
+            # their last busy values and an idle server reads as loaded.
+            if self._on_step is not None and not self._in_warmup:
+                self._on_step(0, 0.0, self._queue.qsize(), len(self._pending))
+            return
+        self._beat("superstep")
+        K = self._decode_steps
+        sampling = any(s is not None and s.sampling for s in self._slots)
+        drafts: list[list[int]] = [[] for _ in range(B)]
+        if (
+            self._spec is not None
+            and not sampling
+            and not self._in_warmup
+            and occupied.any()
+        ):
+            drafts = self._collect_drafts()
+        (
+            ids, roles, offsets, counts, draft_len, active, remaining,
+            eos_ids, last_pos, final_lens, key_data, r_temps, r_tks, r_tps,
+        ) = self._parked_superstep()
+        decode_hi = other_hi = 0
+        n_dec = n_ver = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            pos = slot.prompt_len + len(slot.generated)
+            ids[i, 0] = slot.generated[-1]  # pending (emitted, unfed) token
+            active[i] = True
+            d = drafts[i]
+            if d:
+                roles[i] = llama.ROLE_VERIFY
+                ids[i, 1 : 1 + len(d)] = d
+                draft_len[i] = len(d)
+                counts[i] = len(d) + 1
+                other_hi = max(other_hi, pos)
+                n_ver += 1
+            else:
+                roles[i] = llama.ROLE_DECODE
+                counts[i] = 1
+                remaining[i] = slot.remaining
+                if slot.eos_id is not None:
+                    eos_ids[i] = slot.eos_id
+                decode_hi = max(decode_hi, pos)
+                n_dec += 1
+        C = self._prefill_chunk_size
+        for prog in chunk_progs:
+            i, req = prog.slot, prog.req
+            roles[i] = llama.ROLE_PREFILL
+            off = prog.cached_tokens + prog.next_idx * C
+            offsets[i] = off
+            counts[i] = C
+            ids[i, :C] = prog.chunks[prog.next_idx][0]
+            other_hi = max(other_hi, off)
+            if prog.next_idx == len(prog.chunks) - 1:
+                L = int(req.prompt.size)
+                last_pos[i] = (L - 1) - off
+                final_lens[i] = L
+                r_temps[i] = req.temperature
+                r_tks[i] = req.top_k
+                r_tps[i] = req.top_p
+                key_data[i] = np.asarray(
+                    jax.random.key_data(self._slot_key_for(req))
+                )
+        window = superstep_window(decode_hi, other_hi, K, self.capacity)
+        n_pre = len(chunk_progs)
+        t0 = time.perf_counter()
+        tok_block, valid, greedy, accepted, firsts = self._dispatch_superstep(
+            ids, roles, offsets, counts, draft_len, active, remaining,
+            eos_ids, last_pos, final_lens, key_data, r_temps, r_tks, r_tps,
+            window, sampling,
+        )
+        end = time.perf_counter()
+        finals = sum(
+            1 for prog in chunk_progs
+            if prog.next_idx == len(prog.chunks) - 1
+        )
+        acc_total = int(accepted[occupied].sum()) if n_ver else 0
+        if not self._in_warmup:
+            self.decode_forwards += 1
+            if n_pre:
+                self.prefill_chunks_dispatched += n_pre
+                self.prefill_forwards += 1
+                if self._on_prefill_batch is not None:
+                    self._on_prefill_batch(n_pre)
+            if n_ver:
+                self.spec_verify_ticks += 1
+            wall = end - t0
+            self._record_tick(
+                "superstep", t0, wall,
+                active_slots=int(occupied.sum()),
+                batch_fill=n_pre,
+                tokens=int(valid.sum()) + n_ver + acc_total + finals,
+                spec_accepted=acc_total,
+                steps=K,
+                cost=self._cost_superstep(window, self._super_width, K),
+                roles={"prefill": n_pre, "decode": n_dec, "verify": n_ver},
+            )
+            if self._on_step is not None:
+                self._on_step(
+                    int(occupied.sum()), wall,
+                    self._queue.qsize(), len(self._pending),
+                )
+        # Prefill harvest: the _packed_tick bookkeeping, minus the
+        # dispatch it no longer owns.
+        for i, prog in enumerate(chunk_progs):
+            if prog.req.trace is not None:
+                prog.req.trace.slot = prog.slot
+                prog.req.trace.prefill_chunks += 1
+                self._trace_event(
+                    prog.req.trace, "prefill_chunk", slot=prog.slot
+                )
+            self._maybe_cache_chunk_slot(prog)
+            prog.next_idx += 1
+            if prog.next_idx < len(prog.chunks):
+                continue
+            self._pending.remove(prog)
+            self._reserved.discard(prog.slot)
+            req = prog.req
+            self._slots[prog.slot] = _Slot(
+                future=req.future,
+                remaining=req.max_new_tokens,
+                eos_id=req.eos_id,
+                sampling=req.temperature > 0,
+                on_token=req.on_token,
+                prompt_len=int(req.prompt.size),
+                t_start=t0,
+                request_id=req.request_id,
+                trace=req.trace,
+                **self._spec_slot_state(req),
+            )
+            self._note_ttft(req)
+            self._record_token(prog.slot, int(firsts[prog.slot]))
+        # Decode/verify harvest from the same readback.
+        for i in range(B):
+            if not occupied[i] or self._slots[i] is None:
+                continue
+            slot = self._slots[i]
+            if roles[i] == llama.ROLE_VERIFY:
+                n_prop, n_acc = int(draft_len[i]), int(accepted[i])
+                if slot.draft is not None:
+                    slot.draft.observe(n_prop, n_acc)
+                if n_prop and not self._in_warmup:
+                    self.spec_proposed_tokens += n_prop
+                    self.spec_accepted_tokens += n_acc
+                    if slot.trace is not None:
+                        slot.trace.spec_proposed += n_prop
+                        slot.trace.spec_accepted += n_acc
+                    if self._on_spec is not None:
+                        self._on_spec(n_prop, n_acc)
+                # Emit the accepted draft prefix plus the bonus token;
+                # stop early if the slot finishes (eos/budget/cancel).
+                for j in range(n_acc + 1):
+                    self._record_token(i, int(greedy[i, j]))
+                    if not self._in_warmup:
+                        self.decode_tokens += 1
+                    if self._slots[i] is None:
+                        break
+            else:
+                n = int(valid[i])
+                if n <= 0:
+                    continue
+                # Per-token timestamps spaced across the tick wall (the
+                # _harvest_fused discipline): K tokens on one instant
+                # would zero every ITL observation.
+                base = max(t0, slot.t_last_token)
+                span = max(end - base, 0.0)
+                for j in range(n):
+                    self._record_token(
+                        i, int(tok_block[i, j]), t=base + span * (j + 1) / n
+                    )
+                    if not self._in_warmup:
+                        self.decode_tokens += 1
+                    if self._slots[i] is None:
+                        break
+
+    def _dispatch_superstep(
+        self, ids, roles, offsets, counts, draft_len, active, remaining,
+        eos_ids, last_pos, final_lens, key_data, r_temps, r_tks, r_tps,
+        window, sampling,
+    ):
+        """Broadcast (multihost) then run one unified super-step tick.
+        Unlike the fused multistep burst, every input is a HOST array
+        (the assembler rebuilds role truth each tick), so the replay
+        payload is self-contained — followers keep no chained device
+        state for this op."""
+        args = (
+            ids, roles, offsets, counts, draft_len, active, remaining,
+            eos_ids, last_pos, final_lens, key_data, r_temps, r_tks, r_tps,
+            window, sampling,
+        )
+        if self._channel is None:
+            return self._device_superstep(*args)
+        from .multihost import OP_GEN_SUPERSTEP, encode_message
+
+        payload = encode_message(
+            OP_GEN_SUPERSTEP,
+            {
+                "ids": ids,
+                "roles": roles,
+                "offsets": offsets,
+                "counts": counts,
+                "draft_len": draft_len,
+                "active": active,
+                "remaining": remaining,
+                "eos_ids": eos_ids,
+                "last_pos": last_pos,
+                "final_lens": final_lens,
+                "key_data": key_data,
+                "temps": r_temps,
+                "tks": r_tks,
+                "tps": r_tps,
+                "window": int(window),
+                "sampling": bool(sampling),
+            },
+        )
+        return self._channel.run(
+            payload, lambda: self._device_superstep(*args)
+        )
+
+    def _device_superstep(
+        self, ids, roles, offsets, counts, draft_len, active, remaining,
+        eos_ids, last_pos, final_lens, key_data, r_temps, r_tks, r_tps,
+        window, sampling,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        slot_keys = jax.random.wrap_key_data(jnp.asarray(key_data))
+        (
+            tok_block,
+            valid,
+            greedy,
+            accepted,
+            firsts,
+            self._tokens,
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+        ) = self._superstep(
+            self._params,
+            jnp.asarray(ids),
+            self._cache_k,
+            self._cache_v,
+            self._lengths,
+            self._tokens,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+            jnp.asarray(roles),
+            jnp.asarray(offsets),
+            jnp.asarray(counts),
+            jnp.asarray(draft_len),
+            jnp.asarray(active),
+            jnp.asarray(remaining),
+            jnp.asarray(eos_ids),
+            jnp.asarray(last_pos),
+            jnp.asarray(final_lens),
+            slot_keys,
+            jnp.asarray(r_temps),
+            jnp.asarray(r_tks),
+            jnp.asarray(r_tps),
+            int(window),
+            self._decode_steps,
+            bool(sampling),
+        )
+        return (
+            np.asarray(tok_block), np.asarray(valid), np.asarray(greedy),
+            np.asarray(accepted), np.asarray(firsts),
+        )
+
+    def replay_superstep(
+        self, ids, roles, offsets, counts, draft_len, active, remaining,
+        eos_ids, last_pos, final_lens, key_data, temps, tks, tps,
+        window, sampling,
+    ) -> None:
+        """Follower side of a unified super-step tick (multihost
+        lockstep).  Every input arrives in the payload; no device-
+        resident chain state is consulted."""
+        self._device_superstep(
+            np.asarray(ids), np.asarray(roles), np.asarray(offsets),
+            np.asarray(counts), np.asarray(draft_len), np.asarray(active),
+            np.asarray(remaining), np.asarray(eos_ids),
+            np.asarray(last_pos), np.asarray(final_lens),
+            np.asarray(key_data), np.asarray(temps), np.asarray(tks),
+            np.asarray(tps), int(window), bool(sampling),
         )
 
     # -- self-speculative decoding (n-gram draft + batched verify) -----------
@@ -3388,6 +3932,12 @@ class GenerationEngine:
             self._pending.append(prog)
             popped = True
         if not self._pending:
+            return True
+        if self._unified:
+            # Chunks ride the NEXT super-step dispatch (_super_tick
+            # consumes up to the packed budget of pending admissions as
+            # prefill rows); a failure there runs _loop's recovery,
+            # which fails pending packed admissions too.
             return True
         try:
             self._packed_tick()

@@ -53,6 +53,9 @@ OP_GEN_SEED_SLOT = "gen_seed_slot"  # packed prefill: seed a reserved slot row
 OP_GEN_MULTISTEP = "gen_multistep"  # fused K-step decode tick (replayed);
 #   chained ticks of a burst carry None inputs — the device-resident chain
 #   state from each host's OWN previous replay keeps the slice in lockstep
+OP_GEN_SUPERSTEP = "gen_superstep"  # unified ragged super-step tick: every
+#   role (prefill chunks / fused-K decode / speculative verify) in ONE
+#   dispatch; the payload is self-contained host state — no chained inputs
 
 # Fixed-size round-1 header: payload byte length as uint32.  Round 2 is the
 # payload itself.  Two rounds because ``broadcast_one_to_all`` needs every
@@ -312,12 +315,17 @@ def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None
                 if gen_engine is None:
                     raise RuntimeError("GEN op on a unit without a gen engine")
                 gen_engine.replay_multistep(**inputs)
+            elif op == OP_GEN_SUPERSTEP:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_superstep(**inputs)
             else:  # unknown op: skip rather than desync the group
                 _log.warning("follower ignoring unknown op %r", op)
         except Exception:
             if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET, OP_GEN_CHUNK,
                       OP_GEN_INSERT, OP_GEN_SEED, OP_GEN_VERIFY,
-                      OP_GEN_CHUNKS, OP_GEN_SEED_SLOT, OP_GEN_MULTISTEP):
+                      OP_GEN_CHUNKS, OP_GEN_SEED_SLOT, OP_GEN_MULTISTEP,
+                      OP_GEN_SUPERSTEP):
                 # Generation is STATEFUL: if this host failed a step the
                 # leader executed, its cache/lengths shards now disagree
                 # with every other host's, and all in-flight sequences

@@ -1108,6 +1108,13 @@ class TpuSpec:
     # byte-for-byte.  Composes with speculative per slot (draft ticks
     # verify, draft-less ticks fuse) — see _parse_decode_steps.
     decode_steps: int = 1
+    # Unified ragged super-step: ONE jit program per engine tick covers
+    # packed-prefill chunk commits, fused-K decode with on-device
+    # sampling chains, and speculative verify simultaneously (per-row
+    # role tensors), collapsing the warmup sweep to one variant per
+    # (window-bucket x sampling-mode).  False — the default — keeps the
+    # split-program legacy engine byte-for-byte.
+    unified_step: bool = False
     # Engine flight recorder (per-tick journal + request traces at
     # /debug/engine and /debug/trace); traceRing 0 = off, zero overhead.
     observability: ObservabilitySpec = field(default_factory=ObservabilitySpec)
@@ -1143,7 +1150,7 @@ class TpuSpec:
                     "maxInflightBatches", "compileCacheDir", "quantize",
                     "prefillChunk", "prefillBatch", "prefillTokenBudget",
                     "prefixCache", "speculative", "decodeSteps",
-                    "observability", "snapshot",
+                    "unifiedStep", "observability", "snapshot",
                     "warmupFullGrid", "admissionQueueBudget",
                     "drainGraceSeconds",
                 }
@@ -1190,6 +1197,7 @@ class TpuSpec:
             snapshot=SnapshotSpec.from_spec(spec.get("snapshot")),
             speculative=SpeculativeSpec.from_spec(spec.get("speculative")),
             decode_steps=_parse_decode_steps(spec.get("decodeSteps")),
+            unified_step=bool(spec.get("unifiedStep", False)),
             observability=ObservabilitySpec.from_spec(
                 spec.get("observability")
             ),

@@ -184,6 +184,56 @@ def test_tick_steps_field_only_on_multistep_records():
     assert "steps" not in by_kind["decode"]["args"]
 
 
+def test_tick_roles_field_only_on_superstep_records():
+    # Unified super-step ticks carry "roles" (the per-dispatch
+    # {prefill, decode, verify} row mix); every other kind's record
+    # stays byte-for-byte the pre-unified shape — no new key.
+    rec = FlightRecorder(capacity=8)
+    rec.tick("decode", time.perf_counter(), 0.001, tokens=1)
+    rec.tick("multistep", time.perf_counter(), 0.004, tokens=7, steps=4)
+    rec.tick(
+        "superstep", time.perf_counter(), 0.005, tokens=9, steps=4,
+        roles={"prefill": 1, "decode": 2, "verify": 1},
+    )
+    ticks = rec.snapshot()["ticks"]
+    assert "roles" not in ticks[0] and "roles" not in ticks[1]
+    assert ticks[2]["roles"] == {"prefill": 1, "decode": 2, "verify": 1}
+    assert ticks[2]["steps"] == 4
+
+
+def test_chrome_trace_role_fill_counter_tracks():
+    # Perfetto export: superstep ticks emit a "role_fill" counter event
+    # (one series per role) next to the tick track; exports holding no
+    # superstep ticks stay byte-for-byte free of the counter.
+    rec = FlightRecorder(capacity=8)
+    rec.tick("decode", time.perf_counter(), 0.001, tokens=1)
+    doc = json.loads(json.dumps(rec.chrome_trace()))
+    assert not [
+        e for e in doc["traceEvents"] if e.get("name") == "role_fill"
+    ]
+    rec.tick(
+        "superstep", time.perf_counter(), 0.005, tokens=9, steps=4,
+        roles={"prefill": 2, "decode": 1, "verify": 0},
+    )
+    doc = json.loads(json.dumps(rec.chrome_trace()))
+    _chrome_invariants(doc)
+    counters = [
+        e for e in doc["traceEvents"] if e.get("name") == "role_fill"
+    ]
+    assert len(counters) == 1
+    c = counters[0]
+    assert c["ph"] == "C" and c["cat"] == "roles"
+    assert c["args"] == {"prefill": 2, "decode": 1, "verify": 0}
+    # The tick's X event carries the same breakdown in its args.
+    sup = [
+        e for e in doc["traceEvents"]
+        if e["ph"] == "X" and e["name"] == "superstep"
+    ]
+    assert sup and sup[0]["args"]["roles"] == {
+        "prefill": 2, "decode": 1, "verify": 0,
+    }
+
+
 @pytest.mark.slow
 def test_multistep_tick_reconstructs_per_token_timestamps():
     """Multi-token fused ticks must not corrupt ITL/tick accounting: the

@@ -74,6 +74,33 @@ def test_window_buckets_cover_every_fused_pick():
                 )
 
 
+def test_superstep_window_covers_mixed_role_ticks_exhaustively():
+    # MIXED-role dispatches (unifiedStep): a K-step decode row and a
+    # verify/prefill row share ONE window pre-pick.  Exhaustive over
+    # small capacities: for every (decode-high-water, other-high-water, K)
+    # the picked bucket covers BOTH worst cases — the decode row's last
+    # scan step attending decode_hi + K - 1 positions AND the
+    # verify/prefill row's own high-water — and lands on an enumerated
+    # bucket (the warmup sweep compiles exactly that set, so a miss
+    # would be a live-path lazy compile).
+    from tpumlops.server.generation import superstep_window
+
+    for cap in (64, 96):
+        buckets = set(decode_window_buckets(cap))
+        for decode_hi in range(0, cap + 1):
+            for other_hi in range(0, cap + 1):
+                for k in (1, 2, 4, 16):
+                    w = superstep_window(decode_hi, other_hi, k, cap)
+                    assert w in buckets, (cap, decode_hi, other_hi, k, w)
+                    if decode_hi:
+                        assert w >= min(decode_hi + k - 1, cap), (
+                            cap, decode_hi, other_hi, k, w,
+                        )
+                    assert w >= min(other_hi, cap), (
+                        cap, decode_hi, other_hi, k, w,
+                    )
+
+
 def test_engine_rejects_bad_decode_steps():
     # Constructor-level validation fires before any device state is
     # built for out-of-range K (the params dict is never touched).
