@@ -1874,6 +1874,69 @@ def bench_tensor_parallel() -> dict:
     del ladder[1]["outputs"]
     # HARD gate: token-for-token across the whole ladder.
     assert agreement == 1.0, agreement
+
+    # --- dp rung (PR 17): batch parallelism over the cache's row axis.
+    # Same model, twice the burst: dp=1 keeps 4 rows resident (one
+    # chip's worth of cache) and drains 8 requests in two waves — twice
+    # the decode ticks; dp=2 holds 8 rows at the SAME 4 rows/chip and
+    # serves the burst in one wave.  CPU-mesh tok/s stays emulation-
+    # bound, so the environment-independent gates are token agreement
+    # 1.0 and tokens-per-dispatch >= 1.8x (each decode dispatch carries
+    # ~2x the rows; on a real slice that ratio IS the tok/s ratio at
+    # equal per-tick latency, since dp adds no collectives).
+    dp_prompts = prompts + [
+        rng.integers(1, cfg.vocab_size, size=PROMPT).tolist()
+        for _ in range(N_REQ)
+    ]
+
+    def run_dp(dp: int, slots: int) -> dict:
+        mesh_shape = {"dp": dp} if dp > 1 else None
+        p = params
+        if dp > 1:
+            p = partition.shard_llama_params(
+                params, partition.build_serving_mesh(mesh_shape)
+            )
+        engine = GenerationEngine(
+            p, cfg, max_slots=slots, dtype=jnp.bfloat16,
+            mesh_shape=mesh_shape,
+        )
+        engine.start(warmup=True)
+        try:
+            t0 = time.perf_counter()
+            futs = [engine.submit(pr, NEW) for pr in dp_prompts]
+            outs = [np.asarray(f.result(timeout=600)).tolist() for f in futs]
+            wall = time.perf_counter() - t0
+            disp = dict(engine.dispatches_total)
+            tokens = engine.decode_tokens
+        finally:
+            engine.shutdown()
+        decode_disp = sum(
+            disp.get(k, 0) for k in ("decode", "verify", "multistep")
+        )
+        return {
+            "tok_per_s": round(len(dp_prompts) * NEW / wall, 1),
+            "wall_s": round(wall, 2),
+            "dispatch_mix": disp,
+            "tokens_per_dispatch": round(tokens / max(1, decode_disp), 2),
+            "outputs": outs,
+        }
+
+    dp1 = run_dp(1, SLOTS)
+    dp2 = run_dp(2, 2 * SLOTS)
+    flat1 = [t for o in dp1["outputs"] for t in o]
+    flat2 = [t for o in dp2["outputs"] for t in o]
+    dp_agreement = float(np.mean([x == y for x, y in zip(flat1, flat2)]))
+    dp_ratio = round(
+        dp2["tokens_per_dispatch"] / dp1["tokens_per_dispatch"], 2
+    )
+    del dp1["outputs"], dp2["outputs"]
+    # HARD gates: row-sharding must not change a token, and each decode
+    # dispatch must carry ~2x the rows (>= 1.8 leaves slack for ragged
+    # final ticks).
+    assert dp_agreement == 1.0, dp_agreement
+    assert dp_ratio >= 1.8, (dp_ratio, dp1, dp2)
+    ladder["dp1"] = dp1
+    ladder["dp2"] = dp2
     return {
         "requests": N_REQ,
         "new_tokens_per_request": NEW,
@@ -1886,6 +1949,10 @@ def bench_tensor_parallel() -> dict:
         "per_chip_hbm_bytes_tp1": ladder[1]["per_chip_hbm_bytes"],
         "per_chip_hbm_bytes_tp4": ladder[4]["per_chip_hbm_bytes"],
         "token_agreement": agreement,
+        "tok_per_s_dp1": dp1["tok_per_s"],
+        "tok_per_s_dp2": dp2["tok_per_s"],
+        "dp_tokens_per_dispatch_ratio": dp_ratio,
+        "dp_token_agreement": dp_agreement,
         "ladder": {str(k): v for k, v in ladder.items()},
         **_device_cost_keys(params, cfg, SLOTS, ladder[1]["tok_per_s"]),
         "note": (
@@ -1894,6 +1961,211 @@ def bench_tensor_parallel() -> dict:
             "ledgers at every tp (no per-tick gather, no extra host "
             "round-trips).  per_chip_hbm_bytes counts sharded weights "
             "exactly (shard shapes) + heads/tp KV rows."
+        ),
+    }
+
+
+def bench_long_context() -> dict:
+    """Long-context serving: sp ring-attention prefill (spec.tpu.meshShape
+    sp + spPrefillThreshold) — the 2k/8k/32k ladder, sp off/on.
+
+    Measured rung (2k, real engine on the forced host mesh): one cold
+    2048-token prompt per engine at sp off / {"sp": 1} / sp=2 / sp=4.
+    Long prompts route through the ONE-dispatch ring prefill
+    ('sp-prefill' in the ledger) instead of the serial chunk ladder;
+    {"sp": 1} is the byte-for-byte pin — identical dispatch mix to the
+    absent mesh, no sp program.  CPU TTFT measures SPMD emulation, so
+    the hard gates are structural: routing fired, the pin held, tokens
+    agreed (bf16 near-tie argmaxes reported, f64 bit-parity lives in
+    tests/test_long_context.py).
+
+    Analytic rungs (8k/32k, 7B-class GQA geometry, v5e constants): tp
+    tops out at num_kv_heads=8, so sp is the only axis that puts more
+    chips on ONE prompt — the ladder prices a 16-chip slice as {tp: 8}
+    (best without sp, 8 chips on the prompt) vs {sp: 4, tp: 4} (all 16).
+    The HBM gate: a one-pass 32k prefill materializes the H x (S/sp)^2
+    f32 score block, 137 TB unsharded (cannot exist) vs ~8.6 GB at sp=4
+    (fits beside the tp=4 weight shard) — the ring is what makes a
+    single-dispatch 32k prefill PHYSICAL; est TTFT >= 2x from the chip
+    ratio alone."""
+    jax = _setup_jax()
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama, partition
+    from tpumlops.server.generation import GenerationEngine
+
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        return {
+            "skipped": (
+                f"sp ladder needs >= 4 devices, have {n_dev} (run under "
+                "--xla_force_host_platform_device_count or a multi-chip "
+                "slice)"
+            )
+        }
+
+    cfg = llama.LlamaConfig(
+        vocab_size=4000, hidden_size=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, intermediate_size=704, max_seq=2176,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    PROMPT, NEW, THRESH = 2048, 8, 512
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size, size=PROMPT).tolist()
+
+    def run(mesh_shape) -> dict:
+        p = params
+        if mesh_shape and partition.mesh_device_count(mesh_shape) > 1:
+            p = partition.shard_llama_params(
+                params, partition.build_serving_mesh(mesh_shape)
+            )
+        engine = GenerationEngine(
+            p, cfg, max_slots=1, dtype=jnp.bfloat16,
+            mesh_shape=mesh_shape, sp_prefill_threshold=THRESH,
+        )
+        engine.start(warmup=True)
+        try:
+            ttft: dict = {}
+            ev = threading.Event()
+            t0 = time.perf_counter()
+
+            def cb(_tok):
+                if "s" not in ttft:
+                    ttft["s"] = time.perf_counter() - t0
+                    ev.set()
+
+            fut = engine.submit(prompt, NEW, on_token=cb)
+            out = np.asarray(fut.result(timeout=600)).tolist()
+            wall = time.perf_counter() - t0
+            assert ev.wait(timeout=600)
+            disp = dict(engine.dispatches_total)
+        finally:
+            engine.shutdown()
+        return {
+            "ttft_ms": round(ttft["s"] * 1000, 1),
+            "wall_s": round(wall, 2),
+            "dispatch_mix": disp,
+            "output": out,
+        }
+
+    off = run(None)
+    sp1 = run({"dp": 1, "sp": 1, "tp": 1})
+    measured = {"off": off, "sp1": sp1}
+    for sp in (2, 4):
+        measured[f"sp{sp}"] = run({"sp": sp})
+    # HARD gates, environment-independent:
+    # {"sp": 1} is byte-for-byte the unsharded engine.
+    assert sp1["dispatch_mix"] == off["dispatch_mix"], (
+        sp1["dispatch_mix"], off["dispatch_mix"]
+    )
+    assert "sp-prefill" not in sp1["dispatch_mix"]
+    assert sp1["output"] == off["output"]
+    # A cold >= threshold prompt routes through ONE ring dispatch at
+    # sp > 1 (vs the prompt/chunk-long serial ladder it replaces).
+    for sp in (2, 4):
+        assert measured[f"sp{sp}"]["dispatch_mix"].get("sp-prefill") == 1, (
+            sp, measured[f"sp{sp}"]["dispatch_mix"]
+        )
+    base_out = off["output"]
+    agreement = min(
+        float(np.mean([
+            x == y for x, y in zip(base_out, measured[f"sp{sp}"]["output"])
+        ]))
+        for sp in (2, 4)
+    )
+    for entry in measured.values():
+        del entry["output"]
+
+    # --- analytic 8k/32k rungs: 7B GQA geometry on a 16-chip v5e view.
+    cfg7b = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, max_seq=32768,
+    )
+    wbytes = 2.0 * llama.matmul_param_count(cfg7b)  # bf16 tree
+    hd = cfg7b.head_dim
+    PEAK, HBM = 197e12, 16 * 2**30  # v5e bf16 flops / chip HBM
+    EFF = 0.4  # sustained prefill MFU assumption
+    CHIPS = 16
+
+    def rung(s: int, sp: int, tp: int) -> dict:
+        # One-pass prefill per-chip residency: weight shard + seq-major
+        # K/V scratch (NKV over tp, seq over sp) + the H x (S/sp)^2 f32
+        # ring score block + the ragged cache row (heads over tp).
+        kv_scratch = (
+            2.0 * s * cfg7b.num_kv_heads * hd * 2 * cfg7b.num_layers
+        )
+        scores = cfg7b.num_heads * (s / sp) ** 2 * 4.0
+        per_chip = (
+            wbytes / tp + kv_scratch / (tp * sp) + scores + kv_scratch / tp
+        )
+        flops = 2.0 * llama.matmul_param_count(cfg7b) * s
+        flops += 4.0 * s * (s / 2.0) * cfg7b.num_heads * hd
+        chips_on_prompt = sp * tp
+        ttft = flops / (chips_on_prompt * PEAK * EFF)
+        return {
+            "per_chip_gb": round(per_chip / 1e9, 2),
+            "fits_16gib_chip": bool(per_chip <= HBM),
+            "score_block_gb": round(scores / 1e9, 2),
+            "est_ttft_s": round(ttft, 2),
+            "_ttft_raw": ttft,
+            "chips_on_prompt": chips_on_prompt,
+        }
+
+    analytic = {}
+    for s in (8192, 32768):
+        # Best without sp: tp caps at num_kv_heads=8 -> 8 of 16 chips.
+        analytic[f"{s}_sp1"] = rung(s, 1, 8)
+        analytic[f"{s}_sp4"] = rung(s, 4, 4)
+    # HARD gates: at 32k the unsharded one-pass score block cannot exist
+    # on any chip, the sp=4 rung fits, and putting the idle half of the
+    # slice on the prompt is >= 2x analytic TTFT.
+    assert not analytic["32768_sp1"]["fits_16gib_chip"]
+    assert analytic["32768_sp4"]["fits_16gib_chip"]
+    ttft_gain = round(
+        analytic["32768_sp1"]["_ttft_raw"]
+        / analytic["32768_sp4"]["_ttft_raw"], 2
+    )
+    assert ttft_gain >= 2.0, ttft_gain
+    for entry in analytic.values():
+        del entry["_ttft_raw"]
+
+    return {
+        "prompt_tokens": PROMPT,
+        "new_tokens": NEW,
+        "sp_prefill_threshold": THRESH,
+        "ttft_ms_sp_off": off["ttft_ms"],
+        "ttft_ms_sp2": measured["sp2"]["ttft_ms"],
+        "ttft_ms_sp4": measured["sp4"]["ttft_ms"],
+        "sp_dispatches": 1,
+        "chunk_dispatches_replaced": PROMPT // 512,
+        "token_agreement": round(agreement, 3),
+        "sp1_pin_identical_ledger": True,
+        "fits_32k_sp1": analytic["32768_sp1"]["fits_16gib_chip"],
+        "fits_32k_sp4": analytic["32768_sp4"]["fits_16gib_chip"],
+        "est_ttft_s_32k_sp1": analytic["32768_sp1"]["est_ttft_s"],
+        "est_ttft_s_32k_sp4": analytic["32768_sp4"]["est_ttft_s"],
+        "est_ttft_gain_32k": ttft_gain,
+        "measured_2k": measured,
+        "analytic": analytic,
+        **_device_cost_keys(
+            params, cfg, 1, (PROMPT + NEW) / measured["sp4"]["wall_s"],
+        ),
+        "note": (
+            "CPU-mesh TTFT measures SPMD emulation; the gates are the "
+            "sp routing (one sp-prefill dispatch replaces the serial "
+            "chunk ladder), the {'sp': 1} byte-for-byte ledger pin, and "
+            "the analytic 32k rung: H x (S/sp)^2 f32 ring score block "
+            "137 TB unsharded vs ~8.6 GB at sp=4 on 7B-GQA (nkv=8 caps "
+            "tp at 8, so sp is the only route to all 16 chips; est "
+            "TTFT assumes 40% sustained MFU, ring-permute overlapped)."
         ),
     }
 
@@ -3456,6 +3728,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("multistep_serving", "bench_multistep"),
     ("superstep_serving", "bench_superstep"),
     ("tensor_parallel_serving", "bench_tensor_parallel"),
+    ("long_context_serving", "bench_long_context"),
     ("packed_prefill_serving", "bench_packed_prefill"),
     ("admission_control_serving", "bench_admission_control"),
     ("observability_serving", "bench_observability"),
@@ -3480,7 +3753,18 @@ SCENARIO_SCHEMAS: dict = {
         "tok_per_s_tp1", "tok_per_s_tp2", "tok_per_s_tp4",
         "dispatches_per_token_tp1", "dispatches_per_token_tp4",
         "per_chip_hbm_bytes_tp1", "per_chip_hbm_bytes_tp4",
+        "tok_per_s_dp1", "tok_per_s_dp2",
+        "dp_tokens_per_dispatch_ratio", "dp_token_agreement",
         "token_agreement", "mfu", "hbm_peak_bytes",
+    ),
+    "long_context_serving": (
+        "prompt_tokens", "new_tokens", "sp_prefill_threshold",
+        "ttft_ms_sp_off", "ttft_ms_sp2", "ttft_ms_sp4",
+        "sp_dispatches", "chunk_dispatches_replaced",
+        "token_agreement", "sp1_pin_identical_ledger",
+        "fits_32k_sp1", "fits_32k_sp4",
+        "est_ttft_s_32k_sp1", "est_ttft_s_32k_sp4", "est_ttft_gain_32k",
+        "mfu", "hbm_peak_bytes",
     ),
     "packed_prefill_serving": (
         "requests", "prompt_tokens", "prefill_chunk", "prefill_batch",
@@ -3655,6 +3939,11 @@ _COMPACT_KEYS = {
     "tensor_parallel_serving": (
         "tok_per_s_tp1", "tok_per_s_tp4",
         "dispatches_per_token_tp4", "per_chip_hbm_bytes_tp4",
+        "dp_tokens_per_dispatch_ratio", "dp_token_agreement",
+        "token_agreement", "mfu", "hbm_peak_bytes"),
+    "long_context_serving": (
+        "ttft_ms_sp_off", "ttft_ms_sp4", "chunk_dispatches_replaced",
+        "fits_32k_sp4", "est_ttft_gain_32k",
         "token_agreement", "mfu", "hbm_peak_bytes"),
     "packed_prefill_serving": (
         "serial_ttft_p50_ms", "packed_ttft_p50_ms",

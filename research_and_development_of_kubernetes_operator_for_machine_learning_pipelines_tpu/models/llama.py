@@ -702,6 +702,93 @@ def decode_step(params, token_ids, cache, cfg, dtype=jnp.bfloat16):
     return forward(params, token_ids, cache, cfg, dtype)
 
 
+def _ring_block(x, lp, cos, sin, cfg, mesh, axis_name):
+    """One decoder layer with ring attention over an ``sp``-sharded
+    sequence (long-prompt prefill; no cache read — the prompt IS the
+    context).  x: [B,S,H] with S sharded over ``axis_name``.  Returns
+    (y, k, v) where k/v are this layer's [B,S,NKV,D] cache rows (k
+    rope'd, exactly what :func:`_block` writes)."""
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    xn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = _qmatmul(xn, lp["q"]).astype(x.dtype).reshape(b, s, nh, hd)
+    k = _qmatmul(xn, lp["k"]).astype(x.dtype).reshape(b, s, nkv, hd)
+    v = _qmatmul(xn, lp["v"]).astype(x.dtype).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    # The ring kernel contracts [B,H,S,D] blocks with matching head
+    # counts — GQA groups are repeated here (an S/n-local broadcast per
+    # ring step, not the full-sequence repeat the decode path avoids).
+    group = nh // nkv
+    kf = jnp.repeat(k, group, axis=2) if group > 1 else k
+    vf = jnp.repeat(v, group, axis=2) if group > 1 else v
+    from ..ops.ring_attention import ring_attention_sharded
+
+    ctx = ring_attention_sharded(
+        q.transpose(0, 2, 1, 3),
+        kf.transpose(0, 2, 1, 3),
+        vf.transpose(0, 2, 1, 3),
+        mesh,
+        causal=True,
+        axis_name=axis_name,
+    )
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    x = x + _qmatmul(ctx, lp["o"]).astype(x.dtype)
+
+    xn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    act = jax.nn.silu(_qmatmul(xn, lp["gate"])) * _qmatmul(xn, lp["up"])
+    down = _qmatmul(act.astype(x.dtype), lp["down"]).astype(x.dtype)
+    return x + down, k, v
+
+
+def prefill_ring(
+    params: dict,
+    input_ids: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    mesh,
+    last_idx: jax.Array,
+    dtype=jnp.bfloat16,
+    axis_name: str = "sp",
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence-parallel prefill: the whole (padded) prompt in ONE pass
+    with the sequence axis sharded over ``axis_name`` and exact ring
+    attention (``ops.ring_attention``) in place of the dense S x S
+    score matrix.
+
+    input_ids: [1, S] padded to a bucket divisible by the sp degree;
+    ``last_idx`` (traced) selects the final REAL row so only a [1, V]
+    logits slice crosses the replicated boundary — never [S, V].
+    Returns ``(last_logits [1,V], k_all, v_all)`` with k_all/v_all
+    stacked [L, 1, S, NKV, D], the position-major seq-scratch layout
+    :func:`insert_sequence` consumes.  Pad rows carry garbage K/V
+    exactly like the padded chunked path — insert length caps reads.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    b, s = input_ids.shape
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(dtype)
+    # Pin activations seq-sharded so the per-token work (norms, MLP,
+    # projections) partitions over sp too, not just the attention.
+    seq_sharded = NamedSharding(mesh, PartitionSpec(None, axis_name, None))
+    x = lax.with_sharding_constraint(x, seq_sharded)
+
+    positions = jnp.arange(s)
+    cos, sin = rope_cos_sin(positions, cfg, jnp.float32)
+
+    def scan_body(carry, lp):
+        y, k, v = _ring_block(carry, lp, cos, sin, cfg, mesh, axis_name)
+        return y, (k, v)
+
+    x, (k_all, v_all) = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)  # [1,1,H]
+    logits = _qmatmul(last[:, 0], params["lm_head"])  # [1, V]
+    return logits, k_all, v_all
+
+
 def generate_greedy(
     params: dict,
     prompt_ids: jax.Array,

@@ -39,10 +39,18 @@ from typing import Any, Mapping
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..parallel import AXIS_TENSOR, build_mesh, match_partition_rules
+from ..parallel import (
+    AXIS_DATA,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    build_mesh,
+    match_partition_rules,
+)
 
 P = PartitionSpec
 TP = AXIS_TENSOR
+DP = AXIS_DATA
+SP = AXIS_SEQ
 
 # Regex path -> PartitionSpec, first match wins (rule ORDER is load-
 # bearing: the quantized scale/q8 rules sit above the bare-matrix rules
@@ -69,9 +77,20 @@ LLAMA_PARTITION_RULES: tuple[tuple[str, PartitionSpec], ...] = (
 # Engine device state outside the param tree.  The ragged cache is
 # head-major [L, B, NKV, T, D]; the prefill scratch is position-major
 # [L, B, T, NKV, D]; the int8kv scale planes share their buffer's rank.
+# Under dp > 1 the ragged cache ALSO shards its row (batch) axis — see
+# ``ragged_kv_spec`` — so each dp shard holds B/dp cache rows and the
+# decode forward partitions on batch with replicated weights.
 RAGGED_KV_SPEC = P(None, None, TP, None, None)
+RAGGED_KV_SPEC_DP = P(None, DP, TP, None, None)
 SEQ_KV_SPEC = P(None, None, None, TP, None)
 REPLICATED = P()
+
+
+def ragged_kv_spec(dp: int) -> PartitionSpec:
+    """The ragged cache's PartitionSpec: heads on tp always; the row
+    (batch) axis joins dp only when that axis is real — ``dp <= 1``
+    keeps the PR 15 spec object byte-for-byte (the ``{dp: 1}`` pin)."""
+    return RAGGED_KV_SPEC_DP if int(dp) > 1 else RAGGED_KV_SPEC
 
 
 def tp_degree(mesh_shape: Mapping[str, int] | None) -> int:
@@ -79,6 +98,20 @@ def tp_degree(mesh_shape: Mapping[str, int] | None) -> int:
     if not mesh_shape:
         return 1
     return int(mesh_shape.get(AXIS_TENSOR, 1))
+
+
+def dp_degree(mesh_shape: Mapping[str, int] | None) -> int:
+    """The ``dp`` axis size of a meshShape (1 when absent/empty)."""
+    if not mesh_shape:
+        return 1
+    return int(mesh_shape.get(AXIS_DATA, 1))
+
+
+def sp_degree(mesh_shape: Mapping[str, int] | None) -> int:
+    """The ``sp`` axis size of a meshShape (1 when absent/empty)."""
+    if not mesh_shape:
+        return 1
+    return int(mesh_shape.get(AXIS_SEQ, 1))
 
 
 def mesh_device_count(mesh_shape: Mapping[str, int] | None) -> int:
@@ -112,11 +145,29 @@ def llama_param_specs(params: Any) -> Any:
     return match_partition_rules(LLAMA_PARTITION_RULES, params)
 
 
+def _spec_on_mesh(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Drop axis names the mesh doesn't carry (NamedSharding rejects
+    them): an ``{sp: N}``-only mesh has no ``tp`` axis, so the rule
+    table's tp entries degrade to replication there, exactly as a
+    size-1 tp axis would."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return PartitionSpec(*(keep(e) for e in spec))
+
+
 def llama_param_shardings(params: Any, mesh: Mesh) -> Any:
     import jax
 
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
+        lambda spec: NamedSharding(mesh, _spec_on_mesh(spec, mesh)),
         llama_param_specs(params),
         is_leaf=lambda x: isinstance(x, PartitionSpec),
     )
@@ -152,10 +203,14 @@ def engine_state_shardings(mesh: Mesh, kv_quant: bool):
     """The generation engine's device-state shardings on ``mesh``:
     ``(replicated, ragged_kv, seq_kv)`` where the kv entries mirror the
     engine's cache repr — a bare NamedSharding for the bf16 cache, a
-    ``(values, scales)`` pair under int8kv."""
+    ``(values, scales)`` pair under int8kv.  When the mesh carries a
+    real ``dp`` axis the ragged cache's row axis shards over it (each
+    dp shard holds B/dp rows; sampling state and token read-backs stay
+    replicated so host slot truth is mesh-shape-independent)."""
+    dp = int(dict(mesh.shape).get(DP, 1))
     rep = NamedSharding(mesh, REPLICATED)
-    ragged = NamedSharding(mesh, RAGGED_KV_SPEC)
-    seq = NamedSharding(mesh, SEQ_KV_SPEC)
+    ragged = NamedSharding(mesh, _spec_on_mesh(ragged_kv_spec(dp), mesh))
+    seq = NamedSharding(mesh, _spec_on_mesh(SEQ_KV_SPEC, mesh))
     if kv_quant:
         return rep, (ragged, ragged), seq
     return rep, ragged, seq

@@ -115,6 +115,7 @@ def _tpu_pod_spec(
             "--prefill-chunk", str(tpu.prefill_chunk or 0),
             "--prefill-batch", str(tpu.prefill_batch),
             "--prefill-token-budget", str(tpu.prefill_token_budget),
+            "--sp-prefill-threshold", str(tpu.sp_prefill_threshold),
             "--prefix-cache", "1" if tpu.prefix_cache.enabled else "0",
             "--prefix-cache-budget-mb", str(tpu.prefix_cache.budget_mb),
             "--prefix-cache-chunk", str(tpu.prefix_cache.chunk_tokens),

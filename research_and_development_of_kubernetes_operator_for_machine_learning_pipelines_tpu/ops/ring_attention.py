@@ -39,7 +39,11 @@ def ring_attention(
     r = lax.axis_index(axis_name)
     b, h, chunk, d = q.shape
     scale = scale if scale is not None else 1.0 / (d**0.5)
-    qf = q.astype(jnp.float32) * scale
+    # Accumulate in at least f32; f64 inputs (the parity-proof harness)
+    # keep f64 accumulation so the online softmax matches the dense
+    # reference to the last ulp instead of quantizing through f32.
+    acc_dtype = jnp.promote_types(q.dtype, jnp.float32)
+    qf = q.astype(acc_dtype) * scale
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -49,8 +53,8 @@ def ring_attention(
         # device (r - t) mod n.
         k_origin = (r - t) % n
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, kk.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+            "bhqd,bhkd->bhqk", qf, kk.astype(acc_dtype),
+            preferred_element_type=acc_dtype,
         )
         if causal:
             q_global = r * chunk + lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
@@ -64,16 +68,16 @@ def ring_attention(
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vv.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+            "bhqk,bhkd->bhqd", p, vv.astype(acc_dtype),
+            preferred_element_type=acc_dtype,
         )
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
         return acc_new, m_new, l_new, kk, vv
 
-    acc0 = jnp.zeros((b, h, chunk, d), jnp.float32)
-    m0 = jnp.full((b, h, chunk, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, chunk, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, chunk, d), acc_dtype)
+    m0 = jnp.full((b, h, chunk, 1), NEG_INF, acc_dtype)
+    l0 = jnp.zeros((b, h, chunk, 1), acc_dtype)
     acc, m, l, _, _ = lax.fori_loop(0, n, step, (acc0, m0, l0, k, v))
     out = acc / jnp.where(l == 0.0, 1.0, l)
     return out.astype(q.dtype)

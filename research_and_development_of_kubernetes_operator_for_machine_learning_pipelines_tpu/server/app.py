@@ -1682,6 +1682,9 @@ def make_gen_engine(
         # nothing; the loader already sharded the params over the same
         # device prefix the engine's mesh covers.
         mesh_shape=dict(config.tpu.mesh_shape),
+        # sp > 1: cold prompts at/over this length prefill through the
+        # ring-attention pass instead of serial chunks.
+        sp_prefill_threshold=config.tpu.sp_prefill_threshold,
     )
 
 
@@ -2033,6 +2036,14 @@ def main(argv: list[str] | None = None) -> None:
         "bursts",
     )
     ap.add_argument(
+        "--sp-prefill-threshold",
+        type=int,
+        default=1024,
+        help="prompt length at/over which a cold prompt prefills via the "
+        "sequence-parallel ring-attention pass (effective only when "
+        "meshShape carries sp > 1)",
+    )
+    ap.add_argument(
         "--prefix-cache",
         type=int,
         default=0,
@@ -2224,6 +2235,7 @@ def main(argv: list[str] | None = None) -> None:
                 "prefillChunk": args.prefill_chunk or None,
                 "prefillBatch": args.prefill_batch,
                 "prefillTokenBudget": args.prefill_token_budget,
+                "spPrefillThreshold": args.sp_prefill_threshold,
                 "prefixCache": {
                     "enabled": bool(args.prefix_cache),
                     "budgetMB": args.prefix_cache_budget_mb,

@@ -293,11 +293,13 @@ def build_hbm_ledger(
     dtype_bytes: int = 2,
     prefix_cache_budget_bytes: int = 0,
     tp: int = 1,
+    dp: int = 1,
 ) -> HbmLedger:
+    dp = max(1, int(dp))
     ledger = HbmLedger(
         kv_bytes_per_row=kv_cache_bytes_per_row(cfg, kv_quant, dtype_bytes),
         max_slots=int(max_slots),
-        chips=max(1, int(tp)),
+        chips=max(1, int(tp)) * dp,
     )
     for dtype, nbytes in weights_bytes_by_dtype(params).items():
         ledger.components[f"weights_{dtype}"] = nbytes
@@ -307,14 +309,16 @@ def build_hbm_ledger(
         ledger.host_components["prefix_cache_budget"] = int(
             prefix_cache_budget_bytes
         )
-    if tp > 1:
+    if tp > 1 or dp > 1:
         for dtype, nbytes in weights_bytes_by_dtype(
             params, per_chip=True
         ).items():
             ledger.per_chip[f"weights_{dtype}"] = nbytes
         row_chip = kv_cache_bytes_per_row(cfg, kv_quant, dtype_bytes, tp=tp)
         ledger.per_chip["kv_bytes_per_row"] = row_chip
-        ledger.per_chip["kv_cache"] = row_chip * int(max_slots)
+        # dp shards the ROW axis: one chip holds max_slots/dp rows (of
+        # its tp heads-shard of each).
+        ledger.per_chip["kv_cache"] = row_chip * (int(max_slots) // dp)
         # Sampling state replicates: every chip holds the whole thing.
         ledger.per_chip["sampling_state"] = sampling_state_bytes(max_slots)
         ledger.per_chip["total"] = sum(
@@ -565,10 +569,17 @@ class LlamaCostModel:
     hidden_size: int = 0
     vocab_size: int = 0
     act_bytes: int = 2
+    # Batch (row) and sequence parallel degrees — dp shards the cache's
+    # row axis (no extra collectives: weights replicate and the logits
+    # all-gather already covers the replicated read-back); sp adds the
+    # ring-permute K/V rotation costed in :meth:`ring_bytes`.
+    dp: int = 1
+    sp: int = 1
 
     @classmethod
     def for_model(cls, params, cfg, kv_quant: bool = False,
-                  dtype_bytes: int = 2) -> "LlamaCostModel":
+                  dtype_bytes: int = 2,
+                  mesh_shape=None) -> "LlamaCostModel":
         import jax
 
         from ..models.llama import matmul_param_count
@@ -579,6 +590,15 @@ class LlamaCostModel:
         )
         hd = cfg.head_dim
         kv_eb = 1 + 4.0 / hd if kv_quant else float(dtype_bytes)
+        # Prefer the declared mesh: under dp the params REPLICATE over
+        # dp*tp devices, so the sharded-device count alone would
+        # over-report tp by the dp factor.
+        if mesh_shape:
+            tp = max(1, int(dict(mesh_shape).get("tp", 1)))
+            dp = max(1, int(dict(mesh_shape).get("dp", 1)))
+            sp = max(1, int(dict(mesh_shape).get("sp", 1)))
+        else:
+            tp, dp, sp = param_device_count(params), 1, 1
         return cls(
             matmul_params=matmul_param_count(cfg),
             weight_bytes=wbytes,
@@ -587,10 +607,12 @@ class LlamaCostModel:
             num_kv_heads=cfg.num_kv_heads,
             head_dim=hd,
             kv_elem_bytes=kv_eb,
-            tp=param_device_count(params),
+            tp=tp,
             hidden_size=int(getattr(cfg, "hidden_size", 0)),
             vocab_size=int(getattr(cfg, "vocab_size", 0)),
             act_bytes=int(dtype_bytes),
+            dp=dp,
+            sp=sp,
         )
 
     def collective_bytes(self, rows: int, s: int = 1) -> dict[str, float]:
@@ -662,6 +684,28 @@ class LlamaCostModel:
         """Prefix-cache seed: a pure K/V copy — read + write, no flops."""
         return 0.0, 2.0 * self._kv_bytes(1, tokens)
 
+    def sp_prefill(self, tokens: int) -> tuple[float, float]:
+        """One ring-attention prefill pass over a ``tokens``-long padded
+        prompt: same total flops/bytes as a fused prefill of the whole
+        prompt (the ring changes WHERE the S x S work runs — S/sp per
+        device — not how much exists)."""
+        return self.prefill(1, tokens)
+
+    def ring_bytes(self, tokens: int) -> dict[str, float]:
+        """Per-device ICI bytes the sp ring rotation moves in one
+        prefill pass: each device forwards its K/V shard ``sp - 1``
+        times per layer (k and v each, [1, S/sp, NKV, D] blocks).
+        Empty at sp == 1 — no ring exists to estimate."""
+        if self.sp <= 1:
+            return {}
+        shard = float(tokens) / self.sp
+        per_layer = (
+            2.0 * shard * self.num_kv_heads * self.head_dim * self.act_bytes
+        )
+        return {
+            "ring_permute": per_layer * self.num_layers * (self.sp - 1)
+        }
+
 
 # ---------------------------------------------------------------------------
 # Facade the server wires together
@@ -710,21 +754,34 @@ class DeviceTelemetry:
 
     def attach_model(self, params, cfg, max_slots: int,
                      kv_quant: bool = False, dtype_bytes: int = 2,
-                     prefix_cache_budget_bytes: int = 0) -> None:
+                     prefix_cache_budget_bytes: int = 0,
+                     mesh_shape=None) -> None:
         """Build the ledger + cost model once the engine geometry is
         known; exports the per-component HBM gauges.  Peaks scale to the
         device set actually holding the params (the cost model and
-        ledger count the whole sharded model)."""
-        chips = param_device_count(params)
+        ledger count the whole sharded model).  ``mesh_shape`` (when
+        the engine runs one) disambiguates the axes: params replicated
+        over a dp axis span dp*tp devices, which the sharded-device
+        count alone would misread as tp."""
+        if mesh_shape:
+            tp = max(1, int(dict(mesh_shape).get("tp", 1)))
+            dp = max(1, int(dict(mesh_shape).get("dp", 1)))
+            chips = 1
+            for v in dict(mesh_shape).values():
+                chips *= max(1, int(v))
+        else:
+            tp, dp = param_device_count(params), 1
+            chips = tp
         self.peaks = self._chip_peaks.scaled(chips)
         self.ledger = build_hbm_ledger(
             params, cfg, max_slots, kv_quant=kv_quant,
             dtype_bytes=dtype_bytes,
             prefix_cache_budget_bytes=prefix_cache_budget_bytes,
-            tp=chips,
+            tp=tp, dp=dp,
         )
         self.cost = LlamaCostModel.for_model(
-            params, cfg, kv_quant=kv_quant, dtype_bytes=dtype_bytes
+            params, cfg, kv_quant=kv_quant, dtype_bytes=dtype_bytes,
+            mesh_shape=mesh_shape,
         )
         if self._metrics is not None:
             for comp, nbytes in self.ledger.components.items():
@@ -759,9 +816,9 @@ class DeviceTelemetry:
         }
         if (
             self.cost is not None
-            and self.cost.tp > 1
+            and (self.cost.tp > 1 or kind == "sp-prefill")
             and kind in ("decode", "verify", "multistep", "prefill",
-                         "packed-prefill", "superstep")
+                         "packed-prefill", "superstep", "sp-prefill")
         ):
             # Analytic collective walls at tp > 1: one dispatch's ICI
             # traffic over the per-chip link rate, split by op — the
@@ -772,6 +829,11 @@ class DeviceTelemetry:
             # their full per-dispatch traffic, not one token-row's.
             tokens = flops / max(1.0, 2.0 * self.cost.matmul_params)
             coll = self.cost.collective_bytes(tokens)
+            if kind == "sp-prefill":
+                # The ring rotation is the sp axis's collective wall —
+                # per-layer K/V shard forwards, costed per device.
+                coll = dict(coll)
+                coll.update(self.cost.ring_bytes(tokens))
             total_coll = 0.0
             for op, nbytes in coll.items():
                 secs = nbytes / self.peaks.ici_bytes_per_s

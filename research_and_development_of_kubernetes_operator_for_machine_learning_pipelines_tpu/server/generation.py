@@ -323,7 +323,8 @@ class GenerationEngine:
         on_dispatch: Callable[[str], None] | None = None,
         watchdog=None,  # watchdog.EngineWatchdog | None (leader-side)
         on_poison: Callable[[str], None] | None = None,
-        mesh_shape=None,  # {"dp": 1, "tp": N} | None (tensor parallel)
+        mesh_shape=None,  # {"dp": N, "sp": N, "tp": N} | None
+        sp_prefill_threshold: int = 1024,
     ):
         import jax
         import jax.numpy as jnp
@@ -347,36 +348,72 @@ class GenerationEngine:
         dtype = dtype or jnp.bfloat16
         self._dtype = dtype
         self._kv_quant = bool(kv_quant)
-        # Tensor-parallel serving mesh (spec.tpu.meshShape).  None or a
-        # product-1 shape — the default — arms NOTHING: no mesh object,
-        # no sharding handles, and every jit below compiles exactly the
+        # Serving mesh (spec.tpu.meshShape).  None or a product-1 shape
+        # — the default — arms NOTHING: no mesh object, no sharding
+        # handles, and every jit below compiles exactly the
         # single-device program it always did (pinned byte-for-byte in
-        # tests/test_tensor_parallel.py).  With tp > 1 the params arrive
-        # pre-sharded (loader) over the same device prefix this mesh
-        # covers, the KV cache shards its heads axis, sampling state
-        # replicates, and every program compiles with EXPLICIT output
-        # shardings so K/V commits, the on-device sampling chain, and
-        # donated buffers stay sharded across ticks — no per-tick gather.
+        # tests/test_tensor_parallel.py).  Three axes light up:
+        #
+        # - tp > 1: params arrive pre-sharded (loader) over the same
+        #   device prefix this mesh covers, the KV cache shards its
+        #   heads axis, sampling state replicates, and every program
+        #   compiles with EXPLICIT output shardings so K/V commits, the
+        #   on-device sampling chain, and donated buffers stay sharded
+        #   across ticks — no per-tick gather.
+        # - dp > 1: the ragged cache ALSO shards its row (batch) axis —
+        #   each dp shard holds max_slots/dp rows, weights replicate
+        #   over dp, and GSPMD partitions every batched program on the
+        #   row axis.  Slot bookkeeping stays host-side and identical
+        #   (sampling state replicates), so replay op count is
+        #   unchanged; _free_slot spreads admissions across the row
+        #   blocks so shards fill evenly.
+        # - sp > 1: long prompts (>= sp_prefill_threshold tokens, cold
+        #   prefix) prefill in ONE ring-attention pass with the
+        #   sequence axis split over sp (models.llama.prefill_ring),
+        #   then insert through the existing scratch path.
+        #
+        # pp/ep stay rejected: no pipeline or expert machinery exists.
         self._mesh = None
         self._shard_rep = self._shard_kv = self._shard_seq = None
+        self._dp = 1
+        self._sp = 1
+        self._sp_threshold = int(sp_prefill_threshold)
         if mesh_shape:
             from ..models import partition
 
             if partition.mesh_device_count(mesh_shape) > 1:
                 bad = {
                     a: int(n) for a, n in dict(mesh_shape).items()
-                    if a != "tp" and int(n) > 1
+                    if a not in ("dp", "sp", "tp") and int(n) > 1
                 }
                 if bad:
                     raise ValueError(
-                        "the generation engine shards over tp only; "
-                        f"meshShape axes {bad} must be 1 (slots are the "
-                        "batch dimension — scale replicas, not dp)"
+                        "the generation engine shards over dp/sp/tp "
+                        f"only; meshShape axes {bad} must be 1 (no "
+                        "pipeline or expert parallelism exists here)"
                     )
-                # Typed reject BEFORE any device state: an indivisible
+                # Typed rejects BEFORE any device state: an indivisible
                 # axis would otherwise surface as an opaque XLA shape
                 # error at the first warmup dispatch.
                 partition.validate_llama_mesh(cfg, mesh_shape)
+                dp = partition.dp_degree(mesh_shape)
+                sp = partition.sp_degree(mesh_shape)
+                if dp > 1 and self.max_slots % dp != 0:
+                    raise ValueError(
+                        f"meshShape dp={dp} does not divide maxSlots "
+                        f"{self.max_slots}: the ragged cache's row axis "
+                        "shards over dp in equal blocks"
+                    )
+                if sp > 1 and (
+                    sp & (sp - 1) != 0 or sp > _MIN_BUCKET
+                ):
+                    raise ValueError(
+                        f"meshShape sp={sp} must be a power of two <= "
+                        f"{_MIN_BUCKET} so every prefill bucket divides "
+                        "evenly across the ring"
+                    )
+                self._dp = dp
+                self._sp = sp
                 self._mesh = partition.build_serving_mesh(mesh_shape)
                 (
                     self._shard_rep,
@@ -892,6 +929,33 @@ class GenerationEngine:
             ),
         )
 
+        # Sequence-parallel prefill: the whole padded prompt in ONE
+        # ring-attention pass with the sequence split over sp (one
+        # compiled variant per prompt bucket >= the threshold's bucket).
+        # Stacked K/V lands in the donated seq scratch at origin, and
+        # only the last REAL row's logits [1, V] cross the replicated
+        # boundary — the insert then rides the existing _insert_only
+        # path with last_idx = 0.
+        if self._sp > 1:
+            sp_mesh = self._mesh
+
+            def _prefill_sp(params, ids, sk, sv, last_idx):
+                logits, k_all, v_all = llama.prefill_ring(
+                    params, ids, cfg, mesh=sp_mesh, last_idx=last_idx,
+                    dtype=dtype,
+                )
+                z = jnp.int32(0)
+                sk = lax_dus(sk, k_all.astype(sk.dtype), (z, z, z, z, z))
+                sv = lax_dus(sv, v_all.astype(sv.dtype), (z, z, z, z, z))
+                return logits, sk, sv
+
+            self._prefill_sp = jit_sharded(
+                _prefill_sp, donate_argnums=(2, 3),
+                out_shardings=(rep, seqsh, seqsh) if rep else None,
+            )
+        else:
+            self._prefill_sp = None
+
         max_slots_static = self.max_slots
 
         def _prefill_chunks_batched(
@@ -1106,6 +1170,10 @@ class GenerationEngine:
                 "prefill", self._prefill_one_chunk
             )
             self._insert_only = obs.wrap_jit("prefill", self._insert_only)
+            if self._prefill_sp is not None:
+                self._prefill_sp = obs.wrap_jit(
+                    "sp-prefill", self._prefill_sp
+                )
             self._prefill_chunks = obs.wrap_jit(
                 "packed-prefill", self._prefill_chunks
             )
@@ -1121,6 +1189,7 @@ class GenerationEngine:
                 kv_quant=self._kv_quant,
                 dtype_bytes=jnp.dtype(dtype).itemsize,
                 prefix_cache_budget_bytes=prefix_budget,
+                mesh_shape=mesh_shape,
             )
 
         self._slots: list[_Slot | None] = [None] * self.max_slots
@@ -1471,6 +1540,27 @@ class GenerationEngine:
                             future=Future(),
                         )
                     )
+            if self._sp > 1 and self._sp_threshold <= self.capacity:
+                # sp ring-prefill variants: one executable per power-of-
+                # two prompt bucket at or above the routing threshold
+                # (plus the [1, V] insert variant, shared across
+                # buckets).  Dispatched via _admit_now -> _admit_sp so
+                # followers of a multihost unit compile the same ring
+                # programs.  The prompt length >= threshold guarantees
+                # the sp route fires regardless of chunked/fused mode.
+                bucket = prefill_bucket(self._sp_threshold, self.capacity)
+                while True:
+                    self._admit_now(
+                        _Request(
+                            prompt=np.ones((bucket,), np.int32),
+                            max_new_tokens=1,
+                            eos_id=None,
+                            future=Future(),
+                        )
+                    )
+                    if bucket >= self.capacity:
+                        break
+                    bucket = min(bucket * 2, self.capacity)
         finally:
             self._in_warmup = False
             if self._telemetry is not None:
@@ -1854,10 +1944,28 @@ class GenerationEngine:
     # -- scheduler -----------------------------------------------------------
 
     def _free_slot(self) -> int | None:
-        for i, s in enumerate(self._slots):
-            if s is None and i not in self._reserved:
-                return i
-        return None
+        free = [
+            i for i, s in enumerate(self._slots)
+            if s is None and i not in self._reserved
+        ]
+        if not free:
+            return None
+        if self._dp <= 1:
+            return free[0]
+        # dp > 1: the cache's row axis shards in contiguous blocks of
+        # max_slots/dp, so slot index // rows IS the dp shard.  Admit
+        # into the least-loaded shard (ties -> lowest index) — filling
+        # slots 0..k-1 first would park every active row on shard 0 and
+        # idle the rest of the dp axis.
+        rows = self.max_slots // self._dp
+
+        def shard_load(shard: int) -> int:
+            return sum(
+                1 for i in range(shard * rows, (shard + 1) * rows)
+                if self._slots[i] is not None or i in self._reserved
+            )
+
+        return min(free, key=lambda i: (shard_load(i // rows), i))
 
     def _admit(self, req: _Request) -> None:
         import jax
@@ -2039,6 +2147,11 @@ class GenerationEngine:
     def _admit_now(self, req: _Request) -> None:
         """Synchronous admission (warmup): runs the whole chunked pipeline
         at once when chunking is enabled, else the fused path."""
+        if self._sp_eligible(req):
+            # Warmup prompts are cold by construction — same routing the
+            # live admission phases apply.
+            self._admit_sp(req)
+            return
         if self._prefill_chunk_size is None:
             self._admit(req)
             return
@@ -2453,6 +2566,134 @@ class GenerationEngine:
 
         slot_key = jax.random.wrap_key_data(np.asarray(key_data))
         self._device_insert(slot, length, slot_key, temp, tk, tp, last_idx)
+
+    # -- sequence-parallel prefill (meshShape sp > 1) ------------------------
+
+    def _sp_eligible(self, req: _Request) -> bool:
+        """Long cold prompts ride the ring: one sequence-parallel pass
+        instead of L/C serial chunk forwards.  Short prompts and warm
+        prefixes keep their existing paths — a radix-cached prefix
+        already skips the prefill the ring would parallelize."""
+        return (
+            self._sp > 1
+            and int(req.prompt.size) >= self._sp_threshold
+        )
+
+    def _admit_sp(self, req: _Request) -> None:
+        """Admit ``req`` through the sequence-parallel prefill: one ring
+        pass over the bucket-padded prompt, prefix-cache write-back of
+        its full chunks, then the standard scratch insert."""
+        slot_idx = self._free_slot()
+        assert slot_idx is not None
+        L = int(req.prompt.size)
+        bucket = prefill_bucket(L, self.capacity)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :L] = req.prompt
+        self._beat("prefill")
+        ts = time.perf_counter()
+        self._dispatch_sp_prefill(ids, L)
+        if not self._in_warmup:
+            self.prefill_forwards += 1
+            self._sync_seq_state()
+            self._record_tick(
+                "sp-prefill", ts, time.perf_counter() - ts,
+                active_slots=sum(s is not None for s in self._slots),
+                batch_fill=1,
+                cost=self._cost_sp_prefill(bucket),
+            )
+            self._trace_event(req.trace, "sp_prefill")
+        self._cache_sp_chunks(req)
+        slot_key = self._slot_key_for(req)
+        t0 = time.perf_counter()
+        # The ring pass already selected the final real row: last_idx 0
+        # indexes the [1, V] logits it returned.
+        first = self._dispatch_insert(
+            slot_idx, L, slot_key, req.temperature, req.top_k, req.top_p,
+            last_idx=0,
+        )
+        if not self._in_warmup:
+            if self._sync_ticks:
+                first = int(first)
+            self._record_tick(
+                "prefill", t0, time.perf_counter() - t0,
+                active_slots=sum(s is not None for s in self._slots),
+                batch_fill=1, tokens=1,
+            )
+        if req.trace is not None:
+            req.trace.slot = slot_idx
+        self._slots[slot_idx] = _Slot(
+            future=req.future,
+            remaining=req.max_new_tokens,
+            eos_id=req.eos_id,
+            sampling=req.temperature > 0,
+            on_token=req.on_token,
+            prompt_len=L,
+            t_start=t0,
+            request_id=req.request_id,
+            trace=req.trace,
+            **self._spec_slot_state(req),
+        )
+        self._note_ttft(req)
+        self._record_token(slot_idx, int(first))
+
+    def _cache_sp_chunks(self, req: _Request) -> None:
+        """Radix write-back after a ring prefill: every FULL chunk of the
+        prompt (pad-garbage tails never enter the cache), read from the
+        freshly filled scratch — future shared-prefix requests seed from
+        these exactly as if the chunked path had prefilled them."""
+        if self._prefix_cache is None or self._in_warmup:
+            return
+        import jax.numpy as jnp
+
+        C = self._prefill_chunk_size
+        if C is None:
+            return
+        L = int(req.prompt.size)
+        _, sk, sv, _slen = self._seq_state
+        for chunk_idx in range(L // C):
+            if self._prefix_cache.has_chunk(req.prompt, chunk_idx):
+                continue
+            ck, cv = self._read_chunk(sk, sv, jnp.int32(chunk_idx * C))
+            self._prefix_cache.insert_chunk(
+                req.prompt, chunk_idx, np.asarray(ck), np.asarray(cv)
+            )
+
+    def _dispatch_sp_prefill(self, ids: np.ndarray, length: int) -> None:
+        if self._channel is None:
+            self._device_sp_prefill(ids, length)
+            return
+        from .multihost import OP_GEN_SP_PREFILL, encode_message
+
+        payload = encode_message(
+            OP_GEN_SP_PREFILL, {"ids": ids, "length": int(length)}
+        )
+        self._channel.run(
+            payload, lambda: self._device_sp_prefill(ids, length)
+        )
+
+    def _device_sp_prefill(self, ids: np.ndarray, length: int) -> None:
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        seq = llama.KVCache.create(self._cfg, 1, self._dtype)
+        sk0, sv0 = self._put_seq(seq.k), self._put_seq(seq.v)
+        last_row, sk, sv = self._prefill_sp(
+            self._params, jnp.asarray(ids), sk0, sv0,
+            jnp.int32(int(length) - 1),
+        )
+        self._seq_state = (
+            last_row, sk, sv, jnp.asarray(int(length), jnp.int32)
+        )
+
+    def replay_sp_prefill(self, ids, length) -> None:
+        """Follower side of :meth:`_dispatch_sp_prefill` (lockstep)."""
+        self._device_sp_prefill(np.asarray(ids), int(length))
+
+    def _cost_sp_prefill(self, tokens: int):
+        if self._telemetry is None or self._telemetry.cost is None:
+            return None
+        return self._telemetry.cost.sp_prefill(tokens)
 
     # -- packed multi-admission prefill (prefillBatch > 1) -------------------
 
@@ -3882,13 +4123,33 @@ class GenerationEngine:
                 return False
             self._note_admission_wait(req)
             if self._prefill_chunk_size is not None:
-                self._pending.append(self._make_progress(req))
+                prog = self._make_progress(req)
+                if self._sp_eligible(req) and not prog.cached_tokens:
+                    # Long cold prompt: one ring pass now instead of
+                    # queuing L/C serial chunk ticks.  Warm prefixes
+                    # keep the seed + suffix-chunk path — the cache
+                    # already skips the work sp would parallelize.
+                    try:
+                        self._admit_sp(req)
+                    except Exception as exc:
+                        _log.exception("sp prefill failed")
+                        self._note_admission_crash([req])
+                        self._seq_state = None
+                        if not req.future.done():
+                            _safe_fail(req.future, exc)
+                        self._fail_all_and_recover()
+                    continue
+                self._pending.append(prog)
                 return True  # first chunk runs next iteration's admit phase
             try:
-                self._admit(req)
+                if self._sp_eligible(req):
+                    self._admit_sp(req)
+                else:
+                    self._admit(req)
             except Exception as exc:  # keep the scheduler alive
                 _log.exception("admit failed")
                 self._note_admission_crash([req])
+                self._seq_state = None  # a failed sp pass left it stale
                 if not req.future.done():
                     _safe_fail(req.future, exc)
                 self._fail_all_and_recover()
@@ -3927,6 +4188,21 @@ class GenerationEngine:
                 return False
             self._note_admission_wait(req)
             prog = self._make_progress(req)
+            if self._sp_eligible(req) and not prog.cached_tokens:
+                # Long cold prompt: the ring pass (batch-1 scratch, no
+                # reserved row needed) beats packing its L/C chunks
+                # into the batched program one budget at a time.
+                try:
+                    self._admit_sp(req)
+                except Exception as exc:
+                    _log.exception("sp prefill failed")
+                    self._note_admission_crash([req])
+                    self._seq_state = None
+                    if not req.future.done():
+                        _safe_fail(req.future, exc)
+                    self._fail_all_and_recover()
+                popped = True
+                continue
             prog.slot = slot
             self._reserved.add(slot)
             self._pending.append(prog)

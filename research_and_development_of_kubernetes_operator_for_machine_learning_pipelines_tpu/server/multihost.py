@@ -53,6 +53,7 @@ OP_GEN_SEED_SLOT = "gen_seed_slot"  # packed prefill: seed a reserved slot row
 OP_GEN_MULTISTEP = "gen_multistep"  # fused K-step decode tick (replayed);
 #   chained ticks of a burst carry None inputs — the device-resident chain
 #   state from each host's OWN previous replay keeps the slice in lockstep
+OP_GEN_SP_PREFILL = "gen_sp_prefill"  # sp ring prefill: whole prompt, one pass
 OP_GEN_SUPERSTEP = "gen_superstep"  # unified ragged super-step tick: every
 #   role (prefill chunks / fused-K decode / speculative verify) in ONE
 #   dispatch; the payload is self-contained host state — no chained inputs
@@ -315,6 +316,10 @@ def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None
                 if gen_engine is None:
                     raise RuntimeError("GEN op on a unit without a gen engine")
                 gen_engine.replay_multistep(**inputs)
+            elif op == OP_GEN_SP_PREFILL:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_sp_prefill(**inputs)
             elif op == OP_GEN_SUPERSTEP:
                 if gen_engine is None:
                     raise RuntimeError("GEN op on a unit without a gen engine")
@@ -325,7 +330,7 @@ def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None
             if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET, OP_GEN_CHUNK,
                       OP_GEN_INSERT, OP_GEN_SEED, OP_GEN_VERIFY,
                       OP_GEN_CHUNKS, OP_GEN_SEED_SLOT, OP_GEN_MULTISTEP,
-                      OP_GEN_SUPERSTEP):
+                      OP_GEN_SUPERSTEP, OP_GEN_SP_PREFILL):
                 # Generation is STATEFUL: if this host failed a step the
                 # leader executed, its cache/lengths shards now disagree
                 # with every other host's, and all in-flight sequences

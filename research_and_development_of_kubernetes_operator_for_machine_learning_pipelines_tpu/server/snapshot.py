@@ -37,6 +37,15 @@ full tree — or even a full sharded leaf, beyond the one being assembled
 row-split matrices) keep the flat single-copy layout, so a ``tp: 1``
 snapshot's manifest and chunks are byte-for-byte the pre-tp format.
 
+The shard plan is spec-driven, not axis-named: a ``{dp: N}`` mesh (PR 17)
+replicates every weight leaf over dp while tp still splits heads, and the
+plan's slice-start dedup writes each DISTINCT shard block exactly once —
+a dp x tp tree snapshots the same bytes as the tp-only tree, and restore
+reassembles against whatever mesh ``identity.mesh_shape`` names (dp/sp
+axes included) because ``devices_indices_map`` carries the full
+placement.  No dp/sp-specific code exists here; the geometry tests in
+``tests/test_data_parallel.py`` pin that property.
+
 Identity and invalidation: the snapshot is keyed by a content hash of
 ``(model version/uri, quantize mode, mesh shape, format version)``.  Any
 mismatch — a new model version, a different quantize mode, a resharded

@@ -198,6 +198,18 @@ def _parse_prefill_token_budget(value) -> int:
     return budget
 
 
+def _parse_sp_prefill_threshold(value) -> int:
+    """``spec.tpu.spPrefillThreshold``: minimum cold-prompt length (in
+    tokens) that routes through sequence-parallel ring-attention prefill
+    when meshShape carries sp > 1.  Ignored at sp == 1."""
+    threshold = int(value) if value is not None else 1024
+    if threshold < 1:
+        raise ValueError(
+            f"spec.tpu.spPrefillThreshold must be >= 1, got {value!r}"
+        )
+    return threshold
+
+
 def _parse_prefill_chunk(value) -> int | None:
     """Positivity is checkable here; divisibility into the model's KV
     capacity is not (max_seq lives in the artifact, not the CR) — that
@@ -974,8 +986,15 @@ MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
 def _parse_mesh_shape(value) -> dict:
     """Structural meshShape validation at reconcile time: unknown axis
     names and non-positive sizes must land in CR status, not as a pod
-    CrashLoopBackOff at the server's build_mesh."""
-    mesh = dict(value or {"dp": 1, "tp": 8})
+    CrashLoopBackOff at the server's build_mesh.
+
+    An absent meshShape defaults to ``{"dp": 1, "tp": 1}`` — product 1,
+    i.e. NO mesh — matching the server's ``--mesh-shape`` default, so
+    the manifest the operator renders and the engine the pod builds
+    agree byte-for-byte when the field is omitted (the old ``tp: 8``
+    fallback silently demanded an 8-chip slice from a CR that never
+    asked for sharding)."""
+    mesh = dict(value or {"dp": 1, "tp": 1})
     unknown = set(mesh) - set(MESH_AXES)
     if unknown:
         raise ValueError(
@@ -1002,24 +1021,60 @@ def _parse_mesh_shape(value) -> dict:
 def validate_mesh_for_model(
     mesh_shape: Mapping[str, int] | None,
     *,
-    num_kv_heads: int,
+    num_kv_heads: int | None = None,
     num_heads: int | None = None,
     intermediate_size: int | None = None,
     vocab_size: int | None = None,
+    cache_rows: int | None = None,
+    prefill_chunk: int | None = None,
+    chip_count: int | None = None,
 ) -> None:
-    """Reject a ``meshShape`` whose ``tp`` axis the model geometry cannot
-    shard — typed, naming the knob and the offending count.
+    """Reject a ``meshShape`` the model/serving geometry cannot shard —
+    typed, naming the knob and the offending count.
 
     Without this the mismatch surfaces as an opaque XLA shape error at
     the first warmup dispatch (after the weights already streamed).  The
-    KV-head count is the binding constraint (the cache's heads axis is
-    what decode shards); heads/mlp/vocab ride along so every sharded
-    matrix is covered by one message shape.  Called by the server loader
-    and the generation engine with the artifact's geometry in hand; the
-    operator applies the structural half (:func:`_parse_mesh_shape`) at
-    reconcile, where the artifact is not yet readable.
+    KV-head count is the binding constraint for ``tp`` (the cache's
+    heads axis is what decode shards); heads/mlp/vocab ride along so
+    every sharded matrix is covered by one message shape.  ``dp`` must
+    divide the cache-row count (``cache_rows``, i.e. maxSlots — each dp
+    shard owns B/dp rows), ``sp`` the prefill chunk size
+    (``prefill_chunk`` — ring attention splits the sequence axis
+    evenly), and the total ``dp*pp*ep*sp*tp`` must fit ``chip_count``
+    when given.  Called by the server loader and the generation engine
+    with the artifact's geometry in hand; the operator applies the
+    structural half (:func:`_parse_mesh_shape`) at reconcile, where the
+    artifact is not yet readable.
     """
-    tp = int((mesh_shape or {}).get("tp", 1))
+    mesh = dict(mesh_shape or {})
+    tp = int(mesh.get("tp", 1))
+    dp = int(mesh.get("dp", 1))
+    sp = int(mesh.get("sp", 1))
+    if chip_count is not None:
+        total = 1
+        for v in mesh.values():
+            total *= int(v)
+        if total > int(chip_count):
+            raise ValueError(
+                f"spec.tpu.meshShape {mesh} uses {total} devices but the "
+                f"topology provides only {int(chip_count)} chips; "
+                "dp*pp*ep*sp*tp must not exceed the slice or the pod is "
+                "unschedulable"
+            )
+    if dp > 1 and cache_rows is not None and int(cache_rows) % dp != 0:
+        raise ValueError(
+            f"spec.tpu.meshShape dp={dp} does not divide the KV-cache "
+            f"row count (maxSlots) = {int(cache_rows)}; each dp shard "
+            "owns rows/dp cache rows — pick a maxSlots that dp divides "
+            "(or dp: 1)"
+        )
+    if sp > 1 and prefill_chunk is not None and int(prefill_chunk) % sp != 0:
+        raise ValueError(
+            f"spec.tpu.meshShape sp={sp} does not divide the prefill "
+            f"chunk size (prefillChunk) = {int(prefill_chunk)}; ring "
+            "attention splits the sequence axis into sp equal shards — "
+            "pick a chunk that sp divides (or sp: 1)"
+        )
     if tp <= 1:
         return
     checks = (
@@ -1063,7 +1118,7 @@ class TpuSpec:
     """
 
     topology: str = "v5e-8"
-    mesh_shape: Mapping[str, int] = field(default_factory=lambda: {"dp": 1, "tp": 8})
+    mesh_shape: Mapping[str, int] = field(default_factory=lambda: {"dp": 1, "tp": 1})
     replicas: int = 1
     dtype: str = "bfloat16"
     max_batch_size: int = 32
@@ -1091,6 +1146,11 @@ class TpuSpec:
     # much prefill work a tick may batch so in-flight decode streams
     # keep their token cadence under long-prompt bursts (Sarathi-style).
     prefill_token_budget: int = 0
+    # Sequence-parallel ring-attention prefill (meshShape sp > 1): cold
+    # prompts at least this many tokens long prefill with the sequence
+    # axis split across the sp chips (ops/ring_attention.py) instead of
+    # the chunked/fused single-device path.  Ignored when sp == 1.
+    sp_prefill_threshold: int = 1024
     # Radix prefix KV cache: shared prompt prefixes (system prompts, chat
     # templates) prefill once and are copied thereafter.
     prefix_cache: PrefixCacheSpec = field(default_factory=PrefixCacheSpec)
@@ -1149,6 +1209,7 @@ class TpuSpec:
                     "maxBatchSize", "maxBatchDelayMs", "maxSlots",
                     "maxInflightBatches", "compileCacheDir", "quantize",
                     "prefillChunk", "prefillBatch", "prefillTokenBudget",
+                    "spPrefillThreshold",
                     "prefixCache", "speculative", "decodeSteps",
                     "unifiedStep", "observability", "snapshot",
                     "warmupFullGrid", "admissionQueueBudget",
@@ -1192,6 +1253,9 @@ class TpuSpec:
             prefill_batch=prefill_batch,
             prefill_token_budget=_parse_prefill_token_budget(
                 spec.get("prefillTokenBudget")
+            ),
+            sp_prefill_threshold=_parse_sp_prefill_threshold(
+                spec.get("spPrefillThreshold")
             ),
             prefix_cache=prefix_cache,
             snapshot=SnapshotSpec.from_spec(spec.get("snapshot")),
@@ -1347,13 +1411,30 @@ class OperatorConfig:
                     f"unknown tpuTopology {tpu.topology!r}; known: "
                     f"{sorted(TPU_TOPOLOGIES)}"
                 )
-            if tpu.num_devices != info.chips:
+            if tpu.num_devices > info.chips:
+                # Over-subscription only: a mesh SMALLER than the slice
+                # is legal (the server builds it over a device prefix —
+                # a {dp:1, tp:1} debug CR on a v5e-8 pool runs fine,
+                # idle chips and all); a mesh larger than the slice can
+                # never schedule.  "must match" was the old rule — it
+                # made the absent-meshShape default unschedulable on
+                # every topology but v5e-8.
                 raise ValueError(
                     f"meshShape {dict(tpu.mesh_shape)} uses {tpu.num_devices} "
                     f"devices but tpuTopology {tpu.topology!r} provides "
-                    f"{info.chips} chips; they must match or the pod is "
-                    "unschedulable"
+                    f"only {info.chips} chips; dp*pp*ep*sp*tp must not "
+                    "exceed the slice or the pod is unschedulable"
                 )
+            # Serving-geometry axes are checkable at reconcile (the
+            # model's head counts are not — the loader re-validates with
+            # the artifact in hand): dp must divide the cache-row count,
+            # sp the prefill chunk.
+            validate_mesh_for_model(
+                tpu.mesh_shape,
+                cache_rows=tpu.max_slots,
+                prefill_chunk=tpu.prefill_chunk,
+                chip_count=info.chips,
+            )
             if info.hosts > 1 and tpu.replicas > 1:
                 raise ValueError(
                     f"replicas={tpu.replicas} with multi-host topology "

@@ -25,8 +25,18 @@ Usage: ``env JAX_PLATFORMS=cpu python scripts/check_compile_budget.py``
 from __future__ import annotations
 
 import json
+import os
 import sys
 from pathlib import Path
+
+# 8 virtual CPU devices BEFORE jax initializes: the dp/sp sweeps below
+# build real multi-device meshes (same trick as tests/conftest.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 _ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(_ROOT))
@@ -34,17 +44,21 @@ sys.path.insert(0, str(_ROOT))
 BUDGET_PATH = _ROOT / "COMPILE_BUDGET.json"
 
 
-def _sweep(unified: bool) -> dict:
+def _sweep(unified: bool, mesh_shape: dict | None = None,
+           sp_prefill_threshold: int = 1024) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from tpumlops.models import llama
+    from tpumlops.models import llama, partition
     from tpumlops.server.device_telemetry import DeviceTelemetry
     from tpumlops.server.generation import GenerationEngine
     from tpumlops.server.speculative import SpeculativeConfig
 
     cfg = llama.LlamaConfig.tiny(max_seq=64)
     params = llama.init(jax.random.key(0), cfg, dtype=jnp.float32)
+    if mesh_shape:
+        mesh = partition.build_serving_mesh(mesh_shape)
+        params = partition.shard_llama_params(params, mesh)
     telemetry = DeviceTelemetry()
     engine = GenerationEngine(
         params, cfg, max_slots=4, dtype=jnp.float32, decode_steps=4,
@@ -54,6 +68,8 @@ def _sweep(unified: bool) -> dict:
         ),
         prefill_chunk=8, prefill_batch=4,
         unified_step=unified, telemetry=telemetry,
+        mesh_shape=mesh_shape,
+        sp_prefill_threshold=sp_prefill_threshold,
     )
     engine.start(warmup=True)
     engine.shutdown()
@@ -64,6 +80,14 @@ def main() -> int:
     budget = json.loads(BUDGET_PATH.read_text())
     legacy = _sweep(unified=False)
     unified = _sweep(unified=True)
+    # dp shards the EXISTING programs' row axis — zero new variants
+    # allowed.  sp adds the ring-prefill bucket ladder (+ the shared
+    # [1, V] insert variant), a bounded count pinned here so the sp
+    # axis cannot silently regrow the PR 16 collapse.
+    dp = _sweep(unified=True, mesh_shape={"dp": 2, "tp": 1})
+    sp = _sweep(
+        unified=True, mesh_shape={"sp": 2}, sp_prefill_threshold=32
+    )
     ratio = legacy["compiles"] / max(1, unified["compiles"])
     print(
         f"compile-budget: legacy={legacy['compiles']} "
@@ -74,7 +98,28 @@ def main() -> int:
         f"({unified['seconds']:.1f}s) {unified['ops']} "
         f"ratio={ratio:.2f}"
     )
+    dp_extra = dp["compiles"] - unified["compiles"]
+    sp_extra = sp["compiles"] - unified["compiles"]
+    print(
+        f"compile-budget: dp2={dp['compiles']} (extra {dp_extra}) "
+        f"{dp['ops']}"
+    )
+    print(
+        f"compile-budget: sp2={sp['compiles']} (extra {sp_extra}) "
+        f"{sp['ops']}"
+    )
     failures = []
+    if dp_extra > budget["max_dp_extra_compiles"]:
+        failures.append(
+            f"dp=2 adds {dp_extra} jit variants over the unified sweep "
+            f"(budget {budget['max_dp_extra_compiles']}: dp must reshard "
+            "existing programs, not mint new ones)"
+        )
+    if sp_extra > budget["max_sp_extra_compiles"]:
+        failures.append(
+            f"sp=2 adds {sp_extra} jit variants over the unified sweep, "
+            f"budget {budget['max_sp_extra_compiles']}"
+        )
     if unified["compiles"] > budget["max_unified_compiles"]:
         failures.append(
             f"unified jit-variant count {unified['compiles']} exceeds "

@@ -315,6 +315,14 @@ def test_example_crs_parse_through_operator_config():
         doc = yaml.safe_load((PKG_DIR / "deploy" / "examples" / name).read_text())
         cfg = OperatorConfig.from_spec(doc["spec"])
         assert cfg.model_name
+    # The long-context example: sp mesh + threshold must land (and pass
+    # the reconcile-time sp/prefillChunk/chip checks).
+    lc = OperatorConfig.from_spec(yaml.safe_load(
+        (PKG_DIR / "deploy" / "examples" / "llama-longcontext.yaml")
+        .read_text()
+    )["spec"])
+    assert lc.tpu.mesh_shape == {"sp": 4, "tp": 4}
+    assert lc.tpu.sp_prefill_threshold == 8192
     # Field names must really land (unknown keys silently default!).
     assert cfg.backend == "tpu"
     assert cfg.tpu.quantize == "int8kv"

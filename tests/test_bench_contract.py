@@ -226,7 +226,7 @@ for name in ("bench_time_to_100", "bench_iris"):
 for name in ("bench_xgboost", "bench_resnet", "bench_prefix_cache",
              "bench_speculative", "bench_multistep",
              "bench_superstep", "bench_tensor_parallel",
-             "bench_packed_prefill",
+             "bench_long_context", "bench_packed_prefill",
              "bench_observability", "bench_device_telemetry",
              "bench_admission_control", "bench_cold_start",
              "bench_disaggregated", "bench_chaos", "bench_fleet_trace",

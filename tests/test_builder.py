@@ -130,10 +130,31 @@ def test_tpu_unknown_topology_rejected_at_parse():
 
 
 def test_tpu_mesh_topology_chip_mismatch_rejected():
-    # meshShape devices must equal the topology's chip count, else the
-    # google.com/tpu request can never schedule.
-    with pytest.raises(ValueError, match="must match"):
-        cfg(backend="tpu", tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 4}})
+    # meshShape devices must not exceed the topology's chip count, else
+    # the google.com/tpu request can never schedule.  Under-subscription
+    # (tp 4 on a v5e-8) is legal: the mesh covers a device prefix.
+    with pytest.raises(ValueError, match="must not exceed"):
+        cfg(backend="tpu", tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 16}})
+    cfg(backend="tpu", tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 4}})
+
+
+def test_tpu_absent_mesh_shape_defaults_single_device():
+    """The mesh-default audit pin: an absent spec.tpu.meshShape must
+    land as {dp: 1, tp: 1} — the engine/loader no-mesh default — in the
+    parsed config AND the manifest the builder stamps, byte-for-byte
+    what an explicit {dp: 1, tp: 1} produces."""
+    config = cfg(backend="tpu", tpu={"tpuTopology": "v5e-8"})
+    assert config.tpu.mesh_shape == {"dp": 1, "tp": 1}
+    explicit = cfg(
+        backend="tpu",
+        tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 1}},
+    )
+    assert two_version_manifest(config) == two_version_manifest(explicit)
+    container = two_version_manifest(config)["spec"]["predictors"][1][
+        "componentSpecs"
+    ][0]["spec"]["containers"][0]
+    args = " ".join(container["args"])
+    assert '--mesh-shape {"dp": 1, "tp": 1}' in args
 
 
 def test_set_traffic_rewrites_weights():

@@ -691,3 +691,80 @@ def test_validate_mesh_for_model_tp1_never_rejects():
     validate_mesh_for_model({"dp": 1, "tp": 1}, num_kv_heads=3)
     validate_mesh_for_model(None, num_kv_heads=3)
     validate_mesh_for_model({}, num_kv_heads=3)
+
+
+def test_absent_mesh_shape_defaults_to_no_mesh():
+    """The mesh-default audit: absent spec.tpu.meshShape must land as
+    {dp: 1, tp: 1} — the engine/loader no-mesh default — not the old
+    {dp: 1, tp: 8} that silently armed an 8-way mesh the engine never
+    built."""
+    tpu = TpuSpec.from_spec({})
+    assert dict(tpu.mesh_shape) == {"dp": 1, "tp": 1}
+    assert tpu.num_devices == 1
+    # And the default schedules on EVERY topology (under-subscription
+    # is legal; the old == check would have rejected it on v5e-8).
+    OperatorConfig.from_spec(minimal_spec(backend="tpu"))
+
+
+def test_validate_mesh_for_model_dp_rows_divisibility():
+    """Reconcile-time typed reject: dp must divide the cache row count
+    (maxSlots) — the row axis shards in equal blocks."""
+    from tpumlops.utils.config import validate_mesh_for_model
+
+    with pytest.raises(ValueError, match=r"dp=3.*maxSlots.*= 8"):
+        validate_mesh_for_model({"dp": 3}, cache_rows=8)
+    validate_mesh_for_model({"dp": 4}, cache_rows=8)
+    # dp=1 (or rows unknown) never rejects.
+    validate_mesh_for_model({"dp": 1}, cache_rows=7)
+    validate_mesh_for_model({"dp": 3}, cache_rows=None)
+
+
+def test_validate_mesh_for_model_sp_chunk_divisibility():
+    from tpumlops.utils.config import validate_mesh_for_model
+
+    with pytest.raises(ValueError, match=r"sp=4.*prefillChunk.*= 6"):
+        validate_mesh_for_model({"sp": 4}, prefill_chunk=6)
+    validate_mesh_for_model({"sp": 4}, prefill_chunk=8)
+    validate_mesh_for_model({"sp": 1}, prefill_chunk=7)
+    validate_mesh_for_model({"sp": 4}, prefill_chunk=None)
+
+
+def test_validate_mesh_for_model_chip_oversubscription():
+    from tpumlops.utils.config import validate_mesh_for_model
+
+    with pytest.raises(ValueError, match="only 8 chips"):
+        validate_mesh_for_model({"dp": 2, "sp": 2, "tp": 4}, chip_count=8)
+    validate_mesh_for_model({"dp": 2, "tp": 4}, chip_count=8)
+    validate_mesh_for_model({"dp": 2, "tp": 2}, chip_count=8)  # prefix ok
+
+
+def test_mesh_dp_sp_rejections_fire_from_reconcile():
+    """The reconcile wiring, not just the helper: an indivisible dp/sp
+    meshShape in a CR spec fails at OperatorConfig parse (the backend=tpu
+    reconcile path, where the topology table is in hand) with the knob
+    named."""
+    with pytest.raises(ValueError, match="maxSlots"):
+        OperatorConfig.from_spec(minimal_spec(
+            backend="tpu",
+            tpu={"meshShape": {"dp": 3, "tp": 1}, "maxSlots": 8,
+                 "tpuTopology": "v5e-8"},
+        ))
+    with pytest.raises(ValueError, match="prefillChunk"):
+        OperatorConfig.from_spec(minimal_spec(
+            backend="tpu",
+            tpu={"meshShape": {"sp": 2, "tp": 1}, "prefillChunk": 7,
+                 "tpuTopology": "v5e-8"},
+        ))
+    with pytest.raises(ValueError, match="must not exceed"):
+        OperatorConfig.from_spec(minimal_spec(
+            backend="tpu",
+            tpu={"meshShape": {"dp": 4, "tp": 4}, "tpuTopology": "v5e-8"},
+        ))
+
+
+def test_sp_prefill_threshold_parses_and_rejects():
+    tpu = TpuSpec.from_spec({"spPrefillThreshold": 4096})
+    assert tpu.sp_prefill_threshold == 4096
+    assert TpuSpec.from_spec({}).sp_prefill_threshold == 1024
+    with pytest.raises(ValueError, match="spPrefillThreshold"):
+        TpuSpec.from_spec({"spPrefillThreshold": 0})

@@ -54,7 +54,9 @@ def test_default_mesh_builds_no_sharded_state():
         )
 
 
-def test_engine_rejects_non_tp_parallel_axes():
+def test_engine_rejects_non_dp_sp_tp_parallel_axes():
+    """dp/sp/tp are real engine axes (PR 17); pp/ep stay typed-rejected
+    — no pipeline or expert machinery exists to back them."""
     import jax
     import jax.numpy as jnp
 
@@ -63,10 +65,47 @@ def test_engine_rejects_non_tp_parallel_axes():
 
     cfg = _tiny_cfg()
     params = llama.init(jax.random.key(0), cfg)
-    with pytest.raises(ValueError, match="tp only"):
+    with pytest.raises(ValueError, match="dp/sp/tp"):
         GenerationEngine(
             params, cfg, max_slots=2, dtype=jnp.float32,
-            mesh_shape={"dp": 2, "tp": 2},
+            mesh_shape={"pp": 2, "tp": 2},
+        )
+    with pytest.raises(ValueError, match="dp/sp/tp"):
+        GenerationEngine(
+            params, cfg, max_slots=2, dtype=jnp.float32,
+            mesh_shape={"ep": 2},
+        )
+
+
+def test_engine_rejects_indivisible_dp_rows_typed():
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = _tiny_cfg()
+    params = llama.init(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="does not divide maxSlots"):
+        GenerationEngine(
+            params, cfg, max_slots=3, dtype=jnp.float32,
+            mesh_shape={"dp": 2},
+        )
+
+
+def test_engine_rejects_non_power_of_two_sp_typed():
+    import jax
+    import jax.numpy as jnp
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = _tiny_cfg()
+    params = llama.init(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="power of two"):
+        GenerationEngine(
+            params, cfg, max_slots=4, dtype=jnp.float32,
+            mesh_shape={"sp": 3},
         )
 
 
