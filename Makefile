@@ -13,7 +13,8 @@ DOCKER   ?= docker
 
 .PHONY: images operator-image server-image router-image router-bin \
         install uninstall test test-fast test-e2e test-all lint \
-        bench-contract metrics-contract compile-budget verify bench
+        bench-contract metrics-contract compile-budget plan-contract \
+        verify bench
 
 images: operator-image server-image router-image
 
@@ -103,7 +104,16 @@ metrics-contract:
 compile-budget:
 	env JAX_PLATFORMS=cpu python scripts/check_compile_budget.py
 
-verify: lint bench-contract metrics-contract compile-budget
+# Plan-contract gate (ISSUE 18): the offline SLO planner's output is a
+# pure function of (trace, objective, cost model, grid) — re-planning
+# the committed fixture trace must reproduce the committed plan JSON
+# byte-for-byte.  Cost-model drift fails HERE, locally, instead of
+# silently re-shaping fleets the next time a CR's planner runs.
+plan-contract:
+	env JAX_PLATFORMS=cpu python scripts/plan.py --dry-run \
+	  --expect tests/fixtures/journey_plan.json > /dev/null
+
+verify: lint bench-contract metrics-contract compile-budget plan-contract
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

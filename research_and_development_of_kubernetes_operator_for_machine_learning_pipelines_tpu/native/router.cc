@@ -1675,10 +1675,13 @@ std::string journey_json(const Journey& j) {
 }
 
 std::string journeys_json() {
-  char buf[192];
+  // format_version lets offline consumers (the SLO planner's trace
+  // loader) reject a drifted export typed instead of mis-parsing it;
+  // readers tolerate its absence (older exports are version 1).
+  char buf[224];
   snprintf(buf, sizeof(buf),
-           "{\"capacity\":%d,\"recorded\":%llu,\"started_unix\":%.6f,"
-           "\"requests\":[",
+           "{\"format_version\":1,\"capacity\":%d,\"recorded\":%llu,"
+           "\"started_unix\":%.6f,\"requests\":[",
            g_journey_ring, (unsigned long long)g_journeys_total, g_t0_unix);
   std::string out = buf;
   bool first = true;

@@ -182,6 +182,14 @@ def _tpu_pod_spec(
         container["args"] += [
             "--prefix-cache-l2-budget-mb", str(tpu.prefix_cache.l2_budget_mb),
         ]
+    if tpu.slo_class:
+        # Priority admission classes (spec.sloClass). Appended only when
+        # a default class is set — same byte-identity contract.
+        container["args"] += ["--slo-class", tpu.slo_class]
+    if tpu.preemption:
+        # Mid-decode preemption of lower-class slots. Appended only when
+        # enabled — same byte-identity contract.
+        container["args"] += ["--preemption", "1"]
     if tpu.observability.device_telemetry:
         # Appended only when enabled (same byte-identity contract as the
         # admission/drain flags): an unannotated CR's manifest must stay

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -203,6 +204,11 @@ class Reconciler:
         # so _slo_step reuses it instead of issuing a second identical
         # fetch (False = no fetch ran this step; None = fetched blind).
         self._step_engine_obs: object = False
+        # Offline SLO planner (operator/planner.py): plans are pure
+        # functions of (spec.planner, topology, trace), so each is
+        # computed once and cached until the spec or trace file changes
+        # — a reconcile poll must not re-run the grid search.
+        self._plan_cache: dict = {}
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -249,6 +255,11 @@ class Reconciler:
         # capacity is cheaper to sync centrally): one patch when the
         # spec-derived summary differs from what status carries.
         self._sync_capacity_status(outcome.state)
+        # Planner-output sync mirrors it: status.plan appears/refreshes/
+        # clears with one patch when the computed plan differs from what
+        # status carries; a disabled planner on a CR that never had the
+        # key patches nothing (byte-for-byte).
+        self._sync_plan_status(outcome.state)
         # Replica-churn audit runs centrally too (every path, ERROR-
         # parked CRs included): restart counts are observation, not
         # rollout logic, and must keep flowing while a canary is stuck.
@@ -310,12 +321,28 @@ class Reconciler:
         self._restarts_status = None
         self._restarts_known = False
         self._audit_config = None
+        # Offline planner output (status.plan): same explicit-null
+        # contract as capacity, and the same config-error caution — an
+        # unparseable spec leaves the key untouched.
+        self._had_plan_key = prior_status.get("plan") is not None
+        self._prior_plan = prior_status.get("plan")
+        self._plan_status = None
+        self._plan_known = False
         state = PromotionState.from_status(obj.get("status"))
         events: list[Event] = []
         try:
             config = OperatorConfig.from_spec(obj.get("spec") or {})
         except ValueError as e:
             return self._on_config_error(state, str(e), events)
+        # Offline SLO planner: compute/refresh the costed plan before
+        # the capacity summary so applyMode: apply's knob substitution
+        # is what capacity (and every manifest below) describes.  A
+        # planner failure — unreadable/drifted trace, infeasible
+        # objective — is a spec problem and surfaces exactly like one.
+        try:
+            config, state = self._planner_step(config, state)
+        except ValueError as e:
+            return self._on_config_error(state, f"planner: {e}", events)
         self._capacity_status = _capacity_summary(config)
         self._capacity_known = True
         self._audit_config = config
@@ -378,6 +405,72 @@ class Reconciler:
             state = self._autoscale_step(obj, config, state, events)
             state = self._fleet_step(obj, config, state, events)
         return ReconcileOutcome(state, config.monitoring_interval_s, events)
+
+    def _planner_step(
+        self, config: OperatorConfig, state: PromotionState
+    ) -> "tuple[OperatorConfig, PromotionState]":
+        """Offline SLO planner (operator/planner.py): compute the costed
+        plan behind ``spec.planner``, journal a ``PlanRecord`` when it
+        changes, and — under ``applyMode: apply`` — return the config
+        with the chosen knobs substituted so everything downstream
+        (capacity summary, manifests) describes the planned fleet.
+        ``suggest`` (the default) changes NOTHING but ``status.plan``."""
+        if not config.planner.enabled:
+            self._plan_status = None
+            self._plan_known = True
+            return config, state
+        from . import planner as planner_mod
+
+        # Cache key: the planner inputs.  tracePath contributes its
+        # mtime so replacing the export file on disk re-plans without a
+        # spec edit.
+        key_src: dict = {
+            "planner": dataclasses.asdict(config.planner),
+            "topology": config.tpu.topology,
+        }
+        if config.planner.trace_path:
+            try:
+                key_src["traceMtime"] = os.stat(
+                    config.planner.trace_path
+                ).st_mtime_ns
+            except OSError:
+                pass  # load_journey_trace will raise the typed error
+        key = json.dumps(key_src, sort_keys=True, default=str)
+        plan_dict = self._plan_cache.get(key)
+        if plan_dict is None:
+            with self._op_timer("planner"):
+                plan_dict = planner_mod.plan_for_config(config)
+            self._plan_cache.clear()  # one live plan per CR
+            self._plan_cache[key] = plan_dict
+        self._plan_status = plan_dict
+        self._plan_known = True
+        if plan_dict != getattr(self, "_prior_plan", None):
+            rec = planner_mod.PlanRecord(
+                ts=self.clock.now(),
+                wall=time.time(),
+                apply_mode=config.planner.apply_mode,
+                objective=dict(plan_dict.get("objective", {})),
+                knobs=dict(plan_dict.get("knobs", {})),
+                predicted=dict(plan_dict.get("predicted", {})),
+            )
+            state = self._journal(config, state, rec)
+        if config.planner.apply_mode == "apply":
+            config = planner_mod.apply_plan(config, plan_dict)
+        return config, state
+
+    def _sync_plan_status(self, state: PromotionState) -> None:
+        """Quiescent-CR plan sync, mirroring the capacity sync: one
+        patch when the computed plan differs from what status carries
+        (including the clearing null when the planner was disabled)."""
+        if not getattr(self, "_plan_known", False):
+            return  # config never parsed this step: leave status alone
+        plan_dict = self._plan_status
+        prior = getattr(self, "_prior_plan", None)
+        if plan_dict == prior:
+            return
+        if plan_dict is None and not getattr(self, "_had_plan_key", False):
+            return
+        self._patch_status(state)
 
     def _sync_capacity_status(self, state: PromotionState) -> None:
         """Quiescent-CR capacity sync: transitions carry the key on their
@@ -1656,6 +1749,13 @@ class Reconciler:
             elif getattr(self, "_had_restarts_key", False):
                 status.setdefault("restarts", None)
             self._prior_restarts = rs
+        if getattr(self, "_plan_known", False):
+            plan_dict = self._plan_status
+            if plan_dict is not None:
+                status["plan"] = plan_dict
+            elif getattr(self, "_had_plan_key", False):
+                status.setdefault("plan", None)
+            self._prior_plan = plan_dict
         status["conditions"] = state.conditions(
             getattr(self, "_prior_conditions", None), now_iso
         )

@@ -134,7 +134,7 @@ _NP_TO_V2 = {
 # contract (key named + allowed set) stays spelled once.
 _GEN_PARAM_KEYS = frozenset(
     {"max_new_tokens", "eos_id", "temperature", "top_k", "top_p", "seed",
-     "stream", "debug"}
+     "stream", "debug", "slo_class"}
 )
 
 
@@ -597,6 +597,18 @@ class TpuInferenceServer:
                 "top_p": float(params.get("top_p", 1.0)),
                 "seed": int(seed) if seed is not None else None,
             }
+            # Per-request SLO class override (falls back to the engine's
+            # --slo-class default when absent).  Validated here so a typo
+            # 400s before any sibling is admitted.
+            slo_class = params.get("slo_class")
+            if slo_class is not None:
+                slo_class = str(slo_class)
+                from .generation import SLO_CLASSES
+
+                if slo_class not in SLO_CLASSES:
+                    raise ValueError(
+                        f"slo_class {slo_class!r} not in {SLO_CLASSES}"
+                    )
             # Validate every prompt BEFORE admitting any: a bad sibling must
             # not leave earlier ones generating into abandoned futures.
             prompts = [
@@ -628,7 +640,7 @@ class TpuInferenceServer:
                 try:
                     return await self._stream_generation(
                         request, prompts[0], max_new, eos_id, sampling,
-                        codebox, rid,
+                        codebox, rid, slo_class=slo_class,
                     )
                 finally:
                     code = codebox["code"]
@@ -640,7 +652,8 @@ class TpuInferenceServer:
             # abandoned futures.  Raises EngineOverloaded (-> 429 below)
             # before anything is enqueued.
             self.gen_engine.reserve_admission(
-                sum(int(p.size) + max_new for p in prompts)
+                sum(int(p.size) + max_new for p in prompts),
+                slo_class=slo_class,
             )
             traces = [
                 RequestTrace(
@@ -658,6 +671,7 @@ class TpuInferenceServer:
                     request_id=traces[i].request_id,
                     trace=traces[i],
                     est_reserved=True,
+                    slo_class=slo_class,
                 )
                 for i, p in enumerate(prompts)
             ]
@@ -692,13 +706,18 @@ class TpuInferenceServer:
             # error paths).  Nothing reached the engine — clients retry
             # verbatim on another replica.
             code = 429
+            body = {
+                "error": str(e),
+                "reason": e.reason,
+                "retry_after_s": e.retry_after_s,
+                "request_id": request.get("request_id", ""),
+            }
+            # Per-class sheds name the class so dashboards (and clients)
+            # can tell best-effort load-shedding from real overload.
+            if e.slo_class is not None:
+                body["slo_class"] = e.slo_class
             return web.json_response(
-                {
-                    "error": str(e),
-                    "reason": e.reason,
-                    "retry_after_s": e.retry_after_s,
-                    "request_id": request.get("request_id", ""),
-                },
+                body,
                 status=429,
                 headers={"Retry-After": str(e.retry_after_s)},
             )
@@ -735,7 +754,7 @@ class TpuInferenceServer:
 
     async def _stream_generation(
         self, request, prompt, max_new, eos_id, sampling, codebox,
-        request_id: str = "",
+        request_id: str = "", slo_class: str | None = None,
     ) -> web.StreamResponse:
         """SSE token stream: one ``data:`` event per token, then a final
         event with the full sequence.  Client disconnect cancels the
@@ -762,7 +781,7 @@ class TpuInferenceServer:
         _stamp_handoff(request, [trace])
         fut = self.gen_engine.submit(
             prompt, max_new, eos_id, **sampling, on_token=on_token,
-            request_id=request_id, trace=trace,
+            request_id=request_id, trace=trace, slo_class=slo_class,
         )
         fut.add_done_callback(
             lambda f: loop.call_soon_threadsafe(tokens.put_nowait, None)
@@ -1298,12 +1317,15 @@ class TpuInferenceServer:
             )
         except EngineOverloaded as e:
             code = 429
+            body = {
+                "error": str(e),
+                "reason": e.reason,
+                "retry_after_s": e.retry_after_s,
+            }
+            if e.slo_class is not None:
+                body["slo_class"] = e.slo_class
             return web.json_response(
-                {
-                    "error": str(e),
-                    "reason": e.reason,
-                    "retry_after_s": e.retry_after_s,
-                },
+                body,
                 status=429,
                 headers={"Retry-After": str(e.retry_after_s)},
             )
@@ -1685,6 +1707,14 @@ def make_gen_engine(
         # sp > 1: cold prompts at/over this length prefill through the
         # ring-attention pass instead of serial chunks.
         sp_prefill_threshold=config.tpu.sp_prefill_threshold,
+        # SLO classes + mid-decode preemption: the default class every
+        # submit inherits (per-request slo_class overrides) and whether
+        # a waiting higher class may evict a lower-class slot at a tick
+        # boundary.  Leader-side scheduling, but preemption=True also on
+        # followers so the restore program exists for lockstep replay.
+        slo_class=config.tpu.slo_class,
+        preemption=config.tpu.preemption,
+        on_preempt=metrics.inc_preempt if metrics else None,
     )
 
 
@@ -2198,6 +2228,23 @@ def main(argv: list[str] | None = None) -> None:
         "remedy for a wedged device)",
     )
     ap.add_argument(
+        "--slo-class",
+        default="",
+        help="default SLO class for requests that don't carry one "
+        "(interactive | batch | best-effort); arms the priority "
+        "admission queues — higher classes drain first and lower "
+        "classes shed at a fraction of the admission budget",
+    )
+    ap.add_argument(
+        "--preemption",
+        type=int,
+        default=0,
+        help="1: a waiting higher-class request may evict a lower-class "
+        "slot at a tick boundary (KV parked in the prefix cache, "
+        "restored on re-admission with no lost work); requires "
+        "--prefix-cache 1",
+    )
+    ap.add_argument(
         "--log-format",
         default="text",
         choices=["text", "json"],
@@ -2257,6 +2304,8 @@ def main(argv: list[str] | None = None) -> None:
                 },
                 "admissionQueueBudget": args.admission_queue_budget,
                 "drainGraceSeconds": args.drain_grace_seconds,
+                **({"sloClass": args.slo_class} if args.slo_class else {}),
+                "preemption": bool(args.preemption),
                 "snapshot": {
                     "enabled": bool(args.snapshot_dir),
                     **(

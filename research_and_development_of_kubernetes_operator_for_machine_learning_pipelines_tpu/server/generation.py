@@ -78,10 +78,15 @@ class EngineOverloaded(RuntimeError):
     """
 
     def __init__(self, message: str, reason: str = "budget",
-                 retry_after_s: int = 1):
+                 retry_after_s: int = 1, slo_class: str | None = None):
         super().__init__(message)
-        self.reason = reason  # "budget" | "draining"
+        # "budget" | "draining" | "class_<name>" (per-class threshold)
+        self.reason = reason
         self.retry_after_s = int(retry_after_s)
+        # The shed request's SLO class (None when classes are unarmed):
+        # rides the 429 body so dashboards and clients can tell
+        # best-effort load-shedding from real overload.
+        self.slo_class = slo_class
 
 
 class PoisonRequest(ValueError):
@@ -129,6 +134,18 @@ class _Wake:
 
 
 _WAKE = _Wake()
+
+# SLO priority classes (spec.sloClass / per-request "slo_class").
+# Higher priority drains first from the admission queue; under
+# preemption a waiting higher-class request may evict a lower-class
+# slot at a tick boundary.  Order below is priority DESCENDING.
+SLO_CLASSES = ("interactive", "batch", "best-effort")
+_CLASS_PRIORITY = {name: i for i, name in enumerate(reversed(SLO_CLASSES))}
+# Fraction of the admission budget each class may fill before ITS
+# submissions shed (reason "class_<name>"): lower classes give up queue
+# room early so the headroom stays available to interactive traffic.
+_CLASS_BUDGET_FACTOR = {"interactive": 1.0, "batch": 0.75,
+                        "best-effort": 0.5}
 
 _MIN_BUCKET = 16
 
@@ -231,6 +248,15 @@ class _Slot:
     request_id: str = ""
     trace: "object | None" = None
     t_last_token: float = 0.0  # previous token's wall (inter-token latency)
+    # SLO class / preemption state (defaults when classes are unarmed —
+    # the armed engine records the class, and under preemption also the
+    # prompt and sampling params so an evicted slot can be rebuilt
+    # exactly on restore).
+    slo_class: str = "interactive"
+    prompt: np.ndarray | None = None
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
 
 
 @dataclass(eq=False)  # identity semantics: list membership/removal must
@@ -276,6 +302,47 @@ class _Request:
     # admission budget while queued; released exactly once at dequeue
     # (0 = nothing reserved, e.g. budget disabled).
     est_tokens: int = 0
+    slo_class: str = "interactive"
+
+
+@dataclass(eq=False)  # identity semantics (numpy fields)
+class _Preempted:
+    """An evicted mid-decode sequence awaiting re-admission.
+
+    Everything a restore needs to resume the sequence EXACTLY where the
+    eviction cut it: the committed K/V chunks (host copies — the radix
+    cache holds the full-chunk ones too, but an interleaved admission
+    may evict them before restore), the PRNG carry, the pending
+    not-yet-fed token, and the slot bookkeeping.  Queued at the FRONT
+    of its class deque so an evicted sequence re-admits before newer
+    work of its own class — no starvation pile-up behind the flood that
+    evicted it."""
+
+    future: Future
+    remaining: int
+    eos_id: int | None
+    sampling: bool
+    on_token: Callable[[int], None] | None
+    prompt: np.ndarray
+    generated: list[int]
+    t_start: float
+    request_id: str
+    trace: "object | None"
+    slo_class: str
+    temperature: float
+    top_k: int
+    top_p: float
+    key_data: np.ndarray  # PRNG carry at eviction (jax.random.key_data)
+    chunks: list  # host (k, v) pairs covering hist, chunk-strided
+    hist: int  # committed cache positions (prompt + generated - 1)
+    history: np.ndarray | None  # speculative drafter context
+    hist_len: int
+    draft: "object | None"
+    # Queue-protocol shims: a _Preempted rides the class deques next to
+    # _Request items, and the admission loop's reservation-release and
+    # wait-metric paths read these (0 = nothing reserved / no metric).
+    est_tokens: int = 0
+    t_submit: float = 0.0
 
 
 class GenerationEngine:
@@ -325,6 +392,9 @@ class GenerationEngine:
         on_poison: Callable[[str], None] | None = None,
         mesh_shape=None,  # {"dp": N, "sp": N, "tp": N} | None
         sp_prefill_threshold: int = 1024,
+        slo_class: str | None = None,  # default class for submissions
+        preemption: bool = False,  # mid-decode eviction of lower classes
+        on_preempt: Callable[[str], None] | None = None,  # "evict"|"restore"
     ):
         import jax
         import jax.numpy as jnp
@@ -528,6 +598,41 @@ class GenerationEngine:
                 ),
                 on_l2_event=self._note_prefix_l2,
             )
+        # SLO priority classes + mid-decode preemption.  Both default
+        # off, and off keeps the scheduler byte-for-byte: no class
+        # deques exist, _dequeue IS queue.get, no slot records extra
+        # state.  Classes arm when either a default class is configured
+        # or preemption is on (preemption needs class ordering to pick
+        # victims).
+        if slo_class is not None and slo_class not in SLO_CLASSES:
+            raise ValueError(
+                f"slo_class must be one of {SLO_CLASSES}, got "
+                f"{slo_class!r}"
+            )
+        self._slo_default = slo_class
+        self._classes = slo_class is not None or bool(preemption)
+        self._class_queues: "dict[str, object] | None" = None
+        if self._classes:
+            from collections import deque
+
+            self._class_queues = {name: deque() for name in SLO_CLASSES}
+        self._preemption = bool(preemption)
+        if self._preemption and self._prefix_cache is None:
+            # Evicted K/V is written back THROUGH the radix cache (and
+            # restore re-seeds through the same chunk layout), so
+            # preemption without it has nowhere loss-free to park work.
+            raise ValueError(
+                "preemption requires the radix prefix cache "
+                "(prefixCache.enabled): evicted slots write their K/V "
+                "back through it and restore from the same chunks"
+            )
+        self._on_preempt = on_preempt
+        self.preemptions = 0
+        self.preempt_restores = 0
+        # Tokens a preempted sequence had to RE-generate after restore —
+        # zero by construction (the pending token and PRNG carry travel
+        # with the eviction record); the bench gate pins it there.
+        self.preempt_recomputed_tokens = 0
         # Self-speculative n-gram decoding: disabled (None) = byte-for-byte
         # the plain single-token tick.  Enabled: greedy-only ticks draft up
         # to draft_tokens continuations per slot from the slot's own
@@ -1069,6 +1174,37 @@ class GenerationEngine:
             _read_chunk_slot, out_shardings=(rep, rep) if rep else None
         )
 
+        def _insert_restore(
+            lengths, toks, keys, temps, tks, tps,
+            slot, length, pending, slot_key, temp, tk, tp,
+        ):
+            # Preemption restore: re-install an evicted sequence's slot
+            # bookkeeping after its K/V chunks were re-seeded.  The
+            # mirror of _insert_only's finalize step with two deliberate
+            # differences that make restore+resume token-for-token
+            # identical to never having been evicted: the PRNG carry is
+            # installed AS CAPTURED (no split — the split already
+            # happened in the sequence's own history), and no token is
+            # sampled (the pending token was sampled before eviction
+            # and travels with the record).  Touches no cache buffers.
+            lengths2 = lengths.at[slot].set(length)
+            toks2 = toks.at[slot, 0].set(pending)
+            kd = jax.random.key_data(keys)
+            keys2 = jax.random.wrap_key_data(
+                kd.at[slot].set(jax.random.key_data(slot_key))
+            )
+            temps2 = temps.at[slot].set(temp)
+            tks2 = tks.at[slot].set(tk)
+            tps2 = tps.at[slot].set(tp)
+            return lengths2, toks2, keys2, temps2, tks2, tps2
+
+        self._insert_restore = jit_sharded(
+            _insert_restore,
+            out_shardings=(
+                (rep, rep, rep, rep, rep, rep) if rep else None
+            ),
+        )
+
         def _superstep(
             params, ids, k, v, lengths, toks, keys, temps, tks, tps,
             roles, offsets, counts, draft_len, act_in, remaining, eos_in,
@@ -1425,6 +1561,23 @@ class GenerationEngine:
                     self._dispatch_seed([(zk, zk)], C)
                     _, sk, sv, _slen = self._seq_state
                     self._read_chunk(sk, sv, jnp.int32(0))
+                if self._preemption:
+                    # Evict/restore path: the slot-targeted read/seed
+                    # pair (packed mode compiled them above) plus the
+                    # restore finalize — all dispatched or leader-cheap,
+                    # so the first live eviction never compiles on the
+                    # scheduler thread.
+                    if not self._packed:
+                        self._dispatch_seed_slot([(zk, zk)], 0, C)
+                        self._read_slot(
+                            self._cache_k, self._cache_v,
+                            jnp.int32(0), jnp.int32(0),
+                        )
+                    self._dispatch_restore(
+                        0, C, 1, np.asarray(jax.random.key_data(
+                            jax.random.key(0))),
+                        0.0, 0, 1.0,
+                    )
             if self._packed and not self._unified:
                 # Packed-prefill variants: one executable per B_p bucket
                 # (the ids shape is what jit caches on).  Dispatched, not
@@ -1633,6 +1786,23 @@ class GenerationEngine:
                         "another replica"
                     ),
                 )
+        if self._class_queues is not None:
+            # Class deques hold dequeued-but-unadmitted requests AND
+            # evicted sequences awaiting restore — fail both loudly.
+            for dq in self._class_queues.values():
+                while dq:
+                    item = dq.popleft()
+                    if isinstance(item, _Request):
+                        self._release_queued(item)
+                    if not item.future.done():
+                        self._abort_trace(item.trace, "shutdown")
+                        _safe_fail(
+                            item.future,
+                            EngineShutdown(
+                                "engine shut down before admission; "
+                                "retry on another replica"
+                            ),
+                        )
 
     def _abort_trace(self, trace, reason: str) -> None:
         """Finish a request trace off the normal token path (shutdown /
@@ -1646,7 +1816,9 @@ class GenerationEngine:
 
     # -- admission control / drain (client-facing) ---------------------------
 
-    def reserve_admission(self, est_tokens: int) -> None:
+    def reserve_admission(
+        self, est_tokens: int, slo_class: str | None = None
+    ) -> None:
         """Reserve queue room for ``est_tokens`` or shed.
 
         Raises :class:`EngineOverloaded` when the engine is draining, or
@@ -1657,7 +1829,16 @@ class GenerationEngine:
         HTTP request reserve the TOTAL up front, so a request is
         admitted whole or shed whole — never half-admitted with
         siblings generating into abandoned futures.
+
+        With SLO classes armed, each class sheds at its own fraction of
+        the budget (``_CLASS_BUDGET_FACTOR``): a best-effort request
+        refused at half-full queue sheds with reason
+        ``class_best-effort`` — distinguishable on dashboards from the
+        full-budget ``budget`` overload interactive traffic hits.
         """
+        cls = None
+        if self._classes:
+            cls = slo_class or self._slo_default or "interactive"
         with self._adm_lock:
             if self._draining:
                 self._note_shed("draining")
@@ -1665,8 +1846,15 @@ class GenerationEngine:
                     "engine is draining; retry on another replica",
                     reason="draining",
                     retry_after_s=1,
+                    slo_class=cls,
                 )
             budget = self._admission_budget
+            eff_budget, reason = budget, "budget"
+            if cls is not None and budget:
+                factor = _CLASS_BUDGET_FACTOR.get(cls, 1.0)
+                if factor < 1.0:
+                    eff_budget = int(budget * factor)
+                    reason = f"class_{cls}"
             # The budget bounds the BACKLOG, not request size: with the
             # queue empty, any request validate() allowed is admitted —
             # otherwise a single request whose estimate alone exceeds
@@ -1674,17 +1862,18 @@ class GenerationEngine:
             # deterministic fleet-wide 429 outage for work the engine
             # could run directly.
             if (
-                budget
+                eff_budget
                 and self._queued_est_tokens > 0
-                and self._queued_est_tokens + est_tokens > budget
+                and self._queued_est_tokens + est_tokens > eff_budget
             ):
-                self._note_shed("budget")
+                self._note_shed(reason)
                 raise EngineOverloaded(
                     f"admission queue full: {self._queued_est_tokens} "
                     f"estimated tokens queued + {est_tokens} requested "
-                    f"> budget {budget}; retry on another replica",
-                    reason="budget",
+                    f"> budget {eff_budget}; retry on another replica",
+                    reason=reason,
                     retry_after_s=1,
+                    slo_class=cls,
                 )
             self._queued_est_tokens += est_tokens
 
@@ -1875,17 +2064,26 @@ class GenerationEngine:
         request_id: str = "",
         trace=None,  # flight_recorder.RequestTrace | None
         est_reserved: bool = False,
+        slo_class: str | None = None,
     ) -> Future:
         prompt = self.validate(
             prompt_ids, max_new_tokens, temperature, top_k, top_p, seed
         )
+        # Per-request class overrides the engine default (one engine
+        # serves mixed traffic); meaningless when classes are unarmed.
+        if slo_class is not None and slo_class not in SLO_CLASSES:
+            raise ValueError(
+                f"slo_class must be one of {SLO_CLASSES}, got "
+                f"{slo_class!r}"
+            )
+        cls = slo_class or self._slo_default or "interactive"
         # Admission control: shed BEFORE anything is enqueued (429 at
         # the door, never a half-admitted request).  est_reserved=True
         # means the caller already took the whole multi-prompt request's
         # reservation through reserve_admission.
         est = int(prompt.size) + int(max_new_tokens)
         if not est_reserved:
-            self.reserve_admission(est)
+            self.reserve_admission(est, slo_class=cls)
         fut: Future = Future()
         # None means "use the engine default"; 0 is a legitimate eos token.
         eos = self._eos_default if eos_id is None else eos_id
@@ -1918,6 +2116,7 @@ class GenerationEngine:
                 # (itself or via the caller's batch reserve_admission),
                 # and the dequeue-side release must mirror it exactly.
                 est_tokens=est,
+                slo_class=cls,
             )
         )
         return fut
@@ -1967,6 +2166,318 @@ class GenerationEngine:
 
         return min(free, key=lambda i: (shard_load(i // rows), i))
 
+    # -- SLO classes / preemption --------------------------------------------
+
+    def _queued_work(self) -> bool:
+        """True when any submission waits — transport queue OR class
+        deques (a drained-but-unadmitted request must still break a
+        fused burst / keep the fused-prefill gate closed)."""
+        if not self._queue.empty():
+            return True
+        return self._class_queues is not None and any(
+            self._class_queues[name] for name in SLO_CLASSES
+        )
+
+    def _drain_to_classes(self) -> None:
+        """Route every immediately available submission from the
+        transport queue into its class deque (classes armed only).  The
+        None shutdown sentinel and _Wake are pushed back for the
+        blocking path — they must be observed in the admission loop,
+        not swallowed here."""
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is None or isinstance(item, _Wake):
+                self._queue.put(item)
+                return
+            self._class_queues[item.slo_class].append(item)
+
+    def _dequeue(self, block: bool, timeout: float):
+        """``self._queue.get`` with class priority.
+
+        Unarmed classes make this EXACTLY the plain ``get`` call it
+        replaces.  Armed: drain the transport queue into the per-class
+        deques and pop the highest class first (FIFO within a class;
+        evicted sequences re-enter at the front of theirs), falling
+        back to a blocking get only when every deque is empty."""
+        if self._class_queues is None:
+            return self._queue.get(block=block, timeout=timeout)
+        self._drain_to_classes()
+        for name in SLO_CLASSES:
+            dq = self._class_queues[name]
+            if dq:
+                return dq.popleft()
+        item = self._queue.get(block=block, timeout=timeout)
+        if item is None or isinstance(item, _Wake):
+            return item
+        # A burst may have landed while we blocked: route through the
+        # deques so it is admitted in class order, not arrival order.
+        self._class_queues[item.slo_class].append(item)
+        self._drain_to_classes()
+        for name in SLO_CLASSES:
+            dq = self._class_queues[name]
+            if dq:
+                return dq.popleft()
+        raise AssertionError("unreachable: item was just enqueued")
+
+    def _maybe_preempt(self) -> None:
+        """Tick-boundary preemption: when a strictly higher-class
+        request waits with no free slot, evict the lowest-class active
+        slot (least progress, then lowest index breaks ties) so the
+        waiting work admits next iteration.  At most one eviction per
+        scheduler iteration — preemption tracks demand, it never
+        flushes the batch."""
+        if self._free_slot() is not None:
+            return
+        self._drain_to_classes()
+        waiting = next(
+            (n for n in SLO_CLASSES if self._class_queues[n]), None
+        )
+        if waiting is None:
+            return
+        wprio = _CLASS_PRIORITY[waiting]
+        victim = None
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            prio = _CLASS_PRIORITY.get(slot.slo_class, wprio)
+            if prio >= wprio:
+                continue
+            key = (prio, slot.prompt_len + len(slot.generated), i)
+            if victim is None or key < victim[0]:
+                victim = (key, i)
+        if victim is not None:
+            self._evict_slot(victim[1])
+
+    def _evict_slot(self, idx: int) -> None:
+        """Evict one active slot at a tick boundary, losing no work.
+
+        The committed K/V (positions 0..hist-1, where hist = prompt +
+        generated - 1: the pending token has not been fed yet) is read
+        off the device with the SAME slot-chunk program the prefix
+        cache uses, so full chunks re-enter the radix cache — siblings
+        reuse them, and restore re-seeds from whichever tier they
+        landed in.  Host copies of every chunk ride the eviction record
+        as the fallback (interleaved admissions may evict the cache
+        entries before restore).  The PRNG carry and the pending token
+        complete the record: restore resumes the sequence exactly.
+
+        Leader-only, NO broadcast: ``_read_slot`` is a pure read (no
+        donation), followers' device state is untouched, and the
+        freed slot's stale rows are exactly the normal slot-reuse case
+        every program already tolerates."""
+        import jax
+        import jax.numpy as jnp
+
+        slot = self._slots[idx]
+        assert slot is not None and slot.prompt is not None
+        t0 = time.perf_counter()
+        self._beat("preempt")
+        C = self._prefill_chunk_size
+        hist = slot.prompt_len + len(slot.generated) - 1
+        full = np.empty((hist,), np.int32)
+        full[: slot.prompt_len] = slot.prompt
+        if len(slot.generated) > 1:
+            full[slot.prompt_len:] = np.asarray(
+                slot.generated[:-1], np.int32
+            )
+        n_chunks = -(-hist // C)
+        chunks = []
+        for ci in range(n_chunks):
+            ck, cv = self._read_slot(
+                self._cache_k, self._cache_v,
+                jnp.int32(idx), jnp.int32(ci * C),
+            )
+            chunks.append((np.asarray(ck), np.asarray(cv)))
+        # Whole-token chunks only: the tail chunk holds garbage past
+        # hist and must never be shared under a token-byte key.
+        for ci in range(hist // C):
+            if not self._prefix_cache.has_chunk(full, ci):
+                if not self._prefix_cache.insert_chunk(
+                    full, ci, *chunks[ci]
+                ):
+                    break  # parent path evicted mid-insert: stop here
+        key_data = np.asarray(jax.random.key_data(self._keys))[idx].copy()
+        rec = _Preempted(
+            future=slot.future,
+            remaining=slot.remaining,
+            eos_id=slot.eos_id,
+            sampling=slot.sampling,
+            on_token=slot.on_token,
+            prompt=slot.prompt,
+            generated=slot.generated,
+            t_start=slot.t_start,
+            request_id=slot.request_id,
+            trace=slot.trace,
+            slo_class=slot.slo_class,
+            temperature=slot.temperature,
+            top_k=slot.top_k,
+            top_p=slot.top_p,
+            key_data=key_data,
+            chunks=chunks,
+            hist=hist,
+            history=slot.history,
+            hist_len=slot.hist_len,
+            draft=slot.draft,
+        )
+        self._slots[idx] = None
+        # FRONT of its own class: the evicted sequence outranks newer
+        # work of the same class on re-admission.
+        self._class_queues[slot.slo_class].appendleft(rec)
+        self.preemptions += 1
+        if not self._in_warmup:
+            self._record_tick(
+                "preempt-evict", t0, time.perf_counter() - t0,
+                active_slots=sum(s is not None for s in self._slots),
+                tokens=hist,
+            )
+            self._trace_event(slot.trace, "preempt-evict", slot=idx)
+            if self._on_preempt is not None:
+                self._on_preempt("evict")
+
+    def _admit_restore(self, rec: _Preempted) -> None:
+        """Re-admit an evicted sequence into a free slot, resuming it
+        token-for-token where the eviction cut it.
+
+        K/V re-seeds through the radix cache where its chunks survived
+        (counting prefix hits — an L2-spilled chunk promotes on the
+        way), falling back to the record's host copies; then ONE
+        restore dispatch re-installs the slot's lengths row, pending
+        token, sampling params, and the PRNG carry AS CAPTURED.  No
+        token is re-generated: ``preempt_recomputed_tokens`` stays 0
+        by construction."""
+        slot_idx = self._free_slot()
+        assert slot_idx is not None
+        t0 = time.perf_counter()
+        self._beat("restore")
+        C = self._prefill_chunk_size
+        hist = rec.hist
+        full = np.empty((hist,), np.int32)
+        pl = int(rec.prompt.size)
+        full[:pl] = rec.prompt
+        if hist > pl:
+            full[pl:] = np.asarray(
+                rec.generated[: hist - pl], np.int32
+            )
+        matched, cached = self._prefix_cache.lookup(full)
+        matched = min(matched, (hist // C) * C)
+        seed_chunks = list(cached[: matched // C])
+        seed_chunks.extend(rec.chunks[matched // C:])
+        self._dispatch_seed_slot(seed_chunks, slot_idx, hist)
+        if matched:
+            self.prefix_hits += 1
+            self.prefix_cached_tokens += matched
+            if not self._in_warmup and self._on_prefix_hit is not None:
+                self._on_prefix_hit(matched)
+        self._dispatch_restore(
+            slot_idx, hist, int(rec.generated[-1]), rec.key_data,
+            rec.temperature, rec.top_k, rec.top_p,
+        )
+        self._slots[slot_idx] = _Slot(
+            future=rec.future,
+            remaining=rec.remaining,
+            eos_id=rec.eos_id,
+            sampling=rec.sampling,
+            on_token=rec.on_token,
+            prompt_len=pl,
+            generated=rec.generated,
+            t_start=rec.t_start,
+            history=rec.history,
+            hist_len=rec.hist_len,
+            draft=rec.draft,
+            request_id=rec.request_id,
+            trace=rec.trace,
+            slo_class=rec.slo_class,
+            prompt=rec.prompt,
+            temperature=rec.temperature,
+            top_k=rec.top_k,
+            top_p=rec.top_p,
+        )
+        self.preempt_restores += 1
+        if not self._in_warmup:
+            self._record_tick(
+                "preempt-restore", t0, time.perf_counter() - t0,
+                active_slots=sum(s is not None for s in self._slots),
+                tokens=hist,
+                cost=self._cost_seed(hist),
+            )
+            self._trace_event(rec.trace, "preempt-restore", slot=slot_idx)
+            if self._on_preempt is not None:
+                self._on_preempt("restore")
+
+    def _dispatch_restore(
+        self, slot, length, pending, key_data, temp, tk, tp
+    ):
+        """Broadcast (multihost) then run the restore finalize — a
+        stateful GEN op: every host re-installs the same slot
+        bookkeeping or later replayed ticks diverge."""
+        if self._channel is None:
+            self._device_restore(
+                slot, length, pending, key_data, temp, tk, tp
+            )
+            return
+        from .multihost import OP_GEN_RESTORE, encode_message
+
+        payload = encode_message(
+            OP_GEN_RESTORE,
+            {
+                "slot": int(slot),
+                "length": int(length),
+                "pending": int(pending),
+                "key_data": np.asarray(key_data),
+                "temp": float(temp),
+                "tk": int(tk),
+                "tp": float(tp),
+            },
+        )
+        self._channel.run(
+            payload,
+            lambda: self._device_restore(
+                slot, length, pending, key_data, temp, tk, tp
+            ),
+        )
+
+    def _device_restore(
+        self, slot, length, pending, key_data, temp, tk, tp
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        slot_key = jax.random.wrap_key_data(jnp.asarray(key_data))
+        (
+            self._lengths,
+            self._tokens,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+        ) = self._insert_restore(
+            self._lengths,
+            self._tokens,
+            self._keys,
+            self._temps,
+            self._topk,
+            self._topp,
+            jnp.int32(slot),
+            jnp.int32(length),
+            jnp.int32(pending),
+            slot_key,
+            jnp.float32(temp),
+            jnp.int32(tk),
+            jnp.float32(tp),
+        )
+
+    def replay_restore(
+        self, slot, length, pending, key_data, temp, tk, tp
+    ) -> None:
+        """Follower side of :meth:`_dispatch_restore`."""
+        self._device_restore(
+            int(slot), int(length), int(pending), np.asarray(key_data),
+            float(temp), int(tk), float(tp),
+        )
+
     def _admit(self, req: _Request) -> None:
         import jax
 
@@ -2009,6 +2520,7 @@ class GenerationEngine:
             request_id=req.request_id,
             trace=req.trace,
             **self._spec_slot_state(req),
+            **self._class_slot_state(req),
         )
         self._slots[slot_idx] = slot
         self._note_ttft(req)
@@ -2143,6 +2655,25 @@ class GenerationEngine:
                 self._spec.draft_tokens, adaptive=self._spec.adaptive
             ),
         }
+
+    def _class_slot_state(self, req: _Request) -> dict:
+        """Per-slot SLO-class / preemption state (empty when classes are
+        unarmed — default _Slot fields keep the old layout exactly)."""
+        if not self._classes:
+            return {}
+        out: dict = {"slo_class": req.slo_class}
+        if self._preemption:
+            # Eviction needs the prompt (to key the radix write-back and
+            # rebuild the committed token sequence) and the sampling
+            # params (to refill the slot's device rows on restore —
+            # another admission may reuse the row meanwhile).
+            out.update(
+                prompt=req.prompt,
+                temperature=req.temperature,
+                top_k=req.top_k,
+                top_p=req.top_p,
+            )
+        return out
 
     def _admit_now(self, req: _Request) -> None:
         """Synchronous admission (warmup): runs the whole chunked pipeline
@@ -2632,6 +3163,7 @@ class GenerationEngine:
             request_id=req.request_id,
             trace=req.trace,
             **self._spec_slot_state(req),
+            **self._class_slot_state(req),
         )
         self._note_ttft(req)
         self._record_token(slot_idx, int(first))
@@ -2863,6 +3395,7 @@ class GenerationEngine:
                 request_id=req.request_id,
                 trace=req.trace,
                 **self._spec_slot_state(req),
+                **self._class_slot_state(req),
             )
             self._note_ttft(req)
             self._record_token(prog.slot, int(firsts[i]))
@@ -3104,6 +3637,7 @@ class GenerationEngine:
             request_id=req.request_id,
             trace=req.trace,
             **self._spec_slot_state(req),
+            **self._class_slot_state(req),
         )
         self._note_ttft(req)
         self._record_token(slot_idx, int(first))
@@ -3227,7 +3761,7 @@ class GenerationEngine:
             self._fused
             and not self._in_warmup
             and not self._pending
-            and self._queue.empty()
+            and not self._queued_work()
         ):
             # Fused multi-step decode engages only when the scheduler
             # owes nothing else: no queued request waiting on a slot a
@@ -3356,7 +3890,7 @@ class GenerationEngine:
                 not may_be_active
                 or self._stop.is_set()
                 or self._pending
-                or not self._queue.empty()
+                or self._queued_work()
             ):
                 break
             if (
@@ -3707,6 +4241,7 @@ class GenerationEngine:
                 request_id=req.request_id,
                 trace=req.trace,
                 **self._spec_slot_state(req),
+                **self._class_slot_state(req),
             )
             self._note_ttft(req)
             self._record_token(prog.slot, int(firsts[prog.slot]))
@@ -4082,6 +4617,8 @@ class GenerationEngine:
         their token cadence under long prompts.  Returns False on the
         shutdown sentinel."""
         self._drain_control_ops()
+        if self._preemption:
+            self._maybe_preempt()
         if self._packed:
             return self._admit_phase_packed()
         if self._pending:
@@ -4100,7 +4637,7 @@ class GenerationEngine:
         while self._free_slot() is not None:
             try:
                 idle = all(s is None for s in self._slots)
-                req = self._queue.get(block=idle, timeout=self._idle_poll_s)
+                req = self._dequeue(idle, self._idle_poll_s)
             except queue.Empty:
                 break
             if isinstance(req, _Wake):
@@ -4121,6 +4658,18 @@ class GenerationEngine:
                         ),
                     )
                 return False
+            if isinstance(req, _Preempted):
+                # An evicted sequence re-admits straight into the free
+                # slot the loop condition guarantees — no prefill.
+                try:
+                    self._admit_restore(req)
+                except Exception as exc:
+                    _log.exception("preemption restore failed")
+                    if not req.future.done():
+                        self._abort_trace(req.trace, "error")
+                        _safe_fail(req.future, exc)
+                    self._fail_all_and_recover()
+                continue
             self._note_admission_wait(req)
             if self._prefill_chunk_size is not None:
                 prog = self._make_progress(req)
@@ -4166,8 +4715,8 @@ class GenerationEngine:
                 break
             idle = not self._pending and all(s is None for s in self._slots)
             try:
-                req = self._queue.get(
-                    block=idle and not popped, timeout=self._idle_poll_s
+                req = self._dequeue(
+                    idle and not popped, self._idle_poll_s
                 )
             except queue.Empty:
                 break
@@ -4186,6 +4735,17 @@ class GenerationEngine:
                         ),
                     )
                 return False
+            if isinstance(req, _Preempted):
+                try:
+                    self._admit_restore(req)
+                except Exception as exc:
+                    _log.exception("preemption restore failed")
+                    if not req.future.done():
+                        self._abort_trace(req.trace, "error")
+                        _safe_fail(req.future, exc)
+                    self._fail_all_and_recover()
+                popped = True
+                continue
             self._note_admission_wait(req)
             prog = self._make_progress(req)
             if self._sp_eligible(req) and not prog.cached_tokens:

@@ -335,6 +335,19 @@ class ServerMetrics:
             ident_labels + ["reason"],
             registry=self.registry,
         )
+        # Mid-decode preemption (spec.tpu.preemption + spec.sloClass):
+        # evictions of lower-class slots to admit higher-class work and
+        # the matching restores.  event="evict" | "restore"; restores
+        # lag evictions only while the preempted record waits in its
+        # class queue, so evict-minus-restore is live preempted backlog.
+        self.preempt = Counter(
+            "tpumlops_engine_preempt",
+            "Slot preemption events (evict = KV written back through "
+            "the prefix cache and slot reclaimed; restore = sequence "
+            "re-admitted with no lost work)",
+            ident_labels + ["event"],
+            registry=self.registry,
+        )
         # Model-load stage breakdown (server/loader.py load_stats): the
         # bench has measured disk/transfer/quantize/shard for rounds —
         # this makes it a first-party series so a cold-start regression
@@ -537,6 +550,11 @@ class ServerMetrics:
 
     def inc_shed(self, reason: str):
         self.shed.labels(**self.identity, reason=reason).inc()
+
+    def inc_preempt(self, event: str):
+        """``event``: "evict" (slot reclaimed, KV parked in the prefix
+        cache) or "restore" (preempted sequence re-admitted)."""
+        self.preempt.labels(**self.identity, event=event).inc()
 
     def observe_prefill_batch(self, fill: int):
         self.prefill_batch_fill.labels(**self.identity).observe(fill)

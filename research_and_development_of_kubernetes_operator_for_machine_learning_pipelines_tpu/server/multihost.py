@@ -54,6 +54,9 @@ OP_GEN_MULTISTEP = "gen_multistep"  # fused K-step decode tick (replayed);
 #   chained ticks of a burst carry None inputs — the device-resident chain
 #   state from each host's OWN previous replay keeps the slice in lockstep
 OP_GEN_SP_PREFILL = "gen_sp_prefill"  # sp ring prefill: whole prompt, one pass
+OP_GEN_RESTORE = "gen_restore"  # preemption restore: re-install an evicted
+# slot's lengths/pending-token/PRNG-carry/sampling rows (the K/V re-seed
+# rides OP_GEN_SEED_SLOT)
 OP_GEN_SUPERSTEP = "gen_superstep"  # unified ragged super-step tick: every
 #   role (prefill chunks / fused-K decode / speculative verify) in ONE
 #   dispatch; the payload is self-contained host state — no chained inputs
@@ -324,13 +327,17 @@ def follower_loop(engine: Any, transport: GroupTransport, gen_engine: Any = None
                 if gen_engine is None:
                     raise RuntimeError("GEN op on a unit without a gen engine")
                 gen_engine.replay_superstep(**inputs)
+            elif op == OP_GEN_RESTORE:
+                if gen_engine is None:
+                    raise RuntimeError("GEN op on a unit without a gen engine")
+                gen_engine.replay_restore(**inputs)
             else:  # unknown op: skip rather than desync the group
                 _log.warning("follower ignoring unknown op %r", op)
         except Exception:
             if op in (OP_GEN_ADMIT, OP_GEN_STEP, OP_GEN_RESET, OP_GEN_CHUNK,
                       OP_GEN_INSERT, OP_GEN_SEED, OP_GEN_VERIFY,
                       OP_GEN_CHUNKS, OP_GEN_SEED_SLOT, OP_GEN_MULTISTEP,
-                      OP_GEN_SUPERSTEP, OP_GEN_SP_PREFILL):
+                      OP_GEN_SUPERSTEP, OP_GEN_SP_PREFILL, OP_GEN_RESTORE):
                 # Generation is STATEFUL: if this host failed a step the
                 # leader executed, its cache/lengths shards now disagree
                 # with every other host's, and all in-flight sequences

@@ -14,8 +14,10 @@ New TPU-native spec fields (north star): ``backend``, ``tpuTopology``,
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Mapping
+
+from .journey_trace import SLO_CLASSES
 
 # Reference defaults (file:line cites into /root/reference/mlflow_operator.py)
 DEFAULT_MONITORING_INTERVAL_S = 60  # :31
@@ -978,6 +980,94 @@ class SloSpec:
         return tuple(names)
 
 
+# Objective keys the offline planner (operator/planner.py) can search
+# against.  Unknown keys reject HERE (a typo'd objective must land in CR
+# status); an objective the knob space cannot meet rejects in the planner
+# as a typed InfeasibleObjectiveError.
+PLANNER_OBJECTIVE_KEYS = frozenset({"ttftP99Ms"})
+
+
+@dataclass(frozen=True)
+class PlannerSpec:
+    """``spec.planner``: the offline SLO planner (operator/planner.py).
+
+    The planner replays a journey-ring trace (``/router/debug/requests``
+    export: ``tracePath`` to a file, or ``trace`` inline) through an
+    analytic cost model and searches the knob space — decodeSteps,
+    speculative, prefillBatch/prefillTokenBudget, quantize, cache slots,
+    meshShape chips-vs-replicas — for the cheapest configuration
+    (chip-seconds) meeting ``objective``.  ``applyMode: suggest`` (the
+    default) writes the costed plan to ``status.plan`` and nothing else
+    — manifests stay byte-for-byte; ``apply`` also rebuilds the data
+    plane with the chosen knobs.  Disabled (the default) — no plan, no
+    status writes: byte-for-byte.
+    """
+
+    enabled: bool = False
+    apply_mode: str = "suggest"  # suggest | apply
+    objective: Mapping[str, float] = field(default_factory=dict)
+    trace_path: str | None = None
+    trace: Mapping[str, Any] | None = None
+    # Optional model-profile overrides for the analytic cost model
+    # (layers/hidden/heads/...); absent fields take the planner's
+    # 7B-class defaults.
+    model: Mapping[str, Any] | None = None
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "PlannerSpec":
+        if spec is None:
+            return cls()
+        _reject_unknown_keys(
+            spec,
+            frozenset(
+                {"enabled", "applyMode", "objective", "tracePath",
+                 "trace", "model"}
+            ),
+            "spec.planner",
+        )
+        objective = dict(spec.get("objective") or {})
+        _reject_unknown_keys(
+            objective, PLANNER_OBJECTIVE_KEYS, "spec.planner.objective"
+        )
+        return cls(
+            enabled=bool(spec.get("enabled", False)),
+            apply_mode=str(spec.get("applyMode", "suggest")),
+            objective={k: float(v) for k, v in objective.items()},
+            trace_path=(
+                str(spec["tracePath"])
+                if spec.get("tracePath") is not None
+                else None
+            ),
+            trace=spec.get("trace"),
+            model=spec.get("model"),
+        )
+
+    def __post_init__(self):
+        if self.apply_mode not in ("suggest", "apply"):
+            raise ValueError(
+                "planner.applyMode must be 'suggest' or 'apply', got "
+                f"{self.apply_mode!r}"
+            )
+        if not self.enabled:
+            return
+        if not self.objective:
+            raise ValueError(
+                "planner.enabled requires planner.objective (e.g. "
+                "{ttftP99Ms: 250})"
+            )
+        for key, value in self.objective.items():
+            if value <= 0:
+                raise ValueError(
+                    f"planner.objective.{key} must be > 0, got {value}"
+                )
+        if self.trace_path is None and self.trace is None:
+            raise ValueError(
+                "planner.enabled requires a trace source: tracePath (a "
+                "/router/debug/requests export on disk) or trace (the "
+                "export inline)"
+            )
+
+
 # Mirrors parallel.mesh.MESH_AXIS_ORDER without importing jax into the
 # operator process (tests pin the two tuples equal).
 MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
@@ -1197,6 +1287,20 @@ class TpuSpec:
     # 20 (not 30): + the 3s endpoint lag it fits Kubernetes' default
     # 30s termination grace; larger values emit a pod grace override.
     drain_grace_s: float = 20.0
+    # Default SLO class for requests that don't carry one (interactive |
+    # batch | best-effort).  Setting it arms the engine's priority
+    # admission queues: higher classes drain first, lower classes shed
+    # at a fraction of the admission budget.  None (the default) leaves
+    # the single-queue admission path byte-for-byte.  Top-level
+    # spec.sloClass is the CRD spelling; spec.tpu.sloClass the low-level
+    # one (top-level wins when both are set).
+    slo_class: str | None = None
+    # Mid-decode preemption: a waiting higher-class request may evict a
+    # lower-class slot at a tick boundary — its K/V is written back
+    # through the radix prefix cache, the record requeued at the front
+    # of its class, and restored on re-admission with no lost work.
+    # Requires prefixCache.enabled (the cache IS the parking surface).
+    preemption: bool = False
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "TpuSpec":
@@ -1213,7 +1317,7 @@ class TpuSpec:
                     "prefixCache", "speculative", "decodeSteps",
                     "unifiedStep", "observability", "snapshot",
                     "warmupFullGrid", "admissionQueueBudget",
-                    "drainGraceSeconds",
+                    "drainGraceSeconds", "sloClass", "preemption",
                 }
             ),
             "spec.tpu",
@@ -1235,6 +1339,23 @@ class TpuSpec:
                 f"spec.tpu.prefillBatch {prefill_batch} requires chunked "
                 "prefill: set prefillChunk (or enable prefixCache, which "
                 "implies it)"
+            )
+        slo_class = spec.get("sloClass")
+        if slo_class is not None:
+            slo_class = str(slo_class)
+            if slo_class not in SLO_CLASSES:
+                raise ValueError(
+                    f"spec.tpu.sloClass must be one of {list(SLO_CLASSES)}, "
+                    f"got {slo_class!r}"
+                )
+        preemption = bool(spec.get("preemption", False))
+        if preemption and not prefix_cache.enabled:
+            # The evicted slot's K/V parks in the radix cache; without it
+            # preemption would have to discard decoded work.
+            raise ValueError(
+                "spec.tpu.preemption requires spec.tpu.prefixCache.enabled "
+                "(an evicted slot's K/V is written back through the radix "
+                "prefix cache and restored from it on re-admission)"
             )
         return cls(
             topology=str(spec.get("tpuTopology", "v5e-8")),
@@ -1270,6 +1391,8 @@ class TpuSpec:
                 spec.get("admissionQueueBudget")
             ),
             drain_grace_s=_parse_drain_grace(spec.get("drainGraceSeconds")),
+            slo_class=slo_class,
+            preemption=preemption,
         )
 
     @property
@@ -1348,6 +1471,9 @@ class OperatorConfig:
     # Serving objectives (error-budget accounting in operator/slo.py);
     # absent default = no tracker, no series, byte-for-byte.
     slo: SloSpec = field(default_factory=SloSpec)
+    # Offline SLO planner (operator/planner.py): trace replay + knob
+    # search behind spec.planner; disabled default = byte-for-byte.
+    planner: PlannerSpec = field(default_factory=PlannerSpec)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
@@ -1359,6 +1485,18 @@ class OperatorConfig:
         if backend not in ("seldon", "tpu"):
             raise ValueError(f"spec.backend must be 'seldon' or 'tpu', got {backend!r}")
         tpu = TpuSpec.from_spec(spec.get("tpu"))
+        # Top-level spec.sloClass is the CRD spelling of the data plane's
+        # default class — authoritative over spec.tpu.sloClass when both
+        # are set (the tpu key exists so the server CLI round-trips).
+        top_slo = spec.get("sloClass")
+        if top_slo is not None:
+            top_slo = str(top_slo)
+            if top_slo not in SLO_CLASSES:
+                raise ValueError(
+                    f"spec.sloClass must be one of {list(SLO_CLASSES)}, "
+                    f"got {top_slo!r}"
+                )
+            tpu = replace(tpu, slo_class=top_slo)
         autoscaling = AutoscalingSpec.from_spec(spec.get("autoscaling"))
         fleet = FleetSpec.from_spec(spec.get("fleet"))
         if fleet.disaggregation:
@@ -1498,4 +1636,5 @@ class OperatorConfig:
             autoscaling=autoscaling,
             fleet=fleet,
             slo=SloSpec.from_spec(spec.get("slo")),
+            planner=PlannerSpec.from_spec(spec.get("planner")),
         )

@@ -45,10 +45,15 @@ EXPECTED_SERVER = {
     # the dispatches-per-token amortization series the fused multi-step
     # path (spec.tpu.decodeSteps) collapses ~K-fold.
     "tpumlops_engine_dispatches": ("counter", _IDENT + ("op",)),
-    # Admission control: sheds by typed reason ("budget" | "draining");
-    # exported as tpumlops_engine_shed_total.  The autoscaler's alert
-    # surface for "replica refusing load".
+    # Admission control: sheds by typed reason ("budget" | "draining" |
+    # "class_<slo class>" for per-class budget sheds); exported as
+    # tpumlops_engine_shed_total.  The autoscaler's alert surface for
+    # "replica refusing load".
     "tpumlops_engine_shed": ("counter", _IDENT + ("reason",)),
+    # Mid-decode preemption (spec.tpu.preemption): evict/restore event
+    # pairs; exported as tpumlops_engine_preempt_total.  No samples
+    # unless preemption is armed.
+    "tpumlops_engine_preempt": ("counter", _IDENT + ("event",)),
     # Failure containment (PR 13): scheduler-watchdog stalls + heartbeat
     # age (0 while disarmed — the families exist so dashboards are
     # uniform across fleets with and without --watchdog-deadline-s), and

@@ -1,0 +1,414 @@
+"""Offline SLO planner tests (ISSUE 18): the journey-trace loader's
+typed format contract, the analytic cost model's sanity (replica ladder
+monotonicity), plan determinism (the plan-contract gate's premise),
+typed infeasibility, and the reconciler's suggest/apply split —
+suggest mode must change NOTHING but ``status.plan``."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpumlops.clients.base import MLFLOWMODEL, SELDONDEPLOYMENT, ObjectRef
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator import planner
+from tpumlops.operator.reconciler import Reconciler
+from tpumlops.utils.clock import FakeClock
+from tpumlops.utils.config import OperatorConfig
+from tpumlops.utils.journey_trace import (
+    JOURNEY_TRACE_FORMAT_VERSION,
+    TraceFormatError,
+    load_journey_trace,
+)
+
+FIXTURE_TRACE = Path(__file__).parent / "fixtures" / "journey_trace.json"
+FIXTURE_PLAN = Path(__file__).parent / "fixtures" / "journey_plan.json"
+
+
+# ---------------------------------------------------------------------------
+# Trace loader: the /router/debug/requests format contract
+# ---------------------------------------------------------------------------
+
+
+def _export(**over):
+    payload = {
+        "format_version": 1,
+        "requests": [
+            {"ts_us": 0, "request_id": "a"},
+            {"ts_us": 250_000, "request_id": "b"},
+        ],
+    }
+    payload.update(over)
+    return payload
+
+
+def test_trace_absent_format_version_is_v1():
+    """Exports predating the field ARE version 1 — absence loads."""
+    payload = _export()
+    del payload["format_version"]
+    trace = load_journey_trace(payload)
+    assert trace.format_version == JOURNEY_TRACE_FORMAT_VERSION
+    assert len(trace.requests) == 2
+
+
+@pytest.mark.parametrize("version", [2, 0, "1", True, None, 1.0])
+def test_trace_unknown_format_version_rejected(version):
+    """A PRESENT version the loader does not know (or a non-int) is a
+    typed rejection, never a best-effort mis-parse."""
+    with pytest.raises(TraceFormatError, match="format_version"):
+        load_journey_trace(_export(format_version=version))
+
+
+@pytest.mark.parametrize(
+    "payload, match",
+    [
+        ([1, 2], "not an object"),
+        ({"format_version": 1}, "no 'requests' list"),
+        (_export(requests=[{"request_id": "x"}]), "neither ts_us nor wall"),
+        (_export(requests=[{"ts_us": "soon"}]), "ts_us is not numeric"),
+        (
+            _export(requests=[{"ts_us": 0, "slo_class": "platinum"}]),
+            "slo_class",
+        ),
+        (
+            _export(requests=[{"ts_us": 0, "prompt_tokens": 0}]),
+            "must be positive",
+        ),
+        (_export(started_unix="yesterday"), "started_unix"),
+    ],
+)
+def test_trace_rejects_drifted_payloads(payload, match):
+    with pytest.raises(TraceFormatError, match=match):
+        load_journey_trace(payload)
+
+
+def test_trace_sorts_and_rebases_arrivals(tmp_path):
+    """Ring order is eviction order, not time order: the loader sorts by
+    arrival and rebases to t=0.  Also exercises the file path."""
+    p = tmp_path / "export.json"
+    p.write_text(json.dumps(_export(requests=[
+        {"ts_us": 900_000, "request_id": "late"},
+        {"ts_us": 400_000, "request_id": "early", "slo_class": "batch"},
+    ])))
+    trace = load_journey_trace(p)
+    assert [r.request_id for r in trace.requests] == ["early", "late"]
+    assert trace.requests[0].arrival_s == 0.0
+    assert trace.requests[1].arrival_s == pytest.approx(0.5)
+    assert trace.requests[0].slo_class == "batch"
+    assert trace.span_s == pytest.approx(0.5)
+
+
+def test_trace_invalid_json_file_rejected(tmp_path):
+    p = tmp_path / "garbage.json"
+    p.write_text("{not json")
+    with pytest.raises(TraceFormatError, match="not valid JSON"):
+        load_journey_trace(p)
+
+
+# ---------------------------------------------------------------------------
+# Cost model + search
+# ---------------------------------------------------------------------------
+
+
+def _burst_trace(n=64, prompt=512, new=128):
+    """A saturating burst: n requests in one second, so queueing delay —
+    the thing replicas fix — actually occurs in the replay."""
+    return load_journey_trace({
+        "format_version": 1,
+        "requests": [
+            {
+                "ts_us": i * 15_000,
+                "prompt_tokens": prompt,
+                "max_new_tokens": new,
+            }
+            for i in range(n)
+        ],
+    })
+
+
+def test_replica_ladder_monotone():
+    """More replicas on a saturating burst: predicted interactive TTFT
+    p99 never worsens, and genuinely improves somewhere on the ladder
+    (the queue is the bottleneck, and the model knows it)."""
+    trace = _burst_trace()
+    p99s = [
+        planner.predict(
+            trace, planner.KnobPoint(tp=1, replicas=r, max_slots=4)
+        ).ttft_p99_ms
+        for r in (1, 2, 4)
+    ]
+    assert p99s[0] >= p99s[1] >= p99s[2]
+    assert p99s[2] < p99s[0]
+
+
+def test_fused_decode_steps_amortize_dispatch():
+    """decodeSteps=K fuses K ticks under one host dispatch: per-token
+    seconds strictly drop vs K=1 (same knob otherwise)."""
+    trace = _burst_trace(n=8)
+    k1 = planner.predict(trace, planner.KnobPoint(decode_steps=1))
+    k4 = planner.predict(trace, planner.KnobPoint(decode_steps=4))
+    assert k4.makespan_s < k1.makespan_s
+
+
+def test_plan_deterministic():
+    """Same trace + same objective == byte-for-byte the same plan (the
+    premise of the plan-contract verify gate)."""
+    trace = load_journey_trace(FIXTURE_TRACE)
+    a = planner.plan(trace, {"ttftP99Ms": 250.0})
+    b = planner.plan(trace, {"ttftP99Ms": 250.0})
+    assert a == b
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_plan_reproduces_committed_fixture():
+    """The committed plan JSON is exactly what re-planning the committed
+    trace yields — the in-process twin of `make plan-contract`, so
+    cost-model drift fails tier-1 too, not just the make gate."""
+    trace = load_journey_trace(FIXTURE_TRACE)
+    result = planner.plan(trace, {"ttftP99Ms": 250.0})
+    text = json.dumps(result, indent=1, sort_keys=True) + "\n"
+    assert text == FIXTURE_PLAN.read_text()
+
+
+def test_plan_no_costlier_than_hand_tuned_config():
+    """Acceptance: the plan meets the objective at <= the chip-seconds
+    of the hand-tuned config.  The hand-tuned answer to a tight TTFT
+    objective is "throw the whole slice at it" (tp=8) — feasible, and
+    IN the grid, so a cheaper feasible point must win by construction;
+    this pins that invariant (and that a cheaper point exists here)."""
+    trace = load_journey_trace(FIXTURE_TRACE)
+    objective = 250.0
+    hand_tuned = planner.predict(trace, planner.KnobPoint(tp=8))
+    assert hand_tuned.ttft_p99_ms <= objective  # feasible, by force
+    result = planner.plan(trace, {"ttftP99Ms": objective})
+    assert result["predicted"]["chipSeconds"] <= round(
+        hand_tuned.chip_seconds, 3
+    )
+    assert result["predicted"]["ttftP99Ms"] <= objective
+    assert result["predicted"]["chips"] < hand_tuned.chips  # and cheaper
+
+
+def test_infeasible_objective_typed():
+    """No grid point can prefill in a microsecond: the typed error names
+    the objective, the best the space can do, and where."""
+    trace = load_journey_trace(FIXTURE_TRACE)
+    with pytest.raises(planner.InfeasibleObjectiveError) as ei:
+        planner.plan(trace, {"ttftP99Ms": 0.001})
+    err = ei.value
+    assert isinstance(err, ValueError)  # config-error path compatible
+    assert err.objective_ms == 0.001
+    assert err.best_ms > 0.001
+    assert "meshShape" in err.best_knobs
+    assert "loosen the objective" in str(err)
+
+
+@pytest.mark.parametrize(
+    "objective, match",
+    [
+        ({"ttftP99Ms": 250, "throughput": 9}, "unknown planner objective"),
+        ({}, "requires ttftP99Ms"),
+        ({"ttftP99Ms": 0}, "must be > 0"),
+        ({"ttftP99Ms": -5.0}, "must be > 0"),
+    ],
+)
+def test_bad_objectives_rejected(objective, match):
+    trace = load_journey_trace(FIXTURE_TRACE)
+    with pytest.raises(ValueError, match=match):
+        planner.plan(trace, objective)
+
+
+def test_empty_trace_rejected():
+    trace = load_journey_trace({"format_version": 1, "requests": []})
+    with pytest.raises(ValueError, match="no requests"):
+        planner.plan(trace, {"ttftP99Ms": 250.0})
+
+
+def test_model_profile_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown keys.*head_count"):
+        planner.ModelProfile.from_spec({"head_count": 64})
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing: spec.planner validation, plan_for_config, apply_plan
+# ---------------------------------------------------------------------------
+
+
+_TRACE_INLINE = {
+    "format_version": 1,
+    "requests": [
+        {"ts_us": i * 50_000, "prompt_tokens": 256, "max_new_tokens": 64}
+        for i in range(40)
+    ],
+}
+
+
+def _cr_spec(**extra):
+    spec = {"modelName": "iris", "modelAlias": "champion"}
+    spec.update(extra)
+    return spec
+
+
+@pytest.mark.parametrize(
+    "planner_spec, match",
+    [
+        ({"enabled": True, "frobnicate": 1}, "unknown key"),
+        (
+            {"enabled": True, "objective": {"ttftP99Ms": 1}, "trace": {},
+             "applyMode": "yolo"},
+            "applyMode",
+        ),
+        ({"enabled": True, "trace": {}}, "objective"),
+        (
+            {"enabled": True, "objective": {"p50": 9}, "trace": {}},
+            "objective",
+        ),
+        ({"enabled": True, "objective": {"ttftP99Ms": 250}}, "trace"),
+    ],
+)
+def test_planner_spec_validation(planner_spec, match):
+    with pytest.raises(ValueError, match=match):
+        OperatorConfig.from_spec(_cr_spec(planner=planner_spec))
+
+
+def test_plan_for_config_disabled_returns_none():
+    config = OperatorConfig.from_spec(_cr_spec())
+    assert planner.plan_for_config(config) is None
+
+
+def test_plan_for_config_inline_trace_and_apply():
+    config = OperatorConfig.from_spec(_cr_spec(planner={
+        "enabled": True,
+        "objective": {"ttftP99Ms": 250.0},
+        "trace": _TRACE_INLINE,
+    }))
+    result = planner.plan_for_config(config)
+    assert result["formatVersion"] == planner.PLAN_FORMAT_VERSION
+    knobs = result["knobs"]
+    applied = planner.apply_plan(config, result)
+    assert applied.tpu.quantize == knobs["quantize"]
+    assert applied.tpu.replicas == knobs["replicas"]
+    assert applied.tpu.max_slots == knobs["maxSlots"]
+    assert applied.tpu.decode_steps == knobs["decodeSteps"]
+    assert applied.tpu.mesh_shape == knobs["meshShape"]
+    assert applied.tpu.speculative.enabled == knobs["speculative"]
+    assert config.tpu.quantize == "none"  # original untouched (frozen)
+
+
+# ---------------------------------------------------------------------------
+# Reconciler integration: status.plan, suggest vs apply
+# ---------------------------------------------------------------------------
+
+
+CR = ObjectRef(namespace="ns", name="m", **MLFLOWMODEL)
+SD = ObjectRef(namespace="ns", name="m", **SELDONDEPLOYMENT)
+
+
+def _world(planner_spec=None, **spec_extra):
+    kube, registry = FakeKube(), FakeRegistry()
+    registry.register("iris", "1", "s3://b/1")
+    registry.set_alias("iris", "champion", "1")
+    spec = _cr_spec(
+        backend="tpu",
+        tpu={"tpuTopology": "v5e-8", "meshShape": {"dp": 1, "tp": 1}},
+        **spec_extra,
+    )
+    if planner_spec is not None:
+        spec["planner"] = planner_spec
+    kube.create(CR, {"spec": spec})
+    rec = Reconciler("m", "ns", kube, registry, FakeMetrics(), FakeClock())
+    return kube, rec
+
+
+_PLANNER_ON = {
+    "enabled": True,
+    "objective": {"ttftP99Ms": 250.0},
+    "trace": _TRACE_INLINE,
+}
+
+
+def _tpu_args(kube):
+    sd = kube.get(SD)
+    spec = sd["spec"]["predictors"][0]["componentSpecs"][0]["spec"]
+    return spec["containers"][0]["args"]
+
+
+def test_suggest_mode_only_adds_status_plan():
+    """suggest (the default): the CR is byte-for-byte what it would be
+    with no planner at all, except status.plan."""
+    kube_on, rec_on = _world(_PLANNER_ON)
+    kube_off, rec_off = _world(None)
+    rec_on.reconcile(kube_on.get(CR))
+    rec_off.reconcile(kube_off.get(CR))
+    # The rendered data plane is identical: suggest changed no manifest.
+    assert kube_on.get(SD)["spec"] == kube_off.get(SD)["spec"]
+    status_on = dict(kube_on.get(CR)["status"])
+    status_off = dict(kube_off.get(CR)["status"])
+    plan = status_on.pop("plan")
+    assert status_on == status_off
+    assert plan["knobs"]["replicas"] >= 1
+    assert plan["predicted"]["ttftP99Ms"] <= 250.0
+    assert plan["trace"]["requests"] == len(_TRACE_INLINE["requests"])
+
+
+def test_disabled_planner_never_touches_status():
+    kube, rec = _world(None)
+    rec.reconcile(kube.get(CR))
+    rec.reconcile(kube.get(CR))
+    assert "plan" not in kube.get(CR)["status"]
+
+
+def test_plan_cleared_when_planner_disabled_again():
+    """Flipping the planner off clears status.plan with one explicit
+    null patch — the capacity-key contract."""
+    kube, rec = _world(_PLANNER_ON)
+    rec.reconcile(kube.get(CR))
+    assert kube.get(CR)["status"]["plan"] is not None
+    obj = kube.get(CR)
+    obj["spec"].pop("planner")
+    obj["metadata"].pop("resourceVersion", None)
+    kube.replace(CR, obj)
+    rec.reconcile(kube.get(CR))
+    assert kube.get(CR)["status"].get("plan") is None
+
+
+def test_apply_mode_renders_planned_knobs():
+    """applyMode: apply folds the chosen knobs into the manifests the
+    builder renders — the pod args carry the planned configuration."""
+    kube, rec = _world(dict(_PLANNER_ON, applyMode="apply"))
+    rec.reconcile(kube.get(CR))
+    status = kube.get(CR)["status"]
+    knobs = status["plan"]["knobs"]
+    args = _tpu_args(kube)
+    assert args[args.index("--quantize") + 1] == knobs["quantize"]
+    assert args[args.index("--speculative") + 1] == (
+        "1" if knobs["speculative"] else "0"
+    )
+    # Suggest world for contrast: same plan, untouched manifests.
+    kube_s, rec_s = _world(_PLANNER_ON)
+    rec_s.reconcile(kube_s.get(CR))
+    assert kube_s.get(CR)["status"]["plan"] == status["plan"]
+    args_s = _tpu_args(kube_s)
+    assert args_s[args_s.index("--quantize") + 1] == "none"
+
+
+def test_plan_record_journaled_once():
+    """A changed plan journals ONE PlanRecord (kind: plan) onto
+    status.history; a steady-state re-reconcile does not repeat it."""
+    kube, rec = _world(_PLANNER_ON, observability={"historyLimit": 8})
+    rec.reconcile(kube.get(CR))
+    rec.reconcile(kube.get(CR))
+    history = kube.get(CR)["status"]["history"]
+    plans = [r for r in history if r.get("kind") == "plan"]
+    assert len(plans) == 1
+    assert plans[0]["applyMode"] == "suggest"
+    assert plans[0]["knobs"] == kube.get(CR)["status"]["plan"]["knobs"]
+
+
+def test_infeasible_objective_surfaces_as_config_error():
+    """An infeasible objective is a spec problem: the CR parks on the
+    config-error path with the planner's message, data plane untouched."""
+    kube, rec = _world(dict(_PLANNER_ON, objective={"ttftP99Ms": 0.001}))
+    rec.reconcile(kube.get(CR))
+    status = kube.get(CR)["status"]
+    assert "planner" in status["error"]
+    assert "loosen the objective" in status["error"]
