@@ -3718,6 +3718,353 @@ def bench_fleet_trace() -> dict:
         handle.stop()
 
 
+def bench_multi_model() -> dict:
+    """Serverless multi-model multiplexing: M=4 tiny models share R=2
+    warm-pool replicas (operator/multiplexer.py bin-packer + the
+    router's model-aware pick) vs one dedicated replica per model.
+
+    The fleet problem: one CR per model pins a whole chip for the long
+    tail of rarely-hit models.  The multiplexed shape keeps M models on
+    R < M warm-pool replicas — a model with traffic holds a replica, a
+    cold model holds NOTHING (its requests park at the router; the
+    parked gauge's model label is the wake signal), and the packer
+    swaps models in via snapshot restore on the existing /admin/attach
+    endpoint.
+
+    Measured: the same hot-model request mix through the mux router
+    against 4 dedicated replicas (baseline, 4 chips) and against the
+    2-replica shared pool (2 chips) — chips_saved at equal p99 is the
+    headline.  The swap ladder times the scale-from-zero path
+    (park -> pump/attach -> release -> 200) for a cold model arriving
+    mid-load.  HARD gates: zero lost requests (every parked request
+    completes 200), chips_saved >= 1.5 at equal p99 (3x + 250 ms noise
+    bound), token_agreement 1.0 (each model serves identical tokens
+    from either topology)."""
+    import asyncio
+    import tempfile
+    import threading
+    import urllib.request
+
+    from tpumlops.clients.localplane import free_port, start_model_server
+    from tpumlops.clients.router import RouterProcess
+    from tpumlops.models import llama
+    from tpumlops.operator.multiplexer import Multiplexer, MuxReplica
+    from tpumlops.server.app import build_server
+    from tpumlops.server.loader import save_native_model
+    from tpumlops.utils.config import ServerConfig, TpuSpec
+
+    jax = _setup_jax()
+
+    M, R = 4, 2
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    root = tempfile.mkdtemp()
+    snap_dir = f"{root}/snaps"
+    dims = {
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "num_layers": cfg.num_layers,
+        "num_heads": cfg.num_heads,
+        "num_kv_heads": cfg.num_kv_heads,
+        "intermediate_size": cfg.intermediate_size,
+        "max_seq": cfg.max_seq,
+    }
+    uris = {}
+    for i in range(M):
+        art = f"{root}/m{i}"
+        save_native_model(
+            art, "llama-generate",
+            llama.init(jax.random.key(10 + i), cfg), config=dims,
+        )
+        uris[f"m{i}"] = art
+    uri_to_model = {u: n for n, u in uris.items()}
+    tpu = TpuSpec.from_spec(
+        {
+            "meshShape": {"tp": 1},
+            "maxBatchSize": 2,
+            "maxSlots": 2,
+            "snapshot": {"enabled": True, "dir": snap_dir},
+        }
+    )
+
+    totals = {"requests": 0, "ok": 0}
+
+    def one(router_port: int, model: str, timeout: float = 300.0):
+        """One generate through the router; (wall_ms, tokens)."""
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router_port}/v2/models/{model}/generate",
+            data=json.dumps(
+                {"prompt_ids": [5, 9, 2], "max_new_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        totals["requests"] += 1
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = json.loads(resp.read())
+        totals["ok"] += 1
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        return wall_ms, body["outputs"][0]["data"]
+
+    N_HOT = 16  # timed hot-phase requests per topology (m0/m1 mix)
+
+    # -- baseline: one dedicated replica per model (M chips).  Booting
+    # with snapshots enabled also BAKES each model's snapshot, which is
+    # exactly what the shared pool restores from.
+    dedicated = {}
+    ded_router = None
+    ded_tokens = {}
+    try:
+        for name, uri in uris.items():
+            port = free_port()
+            dedicated[name] = (
+                start_model_server(
+                    uri, "llama-generate", port, model_name=name,
+                    namespace="bench", tpu=tpu, warmup=False,
+                ),
+                port,
+            )
+        ded_router = RouterProcess(
+            port=free_port(),
+            backends={
+                name: ("127.0.0.1", port, 25)
+                for name, (_h, port) in dedicated.items()
+            },
+            namespace="bench",
+            deployment="llm",
+            mux_models=1,
+        ).start()
+        ded_router.admin.set_config(
+            [
+                {"name": name, "host": "127.0.0.1", "port": port,
+                 "weight": 25, "model": name}
+                for name, (_h, port) in dedicated.items()
+            ],
+            namespace="bench", deployment="llm", mux_models=1,
+        )
+        for name in uris:  # prime lazy compiles; canonical tokens
+            _w, toks = one(ded_router.port, name)
+            ded_tokens[name] = toks
+        ded_walls = []
+        for i in range(N_HOT):
+            w, _t = one(ded_router.port, f"m{i % 2}")
+            ded_walls.append(w)
+        ded_walls.sort()
+        dedicated_p99_ms = ded_walls[-1]
+    finally:
+        if ded_router is not None:
+            ded_router.stop()
+        for handle, _port in dedicated.values():
+            handle.stop()
+
+    # -- shared pool: R warm-pool replicas (no weights until attach),
+    # the mux router parking cold-model requests, and the real packer
+    # executing its plan through /admin/attach.
+    def start_warm_replica(port: int):
+        server = build_server(
+            ServerConfig(
+                model_name="llm", model_uri=uris["m0"], tpu=tpu,
+                warm_pool=True,
+            ),
+            warmup=False,
+        )
+        loop = asyncio.new_event_loop()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            from aiohttp import web
+
+            runner = web.AppRunner(server.build_app())
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start()
+            )
+            loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/livez", timeout=1
+                )
+                break
+            except Exception:
+                time.sleep(0.05)
+        return server, loop
+
+    pool_ports = {"rA": free_port(), "rB": free_port()}
+    pool = {n: start_warm_replica(p) for n, p in pool_ports.items()}
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            n: ("127.0.0.1", p, 50) for n, p in pool_ports.items()
+        },
+        namespace="bench",
+        deployment="llm",
+        park_buffer=16,
+        park_timeout_s=120.0,
+        mux_models=1,
+    ).start()
+    mux = Multiplexer(
+        pool="bench-pool",
+        replicas=[
+            MuxReplica(n, url=f"http://127.0.0.1:{p}")
+            for n, p in sorted(pool_ports.items())
+        ],
+        parked=lambda: router.admin.parked().get("models") or {},
+    )
+    for name, uri in uris.items():
+        mux.register(name, uri=uri)
+
+    def sync_router():
+        """What RouterSync does in production: publish the packer's
+        attached-model table so the router routes + releases parks."""
+        held = {
+            r.name: uri_to_model.get(r.attached_uri, "")
+            for r in mux.replicas
+        }
+        router.admin.set_config(
+            [
+                {"name": n, "host": "127.0.0.1", "port": p,
+                 "weight": 50, "model": held.get(n, "")}
+                for n, p in pool_ports.items()
+            ],
+            namespace="bench", deployment="llm", mux_models=1,
+        )
+
+    def parked_requests(models, results):
+        """Fire one request per model on threads; they PARK (no holder
+        yet) until the packer attaches and the router config commits."""
+        threads = []
+        for i, m in enumerate(models):
+            def send(i=i, m=m):
+                try:
+                    results[i] = one(router.port, m)
+                except Exception as e:
+                    results[i] = e
+            t = threading.Thread(target=send, daemon=True)
+            t.start()
+            threads.append(t)
+        return threads
+
+    def wait_parked(n: int):
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            models = router.admin.parked().get("models") or {}
+            if sum(models.values()) >= n:
+                return
+            time.sleep(0.02)
+        raise TimeoutError("requests never parked")
+
+    try:
+        # Phase 1 — wake: the first m0/m1 requests find NO holder (the
+        # pool starts empty: scale-to-zero is the default state), park,
+        # and are released by the packer's attach.
+        res: dict = {}
+        threads = parked_requests(["m0", "m1"], res)
+        wait_parked(2)
+        t0 = time.perf_counter()
+        mux.pump(force=True)
+        sync_router()
+        wake_attach_ms = (time.perf_counter() - t0) * 1000.0
+        for t in threads:
+            t.join(timeout=300)
+        assert all(
+            isinstance(v, tuple) for v in res.values()
+        ), f"wake requests failed: {res}"
+
+        # Phase 2 — hot steady state: the SAME mix the baseline timed.
+        shared_tokens = {}
+        for m in ("m0", "m1"):  # prime post-attach compiles off-clock
+            _w, toks = one(router.port, m)
+            shared_tokens[m] = toks
+        shared_walls = []
+        for i in range(N_HOT):
+            w, _t = one(router.port, f"m{i % 2}")
+            shared_walls.append(w)
+        shared_walls.sort()
+        shared_p99_ms = shared_walls[-1]
+
+        # Phase 3 + 4 — cold-model swaps: m2 then m3 arrive with zero
+        # holders; each parks, the packer REPLACES the lowest-scored
+        # attachment (snapshot restore), the park releases, 200.
+        swap_attach_walls, swap_e2e_walls = [], []
+        for m in ("m2", "m3"):
+            res = {}
+            threads = parked_requests([m], res)
+            wait_parked(1)
+            t0 = time.perf_counter()
+            recs = mux.pump(force=True)
+            sync_router()
+            swap_attach_walls.append(
+                (time.perf_counter() - t0) * 1000.0
+            )
+            assert any(
+                r.action in ("attach", "replace") and r.model == m
+                for r in recs
+            ), [r.as_dict() for r in recs]
+            for t in threads:
+                t.join(timeout=300)
+            assert isinstance(res[0], tuple), f"swap {m} failed: {res}"
+            swap_e2e_walls.append(res[0][0])
+            shared_tokens[m] = res[0][1]
+
+        # The surviving hot model was never displaced by the swaps.
+        _w, toks = one(router.port, "m1")
+        assert toks == ded_tokens["m1"]
+
+        holds_total = sum(
+            1 for rs in mux._pending.values() for r in rs
+            if r.action == "hold"
+        )
+        agreement = float(
+            all(shared_tokens[n] == ded_tokens[n] for n in uris)
+        )
+        lost = totals["requests"] - totals["ok"]
+        chips_saved = round(M / R, 2)  # tp=1: one chip per replica
+        # The acceptance gates — a regression here FAILS the bench.
+        assert lost == 0, f"{lost} lost requests"
+        assert agreement == 1.0, "token disagreement between topologies"
+        assert chips_saved >= 1.5, chips_saved
+        assert shared_p99_ms <= 3.0 * dedicated_p99_ms + 250.0, (
+            shared_p99_ms, dedicated_p99_ms,
+        )
+        assert mux.moves_total >= 4, mux.moves_total  # 2 wakes + 2 swaps
+        return {
+            "models": M,
+            "shared_replicas": R,
+            "dedicated_replicas": M,
+            "requests": totals["requests"],
+            "ok": totals["ok"],
+            "lost": lost,
+            "dedicated_chips": M,
+            "shared_chips": R,
+            "chips_saved": chips_saved,
+            "dedicated_p99_ms": round(dedicated_p99_ms, 1),
+            "shared_p99_ms": round(shared_p99_ms, 1),
+            "p99_ratio": round(
+                shared_p99_ms / max(dedicated_p99_ms, 1e-9), 2
+            ),
+            "wake_attach_ms": round(wake_attach_ms, 1),
+            "swap_attach_ms": round(max(swap_attach_walls), 1),
+            "swap_e2e_p99_ms": round(max(swap_e2e_walls), 1),
+            "swaps_total": mux.moves_total,
+            "holds_total": holds_total,
+            "token_agreement": agreement,
+            "note": "baseline = 4 dedicated replicas (4 chips) behind "
+                    "the same mux router; shared = the 2-replica warm "
+                    "pool (2 chips) with the real bin-packer executing "
+                    "attach/replace via snapshot restore; swap ladder = "
+                    "cold model parks -> pump attaches -> park releases "
+                    "-> 200, measured end to end.",
+        }
+    finally:
+        router.stop()
+        for server, loop in pool.values():
+            server.shutdown()
+            loop.call_soon_threadsafe(loop.stop)
+
+
 def bench_priority_preemption() -> dict:
     """Interactive TTFT under a 2x best-effort flood, mid-decode
     preemption off vs on (server/generation.py ``preemption=True``,
@@ -3903,6 +4250,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("cold_start_serving", "bench_cold_start"),
     ("disaggregated_serving", "bench_disaggregated"),
     ("chaos_serving", "bench_chaos"),
+    ("multi_model_serving", "bench_multi_model"),
     ("fleet_trace_serving", "bench_fleet_trace"),
     ("priority_preemption_serving", "bench_priority_preemption"),
     ("llama_1p35b_decode", "bench_llama_decode"),
@@ -4010,6 +4358,14 @@ SCENARIO_SCHEMAS: dict = {
         "availability_pct", "eject_s", "readmit_s",
         "probe_interval_s", "health_threshold",
         "failover_total", "circuit_open_total",
+    ),
+    "multi_model_serving": (
+        "models", "shared_replicas", "dedicated_replicas",
+        "requests", "ok", "lost",
+        "dedicated_chips", "shared_chips", "chips_saved",
+        "dedicated_p99_ms", "shared_p99_ms", "p99_ratio",
+        "wake_attach_ms", "swap_attach_ms", "swap_e2e_p99_ms",
+        "swaps_total", "holds_total", "token_agreement",
     ),
     "fleet_trace_serving": (
         "requests", "new_tokens_per_request", "journey_ring",
@@ -4145,6 +4501,9 @@ _COMPACT_KEYS = {
     "chaos_serving": (
         "availability_pct", "bare_502", "hangs",
         "eject_s", "readmit_s", "failover_total"),
+    "multi_model_serving": (
+        "chips_saved", "dedicated_p99_ms", "shared_p99_ms",
+        "swap_e2e_p99_ms", "lost", "token_agreement"),
     "fleet_trace_serving": (
         "tok_per_s_off", "tok_per_s_on", "overhead_pct",
         "stitched_shared_ids", "token_agreement"),

@@ -147,6 +147,7 @@ class RouterAdmin:
         namespace: str | None = None,
         deployment: str | None = None,
         journey_ring: int | None = None,
+        mux_models: int | None = None,
     ) -> dict:
         body: dict = {"backends": backends}
         if namespace:
@@ -157,6 +158,12 @@ class RouterAdmin:
             # Fleet trace plane sizing (0 disables; omitted = keep the
             # router's running ring).
             body["journeyRing"] = int(journey_ring)
+        if mux_models is not None:
+            # Multi-model multiplexing toggle (0 disables; omitted =
+            # keep the router's running mode).  Backend entries may then
+            # carry a "model" key — the attached-model table the
+            # model-aware pick and per-model park release consult.
+            body["muxModels"] = int(mux_models)
         return json.loads(self._req("/router/config", "PUT", body))
 
     def metrics_text(self) -> str:
@@ -166,7 +173,10 @@ class RouterAdmin:
         """Park-buffer state (``GET /router/parked``): ``parked`` count,
         ``capacity``, ``oldest_wait_s``, and the released/overflow/
         timeout counters — the operator's wake signal for a CR whose
-        replicas are at zero."""
+        replicas are at zero.  With multiplexing on the body also
+        carries ``models`` — a per-model parked breakdown, so the
+        bin-packer attaches the RIGHT model instead of inferring from
+        the fleet-wide count."""
         return json.loads(self._req("/router/parked"))
 
     def fleet(self) -> dict:
@@ -387,6 +397,13 @@ class RouterSync:
         journey_ring = int(
             annotations.get("tpumlops.dev/fleet-journey-ring") or 0
         )
+        # Multi-model multiplexing: same always-sent contract as the
+        # journey ring (absent = 0) — an omitted toggle would pin a
+        # previously-enabled mux mode on forever after the CR disables
+        # it.  Per-backend attachments ride tpumlops.dev/mux-model-<name>
+        # annotations (the multiplexer stamps them as it executes its
+        # attach plan).
+        mux_models = int(annotations.get("tpumlops.dev/mux-models") or 0)
         backends = []
         for pred in spec.get("predictors") or []:
             name = pred.get("name")
@@ -424,6 +441,16 @@ class RouterSync:
             # pin a backend once tagged prefill out of client traffic
             # forever after disaggregation is turned off.
             entry["role"] = str(pred.get("tpumlopsFleetRole") or "unified")
+            if mux_models:
+                # Attached-model table (explicit "" = detached): sent
+                # ONLY with mux on so the config body — and the router's
+                # survivor-keeping "model" semantics — stay byte-for-
+                # byte for single-model fleets.
+                entry["model"] = str(
+                    pred.get("tpumlopsMuxModel")
+                    or annotations.get(f"tpumlops.dev/mux-model-{name}")
+                    or ""
+                )
             backends.append(entry)
         if backends:
             self.admin.set_config(
@@ -431,6 +458,7 @@ class RouterSync:
                 namespace=meta.get("namespace"),
                 deployment=meta.get("name"),
                 journey_ring=journey_ring,
+                mux_models=mux_models,
             )
 
 
@@ -461,6 +489,7 @@ class RouterProcess:
         failover_retries: int = 0,
         journey_ring: int = 0,
         access_log: bool = False,
+        mux_models: int = 0,
     ):
         self.port = port
         # Values are (host, port, weight) or (host, port, weight, role)
@@ -509,6 +538,12 @@ class RouterProcess:
         # loop mid-request under sustained traffic.
         self.journey_ring = int(journey_ring)
         self.access_log = bool(access_log)
+        # Multi-model multiplexing (default off = old router byte-for-
+        # byte): the model id of a POST's /v2/models/<m>/ path joins the
+        # routing decision — requests reach only replicas whose attached
+        # model (per-backend "model" config key) matches, park per-model
+        # otherwise, and the park release awaits the model's attach.
+        self.mux_models = int(mux_models)
         self.access_log_path: pathlib.Path | None = None
         self._stderr_file = None
         self.proc: subprocess.Popen | None = None
@@ -544,6 +579,8 @@ class RouterProcess:
             argv += ["--journey-ring", str(self.journey_ring)]
         if self.access_log:
             argv += ["--access-log", "1"]
+        if self.mux_models:
+            argv += ["--mux-models", "1"]
         for name, spec in self.backends.items():
             host, port, weight = spec[0], spec[1], spec[2]
             role = spec[3] if len(spec) > 3 else None

@@ -173,11 +173,39 @@ struct Histogram {
 // Backend table + smooth weighted round-robin
 // ---------------------------------------------------------------------------
 
+// Multi-model multiplexing (--mux-models 1, or a "muxModels" key on
+// /router/config): the model id parsed from a POST's /v2/models/<m>/
+// path joins the routing decision — requests go only to a backend whose
+// attached model matches, park per-model when none does, and the park
+// release fires when an attach (a /router/config commit tagging a
+// backend with the model) lands, not merely when a weight flips.
+// 0 (the default) keeps routing, parking, metrics exposition, and every
+// admin body byte-for-byte the single-model router.
+int g_mux = 0;
+
+// Model id of a V2 request path ("/v2/models/<m>/generate" -> "<m>");
+// "" when the path is not model-scoped.
+std::string request_model(const std::string& path) {
+  static const std::string pre = "/v2/models/";
+  if (path.compare(0, pre.size(), pre) != 0) return "";
+  size_t start = pre.size();
+  size_t end = path.find('/', start);
+  if (end == std::string::npos) end = path.size();
+  size_t q = path.find('?', start);
+  if (q != std::string::npos && q < end) end = q;
+  return path.substr(start, end - start);
+}
+
 struct Backend {
   std::string name;  // predictor_name label, e.g. "v3"
   std::string host;
   int port = 0;
   int weight = 0;
+  // Multiplexing: model id this replica currently holds ("" = none /
+  // unknown).  Set from the config's per-backend "model" key (RouterSync
+  // forwards the operator's attach plan); consulted by every pick only
+  // while g_mux is on.
+  std::string model;
   // Disaggregated-fleet role: "unified" (default) serves everything;
   // "decode" joins the prefix-affinity ring and receives KV imports;
   // "prefill" is EXCLUDED from the general SWRR pick — it serves
@@ -299,11 +327,16 @@ struct RouterState {
   // ``exclude`` (may be null) holds backends already tried by this
   // request's failover budget — shared_ptrs, same lifetime contract as
   // pick_prefill's list.
-  BackendPtr pick(const std::vector<BackendPtr>* exclude = nullptr) {
+  // ``model`` (may be null/empty) restricts the pick to backends whose
+  // attached model matches — the multiplexing filter; no-op with g_mux
+  // off so the single-model interleave is untouched.
+  BackendPtr pick(const std::vector<BackendPtr>* exclude = nullptr,
+                  const std::string* model = nullptr) {
     BackendPtr best;
     int total = 0;
     for (auto& b : backends) {
       if (!backend_usable(*b) || b->role == "prefill") continue;
+      if (g_mux && model && !model->empty() && b->model != *model) continue;
       if (exclude) {
         bool skip = false;
         for (const BackendPtr& e : *exclude)
@@ -387,6 +420,7 @@ struct Journey {
   double wall_arrival = 0.0; // unix epoch
   std::string method, path;
   std::string affinity = "none";  // none | hit | miss | fallback
+  std::string model;  // mux: request's model id (field emitted only with mux on)
   int failovers = 0;
   int circuits_open = 0;  // open circuits at dispatch time
   std::string backend;    // backend that produced the final response
@@ -655,11 +689,13 @@ struct BackendSpec {
   std::string name, host;
   int port = 0, weight = 0;
   std::string role;  // "" = keep survivor's role (or "unified")
+  std::string model;      // mux: attached model id ("" + model_set = detach)
+  bool model_set = false; // absent key = keep the survivor's model
 };
 
 bool parse_config(const std::string& body, std::string* ns, std::string* dep,
                   std::vector<BackendSpec>* specs,
-                  int* journey_ring = nullptr) {
+                  int* journey_ring = nullptr, int* mux_models = nullptr) {
   JsonParser j(body);
   if (!j.consume('{')) return false;
   while (j.ok && !j.peek('}')) {
@@ -676,6 +712,13 @@ bool parse_config(const std::string& body, std::string* ns, std::string* dep,
         *journey_ring =
             (v < 0 || v > double(kMaxJourneyRing)) ? -2 : int(v);
     }
+    else if (key == "muxModels") {
+      // Same always-sent contract as journeyRing: RouterSync forwards
+      // the manifest's tpumlops.dev/mux-models annotation (absent = 0)
+      // so disabling multiplexing on the CR actually disables it here.
+      double v = j.parse_number();
+      if (mux_models) *mux_models = (v < 0 || v > 1) ? -2 : int(v);
+    }
     else if (key == "backends") {
       if (!j.consume('[')) return false;
       while (j.ok && !j.peek(']')) {
@@ -689,6 +732,7 @@ bool parse_config(const std::string& body, std::string* ns, std::string* dep,
           else if (k2 == "port") s.port = int(j.parse_number());
           else if (k2 == "weight") s.weight = int(j.parse_number());
           else if (k2 == "role") s.role = j.parse_string();
+          else if (k2 == "model") { s.model = j.parse_string(); s.model_set = true; }
           else j.skip_value();
           if (j.peek(',')) j.consume(',');
         }
@@ -885,6 +929,10 @@ struct ClientConn {
   int retries = 0;     // stale pooled-connection retries this request
   bool closing = false;   // close after out drains
   bool feedback = false;  // current request is /api/v1.0/feedback
+  // Multiplexing: model id of the current request ("" = not model-
+  // scoped, or mux off).  Drives the model-filtered pick, per-model
+  // parking, and the model label on the parked gauge.
+  std::string model;
   bool parked = false;    // held in the scale-to-zero park buffer
   double park_t = 0;      // when parking began (monotonic)
   // FIRST park instant of the current request (0 = never parked):
@@ -1490,9 +1538,28 @@ std::string metrics_text() {
            "deployment_name=\"%s\",namespace=\"%s\"",
            g_state.deployment.c_str(), g_state.ns.c_str());
   out += "# TYPE tpumlops_router_parked_requests gauge\n";
-  snprintf(line, sizeof(line), "tpumlops_router_parked_requests{%s} %zu\n",
-           plabels, g_parked.size());
-  out += line;
+  if (g_mux) {
+    // Multiplexing: the gauge grows a model label so the operator wakes
+    // the RIGHT model from zero (a fleet-wide number cannot say whose
+    // requests wait).  "" = parked before a model-scoped path matched.
+    std::map<std::string, size_t> per_model;
+    for (ClientConn* pc : g_parked) per_model[pc->model]++;
+    if (per_model.empty()) {
+      snprintf(line, sizeof(line),
+               "tpumlops_router_parked_requests{%s} 0\n", plabels);
+      out += line;
+    } else {
+      for (auto& [m, n] : per_model) {
+        out += "tpumlops_router_parked_requests{" + std::string(plabels) +
+               ",model=\"" + json_escape(m) + "\"} " + std::to_string(n) +
+               "\n";
+      }
+    }
+  } else {
+    snprintf(line, sizeof(line), "tpumlops_router_parked_requests{%s} %zu\n",
+             plabels, g_parked.size());
+    out += line;
+  }
   out += "# TYPE tpumlops_router_parked_total counter\n";
   snprintf(line, sizeof(line), "tpumlops_router_parked_total{%s} %llu\n",
            plabels, (unsigned long long)g_parked_total);
@@ -1515,6 +1582,20 @@ std::string metrics_text() {
   out += "# TYPE tpumlops_router_park_wait_seconds histogram\n";
   emit_histogram(&out, "tpumlops_router_park_wait_seconds", plabels,
                  g_park_wait_seconds);
+  if (g_mux) {
+    // Multiplexing attachment table: usable replicas per model.  0 for a
+    // model some backend is tagged with but whose holders are all down —
+    // the operator's re-attach signal.  Family absent with mux off
+    // (byte-for-byte exposition).
+    out += "# TYPE tpumlops_router_model_backends gauge\n";
+    std::map<std::string, int> holders;
+    for (auto& b : g_state.backends)
+      if (!b->model.empty())
+        holders[b->model] += backend_usable(*b) && b->role != "prefill";
+    for (auto& [m, n] : holders)
+      out += "tpumlops_router_model_backends{" + std::string(plabels) +
+             ",model=\"" + json_escape(m) + "\"} " + std::to_string(n) + "\n";
+  }
   // Disaggregated-fleet routing: affinity ring outcomes and the KV
   // handoff relay.  Deployment-scoped like the park series — the
   // decision happens before any predictor is picked.
@@ -1594,6 +1675,7 @@ std::string config_json() {
     // Emitted only when enabled so the default config shape stays
     // byte-for-byte what callers have pinned.
     out += "\"journeyRing\":" + std::to_string(g_journey_ring) + ",";
+  if (g_mux) out += "\"muxModels\":1,";
   out += "\"backends\":[";
   bool first = true;
   for (auto& b : g_state.backends) {
@@ -1602,10 +1684,12 @@ std::string config_json() {
     char item[512];
     snprintf(item, sizeof(item),
              "{\"name\":\"%s\",\"host\":\"%s\",\"port\":%d,\"weight\":%d,"
-             "\"role\":\"%s\"}",
+             "\"role\":\"%s\"",
              b->name.c_str(), b->host.c_str(), b->port, b->weight,
              b->role.c_str());
     out += item;
+    if (g_mux) out += ",\"model\":\"" + json_escape(b->model) + "\"";
+    out += "}";
   }
   out += "]}";
   return out;
@@ -1632,8 +1716,10 @@ std::string journey_json(const Journey& j) {
            (long long)journey_us(j.t_arrival), j.wall_arrival);
   out += num;
   out += "\"method\":\"" + json_escape(j.method) + "\",\"path\":\"" +
-         json_escape(j.path) + "\",\"affinity\":\"" + j.affinity +
-         "\",\"backend\":\"" + json_escape(j.backend) + "\",\"role\":\"" +
+         json_escape(j.path) + "\",\"affinity\":\"" + j.affinity + "\",";
+  if (g_mux)  // mux only: the export shape stays pinned with mux off
+    out += "\"model\":\"" + json_escape(j.model) + "\",";
+  out += "\"backend\":\"" + json_escape(j.backend) + "\",\"role\":\"" +
          json_escape(j.role) + "\",\"outcome\":\"" + j.outcome + "\",";
   snprintf(num, sizeof(num),
            "\"status\":%d,\"failovers\":%d,\"circuits_open\":%d,",
@@ -1811,10 +1897,11 @@ void drain_pool(Backend* b) {
 // shift live traffic).
 std::string apply_config(const std::string& ns, const std::string& dep,
                          const std::vector<BackendSpec>& specs,
-                         int journey_ring = -1) {
+                         int journey_ring = -1, int mux_models = -1) {
   if (journey_ring == -2 || journey_ring > kMaxJourneyRing)
     return "journeyRing out of range (0.." +
            std::to_string(kMaxJourneyRing) + ")";
+  if (mux_models == -2) return "muxModels must be 0 or 1";
   struct Staged {
     BackendPtr survivor;  // null for new backends
     BackendSpec spec;
@@ -1869,6 +1956,7 @@ std::string apply_config(const std::string& ns, const std::string& dep,
         // old one is known to the new one — and the old pod's failure
         // record must not keep the new one's circuit open.
         st.survivor->known_prefixes.clear();
+        if (!st.spec.model_set) st.survivor->model.clear();
         st.survivor->circuit_open = false;
         st.survivor->consecutive_failures = 0;
         st.survivor->probe_interval = 0.0;
@@ -1876,6 +1964,10 @@ std::string apply_config(const std::string& ns, const std::string& dep,
       }
       st.survivor->weight = st.spec.weight;
       if (!st.spec.role.empty()) st.survivor->role = st.spec.role;
+      // Attach/replace/detach lands here: an explicit "model" key (even
+      // "") is authoritative; an absent key keeps the survivor's model
+      // (weight-only syncs must not amnesia the attachment table).
+      if (st.spec.model_set) st.survivor->model = st.spec.model;
       next.push_back(st.survivor);
     } else {
       auto b = std::make_shared<Backend>();
@@ -1884,6 +1976,7 @@ std::string apply_config(const std::string& ns, const std::string& dep,
       b->port = st.spec.port;
       b->weight = st.spec.weight;
       if (!st.spec.role.empty()) b->role = st.spec.role;
+      if (st.spec.model_set) b->model = st.spec.model;
       b->addr = st.addr;
       next.push_back(std::move(b));
     }
@@ -1904,6 +1997,7 @@ std::string apply_config(const std::string& ns, const std::string& dep,
   g_state.backends = std::move(next);
   for (auto& b : removed) drain_pool(b.get());
   rebuild_ring();  // membership/roles may have changed
+  if (mux_models >= 0) g_mux = mux_models;
   if (journey_ring >= 0 && journey_ring != g_journey_ring) {
     // Operator-driven trace plane (RouterSync sends the manifest's
     // tpumlops.dev/fleet-journey-ring annotation).  Shrinking trims the
@@ -1936,17 +2030,34 @@ void handle_admin(ClientConn* c) {
       double wait = now - pc->park_t;
       if (wait > oldest) oldest = wait;
     }
-    char body[256];
-    snprintf(body, sizeof(body),
+    char head[256];
+    snprintf(head, sizeof(head),
              "{\"parked\":%zu,\"capacity\":%d,\"oldest_wait_s\":%.3f,"
              "\"parked_total\":%llu,\"released_total\":%llu,"
-             "\"overflow_total\":%llu,\"timeout_total\":%llu}",
+             "\"overflow_total\":%llu,\"timeout_total\":%llu",
              g_parked.size(), g_park_max, oldest,
              (unsigned long long)g_parked_total,
              (unsigned long long)g_park_released_total,
              (unsigned long long)g_park_overflow_total,
              (unsigned long long)g_park_timeout_total);
-    client_send(c, http_response(200, "OK", "application/json", body));
+    std::string out = head;
+    if (g_mux) {
+      // Per-model breakdown (multiplexing only — the body stays
+      // byte-for-byte with mux off): which model's requests wait, so
+      // the bin-packer attaches the RIGHT one.
+      std::map<std::string, size_t> per_model;
+      for (ClientConn* pc : g_parked) per_model[pc->model]++;
+      out += ",\"models\":{";
+      bool first = true;
+      for (auto& [m, n] : per_model) {
+        if (!first) out += ",";
+        first = false;
+        out += "\"" + json_escape(m) + "\":" + std::to_string(n);
+      }
+      out += "}";
+    }
+    out += "}";
+    client_send(c, http_response(200, "OK", "application/json", out));
   } else if (path == "/router/fleet") {
     // Disaggregated-fleet introspection: ring size, affinity and
     // handoff tallies, per-backend role + known-prefix counts.
@@ -1973,13 +2084,16 @@ void handle_admin(ClientConn* c) {
       snprintf(buf, sizeof(buf),
                "{\"name\":\"%s\",\"role\":\"%s\",\"weight\":%d,"
                "\"known_prefixes\":%zu,\"healthy\":%s,"
-               "\"consecutive_failures\":%d,\"circuit_opened\":%llu}",
+               "\"consecutive_failures\":%d,\"circuit_opened\":%llu",
                b->name.c_str(), b->role.c_str(), b->weight,
                b->known_prefixes.size(),
                b->circuit_open ? "false" : "true",
                b->consecutive_failures,
                (unsigned long long)b->circuit_open_total);
       out += buf;
+      if (g_mux)  // attachment table rides the fleet view with mux on
+        out += ",\"model\":\"" + json_escape(b->model) + "\"";
+      out += "}";
     }
     out += "]}";
     client_send(c, http_response(200, "OK", "application/json", out));
@@ -2035,8 +2149,9 @@ void handle_admin(ClientConn* c) {
     std::string ns, dep;
     std::vector<BackendSpec> specs;
     int journey_ring = -1;  // absent = keep the running ring
-    if (parse_config(body, &ns, &dep, &specs, &journey_ring)) {
-      std::string bad = apply_config(ns, dep, specs, journey_ring);
+    int mux_models = -1;    // absent = keep the running mux mode
+    if (parse_config(body, &ns, &dep, &specs, &journey_ring, &mux_models)) {
+      std::string bad = apply_config(ns, dep, specs, journey_ring, mux_models);
       if (bad.empty()) {
         client_send(c, http_response(200, "OK", "application/json", config_json()));
         // Capacity may just have returned (a replica came back / the
@@ -2116,6 +2231,20 @@ bool any_usable_client_backend() {
   return false;
 }
 
+// Multiplexing-aware capacity check: with mux on and a model-scoped
+// request, only a usable backend HOLDING the model counts — a fleet
+// full of healthy replicas serving other models is still "no capacity"
+// for this request (it parks until an attach lands).  Collapses to
+// any_usable_client_backend with mux off or a model-less request.
+bool any_usable_for_model(const std::string& model) {
+  for (auto& b : g_state.backends) {
+    if (!backend_usable(*b) || b->role == "prefill") continue;
+    if (g_mux && !model.empty() && b->model != model) continue;
+    return true;
+  }
+  return false;
+}
+
 // An upstream leg failed.  ``first_byte_seen`` = response bytes had
 // arrived before the failure (generation may have started; the request
 // is no longer failover-idempotent).  With --failover-retries 0 (the
@@ -2155,7 +2284,7 @@ void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
     if (c->backend) c->failover_tried.push_back(c->backend);
     const bool replayable = !first_byte_seen && !c->feedback;
     if (replayable && c->failover_attempts < g_failover_retries) {
-      BackendPtr next = g_state.pick(&c->failover_tried);
+      BackendPtr next = g_state.pick(&c->failover_tried, &c->model);
       if (next) {
         c->failover_attempts++;
         g_failover_total++;
@@ -2171,7 +2300,7 @@ void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
     // capacity instead of bouncing 503s — but ONLY while replay is
     // idempotent: a response that had started (generation may have
     // run) sheds typed instead of being re-dispatched from the park.
-    if (replayable && !any_usable_client_backend() && g_park_max > 0) {
+    if (replayable && !any_usable_for_model(c->model) && g_park_max > 0) {
       if (int(g_parked.size()) < g_park_max) {
         c->parked = true;
         c->park_t = now_s();
@@ -2504,8 +2633,19 @@ bool try_affinity_route(ClientConn* c) {
     return false;
   uint64_t h = 0;
   if (!affinity_hash(client_body(c), &h)) return false;
+  if (g_mux && !c->model.empty()) {
+    // The model id joins the affinity key: identical prompts of two
+    // DIFFERENT models must not collide on one ring slot (the cache a
+    // hit would reuse belongs to the other model's weights).
+    for (char ch : c->model) {
+      h ^= (unsigned char)ch;
+      h *= 1099511628211ULL;  // FNV-1a prime, same mix as affinity_hash
+    }
+  }
   BackendPtr d = pick_decode(h);
   if (!d) return false;  // no live decode pool: plain routing
+  if (g_mux && !c->model.empty() && d->model != c->model)
+    return false;  // ring target serves another model: model-filtered pick
   c->relay_hash = h;
   if (d->known_prefixes.count(h)) {
     g_affinity_hits++;
@@ -2538,8 +2678,14 @@ bool try_affinity_route(ClientConn* c) {
 }
 
 void start_proxy(ClientConn* c) {
+  // Model-scoped POSTs only: a GET (readiness poll, metadata) must
+  // never park behind a missing attachment — it routes anywhere.
+  c->model = (g_mux && c->req.method == "POST")
+                 ? request_model(c->req.path)
+                 : std::string();
+  if (c->journey && g_mux) c->journey->model = c->model;
   if (try_affinity_route(c)) return;
-  BackendPtr b = g_state.pick();
+  BackendPtr b = g_state.pick(nullptr, &c->model);
   if (!b) {
     if (g_park_max > 0) {
       if (int(g_parked.size()) < g_park_max) {
@@ -2561,6 +2707,22 @@ void start_proxy(ClientConn* c) {
       client_send(c, park_503_body("park_overflow",
                                    int(g_park_timeout_s), c));
       journey_finish(c, 503, "shed_park_overflow");
+      c->req.reset();
+      return;
+    }
+    if (g_mux && !c->model.empty() && any_usable_client_backend()) {
+      // Parking disabled, healthy capacity exists, but no replica holds
+      // this model: typed, retryable — the operator's next convergence
+      // pass attaches it.  Never the bare no-backend 503 (capacity is
+      // NOT the problem).
+      std::string body =
+          "{\"error\":\"no replica holds model " + json_escape(c->model) +
+          "\",\"reason\":\"model_not_attached\",\"retry_after_s\":1" +
+          rid_json_field(c) + "}";
+      std::string hdr = "Retry-After: 1\r\n" + echo_header(c);
+      client_send(c, http_response(503, "Service Unavailable",
+                                   "application/json", body, hdr));
+      journey_finish(c, 503, "shed_model_not_attached");
       c->req.reset();
       return;
     }
@@ -2602,8 +2764,16 @@ void release_parked() {
   for (auto& b : g_state.backends)
     if (backend_usable(*b)) capacity = true;
   if (!capacity) return;
-  std::vector<ClientConn*> waiting;
-  waiting.swap(g_parked);
+  // Multiplexing: release ONLY requests whose model a usable backend now
+  // holds — an attach (config commit tagging a backend) wakes exactly
+  // that model's queue; everyone else keeps waiting for theirs.  With
+  // mux off every entry passes the filter, so the whole buffer releases
+  // FIFO exactly as before.
+  std::vector<ClientConn*> waiting, keep;
+  for (ClientConn* c : g_parked)
+    (any_usable_for_model(c->model) ? waiting : keep).push_back(c);
+  if (waiting.empty()) return;
+  g_parked = std::move(keep);
   for (ClientConn* c : waiting) {
     c->parked = false;
     // CUMULATIVE wait (first park of this request): a release/re-park
@@ -2973,7 +3143,7 @@ void usage() {
       "       [--affinity-tokens N] [--kv-handoff 0|1] [--handoff-retries N]\n"
       "       [--health-probes 0|1] [--health-threshold N]\n"
       "       [--probe-interval-s S] [--failover-retries N]\n"
-      "       [--journey-ring N] [--access-log 0|1]");
+      "       [--journey-ring N] [--access-log 0|1] [--mux-models 0|1]");
 }
 
 }  // namespace
@@ -3001,6 +3171,7 @@ int main(int argc, char** argv) {
     else if (a == "--failover-retries") g_failover_retries = atoi(next().c_str());
     else if (a == "--journey-ring") g_journey_ring = atoi(next().c_str());
     else if (a == "--access-log") g_access_log = atoi(next().c_str());
+    else if (a == "--mux-models") g_mux = atoi(next().c_str());
     else if (a == "--backend") {
       // name=host:port:weight[:role]
       std::string v = next();

@@ -694,6 +694,14 @@ def build_deployment(
         annotations["tpumlops.dev/fleet-journey-ring"] = str(
             config.fleet.observability.journey_ring
         )
+    if config.backend == "tpu" and config.multiplex.enabled:
+        # Multiplexing contract (absent = byte-for-byte): RouterSync
+        # reads mux-models to arm model-aware routing, and the shared
+        # pool's packer reads poolRef/weight — same manifest-as-handoff
+        # pattern as the fleet knobs above.
+        annotations["tpumlops.dev/mux-models"] = "1"
+        annotations["tpumlops.dev/mux-pool"] = str(config.multiplex.pool_ref)
+        annotations["tpumlops.dev/mux-weight"] = str(config.multiplex.weight)
 
     return {
         "apiVersion": SELDON_API_VERSION,

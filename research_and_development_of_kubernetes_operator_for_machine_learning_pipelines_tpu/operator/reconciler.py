@@ -126,6 +126,9 @@ class ReconcileOutcome:
     # {slo_name: SloEval} when spec.slo is configured (None otherwise);
     # OperatorTelemetry reads it for the tpumlops_operator_slo_* gauges.
     slo: Any = None
+    # The step's MuxRecords when this CR is multiplexed (None otherwise);
+    # OperatorTelemetry reads them for tpumlops_operator_mux_*.
+    mux: Any = None
 
 
 class Reconciler:
@@ -149,6 +152,7 @@ class Reconciler:
         warmup=None,  # Callable[(deployment, predictor, namespace, n)]; synthetic traffic
         recorder=None,  # RolloutRecorder | None; per-CR gate/phase journal
         wall=None,  # Callable[[], float]; unix-epoch seconds (tests inject)
+        mux_pools=None,  # Mapping[str, multiplexer.Multiplexer] | None
     ):
         self.name = name
         self.namespace = namespace
@@ -209,6 +213,12 @@ class Reconciler:
         # computed once and cached until the spec or trace file changes
         # — a reconcile poll must not re-run the grid search.
         self._plan_cache: dict = {}
+        # Shared-pool multiplexers (operator/multiplexer.py), keyed by
+        # spec.multiplex.poolRef and SHARED across every member CR's
+        # reconciler — the runtime (or a test harness) owns the mapping.
+        # None/missing pool = this CR surfaces status only; the pump,
+        # journal drain, and mux events all no-op.
+        self.mux_pools = mux_pools
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -238,6 +248,7 @@ class Reconciler:
         self._timings = {}
         self._pending_records = []
         self._scale_record = None
+        self._mux_records = None
         self._step_engine_obs = False
         # Reset per step: an early-returning _slo_step (spec didn't
         # parse, nothing serving) must export NO evals, not re-export
@@ -271,6 +282,7 @@ class Reconciler:
         outcome.slo = self._slo_evals
         outcome.timings = self._timings
         outcome.scale = self._scale_record
+        outcome.mux = self._mux_records
         # Flush the step's journal records.  Gate records get the step's
         # COMPLETE op-timer breakdown here (the status.history copy was
         # written mid-step, before its own status_patch could be timed).
@@ -300,6 +312,8 @@ class Reconciler:
         self._had_snapshot_key = prior_status.get("snapshot") is not None
         # Disaggregated-fleet pool counts: same explicit-null contract.
         self._had_fleet_key = prior_status.get("fleet") is not None
+        # Multiplexed-pool view: same explicit-null contract.
+        self._had_multiplex_key = prior_status.get("multiplex") is not None
         # Device-telemetry capacity summary: recomputed from spec each
         # step (no state round-trip needed); the explicit-null contract
         # mirrors the journal/scaler keys so disabling clears it once.
@@ -384,6 +398,7 @@ class Reconciler:
             state = self._shed_disabled_journal(config, state)
             state = self._autoscale_step(obj, config, state, events)
             state = self._fleet_step(obj, config, state, events)
+            state = self._multiplex_step(obj, config, state, events)
             return ReconcileOutcome(state, config.monitoring_interval_s, events)
 
         # 3. New version detected (reference :97-149).
@@ -404,6 +419,7 @@ class Reconciler:
             state = self._shed_disabled_journal(config, state)
             state = self._autoscale_step(obj, config, state, events)
             state = self._fleet_step(obj, config, state, events)
+            state = self._multiplex_step(obj, config, state, events)
         return ReconcileOutcome(state, config.monitoring_interval_s, events)
 
     def _planner_step(
@@ -997,6 +1013,77 @@ class Reconciler:
             self._patch_status(new_state)
         return new_state
 
+    def _multiplex_step(
+        self,
+        obj: dict,
+        config: OperatorConfig,
+        state: PromotionState,
+        events: list[Event],
+    ) -> PromotionState:
+        """One multiplexer pass for a pool-member CR (steady state only,
+        like the autoscaler — a mid-canary CR must not be swapped out
+        from under the judge).
+
+        Registers this CR with its shared-pool coordinator
+        (operator/multiplexer.py), pumps one observe→plan→execute pass
+        (rate-limited inside the coordinator so N members don't N-fold
+        the convergence rate — attaches go through the existing
+        warm-pool admin endpoint), journals the resulting MuxRecords
+        into THIS CR's status.history, and publishes status.multiplex.
+        Disabled = the key clears once, then byte-for-byte."""
+        mux = config.multiplex
+        if not mux.enabled:
+            if state.multiplex is not None:
+                state = state.with_(multiplex=None)
+                self._patch_status(state)
+            return state
+        status: dict = {"pool": mux.pool_ref, "weight": mux.weight}
+        coord = (self.mux_pools or {}).get(mux.pool_ref)
+        recs = []
+        if coord is not None:
+            uri = None
+            if state.current_version is not None:
+                try:
+                    # The ATTACHABLE artifact uri (what the pool restores
+                    # from), not the raw registry source.
+                    uri = self._resolve_uri(config, state.current_version)
+                except Exception as e:  # registry blip: keep the last
+                    self.log.warning(f"mux uri resolution failed: {e}")
+            if uri:
+                coord.register(self.name, uri=uri, weight=mux.weight)
+            with self._op_timer("mux_pump"):
+                coord.pump()
+            recs = coord.take_records(self.name)
+            status.update(coord.model_status(self.name))
+        self._mux_records = recs
+        new_state = state.with_(multiplex=status)
+        new_state = self._journal(config, new_state, *recs)
+        if new_state != state:
+            self._patch_status(new_state)
+        for rec in recs:
+            if rec.action in ("attach", "replace"):
+                ev = Event(
+                    "Normal",
+                    "MuxAttached",
+                    f"Multiplexer {rec.action}ed {rec.model} onto "
+                    f"{rec.replica} in pool {rec.pool} "
+                    f"(score {rec.score:g}, {rec.parked} parked).",
+                )
+                events.append(ev)
+                self.kube.emit_event(self.cr_ref, ev)
+                self.log.info(ev.message)
+            elif rec.action == "error":
+                ev = Event(
+                    "Warning",
+                    "MuxAttachFailed",
+                    f"Multiplexer could not attach {rec.model}: "
+                    f"{rec.reason}.",
+                )
+                events.append(ev)
+                self.kube.emit_event(self.cr_ref, ev)
+                self.log.warning(ev.message)
+        return new_state
+
     def _snapshot_status(self, config: OperatorConfig, state) -> "dict | None":
         """``status.snapshot`` for a CR parked at zero: the deterministic
         snapshot location (``server/snapshot.py`` keys it by model URI;
@@ -1222,6 +1309,7 @@ class Reconciler:
         if new_state.phase == Phase.STABLE:
             new_state = self._autoscale_step(obj, config, new_state, events)
             new_state = self._fleet_step(obj, config, new_state, events)
+            new_state = self._multiplex_step(obj, config, new_state, events)
 
         # Canary: go straight to the first gate check (the reference enters
         # its metrics loop immediately after the initial apply, :296-310).
@@ -1733,6 +1821,8 @@ class Reconciler:
             status.setdefault("snapshot", None)
         if getattr(self, "_had_fleet_key", False):
             status.setdefault("fleet", None)
+        if getattr(self, "_had_multiplex_key", False):
+            status.setdefault("multiplex", None)
         if getattr(self, "_capacity_known", False):
             cap = self._capacity_status
             if cap is not None:

@@ -79,6 +79,7 @@ class OperatorRuntime:
         telemetry=None,
         recorder=None,
         max_concurrent_reconciles: int = 1,
+        mux_pools=None,
     ):
         if metrics is None and metrics_factory is None:
             raise ValueError(
@@ -92,6 +93,10 @@ class OperatorRuntime:
         self.warmup = warmup
         self.telemetry = telemetry  # OperatorTelemetry | None (SURVEY §5)
         self.recorder = recorder  # RolloutRecorder | None (gate journal)
+        # Mapping[poolRef, Multiplexer] — the shared warm-pool
+        # coordinators CRs with spec.multiplex bind to.  Runtime-owned
+        # (one coordinator outlives any single CR), reconciler-driven.
+        self.mux_pools = mux_pools
         self.clock = clock or SystemClock()
         self.namespace = namespace
         self.sync_interval_s = sync_interval_s
@@ -150,6 +155,7 @@ class OperatorRuntime:
                             metrics_factory=self.metrics_factory,
                             warmup=self.warmup,
                             recorder=self.recorder,
+                            mux_pools=self.mux_pools,
                         ),
                         due_at=self.clock.now(),  # reconcile promptly
                     )

@@ -86,6 +86,11 @@ class PromotionState:
     # {...}}.  None (and omitted from status) when disaggregation is
     # off — an unannotated CR's status stays byte-for-byte.
     fleet: Any = None
+    # Multi-model multiplexing (spec.multiplex, operator/multiplexer.py):
+    # this CR's view of its shared pool, e.g. {"pool": "shared-a",
+    # "weight": 2.0, "attachedReplicas": [...], "parked": 3}.  None (and
+    # omitted from status) when the CR is not multiplexed.
+    multiplex: Any = None
 
     # -- transitions (pure; each returns a new state) -----------------------
 
@@ -107,6 +112,7 @@ class PromotionState:
             scaler=self.scaler,
             snapshot=self.snapshot,
             fleet=self.fleet,
+            multiplex=self.multiplex,
         )
 
     def new_version(self, version: str, initial_traffic: int) -> "PromotionState":
@@ -136,6 +142,7 @@ class PromotionState:
                 scaler=self.scaler,
                 snapshot=self.snapshot,
             fleet=self.fleet,
+            multiplex=self.multiplex,
             )
         if (
             self.previous_version is not None
@@ -159,6 +166,7 @@ class PromotionState:
                 scaler=self.scaler,
                 snapshot=self.snapshot,
             fleet=self.fleet,
+            multiplex=self.multiplex,
             )
         return PromotionState(
             phase=Phase.CANARY,
@@ -177,6 +185,7 @@ class PromotionState:
             scaler=self.scaler,
             snapshot=self.snapshot,
             fleet=self.fleet,
+            multiplex=self.multiplex,
         )
 
     def promoted_step(self, step: int) -> "PromotionState":
@@ -215,6 +224,7 @@ class PromotionState:
             scaler=self.scaler,
             snapshot=self.snapshot,
             fleet=self.fleet,
+            multiplex=self.multiplex,
         )
 
     # -- serialization ------------------------------------------------------
@@ -328,6 +338,8 @@ class PromotionState:
             status["snapshot"] = dict(self.snapshot)
         if self.fleet is not None:
             status["fleet"] = dict(self.fleet)
+        if self.multiplex is not None:
+            status["multiplex"] = dict(self.multiplex)
         return status
 
     @classmethod
@@ -374,4 +386,5 @@ class PromotionState:
             scaler=status.get("autoscaler"),
             snapshot=status.get("snapshot"),
             fleet=status.get("fleet"),
+            multiplex=status.get("multiplex"),
         )

@@ -200,6 +200,22 @@ class OperatorTelemetry:
             ident + ["slo"],
             registry=self.registry,
         )
+        # Multi-model multiplexing (spec.multiplex; operator/
+        # multiplexer.py) — no samples until a CR joins a shared pool.
+        self.mux_moves = Counter(
+            "tpumlops_operator_mux_moves_total",
+            "Executed multiplexer moves by action (attach = onto an "
+            "empty replica, replace = evicted another model)",
+            ident + ["action"],
+            registry=self.registry,
+        )
+        self.mux_parked = Gauge(
+            "tpumlops_operator_mux_parked_requests",
+            "Router-parked requests awaiting this model's attach, as "
+            "last observed by the multiplexer",
+            ident,
+            registry=self.registry,
+        )
         self.rollout_seconds = Histogram(
             "tpumlops_operator_rollout_duration_seconds",
             "Wall time from NEW_VERSION detection to a terminal phase "
@@ -294,6 +310,18 @@ class OperatorTelemetry:
                 self._child(
                     self.autoscale_holds, namespace, name, scale.hold
                 ).inc()
+        mux = getattr(outcome, "mux", None)
+        if mux is not None:
+            for rec in mux:
+                if rec.action in ("attach", "replace"):
+                    self._child(
+                        self.mux_moves, namespace, name, rec.action
+                    ).inc()
+            muxv = getattr(state, "multiplex", None) or {}
+            if muxv.get("parked") is not None:
+                self._child(self.mux_parked, namespace, name).set(
+                    muxv["parked"]
+                )
         slo = getattr(outcome, "slo", None)
         slo_gauges = (
             self.slo_attainment, self.slo_budget_remaining,

@@ -160,6 +160,7 @@ class TpuInferenceServer:
         attach_fn=None,
         cold_start_anchor_wall: float | None = None,
         fleet_role: str = "unified",
+        snapshot_dir=None,
     ):
         self.engine = engine
         self.metrics = metrics
@@ -185,6 +186,14 @@ class TpuInferenceServer:
         # model URI on demand — None on a normal (model-at-boot) server.
         self.attach_fn = attach_fn
         self.predictor = None  # set by attach (release target on replace)
+        # Attached-model identity contract (warm-pool only): what is on
+        # the device right now, echoed by /readyz and /admin/attach so a
+        # multiplexing bin-packer can prove convergence (and skip swaps
+        # that would restore identical weights) without device access.
+        self.snapshot_dir = snapshot_dir
+        self.attached_model_uri: str | None = None
+        self.attached_snapshot_hash: str | None = None
+        self._attached_geometry: dict | None = None
         self._batch_geometry = (max_batch_size, max_batch_delay_ms,
                                 max_inflight_batches)
         # Wall-clock anchor of the current cold start (wake signal time
@@ -236,6 +245,29 @@ class TpuInferenceServer:
             status=503,
             headers={"Retry-After": "5"},
         )
+
+    def _snapshot_probe(
+        self, model_uri: str
+    ) -> tuple[str | None, dict | None]:
+        """Best-effort (content_hash, geometry) of ``model_uri``'s
+        on-disk snapshot — (None, None) when there is no snapshot yet
+        (first attach of a raw model writes one during the load)."""
+        if not self.snapshot_dir:
+            return None, None
+        try:
+            from . import snapshot as _snap
+
+            spath = _snap.snapshot_path_for(self.snapshot_dir, model_uri)
+            if not (spath / _snap.MANIFEST_NAME).exists():
+                return None, None
+            manifest = _snap.read_manifest(spath)
+            geom = manifest.get("config")
+            return (
+                manifest.get("content_hash"),
+                dict(geom) if isinstance(geom, dict) else None,
+            )
+        except Exception:
+            return None, None
 
     def note_first_token(self) -> None:
         """First token served since the cold-start anchor: close the
@@ -546,6 +578,13 @@ class TpuInferenceServer:
             return err
         t0 = time.perf_counter()
         code = 200
+        # Multiplexed warm pool: the wildcard route carries the model id
+        # the router addressed; it keys the per-model admission share so
+        # a flooded hot model sheds at its share instead of filling the
+        # whole queue against the tail models.  The literal (boot-name)
+        # route has no mux_model — the ledger stays untouched there.
+        mux_model = request.match_info.get("mux_model")
+        mux_reserved = 0
         try:
             if self.gen_engine is None:
                 code = 400
@@ -651,10 +690,12 @@ class TpuInferenceServer:
             # a 429 must never leave earlier siblings generating into
             # abandoned futures.  Raises EngineOverloaded (-> 429 below)
             # before anything is enqueued.
+            est_total = sum(int(p.size) + max_new for p in prompts)
             self.gen_engine.reserve_admission(
-                sum(int(p.size) + max_new for p in prompts),
-                slo_class=slo_class,
+                est_total, slo_class=slo_class, model=mux_model,
             )
+            if mux_model:
+                mux_reserved = est_total
             traces = [
                 RequestTrace(
                     request_id=rid if len(prompts) == 1 else f"{rid}/{i}",
@@ -750,6 +791,10 @@ class TpuInferenceServer:
                 status=500,
             )
         finally:
+            if mux_reserved and self.gen_engine is not None:
+                self.gen_engine.release_model_admission(
+                    mux_model, mux_reserved
+                )
             self.metrics.observe_request(time.perf_counter() - t0, code=code)
 
     async def _stream_generation(
@@ -1020,6 +1065,13 @@ class TpuInferenceServer:
             body["fleetRole"] = self.fleet_role
         if self.lifecycle == "draining" and self.gen_engine is not None:
             body["inFlight"] = self.gen_engine.inflight()
+        if self.attach_fn is not None:
+            # Attached-model report (warm-pool replicas only): the
+            # multiplexer's bin-packer and the router's known-model sets
+            # read WHAT is on the device, not just whether something is.
+            body["model"] = self.attached_model_uri
+            if self.attached_snapshot_hash is not None:
+                body["snapshotHash"] = self.attached_snapshot_hash
         return web.json_response(body, status=status)
 
     async def handle_admin_drain(self, request: web.Request) -> web.Response:
@@ -1104,11 +1156,57 @@ class TpuInferenceServer:
                 {"error": "server is terminating"}, status=409
             )
         async with self._attach_lock:
+            req_hash, req_geom = self._snapshot_probe(model_uri)
+            if (
+                self.engine is not None
+                and self.attached_model_uri == model_uri
+                and req_hash is not None
+                and self.attached_snapshot_hash == req_hash
+            ):
+                # Idempotent no-op: same uri AND same snapshot hash as
+                # what is already on the device — a replace here would
+                # drain in-flight work to restore identical weights,
+                # a pointless swap the bin-packer would otherwise pay
+                # on every convergence pass.
+                return web.json_response(
+                    {
+                        "lifecycle": self.lifecycle,
+                        "model_uri": model_uri,
+                        "snapshot_hash": req_hash,
+                        "noop": True,
+                    }
+                )
             if self.engine is not None and not replace:
                 return web.json_response(
                     {
                         "error": "a model is already attached; pass "
                         '"replace": true to swap it',
+                        "lifecycle": self.lifecycle,
+                    },
+                    status=409,
+                )
+            if (
+                self.engine is not None
+                and req_geom is not None
+                and self._attached_geometry is not None
+                and req_geom != self._attached_geometry
+            ):
+                # Geometry-incompatible replace: the incoming snapshot's
+                # model dims differ from what this replica's compile
+                # sweep was baked for — an attach would stall in a full
+                # recompile, exactly what the warm pool exists to avoid.
+                # Typed 409 BEFORE the quiesce: the attached model keeps
+                # serving, and the bin-packer routes the swap to a
+                # compatible (or empty) replica instead.
+                return web.json_response(
+                    {
+                        "error": (
+                            f"snapshot geometry of {model_uri} does not "
+                            "match the attached model's compiled "
+                            "programs"
+                        ),
+                        "reason": "geometry_incompatible",
+                        "attached_model_uri": self.attached_model_uri,
                         "lifecycle": self.lifecycle,
                     },
                     status=409,
@@ -1137,6 +1235,9 @@ class TpuInferenceServer:
                 self.metrics.ready.labels(**self.metrics.identity).set(0)
                 self.engine = None
                 self.gen_engine = None
+                self.attached_model_uri = None
+                self.attached_snapshot_hash = None
+                self._attached_geometry = None
             try:
                 load_stats: dict = {}
                 attached = await loop.run_in_executor(
@@ -1171,6 +1272,14 @@ class TpuInferenceServer:
                 self.metrics.observe_cold_start(
                     "total", time.time() - anchor
                 )
+                # Re-probe AFTER the load: a first attach of a raw
+                # model writes its snapshot during load_predictor, so
+                # the identity contract is complete from attach one.
+                self.attached_model_uri = model_uri
+                (
+                    self.attached_snapshot_hash,
+                    self._attached_geometry,
+                ) = self._snapshot_probe(model_uri)
             except Exception as e:
                 _log.exception("attach of %s failed", model_uri)
                 # Quiesce whatever got wired before the failure — a
@@ -1185,6 +1294,9 @@ class TpuInferenceServer:
                         self.gen_engine.shutdown()
                 self.engine = None
                 self.gen_engine = None
+                self.attached_model_uri = None
+                self.attached_snapshot_hash = None
+                self._attached_geometry = None
                 self.lifecycle = "warm-pool"
                 return web.json_response(
                     {"error": f"attach failed: {e}"}, status=500
@@ -1193,6 +1305,7 @@ class TpuInferenceServer:
             {
                 "lifecycle": self.lifecycle,
                 "model_uri": model_uri,
+                "snapshot_hash": self.attached_snapshot_hash,
                 "restored": restored,
                 "load_breakdown_s": load_stats,
             }
@@ -1472,6 +1585,23 @@ class TpuInferenceServer:
             # asked what; a unified replica can do both).
             app.router.add_post("/admin/kv/export", self.handle_admin_kv_export)
             app.router.add_post("/admin/kv/import", self.handle_admin_kv_import)
+        if self.attach_fn is not None:
+            # Multiplexed warm pool: the router addresses requests by the
+            # CR's model id, which is NOT this replica's boot name — the
+            # wildcard routes catch any model id (the router only sends
+            # ids whose attachment it has confirmed; the server cannot
+            # map CR id -> uri and stays permissive).  Literal routes
+            # above win exact matches, so single-model wire behavior is
+            # unchanged.  {mux_model} keys the per-model admission share.
+            app.router.add_post(
+                "/v2/models/{mux_model}/generate", self.handle_generate
+            )
+            app.router.add_post(
+                "/v2/models/{mux_model}/infer", self.handle_v2_infer
+            )
+            app.router.add_get(
+                "/v2/models/{mux_model}/ready", self.handle_ready
+            )
         app.router.add_post("/api/v1.0/predictions", self.handle_seldon_predict)
         app.router.add_post("/api/v1.0/feedback", self.handle_feedback)
         app.router.add_get("/metrics", self.handle_metrics)
@@ -1897,6 +2027,7 @@ def build_server(
             telemetry=telemetry,
             attach_fn=attach_fn,
             fleet_role=config.fleet_role,
+            snapshot_dir=snapshot_dir,
         )
         if watchdog is not None:
             watchdog.on_stall = server.note_watchdog_stall

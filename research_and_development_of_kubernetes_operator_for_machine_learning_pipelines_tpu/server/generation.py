@@ -1372,6 +1372,13 @@ class GenerationEngine:
             )
         self._adm_lock = threading.Lock()
         self._queued_est_tokens = 0
+        # Per-model fairness ledger (multiplexed warm pool): estimated
+        # tokens outstanding per attached-model id, HTTP-request scoped
+        # (reserved in reserve_admission, returned by
+        # release_model_admission when the carrying request finishes).
+        # Empty — and every branch reading it dead — unless a caller
+        # passes model=, so single-model admission is byte-identical.
+        self._model_est: dict[str, int] = {}
         self._inflight_reqs = 0  # submitted futures not yet done
         self._draining = False
         self._on_shed = on_shed
@@ -1817,7 +1824,10 @@ class GenerationEngine:
     # -- admission control / drain (client-facing) ---------------------------
 
     def reserve_admission(
-        self, est_tokens: int, slo_class: str | None = None
+        self,
+        est_tokens: int,
+        slo_class: str | None = None,
+        model: str | None = None,
     ) -> None:
         """Reserve queue room for ``est_tokens`` or shed.
 
@@ -1835,6 +1845,16 @@ class GenerationEngine:
         refused at half-full queue sheds with reason
         ``class_best-effort`` — distinguishable on dashboards from the
         full-budget ``budget`` overload interactive traffic hits.
+
+        ``model`` (multiplexed warm pool: the model id the router
+        addressed) arms per-model fairness: with two or more models
+        holding outstanding work, each is bounded by an equal SHARE of
+        the budget instead of the whole budget — a flooded hot model
+        sheds with reason ``model_budget`` at its share while a tail
+        model with nothing outstanding is still admitted, so the shared
+        queue cannot starve cold models.  The caller returns the
+        reservation via :meth:`release_model_admission` when the
+        carrying HTTP request finishes.
         """
         cls = None
         if self._classes:
@@ -1855,6 +1875,32 @@ class GenerationEngine:
                 if factor < 1.0:
                     eff_budget = int(budget * factor)
                     reason = f"class_{cls}"
+            fair_share = None
+            if eff_budget and model is not None:
+                active = {m for m, v in self._model_est.items() if v > 0}
+                active.add(model)
+                if len(active) >= 2:
+                    # Two or more models contending: this model's bound
+                    # becomes budget/n INSTEAD of the global backlog
+                    # check below — the global check would let a hot
+                    # model's backlog shed the tail model's first
+                    # request, the exact starvation fairness exists to
+                    # prevent.
+                    fair_share = max(1, eff_budget // len(active))
+                    mine = self._model_est.get(model, 0)
+                    if mine > 0 and mine + est_tokens > fair_share:
+                        self._note_shed("model_budget")
+                        raise EngineOverloaded(
+                            f"model {model!r} admission share full: "
+                            f"{mine} estimated tokens outstanding + "
+                            f"{est_tokens} requested > share "
+                            f"{fair_share} ({eff_budget} budget / "
+                            f"{len(active)} active models); retry "
+                            "after the share drains",
+                            reason="model_budget",
+                            retry_after_s=1,
+                            slo_class=cls,
+                        )
             # The budget bounds the BACKLOG, not request size: with the
             # queue empty, any request validate() allowed is admitted —
             # otherwise a single request whose estimate alone exceeds
@@ -1862,7 +1908,8 @@ class GenerationEngine:
             # deterministic fleet-wide 429 outage for work the engine
             # could run directly.
             if (
-                eff_budget
+                fair_share is None
+                and eff_budget
                 and self._queued_est_tokens > 0
                 and self._queued_est_tokens + est_tokens > eff_budget
             ):
@@ -1876,6 +1923,10 @@ class GenerationEngine:
                     slo_class=cls,
                 )
             self._queued_est_tokens += est_tokens
+            if model is not None:
+                self._model_est[model] = (
+                    self._model_est.get(model, 0) + est_tokens
+                )
 
     def _note_shed(self, reason: str) -> None:
         # _adm_lock held: counter mutations stay consistent with the
@@ -1883,6 +1934,18 @@ class GenerationEngine:
         self.shed_total += 1
         if self._on_shed is not None:
             self._on_shed(reason)
+
+    def release_model_admission(self, model: str | None, est_tokens: int) -> None:
+        """Return a per-model fairness reservation (HTTP-request scoped
+        counterpart of the ``model=`` arm of :meth:`reserve_admission`)."""
+        if not model or not est_tokens:
+            return
+        with self._adm_lock:
+            left = self._model_est.get(model, 0) - est_tokens
+            if left > 0:
+                self._model_est[model] = left
+            else:
+                self._model_est.pop(model, None)
 
     def _release_queued(self, req: _Request) -> None:
         """Return a dequeued request's reservation (idempotent)."""

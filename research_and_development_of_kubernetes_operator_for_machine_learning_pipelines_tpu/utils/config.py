@@ -1437,6 +1437,58 @@ class ServerConfig:
 
 
 @dataclass(frozen=True)
+class MultiplexSpec:
+    """``spec.multiplex``: opt this CR into a shared warm-pool fleet.
+
+    ``poolRef`` names the shared pool (a plain convention string — every
+    CR naming the same pool in the same namespace is bin-packed onto
+    that pool's warm replicas by ``operator/multiplexer.py``).
+    ``weight`` biases the packer's traffic score: a weight-2 model wins
+    a replica over a weight-1 model at equal observed traffic.
+
+    A multiplexed model owns NO replica of its own: with zero traffic
+    it holds nothing (its requests park at the router), and the packer
+    attaches it to a pool replica via the warm-pool admin endpoint when
+    parked/queued traffic appears.  Absent (the default) keeps
+    manifests, router behavior, and metrics byte-for-byte unchanged.
+    """
+
+    pool_ref: str | None = None
+    weight: float = 1.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.pool_ref is not None
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "MultiplexSpec":
+        spec = spec or {}
+        _reject_unknown_keys(
+            spec, frozenset({"poolRef", "weight"}), "spec.multiplex"
+        )
+        pool_ref = spec.get("poolRef")
+        if pool_ref is not None:
+            pool_ref = str(pool_ref)
+            if not pool_ref:
+                raise ValueError("multiplex.poolRef must be non-empty")
+        elif spec.get("weight") is not None:
+            # A weight without a pool is a contradiction the CR author
+            # must resolve — silently ignoring it would leave them
+            # believing the model is multiplexed.
+            raise ValueError("multiplex.weight requires multiplex.poolRef")
+        return cls(
+            pool_ref=pool_ref,
+            weight=float(spec.get("weight", 1.0)),
+        )
+
+    def __post_init__(self):
+        if self.enabled and not (self.weight > 0):
+            raise ValueError(
+                f"multiplex.weight must be > 0, got {self.weight}"
+            )
+
+
+@dataclass(frozen=True)
 class OperatorConfig:
     """Full parsed ``MlflowModel`` spec.
 
@@ -1474,6 +1526,9 @@ class OperatorConfig:
     # Offline SLO planner (operator/planner.py): trace replay + knob
     # search behind spec.planner; disabled default = byte-for-byte.
     planner: PlannerSpec = field(default_factory=PlannerSpec)
+    # Multi-model multiplexing on a shared warm pool
+    # (operator/multiplexer.py); absent default = byte-for-byte.
+    multiplex: MultiplexSpec = field(default_factory=MultiplexSpec)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
@@ -1521,6 +1576,26 @@ class OperatorConfig:
                     "must restore pre-baked weights while the cold "
                     "prompt waits; without a snapshot it pays the full "
                     "cold load)"
+                )
+        multiplex = MultiplexSpec.from_spec(spec.get("multiplex"))
+        if multiplex.enabled:
+            if backend != "tpu":
+                raise ValueError(
+                    "spec.multiplex requires backend: tpu (the Seldon "
+                    "backend has no warm-pool attach data plane)"
+                )
+            if not tpu.snapshot.enabled:
+                raise ValueError(
+                    "spec.multiplex requires spec.tpu.snapshot.enabled "
+                    "(the shared pool attaches models by snapshot "
+                    "restore; without one every swap pays the full "
+                    "cold load)"
+                )
+            if fleet.disaggregation:
+                raise ValueError(
+                    "spec.multiplex with fleet.disaggregation is not "
+                    "supported: the shared pool multiplexes unified "
+                    "replicas, not split prefill/decode pools"
                 )
         if (
             autoscaling.enabled
@@ -1601,6 +1676,12 @@ class OperatorConfig:
                     "single-host replicas; use a larger slice or more "
                     "MlflowModel CRs"
                 )
+            if info.hosts > 1 and multiplex.enabled:
+                raise ValueError(
+                    f"spec.multiplex with multi-host topology "
+                    f"{tpu.topology!r} is not supported: the shared "
+                    "pool attaches by single-host snapshot restore"
+                )
             if info.hosts > 1 and (
                 autoscaling.min_replicas == 0
                 or autoscaling.warm_pool_size > 0
@@ -1637,4 +1718,5 @@ class OperatorConfig:
             fleet=fleet,
             slo=SloSpec.from_spec(spec.get("slo")),
             planner=PlannerSpec.from_spec(spec.get("planner")),
+            multiplex=multiplex,
         )

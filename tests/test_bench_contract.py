@@ -229,8 +229,8 @@ for name in ("bench_xgboost", "bench_resnet", "bench_prefix_cache",
              "bench_long_context", "bench_packed_prefill",
              "bench_observability", "bench_device_telemetry",
              "bench_admission_control", "bench_cold_start",
-             "bench_disaggregated", "bench_chaos", "bench_fleet_trace",
-             "bench_priority_preemption",
+             "bench_disaggregated", "bench_chaos", "bench_multi_model",
+             "bench_fleet_trace", "bench_priority_preemption",
              "bench_llama_decode", "bench_serve_path",
              "bench_llama_7b_decode"):
     setattr(bench, name, {tail_fn})

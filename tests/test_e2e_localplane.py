@@ -1878,3 +1878,439 @@ def test_fleet_trace_park_relay_failover_stitches_to_one_trace(llm_models):
         chaos.stop()
         for h in handles:
             h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Multi-model multiplexing e2e: FOUR CRs share a TWO-replica warm pool.
+# Nothing scripted — live warm-pool servers (booted, NO weights), the
+# compiled mux router parking cold-model requests per model, and the
+# real bin-packer executing attach/replace plans through /admin/attach,
+# driven by the real reconciler loop via OperatorRuntime.mux_pools.
+# Proves: cold-model park -> packer attach -> 200; replace-swap journaled
+# as a MuxRecord in the displacing CR's status.history; a flooded hot
+# model cannot shed the tail model's requests; a zero-traffic member
+# holds NOTHING; and a non-multiplexed CR's manifest/status stay
+# byte-for-byte mux-free.
+# ---------------------------------------------------------------------------
+
+
+def test_multi_model_multiplex_on_shared_warm_pool(tmp_path):
+    import asyncio
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.base import (
+        ObjectRef,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.fakes import (
+        FakeKube,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.models import (
+        llama,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.multiplexer import (
+        Multiplexer,
+        MuxReplica,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.server.app import (
+        ServerConfig,
+        build_server,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.server.loader import (
+        save_native_model,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.config import (
+        TpuSpec,
+    )
+
+    # Four distinguishable tiny models sharing one snapshot dir (the
+    # swap IS a snapshot restore; first attach cold-loads and bakes).
+    root = tmp_path / "arts"
+    snap_dir = str(tmp_path / "snaps")
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    uris = {}
+    for i in range(4):
+        art = root / f"mux{i}"
+        save_native_model(
+            art,
+            "llama-generate",
+            llama.init(jax.random.key(11 + i), cfg),
+            config={
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "num_layers": cfg.num_layers,
+                "num_heads": cfg.num_heads,
+                "num_kv_heads": cfg.num_kv_heads,
+                "intermediate_size": cfg.intermediate_size,
+                "max_seq": cfg.max_seq,
+            },
+        )
+        uris[f"mux{i}"] = str(art)
+    uri_to_model = {u: n for n, u in uris.items()}
+
+    tpu = TpuSpec.from_spec(
+        {
+            "meshShape": {"tp": 1},
+            "maxBatchSize": 2,
+            "maxSlots": 2,
+            # Small admission budget so the flood phase actually sheds
+            # on the hot model's replica (typed 429, never a bare 502).
+            "admissionQueueBudget": 48,
+            "snapshot": {"enabled": True, "dir": snap_dir},
+        }
+    )
+
+    # -- shared pool: two live warm-pool replicas (no weights until the
+    # packer attaches; /v2/health/ready stays 503 so these boot manually).
+    def start_warm_replica(port: int):
+        server = build_server(
+            ServerConfig(
+                model_name="pool", model_uri=uris["mux0"], tpu=tpu,
+                warm_pool=True,
+            ),
+            warmup=False,
+        )
+        loop = asyncio.new_event_loop()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            from aiohttp import web
+
+            runner = web.AppRunner(server.build_app())
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start()
+            )
+            loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        wait_for(
+            lambda: _probe(f"http://127.0.0.1:{port}/livez"),
+            timeout=60.0,
+            what=f"warm replica :{port} live",
+        )
+        return server, loop
+
+    def _probe(url):
+        import urllib.request as _u
+
+        try:
+            _u.urlopen(url, timeout=1)
+            return True
+        except Exception:
+            return False
+
+    pool_ports = {"rA": free_port(), "rB": free_port()}
+    pool = {n: start_warm_replica(p) for n, p in pool_ports.items()}
+
+    router = RouterProcess(
+        port=free_port(),
+        backends={n: ("127.0.0.1", p, 50) for n, p in pool_ports.items()},
+        namespace="models",
+        deployment="sharedpool",
+        park_buffer=8,
+        park_timeout_s=60.0,
+        mux_models=1,
+        journey_ring=64,
+    ).start()
+
+    mux = Multiplexer(
+        pool="shared-a",
+        replicas=[
+            MuxReplica(n, url=f"http://127.0.0.1:{p}")
+            for n, p in sorted(pool_ports.items())
+        ],
+        parked=lambda: router.admin.parked().get("models") or {},
+    )
+
+    # Endpoint sync stand-in (RouterSync's production role): publish the
+    # packer's attached-model table whenever it changes so the router
+    # routes by model and releases the matching parked requests.
+    sync_stop = threading.Event()
+    last_pushed: dict = {}
+
+    def sync_loop():
+        while not sync_stop.is_set():
+            held = {
+                r.name: uri_to_model.get(r.attached_uri, "")
+                for r in mux.replicas
+            }
+            if held != last_pushed:
+                try:
+                    router.admin.set_config(
+                        [
+                            {"name": n, "host": "127.0.0.1", "port": p,
+                             "weight": 50, "model": held.get(n, "")}
+                            for n, p in pool_ports.items()
+                        ],
+                        namespace="models", deployment="sharedpool",
+                        mux_models=1,
+                    )
+                    last_pushed.clear()
+                    last_pushed.update(held)
+                except Exception:
+                    pass
+            time.sleep(0.05)
+
+    threading.Thread(target=sync_loop, daemon=True).start()
+
+    # -- control plane: the real reconciler loop owns the coordinator.
+    kube = FakeKube()
+    registry = FakeRegistry()
+    for name, uri in uris.items():
+        # Real local artifact paths as registry sources: with
+        # spec.artifactRoot at their parent, _resolve_uri passes them
+        # through unchanged — the ATTACHABLE uri the pool restores from.
+        registry.register(name, "1", uri)
+        registry.set_alias(name, "prod", "1")
+    registry.register("solo", "1", uris["mux0"])
+    registry.set_alias("solo", "prod", "1")
+    rt = OperatorRuntime(
+        kube,
+        registry,
+        metrics=RouterMetricsSource(router.admin),
+        clock=SystemClock(),
+        sync_interval_s=0.05,
+        mux_pools={"shared-a": mux},
+    )
+
+    def spec_for(name, weight=None, multiplex=True):
+        spec = {
+            "modelName": name,
+            "modelAlias": "prod",
+            "monitoringInterval": 0.1,
+            "backend": "tpu",
+            "artifactRoot": str(root),
+            "tpu": {
+                "meshShape": {"tp": 1},
+                "maxBatchSize": 2,
+                "maxSlots": 2,
+                "snapshot": {"enabled": True, "dir": snap_dir},
+            },
+            "observability": {"historyLimit": 32},
+        }
+        if multiplex:
+            spec["multiplex"] = {"poolRef": "shared-a"}
+            if weight is not None:
+                spec["multiplex"]["weight"] = weight
+        return spec
+
+    def ref(name):
+        return ObjectRef(namespace="models", name=name, **CR)
+
+    def status(name):
+        return kube.get(ref(name)).get("status") or {}
+
+    def one(model, max_new=4, timeout=90):
+        body = _json.dumps(
+            {"prompt_ids": [5, 9, 2], "max_new_tokens": max_new}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/v2/models/{model}/generate",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _json.loads(e.read().decode())
+            except Exception:
+                return e.code, {}
+
+    try:
+        # mux0 gets weight 2 so phase-1 ranking (and therefore replica
+        # assignment) is deterministic: mux0 -> rA, mux1 -> rB.
+        kube.create(ref("mux0"), {"spec": spec_for("mux0", weight=2.0)})
+        for name in ("mux1", "mux2", "mux3"):
+            kube.create(ref(name), {"spec": spec_for(name)})
+        kube.create(
+            ref("solo"), {"spec": spec_for("solo", multiplex=False)}
+        )
+        threading.Thread(target=rt.serve, daemon=True).start()
+
+        # All five CRs reach Stable; the members publish status.multiplex
+        # (pool view, NOTHING attached: scale-to-zero is the default
+        # state), the non-member stays byte-for-byte mux-free.
+        wait_for(
+            lambda: all(
+                status(n).get("phase") == "Stable"
+                for n in ("mux0", "mux1", "mux2", "mux3", "solo")
+            )
+            and all(
+                (status(n).get("multiplex") or {}).get("pool") == "shared-a"
+                for n in ("mux0", "mux1", "mux2", "mux3")
+            ),
+            timeout=120.0,
+            what="five CRs Stable with mux members registered",
+        )
+        assert status("mux0")["multiplex"]["attachedReplicas"] == []
+        assert "multiplex" not in status("solo")
+
+        # Manifest handoff: member manifests carry the mux annotations
+        # RouterSync arms on; the non-member's manifest has NONE of them
+        # (the multiplexing-disabled byte-for-byte contract).
+        def manifest_annotations(name):
+            obj = kube.get(
+                ObjectRef(namespace="models", name=name, **SELDONDEPLOYMENT)
+            )
+            return (obj.get("metadata") or {}).get("annotations") or {}
+
+        ann = manifest_annotations("mux0")
+        assert ann.get("tpumlops.dev/mux-models") == "1"
+        assert ann.get("tpumlops.dev/mux-pool") == "shared-a"
+        assert ann.get("tpumlops.dev/mux-weight") == "2.0"
+        assert not any(
+            k.startswith("tpumlops.dev/mux")
+            for k in manifest_annotations("solo")
+        )
+
+        # Phase 1 — cold wake: the first mux0/mux1 requests find NO
+        # holder, PARK per model, the reconciler-driven packer attaches
+        # both onto the empty replicas, the config sync releases the
+        # parks, and both complete 200.
+        wake: dict = {}
+
+        def send(name, res, **kw):
+            res[name] = one(name, **kw)
+
+        threads = [
+            threading.Thread(target=send, args=(n, wake), daemon=True)
+            for n in ("mux0", "mux1")
+        ]
+        for t in threads:
+            t.start()
+        wait_for(
+            lambda: sum(
+                (router.admin.parked().get("models") or {}).values()
+            ) >= 1,
+            timeout=30.0,
+            what="cold-model requests parked per model",
+        )
+        for t in threads:
+            t.join(timeout=120)
+
+        def toks(result):
+            return result[1]["outputs"][0]["data"]
+
+        assert wake["mux0"][0] == 200 and toks(wake["mux0"]), wake
+        assert wake["mux1"][0] == 200 and toks(wake["mux1"]), wake
+        wait_for(
+            lambda: status("mux0")["multiplex"].get("attachedReplicas")
+            == ["rA"]
+            and status("mux1")["multiplex"].get("attachedReplicas")
+            == ["rB"],
+            timeout=30.0,
+            what="status.multiplex reflects the wake attachments",
+        )
+        assert "MuxAttached" in kube.event_reasons()
+
+        # Phase 2 — replace-swap: a request for cold mux2 parks; the
+        # packer evicts the cheapest attachment (rA, score 0) via a
+        # REPLACE through /admin/attach, and the request completes 200
+        # with zero client-visible failures.
+        swap: dict = {}
+        t2 = threading.Thread(target=send, args=("mux2", swap), daemon=True)
+        t2.start()
+        wait_for(
+            lambda: (router.admin.parked().get("models") or {}).get(
+                "mux2", 0
+            ) >= 1,
+            timeout=30.0,
+            what="mux2 request parked",
+        )
+        t2.join(timeout=120)
+        assert swap["mux2"][0] == 200 and toks(swap["mux2"]), swap
+        wait_for(
+            lambda: status("mux2")["multiplex"].get("attachedReplicas")
+            == ["rA"],
+            timeout=30.0,
+            what="mux2 holds rA after the swap",
+        )
+
+        # Phase 3 — flood isolation: 8 concurrent requests flood the hot
+        # model (mux2 on rA) past the admission budget while the tail
+        # model (mux1 on rB) sends one request.  The tail request
+        # completes 200 — a flooded hot model cannot shed another
+        # model's requests — and every flood response is 200 or a TYPED
+        # shed, never a bare transport error.
+        flood: dict = {}
+        tail: dict = {}
+        flood_threads = [
+            threading.Thread(
+                target=lambda i=i: flood.__setitem__(
+                    i, one("mux2", max_new=16)
+                ),
+                daemon=True,
+            )
+            for i in range(8)
+        ]
+        for t in flood_threads:
+            t.start()
+        t_tail = threading.Thread(
+            target=send, args=("mux1", tail), daemon=True
+        )
+        t_tail.start()
+        for t in flood_threads:
+            t.join(timeout=120)
+        t_tail.join(timeout=120)
+        assert tail["mux1"][0] == 200 and toks(tail["mux1"]), tail
+        codes = sorted(c for c, _ in flood.values())
+        assert set(codes) <= {200, 429, 503}, codes
+        for code, body in flood.values():
+            if code != 200:
+                # Typed shed: machine-readable reason, by contract.
+                assert body.get("reason"), (code, body)
+
+        # Phase 4 — per-model scale-to-zero: mux3 never saw a request
+        # and holds NOTHING (its chips bill is zero); mux0, displaced by
+        # the swap, holds nothing either.
+        assert status("mux3")["multiplex"]["attachedReplicas"] == []
+        assert status("mux3")["multiplex"].get("parked", 0) == 0
+        assert status("mux0")["multiplex"]["attachedReplicas"] == []
+
+        # Reconstruction — the story from status.history alone: mux2's
+        # journal carries the replace (kind "mux") naming the replica,
+        # the displaced uri, and the attach endpoint's echoed snapshot
+        # hash (the identity contract).
+        mux2_recs = [
+            r
+            for r in (status("mux2").get("history") or [])
+            if r.get("kind") == "mux"
+        ]
+        replaces = [r for r in mux2_recs if r["action"] == "replace"]
+        assert replaces, mux2_recs
+        rec = replaces[0]
+        assert rec["pool"] == "shared-a"
+        assert rec["replica"] == "rA"
+        assert rec["displaced"] == uris["mux0"]
+        assert rec["parked"] >= 1
+        assert rec.get("snapshotHash")
+        mux0_recs = [
+            r
+            for r in (status("mux0").get("history") or [])
+            if r.get("kind") == "mux"
+        ]
+        assert any(r["action"] == "attach" for r in mux0_recs)
+
+        # ...and from /router/debug/requests alone: the journey ring
+        # shows mux2's request parked (park_ms > 0) under its model id.
+        journeys = router.admin.journeys()["requests"]
+        assert any(
+            j.get("model") == "mux2" and j.get("park_ms", 0) > 0
+            for j in journeys
+        ), journeys
+    finally:
+        sync_stop.set()
+        rt.stop()
+        router.stop()
+        for server, loop in pool.values():
+            try:
+                server.shutdown()
+            except Exception:
+                pass
+            loop.call_soon_threadsafe(loop.stop)

@@ -128,6 +128,10 @@ EXPECTED_OPERATOR = {
     "tpumlops_operator_gate_evaluations": (
         "counter", _OP_IDENT + ("result",)),
     "tpumlops_operator_gate_margin": ("gauge", _OP_IDENT + ("check",)),
+    # Multi-model multiplexing (spec.multiplex; operator/multiplexer.py)
+    # — no samples until a CR joins a shared pool.
+    "tpumlops_operator_mux_moves": ("counter", _OP_IDENT + ("action",)),
+    "tpumlops_operator_mux_parked_requests": ("gauge", _OP_IDENT),
     "tpumlops_operator_phase": ("gauge", _OP_IDENT + ("phase",)),
     "tpumlops_operator_promotions": ("counter", _OP_IDENT + ("outcome",)),
     "tpumlops_operator_reconcile": ("counter", _OP_IDENT + ("result",)),
@@ -315,3 +319,73 @@ def test_gate_series_present_in_exposition():
     )
     assert "seldon_api_executor_server_requests_seconds_count{" in text
     assert 'code="200"' in text
+
+
+def test_router_mux_family_pinned_when_mux_on():
+    """--mux-models 1 adds exactly ONE new family —
+    tpumlops_router_model_backends{model} (usable replicas per attached
+    model) — and the parked gauge's samples gain the model label; both
+    are the bin-packer's observability surface (docs/SCALE.md).  The
+    mux-OFF surface is pinned byte-for-byte by
+    test_router_fleet_series_pinned above."""
+    import socket
+    import time
+
+    from tpumlops.clients.router import RouterProcess, parse_prometheus_text
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        bport = s.getsockname()[1]  # never connected: identity only
+    router = RouterProcess(port=port, backends={}, deployment="d",
+                           namespace="n", mux_models=1).start()
+    try:
+        router.admin.set_config(
+            [{"name": "v1", "host": "127.0.0.1", "port": bport,
+              "weight": 100, "model": "llm-a"}]
+        )
+        names = set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not names:
+            parsed = parse_prometheus_text(router.admin.metrics_text())
+            names = {
+                name.replace("_bucket", "").replace("_sum", "")
+                .replace("_count", "")
+                for name, _ in parsed
+                if name.startswith("tpumlops_router_")
+            }
+        base = {
+            "tpumlops_router_proxied_total",
+            "tpumlops_router_parked_requests",
+            "tpumlops_router_parked_total",
+            "tpumlops_router_park_released_total",
+            "tpumlops_router_park_overflow_total",
+            "tpumlops_router_park_timeouts_total",
+            "tpumlops_router_park_wait_seconds",
+            "tpumlops_router_affinity_hits",
+            "tpumlops_router_affinity_misses",
+            "tpumlops_router_kv_handoff_bytes",
+            "tpumlops_router_kv_handoff_failures",
+            "tpumlops_router_kv_handoff_seconds",
+            "tpumlops_router_failover_total",
+            "tpumlops_router_probe_seconds",
+            # Per-backend containment families: present because this
+            # test configures a backend (identity pinned in
+            # tests/test_router.py), not because of mux.
+            "tpumlops_router_backend_healthy",
+            "tpumlops_router_circuit_open_total",
+        }
+        assert names == base | {"tpumlops_router_model_backends"}
+        parsed = parse_prometheus_text(router.admin.metrics_text())
+        model_series = [
+            dict(labels)
+            for name, labels in parsed
+            if name == "tpumlops_router_model_backends"
+        ]
+        assert model_series and all(
+            labels["model"] == "llm-a" for labels in model_series
+        )
+    finally:
+        router.stop()

@@ -1,0 +1,387 @@
+"""Multi-model multiplexing (spec.multiplex, operator/multiplexer.py).
+
+The bin-packer assigns N MlflowModel CRs onto a shared warm-pool fleet
+by observed traffic: plan() is pure (ranking, minimal moves, scale-to-
+zero, typed holds), the Multiplexer coordinator owns the observe →
+plan → execute → journal loop over injected I/O seams, and the
+reconciler's _multiplex_step surfaces it per CR (status.multiplex,
+MuxRecords in status.history, Events).  Disabled = byte-for-byte.
+"""
+
+import urllib.error
+
+import pytest
+
+from tpumlops.clients.base import MLFLOWMODEL, ObjectRef
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator.multiplexer import (
+    Multiplexer,
+    MuxModel,
+    MuxReplica,
+    plan,
+)
+from tpumlops.operator.reconciler import Reconciler
+from tpumlops.operator.state import Phase
+from tpumlops.utils.clock import FakeClock
+from tpumlops.utils.config import MultiplexSpec, OperatorConfig
+
+# ---------------------------------------------------------------------------
+# plan(): the pure bin-pack pass
+# ---------------------------------------------------------------------------
+
+
+def _m(name, parked=0, weight=1.0, depth=0.0, uri=None):
+    return MuxModel(
+        name=name, uri=uri or f"/models/{name}", weight=weight,
+        parked=parked, queue_depth=depth,
+    )
+
+
+def _r(name, attached=None):
+    return MuxReplica(name=name, url=f"http://{name}", attached_uri=attached)
+
+
+def test_plan_scale_to_zero_no_traffic_holds_no_replica():
+    """A model with zero observed traffic is NOT placed — its requests
+    park at the router and the parked signal re-ranks it next pass."""
+    p = plan("pool", [_m("a"), _m("b")], [_r("r1"), _r("r2")], wall=1.0)
+    assert p.moves == () and p.holds == ()
+    assert p.converged
+
+
+def test_plan_ranks_by_weighted_traffic_and_holds_the_overflow():
+    models = [
+        _m("a", parked=1),
+        _m("b", parked=5),
+        _m("c", parked=2, weight=3.0),  # score 6: weight biases the rank
+        _m("d"),                        # zero traffic: not even ranked
+    ]
+    p = plan("pool", models, [_r("r1"), _r("r2")], wall=1.0)
+    placed = {mv.model.name for mv in p.moves}
+    assert placed == {"b", "c"}  # scores 5 and 6 beat 1
+    assert all(not mv.replace for mv in p.moves)  # empty pool: attaches
+    assert [h.model for h in p.holds] == ["a"]
+    assert p.holds[0].reason == "pool_full"
+    assert p.holds[0].as_dict()["kind"] == "mux"
+
+
+def test_plan_is_minimal_moves_and_evicts_cheapest_loser():
+    """A replica already serving a winner is never touched; a needed
+    replace evicts the attachment with the LEAST traffic behind it."""
+    models = [
+        _m("hot", parked=9),
+        _m("warm", parked=4),
+        _m("cold", parked=1),
+    ]
+    replicas = [
+        _r("r1", attached="/models/hot"),
+        _r("r2", attached="/models/cold"),
+    ]
+    p = plan("pool", models, replicas, wall=1.0)
+    assert len(p.moves) == 1
+    mv = p.moves[0]
+    assert mv.model.name == "warm"
+    assert mv.replica.name == "r2" and mv.replace
+    assert mv.displaced == "/models/cold"
+    # cold lost its seat on traffic: journaled as a typed hold.
+    assert [h.model for h in p.holds] == ["cold"]
+    # Settled pool converges to zero moves (re-run against the result).
+    settled = [
+        _r("r1", attached="/models/hot"),
+        _r("r2", attached="/models/warm"),
+    ]
+    assert plan("pool", models[:2], settled, wall=2.0).converged
+
+
+def test_plan_prefers_empty_replicas_before_evicting():
+    models = [_m("a", parked=3), _m("b", parked=2)]
+    replicas = [_r("r1", attached="/models/a"), _r("r2")]
+    p = plan("pool", models, replicas, wall=1.0)
+    assert len(p.moves) == 1
+    assert p.moves[0].model.name == "b"
+    assert p.moves[0].replica.name == "r2"
+    assert not p.moves[0].replace
+
+
+def test_plan_tie_breaks_by_name_for_determinism():
+    models = [_m("z", parked=2), _m("a", parked=2)]
+    p = plan("pool", models, [_r("r1")], wall=1.0)
+    assert p.moves[0].model.name == "a"
+    assert [h.model for h in p.holds] == ["z"]
+
+
+# ---------------------------------------------------------------------------
+# Multiplexer: the pool coordinator over injected seams
+# ---------------------------------------------------------------------------
+
+
+class _FakePool:
+    """In-memory pool: attach/ready/parked seams + a call journal."""
+
+    def __init__(self, replicas=("r1", "r2")):
+        self.attached: dict[str, str] = {}
+        self.parked: dict[str, int] = {}
+        self.attach_calls: list[tuple] = []
+        self.fail_with: urllib.error.HTTPError | None = None
+        self.replicas = [MuxReplica(name=n, url=f"http://{n}") for n in replicas]
+
+    def attach(self, replica, model_uri, replace, wake_start_wall):
+        self.attach_calls.append((replica.name, model_uri, replace))
+        if self.fail_with is not None:
+            raise self.fail_with
+        if self.attached.get(replica.name) == model_uri:
+            return {"noop": True, "snapshot_hash": "h-" + model_uri[-1]}
+        self.attached[replica.name] = model_uri
+        return {"lifecycle": "ready", "snapshot_hash": "h-" + model_uri[-1]}
+
+    def ready(self, replica):
+        return {"model": self.attached.get(replica.name)}
+
+    def parked_fn(self):
+        return dict(self.parked)
+
+
+def _coord(pool, **kw):
+    return Multiplexer(
+        "shared-a", replicas=pool.replicas, attach=pool.attach,
+        ready=pool.ready, parked=pool.parked_fn, wall=lambda: 100.0, **kw
+    )
+
+
+def test_coordinator_attaches_on_parked_traffic_and_journals_per_cr():
+    pool = _FakePool()
+    mux = _coord(pool)
+    mux.register("iris", uri="/models/a")
+    mux.register("rose", uri="/models/b")
+    assert mux.pump() == []  # zero traffic: nothing moves
+    pool.parked = {"iris": 3}
+    recs = mux.pump()
+    assert [(r.action, r.model, r.replica) for r in recs] == [
+        ("attach", "iris", "r1")
+    ]
+    assert recs[0].snapshot_hash == "h-a"
+    assert recs[0].parked == 3
+    assert pool.attached == {"r1": "/models/a"}
+    # Per-CR drain: iris's reconciler takes its slice, rose sees none.
+    assert mux.take_records("rose") == []
+    assert [r.action for r in mux.take_records("iris")] == ["attach"]
+    assert mux.take_records("iris") == []  # drained
+
+    st = mux.model_status("iris")
+    assert st["poolReplicas"] == 2
+    assert st["attachedReplicas"] == ["r1"]
+    assert st["parked"] == 3 and st["score"] == 3.0
+
+
+def test_coordinator_replace_evicts_and_reports_noop_on_settled_plan():
+    pool = _FakePool(replicas=("r1",))
+    mux = _coord(pool)
+    mux.register("iris", uri="/models/a")
+    mux.register("rose", uri="/models/b")
+    pool.parked = {"iris": 1}
+    assert [r.action for r in mux.pump(force=True)] == ["attach"]
+    # rose overtakes: the sole replica is replaced, iris holds.
+    pool.parked = {"iris": 1, "rose": 9}
+    recs = mux.pump(force=True)
+    by_model = {r.model: r for r in recs}
+    assert by_model["rose"].action == "replace"
+    assert by_model["rose"].displaced == "/models/a"
+    assert by_model["iris"].action == "hold"
+    assert mux.moves_total == 2
+    # A re-emitted move against the device's state is a no-op record —
+    # the attach endpoint's idempotency contract absorbs it.
+    pool.attached = {"r1": "/models/b"}
+    pool.parked = {"rose": 9}
+    mux.replicas = [MuxReplica(name="r1", url="http://r1")]  # stale memory
+    recs = mux.pump(force=True)
+    assert recs == []  # refresh_replicas re-read the device: converged
+
+
+def test_coordinator_attach_failure_is_a_typed_error_record():
+    import io
+
+    pool = _FakePool(replicas=("r1",))
+    pool.fail_with = urllib.error.HTTPError(
+        "http://r1/admin/attach", 409, "conflict", {},
+        io.BytesIO(b'{"reason": "geometry_incompatible"}'),
+    )
+    mux = _coord(pool)
+    mux.register("iris", uri="/models/a")
+    pool.parked = {"iris": 2}
+    recs = mux.pump()
+    assert [r.action for r in recs] == ["error"]
+    assert recs[0].reason == "attach_failed:409:geometry_incompatible"
+    assert mux.moves_total == 0
+
+
+def test_coordinator_rate_limits_member_pumps():
+    pool = _FakePool()
+    clock = {"now": 100.0}
+    mux = Multiplexer(
+        "shared-a", replicas=pool.replicas, attach=pool.attach,
+        ready=pool.ready, parked=pool.parked_fn,
+        min_interval_s=30.0, wall=lambda: clock["now"],
+    )
+    mux.register("iris", uri="/models/a")
+    pool.parked = {"iris": 1}
+    assert len(mux.pump()) == 1
+    mux.register("rose", uri="/models/b")
+    pool.parked = {"iris": 1, "rose": 5}
+    assert mux.pump() == []  # second member's pump inside the window
+    clock["now"] += 31.0
+    recs = mux.pump()  # window passed: converges again
+    assert [r.model for r in recs if r.action == "attach"] == ["rose"]
+
+
+# ---------------------------------------------------------------------------
+# spec.multiplex parsing + compatibility validation
+# ---------------------------------------------------------------------------
+
+_TPU = {"meshShape": {"tp": 1}, "snapshot": {"enabled": True, "dir": "/s"}}
+
+
+def _cfg(spec_extra):
+    spec = {"modelName": "iris", "modelAlias": "champion", "minioSecret": "m"}
+    spec.update(spec_extra)
+    return OperatorConfig.from_spec(spec)
+
+
+def test_multiplex_spec_parses_and_defaults_off():
+    assert not MultiplexSpec.from_spec(None).enabled
+    mux = MultiplexSpec.from_spec({"poolRef": "shared-a", "weight": 2})
+    assert mux.enabled and mux.pool_ref == "shared-a" and mux.weight == 2.0
+    cfg = _cfg(
+        {"backend": "tpu", "tpu": _TPU,
+         "multiplex": {"poolRef": "shared-a"}}
+    )
+    assert cfg.multiplex.enabled and cfg.multiplex.weight == 1.0
+
+
+@pytest.mark.parametrize(
+    "mux_spec,msg",
+    [
+        ({"poolRef": ""}, "non-empty"),
+        ({"weight": 2}, "requires multiplex.poolRef"),
+        ({"poolRef": "p", "weight": 0}, "must be > 0"),
+        ({"poolRef": "p", "typo": 1}, "unknown"),
+    ],
+)
+def test_multiplex_spec_rejects_contradictions(mux_spec, msg):
+    with pytest.raises(ValueError, match=msg):
+        _cfg({"backend": "tpu", "tpu": _TPU, "multiplex": mux_spec})
+
+
+def test_multiplex_requires_tpu_backend_and_snapshot():
+    with pytest.raises(ValueError, match="backend: tpu"):
+        _cfg({"multiplex": {"poolRef": "p"}})
+    with pytest.raises(ValueError, match="snapshot.enabled"):
+        _cfg(
+            {"backend": "tpu", "tpu": {"meshShape": {"tp": 1}},
+             "multiplex": {"poolRef": "p"}}
+        )
+    with pytest.raises(ValueError, match="disaggregation"):
+        _cfg(
+            {"backend": "tpu", "tpu": _TPU,
+             "fleet": {"disaggregation": True},
+             "multiplex": {"poolRef": "p"}}
+        )
+
+
+# ---------------------------------------------------------------------------
+# Reconciler integration: _multiplex_step drives the shared coordinator
+# ---------------------------------------------------------------------------
+
+NS = "models"
+NAME = "iris"
+
+
+def cr_ref():
+    return ObjectRef(namespace=NS, name=NAME, **MLFLOWMODEL)
+
+
+def make_world(spec_extra=None, mux_pools=None):
+    kube = FakeKube()
+    registry = FakeRegistry()
+    metrics = FakeMetrics()
+    clock = FakeClock()
+    spec = {"modelName": "iris", "modelAlias": "champion", "minioSecret": "m"}
+    spec.update(spec_extra or {})
+    kube.create(
+        cr_ref(),
+        {
+            "apiVersion": "mlflow.nizepart.com/v1alpha1",
+            "kind": "MlflowModel",
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": spec,
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec = Reconciler(
+        NAME, NS, kube, registry, metrics, clock, mux_pools=mux_pools
+    )
+    return kube, registry, metrics, clock, rec
+
+
+MUX_SPEC = {
+    "backend": "tpu",
+    "tpu": _TPU,
+    "observability": {"historyLimit": 20},
+    "multiplex": {"poolRef": "shared-a", "weight": 2.0},
+}
+
+
+def test_reconciler_publishes_status_and_journals_mux_records():
+    pool = _FakePool()
+    coord = _coord(pool)
+    kube, registry, metrics, clock, rec = make_world(
+        MUX_SPEC, mux_pools={"shared-a": coord}
+    )
+    out = rec.reconcile(kube.get(cr_ref()))
+    assert out.state.phase == Phase.STABLE
+    status = kube.get(cr_ref())["status"]
+    # Zero traffic: a member of the pool, holding nothing.
+    assert status["multiplex"] == {
+        "pool": "shared-a", "weight": 2.0,
+        "poolReplicas": 2, "attachedReplicas": [],
+        "parked": 0, "score": 0.0,
+    }
+    # Parked traffic appears at the router: the next pass attaches and
+    # the CR journals ITS slice of the pool's decisions.
+    pool.parked = {"iris": 4}
+    out = rec.reconcile(kube.get(cr_ref()))
+    assert out.mux and out.mux[0].action == "attach"
+    status = kube.get(cr_ref())["status"]
+    assert status["multiplex"]["attachedReplicas"] == ["r1"]
+    assert status["multiplex"]["parked"] == 4
+    mux_events = [
+        h for h in status["history"] if h.get("kind") == "mux"
+    ]
+    assert [e["action"] for e in mux_events] == ["attach"]
+    assert mux_events[0]["pool"] == "shared-a"
+    assert mux_events[0]["replica"] == "r1"
+    assert mux_events[0]["snapshotHash"] == "h-l"  # echoed identity
+    # The attach used the RESOLVED artifact uri, not the raw source.
+    assert pool.attach_calls[0][1].startswith("s3://mlflow/")
+    assert kube.event_reasons().count("MuxAttached") == 1
+
+
+def test_reconciler_mux_disabled_is_byte_for_byte_then_clears():
+    # Never enabled: no multiplex key anywhere near status.
+    kube, registry, metrics, clock, rec = make_world(
+        {"backend": "tpu", "tpu": _TPU}
+    )
+    rec.reconcile(kube.get(cr_ref()))
+    assert "multiplex" not in kube.get(cr_ref())["status"]
+    # Enabled then disabled: one explicit null clears the stale key.
+    pool = _FakePool()
+    kube2, registry2, metrics2, clock2, rec2 = make_world(
+        MUX_SPEC, mux_pools={"shared-a": _coord(pool)}
+    )
+    rec2.reconcile(kube2.get(cr_ref()))
+    assert kube2.get(cr_ref())["status"]["multiplex"] is not None
+    obj = kube2.get(cr_ref())
+    del obj["spec"]["multiplex"]
+    kube2.replace(cr_ref(), obj)
+    rec2.reconcile(kube2.get(cr_ref()))
+    assert kube2.get(cr_ref())["status"]["multiplex"] is None

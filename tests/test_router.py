@@ -1609,3 +1609,290 @@ def test_typed_sheds_carry_request_id_with_journey_ring_on(binary):
             assert e.headers.get("X-Request-Id") is None
     finally:
         router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Multi-model multiplexing (--mux-models): model-aware routing, per-model
+# parking with attach-triggered release, the chaos swap, and the off-pin.
+# ---------------------------------------------------------------------------
+
+
+def _mux_backends(world_ports, models):
+    """Backend dicts for set_config with per-backend attached models."""
+    return [
+        {"name": name, "host": "127.0.0.1", "port": port,
+         "weight": weight, "model": models.get(name, "")}
+        for name, (port, weight) in world_ports.items()
+    ]
+
+
+def test_mux_model_aware_routing_and_typed_shed(binary):
+    """With mux on, the /v2/models/<m>/ path joins the pick: requests
+    reach only replicas whose attached model matches; a model nobody
+    holds sheds typed 503 model_not_attached (parking off) while
+    healthy capacity exists; GETs and model-less paths route anywhere."""
+    srv1, p1 = start_backend("a")
+    srv2, p2 = start_backend("b")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", p1, 50), "b": ("127.0.0.1", p2, 50)},
+        namespace="models",
+        deployment="mux",
+        binary=binary,
+        mux_models=1,
+    ).start()
+    try:
+        router.admin.set_config(_mux_backends(
+            {"a": (p1, 50), "b": (p2, 50)}, {"a": "m-a", "b": "m-b"}
+        ))
+        # Model-scoped POSTs land ONLY on their holder, regardless of
+        # the 50/50 SWRR split.
+        for model, who in (("m-a", "a"), ("m-b", "b")):
+            codes = _collect_codes(
+                router.port, 6, path=f"/v2/models/{model}/generate"
+            )
+            assert [c for c, _ in codes] == [200] * 6, codes
+            assert {body["who"] for _, body in codes} == {who}
+        # A model no replica holds: typed + retryable, never the bare
+        # no-backend 503 — capacity exists, attachment doesn't.
+        code, body = _collect_codes(
+            router.port, 1, path="/v2/models/m-c/generate"
+        )[0]
+        assert code == 503, (code, body)
+        assert body["reason"] == "model_not_attached"
+        # GETs (readiness polls) and model-less paths are never gated.
+        assert ask(router.port, path="/v2/models/m-c/ready")["who"] in (
+            "a", "b"
+        )
+        assert ask(router.port, body={})["who"] in ("a", "b")
+        # Introspection: the attachment table rides /router/config and
+        # the per-model capacity gauge is on the metric surface.
+        cfg = router.admin.get_config()
+        assert cfg["muxModels"] == 1
+        assert {b["name"]: b["model"] for b in cfg["backends"]} == {
+            "a": "m-a", "b": "m-b"
+        }
+        mt = router.admin.metrics_text()
+        plabels = 'deployment_name="mux",namespace="models"'
+        assert (
+            f'tpumlops_router_model_backends{{{plabels},model="m-a"}} 1'
+            in mt
+        )
+        assert (
+            f'tpumlops_router_model_backends{{{plabels},model="m-b"}} 1'
+            in mt
+        )
+    finally:
+        router.stop()
+        srv1.shutdown()
+        srv2.shutdown()
+
+
+def test_mux_park_per_model_and_release_on_attach(binary):
+    """Requests for an unattached model park PER MODEL: the breakdown
+    rides /router/parked + the model-labeled gauge (the bin-packer's
+    wake signal), the attached model's traffic flows untouched, and the
+    attach — a config commit tagging a backend — releases exactly that
+    model's queue."""
+    import time as _time
+
+    srv1, p1 = start_backend("a")
+    srv2, p2 = start_backend("b")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", p1, 100), "b": ("127.0.0.1", p2, 100)},
+        namespace="models",
+        deployment="mux",
+        binary=binary,
+        mux_models=1,
+        park_buffer=8,
+        park_timeout_s=20.0,
+    ).start()
+    try:
+        router.admin.set_config(_mux_backends(
+            {"a": (p1, 100), "b": (p2, 100)}, {"a": "m-a"}
+        ))
+        results: list = []
+        threads = []
+        for i in range(2):
+            t = threading.Thread(
+                target=_mux_send, args=(router.port, "m-b", results, i)
+            )
+            t.start()
+            threads.append(t)
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            if router.admin.parked()["parked"] == 2:
+                break
+            _time.sleep(0.02)
+        state = router.admin.parked()
+        assert state["parked"] == 2, state
+        assert state["models"] == {"m-b": 2}
+        mt = router.admin.metrics_text()
+        assert (
+            'tpumlops_router_parked_requests{deployment_name="mux",'
+            'namespace="models",model="m-b"} 2' in mt
+        )
+        # The attached model's traffic is untouched by the parked tail.
+        codes = _collect_codes(
+            router.port, 3, path="/v2/models/m-a/generate"
+        )
+        assert [c for c, _ in codes] == [200] * 3
+        assert all(body["who"] == "a" for _, body in codes)
+        # The attach lands: tagging b with m-b wakes EXACTLY that queue.
+        router.admin.set_config(_mux_backends(
+            {"a": (p1, 100), "b": (p2, 100)}, {"a": "m-a", "b": "m-b"}
+        ))
+        for t in threads:
+            t.join(timeout=15)
+        assert sorted(r[1] for r in results) == [200, 200], results
+        state = router.admin.parked()
+        assert state["parked"] == 0 and state["released_total"] == 2
+    finally:
+        router.stop()
+        srv1.shutdown()
+        srv2.shutdown()
+
+
+def _mux_send(port, model, results, i, timeout=20):
+    import time as _time
+
+    t0 = _time.time()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v2/models/{model}/generate",
+            data=b"{}",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            results.append(
+                (i, resp.status, _time.time() - t0,
+                 json.loads(resp.read()))
+            )
+    except urllib.error.HTTPError as e:
+        results.append(
+            (i, e.code, _time.time() - t0, json.loads(e.read() or b"{}"))
+        )
+    except Exception as e:  # pragma: no cover - diagnostic shape
+        results.append((i, None, _time.time() - t0, str(e)))
+
+
+def test_mux_chaos_swap_zero_bare_502s(binary):
+    """The chaos swap (satellite): the replica holding a model dies
+    mid-replace under load.  In-flight requests fail over or park, the
+    completed attach on the surviving replica releases them, every
+    request resolves 200 or a TYPED 503 — never a bare 502 — and the
+    journey ring tells the whole story (model, park hold, final
+    backend)."""
+    srv1, port1 = start_backend("r1")
+    proxy = ChaosProxy(port1)
+    srv2, p2 = start_backend("r2")
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "r1": ("127.0.0.1", proxy.port, 100),
+            "r2": ("127.0.0.1", p2, 100),
+        },
+        namespace="models",
+        deployment="swap",
+        binary=binary,
+        mux_models=1,
+        park_buffer=8,
+        park_timeout_s=20.0,
+        health_probes=True,
+        health_threshold=1,
+        probe_interval_s=0.2,
+        failover_retries=2,
+        journey_ring=32,
+    ).start()
+    try:
+        table = {"r1": (proxy.port, 100), "r2": (p2, 100)}
+        router.admin.set_config(
+            _mux_backends(table, {"r1": "m", "r2": "other"}),
+            journey_ring=32,
+        )
+        # Steady state: model m serves from its holder through the
+        # (transparent) chaos proxy.
+        codes = _collect_codes(router.port, 2, path="/v2/models/m/generate")
+        assert [c for c, _ in codes] == [200] * 2
+        assert all(body["who"] == "r1" for _, body in codes)
+        # The replica dies mid-replace: every new connection refused
+        # while the operator is swapping m onto r2.
+        proxy.inject_refuse(times=10)
+        results: list = []
+        threads = []
+        for i in range(3):
+            t = threading.Thread(
+                target=_mux_send, args=(router.port, "m", results, i)
+            )
+            t.start()
+            threads.append(t)
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            if router.admin.parked()["models"].get("m") == 3:
+                break
+            _t.sleep(0.02)
+        assert router.admin.parked()["models"] == {"m": 3}
+        # The replace completes on the survivor; the park queue drains
+        # onto it.  (r1 detaches — the bin-packer moved m off it.)
+        router.admin.set_config(
+            _mux_backends(table, {"r1": "", "r2": "m"}),
+            journey_ring=32,
+        )
+        for t in threads:
+            t.join(timeout=15)
+        # Zero lost requests, zero bare 502s: every one completed 200
+        # on the NEW holder after a park hold.
+        assert sorted(r[1] for r in results) == [200] * 3, results
+        assert all(r[3]["who"] == "r2" for r in results), results
+        # The story is reconstructable from the journey ring alone:
+        # model-tagged records that parked and finished ok on r2.
+        swapped = [
+            j for j in router.admin.journeys()["requests"]
+            if j.get("model") == "m" and j.get("park_ms", 0) > 0
+        ]
+        assert len(swapped) >= 3, swapped
+        assert all(
+            j["outcome"] == "ok" and j["backend"] == "r2"
+            for j in swapped
+        ), swapped
+    finally:
+        router.stop()
+        proxy.stop()
+        srv1.shutdown()
+        srv2.shutdown()
+
+
+def test_mux_off_is_old_router_byte_for_byte(binary):
+    """The off-pin: without --mux-models the model-scoped path does NOT
+    gate the pick (SWRR splits as ever), /router/parked and /router/
+    config keep their pinned shapes, and the model-labeled families are
+    absent from the exposition."""
+    srv1, p1 = start_backend("a")
+    srv2, p2 = start_backend("b")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", p1, 50), "b": ("127.0.0.1", p2, 50)},
+        namespace="models",
+        deployment="plain",
+        binary=binary,
+    ).start()
+    try:
+        codes = _collect_codes(
+            router.port, 8, path="/v2/models/m-a/generate"
+        )
+        assert [c for c, _ in codes] == [200] * 8
+        # Both backends serve the "model-scoped" path: no gating.
+        assert {body["who"] for _, body in codes} == {"a", "b"}
+        assert "models" not in router.admin.parked()
+        cfg = router.admin.get_config()
+        assert "muxModels" not in cfg
+        assert all("model" not in b for b in cfg["backends"])
+        mt = router.admin.metrics_text()
+        assert "tpumlops_router_model_backends" not in mt
+        assert 'tpumlops_router_parked_requests{deployment_name="plain",' \
+            'namespace="models"} 0' in mt
+        assert "model=" not in mt
+    finally:
+        router.stop()
+        srv1.shutdown()
+        srv2.shutdown()

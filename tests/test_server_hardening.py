@@ -415,6 +415,48 @@ def test_engine_level_shed_when_queue_over_budget():
         engine.shutdown()
 
 
+def test_per_model_admission_fairness_on_shared_replica():
+    """Multiplexed warm pool: with two models holding outstanding work
+    on one replica, each is bounded by an equal SHARE of the admission
+    budget — the flooded model sheds reason=model_budget at its share
+    while the tail model's first request is admitted even though the
+    GLOBAL backlog already exceeds the budget (fairness replaces the
+    global check; a hot model's backlog must never shed the tail
+    model's first token).  Without model= the single-model contract is
+    byte-identical (pinned above)."""
+    import jax
+
+    from tpumlops.models import llama
+    from tpumlops.server.generation import GenerationEngine
+
+    cfg = llama.LlamaConfig.tiny(max_seq=64)
+    params = llama.init(jax.random.key(0), cfg)
+    engine = GenerationEngine(
+        params, cfg, max_slots=1, admission_queue_budget=80
+    )
+    # Never started: reservations stay queued, so the ledger is exact.
+    engine.reserve_admission(60, model="hot")  # empty queue: admitted
+    # Tail model's FIRST request admits despite 60 queued + 30 > 80.
+    engine.reserve_admission(30, model="tail")
+    # The hot model is now bounded by budget/2 = 40 < its 60 backlog.
+    with pytest.raises(EngineOverloaded) as err:
+        engine.reserve_admission(10, model="hot")
+    assert err.value.reason == "model_budget"
+    assert err.value.retry_after_s >= 1
+    # The share binds the tail model too once IT has outstanding work.
+    with pytest.raises(EngineOverloaded) as err:
+        engine.reserve_admission(30, model="tail")
+    assert err.value.reason == "model_budget"
+    assert engine.shed_total == 2
+    # The HTTP-request-scoped release returns the reservation: the tail
+    # model drops to zero outstanding and admits again.
+    engine.release_model_admission("tail", 30)
+    engine.reserve_admission(5, model="tail")
+    engine.release_model_admission("tail", 5)
+    engine.release_model_admission("hot", 60)
+    assert engine._model_est == {}  # ledger empty: single-model path back
+
+
 def test_sse_stream_survives_drain_and_new_requests_shed(tmp_path):
     """The lossless-drain contract end to end: an SSE stream in flight
     when /admin/drain lands keeps streaming to completion; new requests

@@ -198,6 +198,74 @@ def test_warm_pool_lifecycle_attach_and_replace(world):
     assert server.lifecycle == "ready"
 
 
+def test_attach_same_model_and_hash_is_idempotent_noop(world):
+    """The multiplexer re-emits its plan every convergence pass: an
+    attach of the uri + snapshot hash already on the device must be a
+    no-op 200 (with or without replace), never a drain-and-restore of
+    identical weights."""
+    server, port, uris = world
+    code, body, _ = _req(
+        port, "/admin/attach", {"model_uri": uris["1"], "replace": True}
+    )
+    assert code == 200, body
+    attached_hash = body["snapshot_hash"]
+    assert attached_hash  # the identity contract echoes the baked hash
+    inflight_before = server.gen_engine
+    for payload in (
+        {"model_uri": uris["1"], "replace": True},
+        {"model_uri": uris["1"]},  # even without replace: same model
+    ):
+        code, body, _ = _req(port, "/admin/attach", payload)
+        assert code == 200, body
+        assert body.get("noop") is True
+        assert body["snapshot_hash"] == attached_hash
+    # No quiesce happened: the same engine object is still serving.
+    assert server.gen_engine is inflight_before
+    assert server.lifecycle == "ready"
+    # /readyz reports the attached-model identity for the bin-packer.
+    code, body, _ = _req(port, "/readyz")
+    assert code == 200
+    assert body["model"] == uris["1"]
+    assert body["snapshotHash"] == attached_hash
+
+
+def test_attach_geometry_incompatible_replace_is_typed_409(world):
+    """A replace whose snapshot was baked for DIFFERENT model dims
+    would stall the warm replica in a full recompile — typed 409
+    before any quiesce, attached model keeps serving."""
+    from tpumlops.server import snapshot as _snap
+
+    server, port, uris = world
+    code, body, _ = _req(
+        port, "/admin/attach", {"model_uri": uris["1"], "replace": True}
+    )
+    assert code == 200, body
+    # Hand-bake a manifest for a bogus uri with fatter dims than the
+    # attached model's compiled programs.
+    bogus = "/fat/model"
+    spath = _snap.snapshot_path_for(server.snapshot_dir, bogus)
+    spath.mkdir(parents=True, exist_ok=True)
+    manifest = _snap.read_manifest(
+        _snap.snapshot_path_for(server.snapshot_dir, uris["1"])
+    )
+    fat = dict(manifest)
+    fat["config"] = {**manifest["config"], "hidden_size": 4096}
+    (spath / _snap.MANIFEST_NAME).write_text(json.dumps(fat))
+    code, body, _ = _req(
+        port, "/admin/attach", {"model_uri": bogus, "replace": True}
+    )
+    assert code == 409, body
+    assert body["reason"] == "geometry_incompatible"
+    assert body["attached_model_uri"] == uris["1"]
+    # The refusal happened BEFORE the quiesce: still ready, still v1.
+    assert server.lifecycle == "ready"
+    code, body, _ = _req(
+        port, "/v2/models/llm/generate",
+        {"prompt_ids": [1, 2], "max_new_tokens": 1},
+    )
+    assert code == 200, body
+
+
 def test_attach_requires_model_uri_and_warm_pool_flag(world):
     server, port, uris = world
     code, body, _ = _req(port, "/admin/attach", {})
