@@ -14,7 +14,7 @@ DOCKER   ?= docker
 .PHONY: images operator-image server-image router-image router-bin \
         install uninstall test test-fast test-e2e test-all lint \
         bench-contract metrics-contract compile-budget plan-contract \
-        verify bench
+        bench-history metrics-catalog verify bench
 
 images: operator-image server-image router-image
 
@@ -113,9 +113,22 @@ plan-contract:
 	env JAX_PLATFORMS=cpu python scripts/plan.py --dry-run \
 	  --expect tests/fixtures/journey_plan.json > /dev/null
 
-verify: lint bench-contract metrics-contract compile-budget plan-contract
+# Bench regression sentinel (ISSUE 20): every committed BENCH_*.json's
+# headline keys versus their last BENCH_HISTORY.jsonl revision — a
+# silent tok/s or collapse-ratio regression fails here, in the diff.
+bench-history:
+	python scripts/check_bench_history.py
+
+# Metrics-catalog lint (ISSUE 20): the three OBSERVABILITY.md series
+# tables must enumerate EXACTLY the families the server / operator /
+# router planes export — both directions.
+metrics-catalog:
+	env JAX_PLATFORMS=cpu python scripts/check_metrics_catalog.py
+
+verify: lint bench-contract metrics-contract compile-budget plan-contract \
+        bench-history metrics-catalog
 	set -o pipefail; rm -f /tmp/_t1.log; \
-	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	timeout -k 10 1150 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
 	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
 	rc=$${PIPESTATUS[0]}; \

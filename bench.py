@@ -2422,6 +2422,155 @@ def bench_observability() -> dict:
     }
 
 
+def bench_anomaly_observability() -> dict:
+    """Fleet anomaly observatory (server/timeseries.py +
+    operator/anomaly.py): two claims in one scenario.
+
+    (1) Ring overhead: the same continuous-batching serving run with the
+    per-second timeseries ring absent (the default — no ring object, the
+    engine callbacks are None) vs fanned onto every metric hook.  The
+    ring's per-event cost is a lock + capped list append, so the bar is
+    the flight recorder's: tok/s within noise, token-for-token output
+    agreement (observation must not perturb scheduling).
+
+    (2) Detection: a 4-replica fleet of REAL rings is fed from the ON
+    run's measured inter-token latencies — three healthy replicas carry
+    the measured stream with small deterministic skews (x1.0 / x1.03 /
+    x0.97: realistic inter-host spread), the fourth carries it slowed
+    6x (the injected straggler) — spread over per-second buckets with a
+    fake clock.  ``detect()`` at default ``AnomalySpec`` thresholds must
+    flag the slow replica and ONLY the slow replica: the acceptance bar
+    is straggler_flagged = 1 with false_positives = 0.  The signal is
+    real serving jitter; only the slowdown is injected — the fully-live
+    version (ChaosProxy delay, operator polling HTTP rings) runs in
+    tests/test_e2e_localplane.py."""
+    jax = _setup_jax()
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumlops.models import llama
+    from tpumlops.operator import anomaly
+    from tpumlops.server.generation import GenerationEngine
+    from tpumlops.server.timeseries import TimeseriesRing
+    from tpumlops.utils.config import AnomalySpec
+
+    cfg = llama.LlamaConfig(
+        vocab_size=4000, hidden_size=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, intermediate_size=704, max_seq=256,
+    )
+    params = llama.init(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    N_REQ, PROMPT, NEW, SLOTS = 8, 32, 64, 4
+    RING = 64
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=PROMPT).tolist()
+        for _ in range(N_REQ)
+    ]
+    itl_stream: "list[float]" = []
+
+    def run(ring):
+        def on_itl(seconds):
+            itl_stream.append(float(seconds))
+            ring.observe_itl(seconds)
+
+        engine = GenerationEngine(
+            params, cfg, max_slots=SLOTS, dtype=jnp.bfloat16,
+            on_step=ring.observe_decode_step if ring else None,
+            on_itl=on_itl if ring else None,
+            on_tick=ring.observe_tick if ring else None,
+            on_shed=ring.inc_shed if ring else None,
+        )
+        engine.start(warmup=True)
+        try:
+            t0 = time.perf_counter()
+            futs = [engine.submit(p, NEW) for p in prompts]
+            outs = [np.asarray(f.result(timeout=600)).tolist() for f in futs]
+            wall = time.perf_counter() - t0
+        finally:
+            engine.shutdown()
+        return {"tok_per_s": N_REQ * NEW / wall, "outputs": outs}
+
+    off = run(None)
+    ring = TimeseriesRing(RING)
+    on = run(ring)
+    ring_samples = len(ring.snapshot()["samples"])
+    agree = float(
+        np.mean(
+            [
+                x == y
+                for a, b in zip(off["outputs"], on["outputs"])
+                for x, y in zip(a, b)
+            ]
+        )
+    )
+    overhead_pct = 100.0 * (1.0 - on["tok_per_s"] / off["tok_per_s"])
+
+    # -- detection half: replay the measured ITL stream into a fleet ----
+    SKEWS = {"r0": 1.0, "r1": 1.03, "r2": 0.97, "r-slow": 6.0}
+    QUEUE = {"r0": 2, "r1": 3, "r2": 2, "r-slow": 9}
+    SECONDS = 12
+    fake = {"t": 1_000_000.0}
+    rings = {
+        name: TimeseriesRing(RING, clock=lambda: fake["t"]) for name in SKEWS
+    }
+    itl = itl_stream or [0.005] * SECONDS  # engine always produces ITL
+    per_sec = max(1, len(itl) // SECONDS)
+    for sec in range(SECONDS):
+        fake["t"] = 1_000_000.0 + sec + 0.5
+        chunk = itl[sec * per_sec : (sec + 1) * per_sec] or itl[-per_sec:]
+        for name, skew in SKEWS.items():
+            for s in chunk:
+                rings[name].observe_itl(s * skew)
+            rings[name].observe_decode_step(
+                SLOTS, 0.0, queue_depth=QUEUE[name]
+            )
+    fake["t"] += 2.0  # close the last bucket
+    spec = AnomalySpec(enabled=True)
+    windows = {
+        name: anomaly.replica_series(r.snapshot(), spec.window_s)
+        for name, r in rings.items()
+    }
+    verdicts = anomaly.detect(windows, spec)
+    stragglers = sorted({v.replica for v in verdicts if v.kind == "straggler"})
+    false_positives = sum(1 for v in verdicts if v.replica != "r-slow")
+    slow_verdicts = [v for v in verdicts if v.replica == "r-slow"]
+    return {
+        "requests": N_REQ,
+        "new_tokens_per_request": NEW,
+        "slots": SLOTS,
+        "timeseries_ring": RING,
+        "tok_per_s_off": round(off["tok_per_s"], 1),
+        "tok_per_s_on": round(on["tok_per_s"], 1),
+        # Negative = the ring run was faster (run-to-run noise on a
+        # shared host; the contract is "within noise of 0").
+        "overhead_pct": round(overhead_pct, 2),
+        "ring_samples": ring_samples,
+        "itl_samples": len(itl_stream),
+        "replicas": len(SKEWS),
+        "injected_slowdown_x": SKEWS["r-slow"],
+        "mad_threshold": spec.mad_threshold,
+        "straggler_flagged": int(stragglers == ["r-slow"]),
+        "straggler_series": sorted(v.series for v in slow_verdicts),
+        "max_z": round(
+            max((abs(v.z) for v in slow_verdicts if v.z is not None), default=0.0), 1
+        ),
+        "false_positives": false_positives,
+        "token_agreement": round(agree, 3),
+        **_device_cost_keys(params, cfg, SLOTS, on["tok_per_s"]),
+        "note": (
+            "detection replays the ON run's measured ITL stream into 4 "
+            "per-second rings (3 healthy skews + one 6x slow) and runs "
+            "detect() at default thresholds; the live-HTTP version is "
+            "the e2e test"
+        ),
+    }
+
+
 def bench_device_telemetry() -> dict:
     """Device telemetry layer (server/device_telemetry.py): the same
     continuous-batching run with telemetry absent (the default — no
@@ -4246,6 +4395,7 @@ SCENARIOS: "tuple[tuple[str, str], ...]" = (
     ("packed_prefill_serving", "bench_packed_prefill"),
     ("admission_control_serving", "bench_admission_control"),
     ("observability_serving", "bench_observability"),
+    ("anomaly_observability_serving", "bench_anomaly_observability"),
     ("device_telemetry_serving", "bench_device_telemetry"),
     ("cold_start_serving", "bench_cold_start"),
     ("disaggregated_serving", "bench_disaggregated"),
@@ -4321,6 +4471,13 @@ SCENARIO_SCHEMAS: dict = {
         "decode_step_ms_off", "decode_step_ms_on",
         "ring_ticks", "trace_events", "token_agreement",
         "mfu", "hbm_peak_bytes",
+    ),
+    "anomaly_observability_serving": (
+        "requests", "new_tokens_per_request", "slots", "timeseries_ring",
+        "tok_per_s_off", "tok_per_s_on", "overhead_pct",
+        "ring_samples", "replicas", "injected_slowdown_x",
+        "mad_threshold", "straggler_flagged", "false_positives",
+        "token_agreement", "mfu", "hbm_peak_bytes",
     ),
     "device_telemetry_serving": (
         "tok_per_s_off", "tok_per_s_on", "overhead_pct",
@@ -4483,6 +4640,10 @@ _COMPACT_KEYS = {
         "chunk_call_reduction", "mfu", "hbm_peak_bytes"),
     "observability_serving": (
         "tok_per_s_off", "tok_per_s_on", "overhead_pct",
+        "mfu", "hbm_peak_bytes"),
+    "anomaly_observability_serving": (
+        "tok_per_s_off", "tok_per_s_on", "overhead_pct",
+        "straggler_flagged", "false_positives",
         "mfu", "hbm_peak_bytes"),
     "device_telemetry_serving": (
         "overhead_pct", "decode_mfu", "ledger_vs_measured_pct",

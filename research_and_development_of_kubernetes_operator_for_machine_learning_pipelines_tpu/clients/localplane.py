@@ -223,6 +223,18 @@ class LocalReplicaSet:
         # zero-lost-requests proof.
         self.drain_reports: list[dict] = []
         self.scale_log: list[tuple[str, int]] = []  # (predictor, replicas)
+        # Straggler verdicts (anomaly observatory, operator/anomaly.py):
+        # ports to drain FIRST when the next scale-down picks victims.
+        # Empty (the default) = the historical newest-last choice,
+        # byte-identical.
+        self.straggler_ports: frozenset = frozenset()
+
+    def set_stragglers(self, ports) -> None:
+        """Replace the straggler port set the next scale-down prefers
+        as victims (a flagged replica should leave the fleet before a
+        healthy one does)."""
+        with self._lock:
+            self.straggler_ports = frozenset(int(p) for p in ports)
 
     def ports(self) -> list[int]:
         """Live (non-draining) replica ports, all predictors."""
@@ -264,6 +276,13 @@ class LocalReplicaSet:
                 self.scale_log.append((pred, n))
         for pred, handles in current.items():
             keep = desired.get(pred, 0)
+            if self.straggler_ports and len(handles) > keep:
+                # Stable sort pushes flagged ports into the drained
+                # slice; with no verdicts the slice (and every drain
+                # order) is exactly what it always was.
+                handles = sorted(
+                    handles, key=lambda h: h.port in self.straggler_ports
+                )
             for handle in handles[keep:]:
                 self._drain_stop(pred, handle)
 

@@ -148,6 +148,7 @@ class RouterAdmin:
         deployment: str | None = None,
         journey_ring: int | None = None,
         mux_models: int | None = None,
+        timeseries_ring: int | None = None,
     ) -> dict:
         body: dict = {"backends": backends}
         if namespace:
@@ -158,6 +159,10 @@ class RouterAdmin:
             # Fleet trace plane sizing (0 disables; omitted = keep the
             # router's running ring).
             body["journeyRing"] = int(journey_ring)
+        if timeseries_ring is not None:
+            # Per-backend 1 s history sizing (0 disables; omitted = keep
+            # the router's running ring).
+            body["timeseriesRing"] = int(timeseries_ring)
         if mux_models is not None:
             # Multi-model multiplexing toggle (0 disables; omitted =
             # keep the router's running mode).  Backend entries may then
@@ -198,6 +203,15 @@ class RouterAdmin:
         (``GET /router/debug/trace?format=chrome``): one track per
         backend, async request spans keyed by request id."""
         return json.loads(self._req(f"/router/debug/trace?format={fmt}"))
+
+    def timeseries(self) -> dict:
+        """The timeseries ring (``GET /router/debug/timeseries``):
+        per-backend 1 s buckets of leg wall p50/p99, leg/error/failover
+        counts, plus a router-level park series — the proxy-side
+        per-replica history the operator's anomaly detector compares
+        across peers.  404 (HTTPError) while ``--timeseries-ring`` is
+        0."""
+        return json.loads(self._req("/router/debug/timeseries"))
 
 
 def parse_prometheus_text(text: str) -> dict[tuple[str, frozenset], float]:
@@ -404,6 +418,12 @@ class RouterSync:
         # annotations (the multiplexer stamps them as it executes its
         # attach plan).
         mux_models = int(annotations.get("tpumlops.dev/mux-models") or 0)
+        # Router timeseries ring: same always-sent contract (absent = 0)
+        # — an omitted size would pin a previously-enabled ring on
+        # forever after the CR disables it.
+        timeseries_ring = int(
+            annotations.get("tpumlops.dev/fleet-timeseries-ring") or 0
+        )
         backends = []
         for pred in spec.get("predictors") or []:
             name = pred.get("name")
@@ -459,6 +479,7 @@ class RouterSync:
                 deployment=meta.get("name"),
                 journey_ring=journey_ring,
                 mux_models=mux_models,
+                timeseries_ring=timeseries_ring,
             )
 
 
@@ -490,6 +511,7 @@ class RouterProcess:
         journey_ring: int = 0,
         access_log: bool = False,
         mux_models: int = 0,
+        timeseries_ring: int = 0,
     ):
         self.port = port
         # Values are (host, port, weight) or (host, port, weight, role)
@@ -538,6 +560,10 @@ class RouterProcess:
         # loop mid-request under sustained traffic.
         self.journey_ring = int(journey_ring)
         self.access_log = bool(access_log)
+        # Per-backend 1 s history (default off = old router byte-for-
+        # byte): leg wall p50/p99 + error/failover/park buckets served
+        # at /router/debug/timeseries for the fleet anomaly observatory.
+        self.timeseries_ring = int(timeseries_ring)
         # Multi-model multiplexing (default off = old router byte-for-
         # byte): the model id of a POST's /v2/models/<m>/ path joins the
         # routing decision — requests reach only replicas whose attached
@@ -577,6 +603,8 @@ class RouterProcess:
             argv += ["--failover-retries", str(self.failover_retries)]
         if self.journey_ring > 0:
             argv += ["--journey-ring", str(self.journey_ring)]
+        if self.timeseries_ring > 0:
+            argv += ["--timeseries-ring", str(self.timeseries_ring)]
         if self.access_log:
             argv += ["--access-log", "1"]
         if self.mux_models:

@@ -196,6 +196,106 @@ std::string request_model(const std::string& path) {
   return path.substr(start, end - start);
 }
 
+// ---------------------------------------------------------------------------
+// Fleet timeseries ring (--timeseries-ring N): per-backend 1 s history
+//
+// The journey ring (below) answers "what happened to request X"; the
+// timeseries ring answers "how has backend Y behaved over the last N
+// seconds" — the router-side twin of the server's /debug/timeseries.
+// Each backend keeps a bounded deque of finalized per-second buckets
+// (leg count, leg wall p50/p99 ms, error count, failover departures)
+// plus one open bucket; a router-level ring counts park admissions the
+// same way (a park means NO backend took the request, so it cannot be
+// attributed to one).  The operator's anomaly detector compares these
+// per-replica leg-latency series across peers — proxy-visible slowness
+// (a slow pod, a slow link) shows up here even when the replica's own
+// server-side ITL looks healthy.  --timeseries-ring 0 (the default)
+// keeps the router byte-for-byte: no buckets, no allocation on the
+// request path, 404 on the debug endpoint.
+// ---------------------------------------------------------------------------
+
+int g_timeseries_ring = 0;  // --timeseries-ring (0 = ring off)
+// A /router/debug scrape serializes every ring on the single-threaded
+// event loop (same bound rationale as kMaxJourneyRing), but a bucket is
+// tiny (~48 B) so a day of seconds per backend stays a few MiB.
+constexpr int kMaxTimeseriesRing = 86400;
+// Raw leg walls kept per open bucket: quantiles past the cap are over
+// the first kTsSampleCap legs of that second (fixed-memory contract,
+// same cap as the server ring's BUCKET_SAMPLE_CAP).
+constexpr size_t kTsSampleCap = 256;
+
+struct TsSample {  // one finalized 1 s bucket
+  long t = 0;              // unix second
+  uint32_t n = 0;          // completed legs
+  double p50_ms = 0.0;     // leg wall quantiles (nearest-rank)
+  double p99_ms = 0.0;
+  uint32_t errors = 0;     // legs that answered >= 500
+  uint32_t failovers = 0;  // legs re-dispatched AWAY from this backend
+  uint32_t parks = 0;      // router-level ring only: park admissions
+};
+
+struct TsRing {
+  long open_t = -1;           // unix second of the open bucket (-1 = none)
+  std::vector<double> walls;  // capped raw leg walls (seconds)
+  TsSample open;              // counters of the open bucket
+  std::deque<TsSample> samples;
+
+  TsSample finalize_open() {
+    TsSample s = open;
+    s.t = open_t;
+    std::sort(walls.begin(), walls.end());
+    if (!walls.empty()) {
+      size_t i50 = std::min(walls.size() - 1, size_t(0.50 * walls.size()));
+      size_t i99 = std::min(walls.size() - 1, size_t(0.99 * walls.size()));
+      s.p50_ms = walls[i50] * 1e3;
+      s.p99_ms = walls[i99] * 1e3;
+    }
+    return s;
+  }
+
+  // Finalize the open bucket once the wall clock leaves its second.
+  void roll() {
+    long sec = long(wall_s());
+    if (open_t < 0) {
+      open_t = sec;
+      return;
+    }
+    if (sec <= open_t) return;
+    samples.push_back(finalize_open());
+    while (int(samples.size()) > g_timeseries_ring) samples.pop_front();
+    open_t = sec;
+    open = TsSample{};
+    walls.clear();
+  }
+
+  void observe_leg(double seconds, bool error) {
+    roll();
+    open.n++;
+    if (error) open.errors++;
+    if (walls.size() < kTsSampleCap) walls.push_back(seconds);
+  }
+
+  void inc_failover() {
+    roll();
+    open.failovers++;
+  }
+
+  void inc_park() {
+    roll();
+    open.parks++;
+  }
+
+  void clear() {
+    samples.clear();
+    walls.clear();
+    open = TsSample{};
+    open_t = -1;
+  }
+};
+
+// Router-level ring: park admissions (no backend took the request).
+TsRing g_router_ts;
+
 struct Backend {
   std::string name;  // predictor_name label, e.g. "v3"
   std::string host;
@@ -239,6 +339,9 @@ struct Backend {
   // feedback volume via service="feedback" (:410-415).
   std::map<std::pair<std::string, std::string>, Histogram> by_code;
   std::vector<int> idle_conns;  // keep-alive pool (fds)
+  // Per-second leg history (--timeseries-ring): never touched — zero
+  // bytes of samples — with the ring off.
+  TsRing ts;
 };
 
 // Resolve host:port once at config time (k8s service names and "localhost"
@@ -695,7 +798,8 @@ struct BackendSpec {
 
 bool parse_config(const std::string& body, std::string* ns, std::string* dep,
                   std::vector<BackendSpec>* specs,
-                  int* journey_ring = nullptr, int* mux_models = nullptr) {
+                  int* journey_ring = nullptr, int* mux_models = nullptr,
+                  int* timeseries_ring = nullptr) {
   JsonParser j(body);
   if (!j.consume('{')) return false;
   while (j.ok && !j.peek('}')) {
@@ -711,6 +815,13 @@ bool parse_config(const std::string& body, std::string* ns, std::string* dep,
       if (journey_ring)
         *journey_ring =
             (v < 0 || v > double(kMaxJourneyRing)) ? -2 : int(v);
+    }
+    else if (key == "timeseriesRing") {
+      // Same range-check-as-double rationale as journeyRing.
+      double v = j.parse_number();
+      if (timeseries_ring)
+        *timeseries_ring =
+            (v < 0 || v > double(kMaxTimeseriesRing)) ? -2 : int(v);
     }
     else if (key == "muxModels") {
       // Same always-sent contract as journeyRing: RouterSync forwards
@@ -1675,6 +1786,8 @@ std::string config_json() {
     // Emitted only when enabled so the default config shape stays
     // byte-for-byte what callers have pinned.
     out += "\"journeyRing\":" + std::to_string(g_journey_ring) + ",";
+  if (g_timeseries_ring > 0)
+    out += "\"timeseriesRing\":" + std::to_string(g_timeseries_ring) + ",";
   if (g_mux) out += "\"muxModels\":1,";
   out += "\"backends\":[";
   bool first = true;
@@ -1692,6 +1805,55 @@ std::string config_json() {
     out += "}";
   }
   out += "]}";
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Timeseries ring exposition (/router/debug/timeseries)
+// ---------------------------------------------------------------------------
+
+void ts_samples_json(std::string* out, TsRing* r, bool router_level) {
+  // roll() first so a bucket whose second has passed is finalized even
+  // on an idle ring; the still-open bucket is appended as a view with
+  // "open":true (same contract as the server's /debug/timeseries).
+  r->roll();
+  *out += "[";
+  char buf[192];
+  bool first = true;
+  auto emit = [&](const TsSample& s, bool open) {
+    if (!first) *out += ",";
+    first = false;
+    if (router_level) {
+      snprintf(buf, sizeof(buf), "{\"t\":%ld,\"parks\":%u", s.t, s.parks);
+    } else {
+      snprintf(buf, sizeof(buf),
+               "{\"t\":%ld,\"n\":%u,\"p50_ms\":%.4f,\"p99_ms\":%.4f,"
+               "\"errors\":%u,\"failovers\":%u",
+               s.t, s.n, s.p50_ms, s.p99_ms, s.errors, s.failovers);
+    }
+    *out += buf;
+    if (open) *out += ",\"open\":true";
+    *out += "}";
+  };
+  for (const TsSample& s : r->samples) emit(s, false);
+  if (r->open_t >= 0) emit(r->finalize_open(), true);
+  *out += "]";
+}
+
+std::string timeseries_json() {
+  std::string out = "{\"capacity\":" + std::to_string(g_timeseries_ring) +
+                    ",\"resolution_s\":1,\"router\":{\"samples\":";
+  ts_samples_json(&out, &g_router_ts, /*router_level=*/true);
+  out += "},\"backends\":{";
+  bool first = true;
+  for (auto& b : g_state.backends) {
+    if (!first) out += ",";
+    first = false;
+    out += "\"" + json_escape(b->name) + "\":{\"samples\":";
+    ts_samples_json(&out, &b->ts, /*router_level=*/false);
+    out += "}";
+  }
+  out += "}}";
   return out;
 }
 
@@ -1897,11 +2059,15 @@ void drain_pool(Backend* b) {
 // shift live traffic).
 std::string apply_config(const std::string& ns, const std::string& dep,
                          const std::vector<BackendSpec>& specs,
-                         int journey_ring = -1, int mux_models = -1) {
+                         int journey_ring = -1, int mux_models = -1,
+                         int timeseries_ring = -1) {
   if (journey_ring == -2 || journey_ring > kMaxJourneyRing)
     return "journeyRing out of range (0.." +
            std::to_string(kMaxJourneyRing) + ")";
   if (mux_models == -2) return "muxModels must be 0 or 1";
+  if (timeseries_ring == -2 || timeseries_ring > kMaxTimeseriesRing)
+    return "timeseriesRing out of range (0.." +
+           std::to_string(kMaxTimeseriesRing) + ")";
   struct Staged {
     BackendPtr survivor;  // null for new backends
     BackendSpec spec;
@@ -2009,6 +2175,22 @@ std::string apply_config(const std::string& ns, const std::string& dep,
       g_journeys_total = 0;
     }
     while (int(g_journeys.size()) > g_journey_ring) g_journeys.pop_front();
+  }
+  if (timeseries_ring >= 0 && timeseries_ring != g_timeseries_ring) {
+    // Operator-driven (RouterSync sends the manifest's
+    // tpumlops.dev/fleet-timeseries-ring annotation).  Shrinking trims
+    // the oldest buckets; 0 drops every ring.
+    g_timeseries_ring = timeseries_ring;
+    if (g_timeseries_ring == 0) {
+      g_router_ts.clear();
+      for (auto& b : g_state.backends) b->ts.clear();
+    } else {
+      while (int(g_router_ts.samples.size()) > g_timeseries_ring)
+        g_router_ts.samples.pop_front();
+      for (auto& b : g_state.backends)
+        while (int(b->ts.samples.size()) > g_timeseries_ring)
+          b->ts.samples.pop_front();
+    }
   }
   return "";
 }
@@ -2140,6 +2322,18 @@ void handle_admin(ClientConn* c) {
         client_send(c, http_response(400, "Bad Request", "text/plain",
                                      "unknown format '" + fmt + "'\n"));
     }
+  } else if (path == "/router/debug/timeseries") {
+    // Per-backend 1 s history; 404 names the knob when the ring is
+    // off, same contract as the journey endpoints above.
+    if (g_timeseries_ring <= 0) {
+      client_send(c, http_response(
+          404, "Not Found", "application/json",
+          "{\"error\":\"timeseries ring disabled; enable --timeseries-ring N "
+          "(spec.tpu.observability.timeseriesRing)\"}"));
+    } else {
+      client_send(c, http_response(200, "OK", "application/json",
+                                   timeseries_json()));
+    }
   } else if (path == "/router/metrics") {
     client_send(c, http_response(200, "OK", "text/plain; version=0.0.4",
                                  metrics_text()));
@@ -2150,8 +2344,11 @@ void handle_admin(ClientConn* c) {
     std::vector<BackendSpec> specs;
     int journey_ring = -1;  // absent = keep the running ring
     int mux_models = -1;    // absent = keep the running mux mode
-    if (parse_config(body, &ns, &dep, &specs, &journey_ring, &mux_models)) {
-      std::string bad = apply_config(ns, dep, specs, journey_ring, mux_models);
+    int timeseries_ring = -1;  // absent = keep the running ring
+    if (parse_config(body, &ns, &dep, &specs, &journey_ring, &mux_models,
+                     &timeseries_ring)) {
+      std::string bad = apply_config(ns, dep, specs, journey_ring, mux_models,
+                                     timeseries_ring);
       if (bad.empty()) {
         client_send(c, http_response(200, "OK", "application/json", config_json()));
         // Capacity may just have returned (a replica came back / the
@@ -2211,6 +2408,10 @@ void finish_request(const BackendPtr& b, int code, double seconds,
   // Feedback posts count under their own service label but stay out of
   // the latency histogram the gate's p95/mean queries read.
   if (!feedback) b->client_latency.observe(seconds);
+  // The timeseries ring mirrors the histogram's scope (predictions
+  // only) so its per-second p50/p99 and the gate's queries agree.
+  if (g_timeseries_ring > 0 && !feedback)
+    b->ts.observe_leg(seconds, code >= 500);
   b->by_code[{std::to_string(code), feedback ? "feedback" : "predictions"}]
       .observe(seconds);
   // The exact-latency ring mirrors the histogram's scope: predictions
@@ -2289,6 +2490,9 @@ void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
         c->failover_attempts++;
         g_failover_total++;
         if (c->journey) c->journey->failovers++;
+        // Attributed to the backend being LEFT: a straggler sheds load
+        // onto its peers, and that departure count is the signal.
+        if (g_timeseries_ring > 0 && c->backend) c->backend->ts.inc_failover();
         c->backend = next;
         c->retries = 0;
         connect_upstream(c, /*allow_pool=*/true);
@@ -2308,6 +2512,7 @@ void fail_502(ClientConn* c, const char* why, bool first_byte_seen = false) {
         journey_park_begin(c);
         g_parked.push_back(c);
         g_parked_total++;
+        if (g_timeseries_ring > 0) g_router_ts.inc_park();
         return;
       }
       g_park_overflow_total++;
@@ -2701,6 +2906,7 @@ void start_proxy(ClientConn* c) {
         journey_park_begin(c);
         g_parked.push_back(c);
         g_parked_total++;
+        if (g_timeseries_ring > 0) g_router_ts.inc_park();
         return;
       }
       g_park_overflow_total++;
@@ -3143,7 +3349,8 @@ void usage() {
       "       [--affinity-tokens N] [--kv-handoff 0|1] [--handoff-retries N]\n"
       "       [--health-probes 0|1] [--health-threshold N]\n"
       "       [--probe-interval-s S] [--failover-retries N]\n"
-      "       [--journey-ring N] [--access-log 0|1] [--mux-models 0|1]");
+      "       [--journey-ring N] [--timeseries-ring N] [--access-log 0|1]\n"
+      "       [--mux-models 0|1]");
 }
 
 }  // namespace
@@ -3170,6 +3377,7 @@ int main(int argc, char** argv) {
     else if (a == "--probe-interval-s") g_probe_interval_s = atof(next().c_str());
     else if (a == "--failover-retries") g_failover_retries = atoi(next().c_str());
     else if (a == "--journey-ring") g_journey_ring = atoi(next().c_str());
+    else if (a == "--timeseries-ring") g_timeseries_ring = atoi(next().c_str());
     else if (a == "--access-log") g_access_log = atoi(next().c_str());
     else if (a == "--mux-models") g_mux = atoi(next().c_str());
     else if (a == "--backend") {
@@ -3198,6 +3406,8 @@ int main(int argc, char** argv) {
   if (!port) usage();
   if (g_journey_ring < 0 || g_journey_ring > kMaxJourneyRing)
     die("--journey-ring must be in [0, %d]", kMaxJourneyRing);
+  if (g_timeseries_ring < 0 || g_timeseries_ring > kMaxTimeseriesRing)
+    die("--timeseries-ring must be in [0, %d]", kMaxTimeseriesRing);
   // Trace-plane clock anchors + id-minting seed.
   g_t0_mono = now_s();
   g_t0_unix = wall_s();

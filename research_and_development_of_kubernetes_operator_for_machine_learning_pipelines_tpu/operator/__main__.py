@@ -134,6 +134,13 @@ def main(argv: list[str] | None = None) -> None:
                 '{"name", "base_url", "kind"} objects'
             )
         fleet_trace_sources = lambda: specs  # noqa: E731
+    # The same source list drives the anomaly observatory: ring
+    # snapshots for CRs with spec.anomaly, and /debug/fleet-overview.
+    ring_sources = None
+    if fleet_trace_sources is not None:
+        from .anomaly import ring_sources_from
+
+        ring_sources = ring_sources_from(fleet_trace_sources)
     if args.metrics_port:
         telemetry.serve(
             args.metrics_port,
@@ -171,6 +178,7 @@ def main(argv: list[str] | None = None) -> None:
                 telemetry=telemetry,
                 recorder=recorder,
                 max_concurrent_reconciles=args.concurrent_reconciles,
+                ring_sources=ring_sources,
             )
             # Watchers start HERE, synchronously, so teardown can never
             # race a half-started serve thread into orphaning them.

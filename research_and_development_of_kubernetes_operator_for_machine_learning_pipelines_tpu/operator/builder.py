@@ -195,6 +195,13 @@ def _tpu_pod_spec(
         # admission/drain flags): an unannotated CR's manifest must stay
         # byte-for-byte what it was before the device telemetry layer.
         container["args"] += ["--device-telemetry", "1"]
+    if tpu.observability.timeseries_ring > 0:
+        # Per-second serving time-series ring (the anomaly detector's
+        # input plane).  Appended only when sized — same byte-identity
+        # contract.
+        container["args"] += [
+            "--timeseries-ring", str(tpu.observability.timeseries_ring)
+        ]
     if tpu.snapshot.enabled:
         # Pre-baked weight snapshots (scale-to-zero fast restore).
         # Appended only when enabled — same byte-identity contract.  The
@@ -693,6 +700,17 @@ def build_deployment(
         # affinity/kv knobs above.
         annotations["tpumlops.dev/fleet-journey-ring"] = str(
             config.fleet.observability.journey_ring
+        )
+    if (
+        config.backend == "tpu"
+        and config.tpu.observability.timeseries_ring > 0
+    ):
+        # Router half of the anomaly observatory (absent = byte-for-
+        # byte): RouterSync reads this annotation and sizes the router's
+        # per-backend time-series ring to match the replicas' rings —
+        # proxy-visible slowness (leg latency) lives only at the router.
+        annotations["tpumlops.dev/fleet-timeseries-ring"] = str(
+            config.tpu.observability.timeseries_ring
         )
     if config.backend == "tpu" and config.multiplex.enabled:
         # Multiplexing contract (absent = byte-for-byte): RouterSync

@@ -147,6 +147,7 @@ def plan(
     models: Sequence[MuxModel],
     replicas: Sequence[MuxReplica],
     wall: float,
+    stragglers: frozenset = frozenset(),
 ) -> MuxPlan:
     """Pure bin-pack pass: who should hold what, expressed as moves.
 
@@ -154,6 +155,11 @@ def plan(
     never touched, so re-running the plan against a settled pool yields
     zero moves (and the attach endpoint's idempotent no-op backstops
     even a re-emitted one).  Ties rank by name for determinism.
+
+    ``stragglers`` (anomaly-observatory verdicts, operator/anomaly.py)
+    demotes the named replicas to LAST choice as attach targets: a
+    model newly winning capacity should not land on the pool's slowest
+    device.  The empty set leaves every decision byte-identical.
     """
     ranked = sorted(
         (m for m in models if m.score > 0),
@@ -165,11 +171,13 @@ def plan(
     satisfied = {
         r.attached_uri for r in replicas if r.attached_uri in winner_uris
     }
-    # Free list: empty replicas first, then losers cheapest-first (evict
-    # the attachment with the least traffic behind it).
+    # Free list: healthy replicas before stragglers, then empty replicas
+    # first, then losers cheapest-first (evict the attachment with the
+    # least traffic behind it).
     free = sorted(
         (r for r in replicas if r.attached_uri not in winner_uris),
         key=lambda r: (
+            r.name in stragglers,
             r.attached_uri is not None,
             score_by_uri.get(r.attached_uri, 0.0),
             r.name,
@@ -288,6 +296,10 @@ class Multiplexer:
         self._pending: dict[str, list[MuxRecord]] = {}
         self._last_pass = 0.0
         self.moves_total = 0
+        # Straggler verdicts from the anomaly observatory (reconciler
+        # _anomaly_step): replica names to treat as last-choice attach
+        # targets.  Empty (the default) = byte-identical planning.
+        self._stragglers: frozenset = frozenset()
 
     # -- membership / observation -------------------------------------------
 
@@ -310,6 +322,11 @@ class Multiplexer:
         with self._lock:
             self._members.pop(name, None)
             self._pending.pop(name, None)
+
+    def set_stragglers(self, names) -> None:
+        """Replace the straggler set the next plan will avoid."""
+        with self._lock:
+            self._stragglers = frozenset(names)
 
     def observe(
         self,
@@ -372,7 +389,9 @@ class Multiplexer:
             with self._lock:
                 members = list(self._members.values())
         self.refresh_replicas()
-        p = plan(self.pool, members, self.replicas, now)
+        with self._lock:
+            stragglers = self._stragglers
+        p = plan(self.pool, members, self.replicas, now, stragglers)
         records = list(p.holds)
         for mv in p.moves:
             records.append(self._execute(mv, now))

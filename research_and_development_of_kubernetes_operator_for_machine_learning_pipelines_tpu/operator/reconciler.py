@@ -129,6 +129,11 @@ class ReconcileOutcome:
     # The step's MuxRecords when this CR is multiplexed (None otherwise);
     # OperatorTelemetry reads them for tpumlops_operator_mux_*.
     mux: Any = None
+    # The step's AnomalyRecords when this step journaled a verdict-set
+    # transition (None otherwise — including every step with
+    # spec.anomaly absent); OperatorTelemetry reads them for
+    # tpumlops_operator_anomaly_*.
+    anomaly: Any = None
 
 
 class Reconciler:
@@ -153,6 +158,7 @@ class Reconciler:
         recorder=None,  # RolloutRecorder | None; per-CR gate/phase journal
         wall=None,  # Callable[[], float]; unix-epoch seconds (tests inject)
         mux_pools=None,  # Mapping[str, multiplexer.Multiplexer] | None
+        ring_sources=None,  # Callable[[], dict] | None; fleet ring snapshots
     ):
         self.name = name
         self.namespace = namespace
@@ -219,6 +225,23 @@ class Reconciler:
         # None/missing pool = this CR surfaces status only; the pump,
         # journal drain, and mux events all no-op.
         self.mux_pools = mux_pools
+        # Fleet anomaly observatory (spec.anomaly, operator/anomaly.py).
+        # ``ring_sources`` is a zero-arg callable returning
+        # ``{"replicas": {name: server-ring snapshot}, "router":
+        # router-ring snapshot | None}`` — the reconciler never does its
+        # own HTTP; the runtime (or a test) owns the fetching.  The
+        # verdict-set shape of the last journaled transition dedupes the
+        # journal/event stream exactly like the PromotionHold limiter;
+        # None = unknown (rebuilt from status.anomalies on the first
+        # step, so an operator restart doesn't re-announce a standing
+        # verdict).
+        self.ring_sources = ring_sources
+        self._anomaly_last_shape: "frozenset | None" = None
+        self._anomaly_records = None
+        # Replicas currently under a straggler verdict — read by the
+        # multiplexer pump (straggler = last-choice attach target).
+        # None = unknown until the first step reads status back.
+        self._stragglers: "frozenset | None" = None
 
     def _metrics_source(self, config: OperatorConfig) -> MetricsSource:
         """Fixed source (tests) or per-CR source from spec.prometheusUrl."""
@@ -249,6 +272,7 @@ class Reconciler:
         self._pending_records = []
         self._scale_record = None
         self._mux_records = None
+        self._anomaly_records = None
         self._step_engine_obs = False
         # Reset per step: an early-returning _slo_step (spec didn't
         # parse, nothing serving) must export NO evals, not re-export
@@ -280,6 +304,11 @@ class Reconciler:
         # exactly what the journal must be able to show).
         outcome.state = self._slo_step(outcome.state, outcome.events)
         outcome.slo = self._slo_evals
+        # Anomaly detection is fleet observation on the same footing:
+        # every path, stuck canaries included — a straggler mid-rollout
+        # is precisely what the observatory exists to catch.
+        outcome.state = self._anomaly_step(outcome.state, outcome.events)
+        outcome.anomaly = self._anomaly_records
         outcome.timings = self._timings
         outcome.scale = self._scale_record
         outcome.mux = self._mux_records
@@ -314,6 +343,29 @@ class Reconciler:
         self._had_fleet_key = prior_status.get("fleet") is not None
         # Multiplexed-pool view: same explicit-null contract.
         self._had_multiplex_key = prior_status.get("multiplex") is not None
+        # Anomaly verdicts: same explicit-null contract; the straggler
+        # set and journal-dedupe shape also rebuild from status here so
+        # an operator restart neither re-announces a standing verdict
+        # nor forgets which replicas the multiplexer should avoid.
+        self._had_anomalies_key = prior_status.get("anomalies") is not None
+        if self._stragglers is None:
+            prior_anoms = prior_status.get("anomalies") or ()
+            self._stragglers = frozenset(
+                a.get("replica")
+                for a in prior_anoms
+                if isinstance(a, dict) and a.get("kind") == "straggler"
+            )
+            if self._anomaly_last_shape is None:
+                self._anomaly_last_shape = frozenset(
+                    (
+                        a.get("replica"),
+                        a.get("kind"),
+                        a.get("series"),
+                        a.get("direction"),
+                    )
+                    for a in prior_anoms
+                    if isinstance(a, dict)
+                )
         # Device-telemetry capacity summary: recomputed from spec each
         # step (no state round-trip needed); the explicit-null contract
         # mirrors the journal/scaler keys so disabling clears it once.
@@ -745,6 +797,103 @@ class Reconciler:
             self._patch_status(state)
         return state
 
+    def _anomaly_step(
+        self, state: PromotionState, events: list[Event]
+    ) -> PromotionState:
+        """One fleet anomaly-detection pass (``spec.anomaly``;
+        operator/anomaly.py).
+
+        Pulls ring snapshots through the injected ``ring_sources``
+        callable, builds the per-replica named-series windows (server
+        ITL/MFU/queue PLUS the router's per-backend leg latency — the
+        only vantage that sees proxy-injected slowness), and runs the
+        pure ``detect()``.  A verdict-set SHAPE transition — which
+        replicas/series/directions, never the jittering statistics —
+        journals one ``AnomalyRecord``, emits one ``AnomalyDetected``
+        Warning, and refreshes ``status.anomalies``; an unchanged
+        standing verdict is silent.  Absent ``spec.anomaly`` (the
+        default): no fetches, no status writes — byte-for-byte."""
+        config = self._audit_config
+        if config is None:
+            return state  # spec didn't parse: leave everything alone
+        spec = config.anomaly
+        if not spec.enabled:
+            self._anomaly_last_shape = frozenset()
+            self._stragglers = frozenset()
+            if state.anomalies is not None:
+                # spec.anomaly removed with the key lingering: one
+                # explicit-null patch clears it, then patch-free again.
+                state = state.with_(anomalies=None)
+                self._patch_status(state)
+            return state
+        if self.ring_sources is None:
+            return state  # observatory not wired into this runtime
+        from . import anomaly as _anomaly
+
+        with self._op_timer("anomaly"):
+            try:
+                obs = self.ring_sources() or {}
+            except Exception as e:
+                self.log.warning(f"anomaly ring fetch failed: {e}")
+                return state
+            windows: dict = {}
+            baselines: dict = {}
+            for replica, snap in sorted(
+                (obs.get("replicas") or {}).items()
+            ):
+                series = _anomaly.replica_series(snap, spec.window_s)
+                if series:
+                    windows[replica] = series
+                base = _anomaly.baseline_of(snap, spec.baseline_s)
+                if base:
+                    baselines[replica] = base
+            router_snap = obs.get("router")
+            if router_snap:
+                for replica, series in _anomaly.router_series(
+                    router_snap, spec.window_s
+                ).items():
+                    windows.setdefault(replica, {}).update(series)
+            verdicts = _anomaly.detect(windows, spec, baselines)
+        shape = frozenset(v.shape for v in verdicts)
+        self._stragglers = frozenset(
+            v.replica for v in verdicts if v.kind == "straggler"
+        )
+        prev = self._anomaly_last_shape
+        if prev is None:
+            prev = frozenset()
+        if shape == prev:
+            return state  # standing verdict (or standing quiet): silent
+        self._anomaly_last_shape = shape
+        rec = _anomaly.AnomalyRecord(
+            wall=self._wall(),
+            action="detected" if verdicts else "cleared",
+            verdicts=verdicts,
+            replicas=len(windows),
+        )
+        self._anomaly_records = [rec]
+        state = self._journal(config, state, rec)
+        # status.anomalies carries the verdicts stamped at this
+        # transition (live numbers would force a patch per poll).
+        state = state.with_(anomalies=[v.as_dict() for v in verdicts])
+        self._patch_status(state)
+        if verdicts:
+            ev = Event(
+                "Warning",
+                "AnomalyDetected",
+                f"Fleet anomaly across {len(windows)} replicas: "
+                + "; ".join(
+                    f"{v.replica} {v.kind} on {v.series} "
+                    f"({v.direction})"
+                    for v in verdicts
+                ),
+            )
+            events.append(ev)
+            self.kube.emit_event(self.cr_ref, ev)
+            self.log.warning(ev.message)
+        else:
+            self.log.info("fleet anomaly verdicts cleared")
+        return state
+
     def _shed_disabled_journal(
         self, config: OperatorConfig, state: PromotionState
     ) -> PromotionState:
@@ -1051,6 +1200,12 @@ class Reconciler:
                     self.log.warning(f"mux uri resolution failed: {e}")
             if uri:
                 coord.register(self.name, uri=uri, weight=mux.weight)
+            # Straggler verdicts steer placement: a flagged replica is
+            # the LAST choice as an attach target.  Empty set (verdicts
+            # off or all clear) leaves every decision byte-identical.
+            set_stragglers = getattr(coord, "set_stragglers", None)
+            if set_stragglers is not None:
+                set_stragglers(self._stragglers or frozenset())
             with self._op_timer("mux_pump"):
                 coord.pump()
             recs = coord.take_records(self.name)
@@ -1823,6 +1978,8 @@ class Reconciler:
             status.setdefault("fleet", None)
         if getattr(self, "_had_multiplex_key", False):
             status.setdefault("multiplex", None)
+        if getattr(self, "_had_anomalies_key", False):
+            status.setdefault("anomalies", None)
         if getattr(self, "_capacity_known", False):
             cap = self._capacity_status
             if cap is not None:

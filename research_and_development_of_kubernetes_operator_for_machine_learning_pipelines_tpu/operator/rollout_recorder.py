@@ -351,6 +351,24 @@ class RolloutRecorder:
                             },
                         }
                     )
+                elif r.get("kind") == "anomaly":
+                    # Fleet anomaly observatory (operator/anomaly.py
+                    # AnomalyRecord): verdict-set transitions.
+                    out.append(
+                        {
+                            "name": f"anomaly {r.get('action')}",
+                            "cat": "anomaly",
+                            "ph": "i",
+                            "s": "t",
+                            "ts": ts,
+                            "pid": 1,
+                            "tid": tid,
+                            "args": {
+                                "replicas": r.get("replicas"),
+                                "verdicts": r.get("verdicts") or [],
+                            },
+                        }
+                    )
                 else:
                     out.append(
                         {

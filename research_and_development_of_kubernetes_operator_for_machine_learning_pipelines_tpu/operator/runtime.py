@@ -80,6 +80,7 @@ class OperatorRuntime:
         recorder=None,
         max_concurrent_reconciles: int = 1,
         mux_pools=None,
+        ring_sources=None,
     ):
         if metrics is None and metrics_factory is None:
             raise ValueError(
@@ -97,6 +98,11 @@ class OperatorRuntime:
         # coordinators CRs with spec.multiplex bind to.  Runtime-owned
         # (one coordinator outlives any single CR), reconciler-driven.
         self.mux_pools = mux_pools
+        # Zero-arg callable returning fleet ring snapshots
+        # ({"replicas": {name: snapshot}, "router": snapshot|None}) for
+        # the anomaly observatory; None = spec.anomaly CRs detect
+        # nothing (the seam is runtime wiring, not per-CR config).
+        self.ring_sources = ring_sources
         self.clock = clock or SystemClock()
         self.namespace = namespace
         self.sync_interval_s = sync_interval_s
@@ -156,6 +162,7 @@ class OperatorRuntime:
                             warmup=self.warmup,
                             recorder=self.recorder,
                             mux_pools=self.mux_pools,
+                            ring_sources=self.ring_sources,
                         ),
                         due_at=self.clock.now(),  # reconcile promptly
                     )

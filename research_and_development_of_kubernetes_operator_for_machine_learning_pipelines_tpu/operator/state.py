@@ -91,6 +91,12 @@ class PromotionState:
     # "weight": 2.0, "attachedReplicas": [...], "parked": 3}.  None (and
     # omitted from status) when the CR is not multiplexed.
     multiplex: Any = None
+    # Fleet anomaly observatory (spec.anomaly, operator/anomaly.py): the
+    # active verdict list from the last detection pass, e.g.
+    # [{"replica": "m-2", "kind": "straggler", "series":
+    # "router_leg_p99_ms", ...}].  None (and omitted from status) when
+    # anomaly detection is off — an unannotated CR stays byte-for-byte.
+    anomalies: Any = None
 
     # -- transitions (pure; each returns a new state) -----------------------
 
@@ -113,6 +119,7 @@ class PromotionState:
             snapshot=self.snapshot,
             fleet=self.fleet,
             multiplex=self.multiplex,
+            anomalies=self.anomalies,
         )
 
     def new_version(self, version: str, initial_traffic: int) -> "PromotionState":
@@ -143,6 +150,7 @@ class PromotionState:
                 snapshot=self.snapshot,
             fleet=self.fleet,
             multiplex=self.multiplex,
+            anomalies=self.anomalies,
             )
         if (
             self.previous_version is not None
@@ -167,6 +175,7 @@ class PromotionState:
                 snapshot=self.snapshot,
             fleet=self.fleet,
             multiplex=self.multiplex,
+            anomalies=self.anomalies,
             )
         return PromotionState(
             phase=Phase.CANARY,
@@ -186,6 +195,7 @@ class PromotionState:
             snapshot=self.snapshot,
             fleet=self.fleet,
             multiplex=self.multiplex,
+            anomalies=self.anomalies,
         )
 
     def promoted_step(self, step: int) -> "PromotionState":
@@ -225,6 +235,7 @@ class PromotionState:
             snapshot=self.snapshot,
             fleet=self.fleet,
             multiplex=self.multiplex,
+            anomalies=self.anomalies,
         )
 
     # -- serialization ------------------------------------------------------
@@ -340,6 +351,8 @@ class PromotionState:
             status["fleet"] = dict(self.fleet)
         if self.multiplex is not None:
             status["multiplex"] = dict(self.multiplex)
+        if self.anomalies is not None:
+            status["anomalies"] = list(self.anomalies)
         return status
 
     @classmethod
@@ -387,4 +400,9 @@ class PromotionState:
             snapshot=status.get("snapshot"),
             fleet=status.get("fleet"),
             multiplex=status.get("multiplex"),
+            anomalies=(
+                list(status["anomalies"])
+                if status.get("anomalies") is not None
+                else None
+            ),
         )

@@ -67,6 +67,67 @@ _TERMINAL_REASONS = (
 )
 
 
+def _fetch_json(url: str, timeout: float = 5.0):
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return _json.loads(resp.read().decode())
+
+
+def fleet_overview(sources, models) -> dict:
+    """One aggregated fleet view: every source's ring snapshot (server
+    rings for replicas, the leg-latency ring + per-backend circuit
+    state for the router) plus the control plane's per-model verdicts
+    and mux assignments.
+
+    ``sources`` is the fleet-trace source list
+    (``[{"name", "base_url", "kind": "router"|"replica"}, ...]``).
+    Unlike ``/debug/fleet-trace`` — where a missing component makes the
+    merged trace silently wrong, so a fetch error is a 502 — a dark
+    replica IS the story here: it stays listed with an ``error`` field
+    instead of taking the whole overview down.  A 404 from a ring
+    endpoint (ring disabled) lists the source with ``timeseries: null``
+    and no error."""
+    import urllib.error
+
+    srcs: dict = {}
+    for spec in sources:
+        base = str(spec.get("base_url") or "").rstrip("/")
+        name = spec.get("name") or base
+        kind = spec.get("kind") or "replica"
+        entry: dict = {"kind": kind, "base_url": base, "timeseries": None}
+        ts_path = (
+            "/router/debug/timeseries"
+            if kind == "router"
+            else "/debug/timeseries"
+        )
+        try:
+            entry["timeseries"] = _fetch_json(base + ts_path)
+        except urllib.error.HTTPError as e:
+            if e.code != 404:  # 404 = ring off, a legitimate state
+                entry["error"] = f"HTTP {e.code}"
+        except Exception as e:
+            entry["error"] = str(e)
+        if kind == "router" and "error" not in entry:
+            try:
+                fl = _fetch_json(base + "/router/fleet")
+                circuits = {}
+                for b in fl.get("backends") or []:
+                    c = {
+                        "healthy": b.get("healthy"),
+                        "circuitOpened": b.get("circuit_opened"),
+                    }
+                    if b.get("model"):
+                        c["model"] = b["model"]
+                    circuits[b.get("name")] = c
+                entry["circuits"] = circuits
+            except Exception as e:
+                entry["error"] = str(e)
+        srcs[name] = entry
+    return {"sources": srcs, "models": models}
+
+
 class OperatorTelemetry:
     def __init__(self) -> None:
         self.registry = CollectorRegistry()
@@ -216,6 +277,22 @@ class OperatorTelemetry:
             ident,
             registry=self.registry,
         )
+        # Fleet anomaly observatory (spec.anomaly; operator/anomaly.py)
+        # — no samples until a CR enables spec.anomaly.
+        self.anomaly_active = Gauge(
+            "tpumlops_operator_anomaly_active",
+            "Active anomaly verdicts by kind (straggler / drift), as "
+            "stamped at the last journaled verdict-set transition",
+            ident + ["kind"],
+            registry=self.registry,
+        )
+        self.anomaly_events = Counter(
+            "tpumlops_operator_anomaly_events_total",
+            "Journaled anomaly verdicts by kind, plus 'cleared' "
+            "all-quiet transitions",
+            ident + ["kind"],
+            registry=self.registry,
+        )
         self.rollout_seconds = Histogram(
             "tpumlops_operator_rollout_duration_seconds",
             "Wall time from NEW_VERSION detection to a terminal phase "
@@ -233,6 +310,9 @@ class OperatorTelemetry:
         # slo-label children currently exported per CR (pruned when an
         # SLO vanishes from the spec or spec.slo is removed).
         self._slo_children: dict[tuple[str, str], set] = {}
+        # Per-CR control-plane view for /debug/fleet-overview: the
+        # latest anomaly verdicts and mux assignment per model.
+        self._overview: dict[tuple[str, str], dict] = {}
 
     def _child(self, metric, namespace: str, name: str, *extra: str):
         values = (namespace, name, *extra)
@@ -322,6 +402,48 @@ class OperatorTelemetry:
                 self._child(self.mux_parked, namespace, name).set(
                     muxv["parked"]
                 )
+        anomaly = getattr(outcome, "anomaly", None)
+        if anomaly:
+            for rec in anomaly:
+                if rec.verdicts:
+                    for v in rec.verdicts:
+                        self._child(
+                            self.anomaly_events, namespace, name, v.kind
+                        ).inc()
+                else:
+                    self._child(
+                        self.anomaly_events, namespace, name, "cleared"
+                    ).inc()
+        anoms = getattr(state, "anomalies", None)
+        if anoms is not None:
+            counts = {"straggler": 0, "drift": 0}
+            for a in anoms:
+                k = a.get("kind") if isinstance(a, dict) else None
+                if k in counts:
+                    counts[k] += 1
+            for kind, n in counts.items():
+                self._child(
+                    self.anomaly_active, namespace, name, kind
+                ).set(n)
+        elif (namespace, name) in self._series:
+            # spec.anomaly removed: stop exporting stale verdict counts.
+            for kind in ("straggler", "drift"):
+                try:
+                    self.anomaly_active.remove(namespace, name, kind)
+                except KeyError:
+                    pass
+        # Fleet-overview stash: what the control plane currently
+        # believes about this model, next to the rings fetched live.
+        ov: dict = {}
+        if anoms is not None:
+            ov["anomalies"] = list(anoms)
+        muxv = getattr(state, "multiplex", None)
+        if muxv is not None:
+            ov["multiplex"] = dict(muxv)
+        if ov:
+            self._overview[(namespace, name)] = ov
+        else:
+            self._overview.pop((namespace, name), None)
         slo = getattr(outcome, "slo", None)
         slo_gauges = (
             self.slo_attainment, self.slo_budget_remaining,
@@ -383,6 +505,7 @@ class OperatorTelemetry:
                 pass
         self._rollout_t0.pop((namespace, name), None)
         self._slo_children.pop((namespace, name), None)
+        self._overview.pop((namespace, name), None)
 
     def exposition(self) -> bytes:
         return generate_latest(self.registry)
@@ -408,7 +531,13 @@ class OperatorTelemetry:
         /debug/fleet-trace``: the sources' chrome traces fetched,
         shifted onto one clock, and merged into ONE Perfetto trace whose
         request spans share the propagated request ids
-        (``utils/trace_stitch.py``).  404 when not wired."""
+        (``utils/trace_stitch.py``).  404 when not wired.
+
+        The same sources also drive ``GET /debug/fleet-overview``: each
+        source's timeseries ring (plus the router's circuit states)
+        fetched live and merged with the control plane's per-model
+        anomaly verdicts and mux assignments — what
+        ``scripts/fleet_top.py`` renders."""
         import json
         import threading
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -452,6 +581,29 @@ class OperatorTelemetry:
 
                         merged = filter_request(merged, q)
                     body = json.dumps(merged).encode()
+                    ctype = "application/json"
+                elif path == "/debug/fleet-overview":
+                    if fleet_trace_sources is None:
+                        self.send_error(
+                            404,
+                            "fleet trace sources not wired (pass "
+                            "fleet_trace_sources to telemetry.serve)",
+                        )
+                        return
+                    try:
+                        specs = list(fleet_trace_sources())
+                    except Exception as e:
+                        self.send_error(502, f"fleet overview sources: {e}")
+                        return
+                    models = {
+                        f"{ns}/{nm}": dict(ov)
+                        for (ns, nm), ov in sorted(
+                            telemetry._overview.items()
+                        )
+                    }
+                    body = json.dumps(
+                        fleet_overview(specs, models)
+                    ).encode()
                     ctype = "application/json"
                 elif path == "/debug/rollouts":
                     if recorder is None:

@@ -144,6 +144,43 @@ def _check_gen_params(params: dict, allowed: frozenset) -> None:
     _reject_unknown_keys(params, allowed, "generate parameters")
 
 
+# Capture directories kept under /tmp/tpumlops-profile: a device trace
+# is tens of MB, the endpoint is unauthenticated, and nothing else ever
+# cleaned the path — the newest N stay, older ones are deleted after
+# each successful capture.
+PROFILE_KEEP_DIRS = 8
+
+
+def _gc_profile_dirs(root: str, keep: int = PROFILE_KEEP_DIRS) -> list:
+    """Delete all but the ``keep`` newest capture dirs under ``root``;
+    returns the deleted directory names (the ``evicted`` response
+    field).  Best-effort: a dir that vanishes mid-walk is skipped, never
+    an endpoint error — GC must not fail a successful capture."""
+    import shutil
+
+    try:
+        entries = [
+            e for e in os.scandir(root) if e.is_dir(follow_symlinks=False)
+        ]
+    except OSError:
+        return []
+    def _mtime(entry) -> float:
+        try:
+            return entry.stat().st_mtime
+        except OSError:
+            return 0.0
+
+    entries.sort(key=_mtime, reverse=True)
+    evicted = []
+    for entry in entries[keep:]:
+        try:
+            shutil.rmtree(entry.path)
+            evicted.append(entry.name)
+        except OSError:
+            continue
+    return evicted
+
+
 class TpuInferenceServer:
     def __init__(
         self,
@@ -161,6 +198,7 @@ class TpuInferenceServer:
         cold_start_anchor_wall: float | None = None,
         fleet_role: str = "unified",
         snapshot_dir=None,
+        timeseries=None,
     ):
         self.engine = engine
         self.metrics = metrics
@@ -182,6 +220,7 @@ class TpuInferenceServer:
         self.gen_engine = gen_engine  # GenerationEngine for causal-LM flavors
         self.recorder = recorder  # flight_recorder.FlightRecorder | None
         self.telemetry = telemetry  # device_telemetry.DeviceTelemetry | None
+        self.timeseries = timeseries  # timeseries.TimeseriesRing | None
         # Warm-pool seam: builds (engine, gen_engine, predictor) for a
         # model URI on demand — None on a normal (model-at-boot) server.
         self.attach_fn = attach_fn
@@ -937,7 +976,10 @@ class TpuInferenceServer:
         activity for the window and returns the trace directory (TensorBoard
         / xprof readable; always under ``/tmp/tpumlops-profile`` — the
         endpoint is unauthenticated, so no caller-chosen paths).  One
-        capture at a time."""
+        capture at a time.  After a successful capture only the newest
+        :data:`PROFILE_KEEP_DIRS` capture directories are kept — older
+        ones are deleted (the dir used to grow without bound across
+        calls) and returned as ``evicted``."""
         import math
 
         import jax
@@ -963,9 +1005,16 @@ class TpuInferenceServer:
                     with contextlib.suppress(Exception):
                         # raises "no session" when start_trace itself failed
                         jax.profiler.stop_trace()
+                evicted = _gc_profile_dirs("/tmp/tpumlops-profile")
             finally:
                 self._profile_lock.release()
-            return web.json_response({"trace_dir": out_dir, "duration_s": duration})
+            return web.json_response(
+                {
+                    "trace_dir": out_dir,
+                    "duration_s": duration,
+                    "evicted": evicted,
+                }
+            )
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             return web.json_response({"error": str(e)}, status=400)
         except Exception as e:
@@ -1037,6 +1086,23 @@ class TpuInferenceServer:
                 status=404,
             )
         return await self._debug_json(self.telemetry.snapshot)
+
+    async def handle_debug_timeseries(
+        self, request: web.Request
+    ) -> web.Response:
+        """Per-second serving time-series ring (the anomaly detector's
+        input plane; spec.tpu.observability.timeseriesRing; 404 names
+        the knob when off)."""
+        if self.timeseries is None:
+            return web.json_response(
+                {
+                    "error": "timeseries ring disabled; set "
+                    "spec.tpu.observability.timeseriesRing "
+                    "(--timeseries-ring) > 0"
+                },
+                status=404,
+            )
+        return await self._debug_json(self.timeseries.snapshot)
 
     async def handle_debug_spans(self, request: web.Request) -> web.Response:
         """GLOBAL_TRACER span stats (count/mean/max per name) — the
@@ -1280,6 +1346,10 @@ class TpuInferenceServer:
                     self.attached_snapshot_hash,
                     self._attached_geometry,
                 ) = self._snapshot_probe(model_uri)
+                if self.timeseries is not None:
+                    # Baseline-reset stamp for the anomaly detector:
+                    # drift is measured against the post-attach window.
+                    self.timeseries.mark("attach")
             except Exception as e:
                 _log.exception("attach of %s failed", model_uri)
                 # Quiesce whatever got wired before the failure — a
@@ -1610,6 +1680,7 @@ class TpuInferenceServer:
         app.router.add_get("/debug/trace", self.handle_debug_trace)
         app.router.add_get("/debug/spans", self.handle_debug_spans)
         app.router.add_get("/debug/device", self.handle_debug_device)
+        app.router.add_get("/debug/timeseries", self.handle_debug_timeseries)
 
         async def on_shutdown(_app):
             self.shutdown()
@@ -1735,9 +1806,27 @@ def _to_v2_outputs(out: Any) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
+def _fan(*fns):
+    """Chain observer callbacks onto ONE engine hook (the timeseries
+    ring rides the metrics callbacks instead of new instrumentation
+    points).  None entries drop out; a single survivor is returned
+    unwrapped so the common no-ring path stays the bare bound method."""
+    live = [f for f in fns if f is not None]
+    if not live:
+        return None
+    if len(live) == 1:
+        return live[0]
+
+    def fanned(*args, **kwargs):
+        for f in live:
+            f(*args, **kwargs)
+
+    return fanned
+
+
 def make_gen_engine(
     predictor, config: ServerConfig, channel=None, metrics=None,
-    recorder=None, telemetry=None, watchdog=None,
+    recorder=None, telemetry=None, watchdog=None, timeseries=None,
 ):
     """Construct the GenerationEngine for a causal-LM predictor.
 
@@ -1746,6 +1835,8 @@ def make_gen_engine(
     shared knobs must never be spelled twice.
     """
     from .generation import GenerationEngine
+
+    ts = timeseries  # per-second ring: fans onto the metric callbacks
 
     prefix_cache = None
     if config.tpu.prefix_cache.enabled:
@@ -1782,7 +1873,10 @@ def make_gen_engine(
         # amortize that; see bench.py slot ladder).
         max_slots=config.tpu.max_slots or min(config.tpu.max_batch_size, 8),
         eos_id=predictor.causal_lm.get("eos_id"),
-        on_step=metrics.observe_decode_step if metrics else None,
+        on_step=_fan(
+            metrics.observe_decode_step if metrics else None,
+            ts.observe_decode_step if ts else None,
+        ),
         on_tokens=metrics.inc_generated_tokens if metrics else None,
         channel=channel,
         kv_quant=config.tpu.quantize == "int8kv",
@@ -1810,16 +1904,25 @@ def make_gen_engine(
         on_prefill_batch=metrics.observe_prefill_batch if metrics else None,
         on_admission_wait=metrics.observe_admission_wait if metrics else None,
         on_ttft=metrics.observe_ttft if metrics else None,
-        on_itl=metrics.observe_itl if metrics else None,
+        on_itl=_fan(
+            metrics.observe_itl if metrics else None,
+            ts.observe_itl if ts else None,
+        ),
         on_request_tokens=metrics.observe_request_tokens if metrics else None,
-        on_tick=metrics.observe_tick if metrics else None,
+        on_tick=_fan(
+            metrics.observe_tick if metrics else None,
+            ts.observe_tick if ts else None,
+        ),
         # Leader-side only: the scheduler (and so the journal) runs on
         # the leader; follower processes replay device ops blind.
         recorder=recorder,
         # Admission control (leader-side: followers never take
         # submissions): shed past the queued-token budget, 429 upstream.
         admission_queue_budget=config.tpu.admission_queue_budget,
-        on_shed=metrics.inc_shed if metrics else None,
+        on_shed=_fan(
+            metrics.inc_shed if metrics else None,
+            ts.inc_shed if ts else None,
+        ),
         # Leader-side only, like the recorder: the ledger/observatory
         # describe the scheduling process; followers replay blind.
         telemetry=telemetry,
@@ -1827,7 +1930,10 @@ def make_gen_engine(
         # monitors runs on the leader; followers block inside replayed
         # collectives by design.
         watchdog=watchdog,
-        on_poison=metrics.inc_poison if metrics else None,
+        on_poison=_fan(
+            metrics.inc_poison if metrics else None,
+            ts.inc_poison if ts else None,
+        ),
         # Tensor-parallel mesh: same shape on leader and followers (this
         # one construction site) — sharded programs must agree for
         # lockstep replay.  {"dp": 1, "tp": 1} (the default) arms
@@ -1958,6 +2064,17 @@ def build_server(
         from .flight_recorder import FlightRecorder
 
         recorder = FlightRecorder(config.tpu.observability.trace_ring)
+    timeseries = None
+    if config.tpu.observability.timeseries_ring > 0:
+        from .timeseries import TimeseriesRing
+
+        # Leader-side only, like the recorder: the callback stream it
+        # distills runs on the scheduling leader; followers replay blind.
+        timeseries = TimeseriesRing(config.tpu.observability.timeseries_ring)
+        if telemetry is not None:
+            # MFU / HBM-bandwidth per bucket come from the telemetry
+            # layer's existing last_util gauge — no new hook.
+            timeseries.bind_telemetry(telemetry)
     watchdog = None
     if config.watchdog_deadline_s > 0:
         from .watchdog import EngineWatchdog
@@ -1989,6 +2106,7 @@ def build_server(
             gen_engine = make_gen_engine(
                 predictor, config, channel=channel, metrics=metrics,
                 recorder=recorder, telemetry=telemetry, watchdog=watchdog,
+                timeseries=timeseries,
             )
         return engine, gen_engine
 
@@ -2028,6 +2146,7 @@ def build_server(
             attach_fn=attach_fn,
             fleet_role=config.fleet_role,
             snapshot_dir=snapshot_dir,
+            timeseries=timeseries,
         )
         if watchdog is not None:
             watchdog.on_stall = server.note_watchdog_stall
@@ -2064,6 +2183,7 @@ def build_server(
         gen_engine = make_gen_engine(
             predictor, config, channel=channel, metrics=metrics,
             recorder=recorder, telemetry=telemetry, watchdog=watchdog,
+            timeseries=timeseries,
         )
     metrics.observe_model_load(load_stats)
     restored = load_stats.get("restore_s") is not None
@@ -2088,6 +2208,7 @@ def build_server(
         telemetry=telemetry,
         cold_start_anchor_wall=anchor,
         fleet_role=config.fleet_role,
+        timeseries=timeseries,
     )
     server.predictor = predictor
     if watchdog is not None:
@@ -2099,6 +2220,10 @@ def build_server(
     server.startup(warmup=warmup)
     metrics.observe_cold_start("compile", time.time() - t_warm)
     metrics.observe_cold_start("total", time.time() - anchor)
+    if timeseries is not None:
+        # Baseline anchor for the anomaly detector: samples before this
+        # mark are warmup noise, not serving behavior.
+        timeseries.mark("warmup")
     return server
 
 
@@ -2332,6 +2457,16 @@ def main(argv: list[str] | None = None) -> None:
         "0 disables recording entirely (the default — zero overhead)",
     )
     ap.add_argument(
+        "--timeseries-ring",
+        type=int,
+        default=0,
+        help="per-second serving time-series ring size (seconds of "
+        "history kept: tick-wall quantiles, ITL, queue depth, MFU/HBM "
+        "bandwidth, shed/poison counts; served at /debug/timeseries — "
+        "the operator anomaly detector's input plane); 0 disables the "
+        "ring entirely (the default — zero overhead)",
+    )
+    ap.add_argument(
         "--device-telemetry",
         type=int,
         default=0,
@@ -2432,6 +2567,7 @@ def main(argv: list[str] | None = None) -> None:
                 "observability": {
                     "traceRing": args.trace_ring,
                     "deviceTelemetry": bool(args.device_telemetry),
+                    "timeseriesRing": args.timeseries_ring,
                 },
                 "admissionQueueBudget": args.admission_queue_budget,
                 "drainGraceSeconds": args.drain_grace_seconds,

@@ -458,22 +458,31 @@ class ObservabilitySpec:
     ticks, and a ``status.capacity`` summary on the CR).  False — the
     default — constructs none of it: ticks, metric families, status
     patches, and ``/debug/*`` payloads stay byte-for-byte.
+
+    ``timeseries_ring`` sizes the per-second serving time-series ring
+    (``server/timeseries.py``: per-tick-kind wall quantiles, ITL, queue
+    depth, MFU/HBM-bandwidth, shed/poison counts, served at
+    ``GET /debug/timeseries`` — the anomaly detector's input plane).
+    0 — the default — constructs no ring: callbacks, routes, and
+    payloads stay byte-for-byte.
     """
 
     trace_ring: int = 0
     device_telemetry: bool = False
+    timeseries_ring: int = 0
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any] | None) -> "ObservabilitySpec":
         spec = spec or {}
         _reject_unknown_keys(
             spec,
-            frozenset({"traceRing", "deviceTelemetry"}),
+            frozenset({"traceRing", "deviceTelemetry", "timeseriesRing"}),
             "spec.tpu.observability",
         )
         return cls(
             trace_ring=int(spec.get("traceRing", 0)),
             device_telemetry=bool(spec.get("deviceTelemetry", False)),
+            timeseries_ring=int(spec.get("timeseriesRing", 0)),
         )
 
     def __post_init__(self):
@@ -482,6 +491,14 @@ class ObservabilitySpec:
             raise ValueError(
                 "observability.traceRing must be >= 0, got "
                 f"{self.trace_ring}"
+            )
+        # One day of 1 s samples is already ~86 KB of JSON per replica
+        # per fleet-overview scrape; anything larger is a typo, not a
+        # window.
+        if not (0 <= self.timeseries_ring <= 86400):
+            raise ValueError(
+                "observability.timeseriesRing must be in [0, 86400], got "
+                f"{self.timeseries_ring}"
             )
 
 
@@ -978,6 +995,88 @@ class SloSpec:
             names.append("itl_p99")
         names.append("availability")  # always tracked when enabled
         return tuple(names)
+
+
+@dataclass(frozen=True)
+class AnomalySpec:
+    """``spec.anomaly``: the fleet anomaly detector (operator/anomaly.py).
+
+    Present (any value, even ``{}``) arms a per-reconcile detection pass
+    over the fleet's time-series ring snapshots: robust peer comparison
+    (median/MAD z-score of each replica's ITL / MFU / queue slope
+    against the other replicas of the same pool → straggler verdicts)
+    plus self-baseline drift (the current window vs the post-warmup /
+    post-attach baseline window).  Verdicts are journaled as
+    ``AnomalyRecord``s, published at ``status.anomalies``, exported as
+    ``tpumlops_operator_anomaly_{active,events_total}``, and fed into
+    the multiplexer's eviction scoring and the autoscaler's scale-down
+    victim choice.  Requires ``spec.tpu.observability.timeseriesRing``
+    > 0 (the rings ARE the input plane).  Absent (the default) — no
+    detector, no series, no status writes, identical mux/autoscaler
+    decisions: byte-for-byte.
+    """
+
+    enabled: bool = False
+    mad_threshold: float = 3.5  # |robust z| beyond which a peer straggles
+    drift_pct: float = 25.0  # self-baseline drift trigger (0 = off)
+    min_peers: int = 3  # below this: no peer verdicts at all
+    window_s: int = 30  # trailing comparison window (ring seconds)
+    baseline_s: int = 30  # baseline window (post-warmup/attach seconds)
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | None) -> "AnomalySpec":
+        if spec is None:
+            return cls()
+        _reject_unknown_keys(
+            spec,
+            frozenset(
+                {
+                    "madThreshold", "driftPct", "minPeers", "windowSeconds",
+                    "baselineSeconds",
+                }
+            ),
+            "spec.anomaly",
+        )
+        return cls(
+            enabled=True,
+            mad_threshold=float(spec.get("madThreshold", 3.5)),
+            drift_pct=float(spec.get("driftPct", 25.0)),
+            min_peers=int(spec.get("minPeers", 3)),
+            window_s=int(spec.get("windowSeconds", 30)),
+            baseline_s=int(spec.get("baselineSeconds", 30)),
+        )
+
+    def __post_init__(self):
+        if not self.enabled:
+            return
+        if self.mad_threshold <= 0:
+            raise ValueError(
+                "anomaly.madThreshold must be > 0, got "
+                f"{self.mad_threshold}"
+            )
+        if self.drift_pct < 0:
+            raise ValueError(
+                f"anomaly.driftPct must be >= 0 (0 disables drift "
+                f"detection), got {self.drift_pct}"
+            )
+        if self.min_peers < 3:
+            # Median/MAD of two peers is degenerate (MAD of a pair is
+            # half their spread; every pair member is its own outlier) —
+            # the detector hard-refuses verdicts below 3, so a smaller
+            # spec value is a contradiction, not a tuning choice.
+            raise ValueError(
+                f"anomaly.minPeers must be >= 3, got {self.min_peers}"
+            )
+        if not (5 <= self.window_s <= 3600):
+            raise ValueError(
+                f"anomaly.windowSeconds must be in [5, 3600], got "
+                f"{self.window_s}"
+            )
+        if not (5 <= self.baseline_s <= 3600):
+            raise ValueError(
+                f"anomaly.baselineSeconds must be in [5, 3600], got "
+                f"{self.baseline_s}"
+            )
 
 
 # Objective keys the offline planner (operator/planner.py) can search
@@ -1529,6 +1628,10 @@ class OperatorConfig:
     # Multi-model multiplexing on a shared warm pool
     # (operator/multiplexer.py); absent default = byte-for-byte.
     multiplex: MultiplexSpec = field(default_factory=MultiplexSpec)
+    # Fleet anomaly detector (operator/anomaly.py): straggler + drift
+    # verdicts over time-series ring snapshots; absent default = no
+    # detector, no series, byte-for-byte.
+    anomaly: AnomalySpec = field(default_factory=AnomalySpec)
 
     @classmethod
     def from_spec(cls, spec: Mapping[str, Any]) -> "OperatorConfig":
@@ -1577,6 +1680,17 @@ class OperatorConfig:
                     "prompt waits; without a snapshot it pays the full "
                     "cold load)"
                 )
+        anomaly = AnomalySpec.from_spec(spec.get("anomaly"))
+        if anomaly.enabled and tpu.observability.timeseries_ring <= 0:
+            # The detector's ONLY input plane is the per-replica ring —
+            # without one it would silently never fire, the worst
+            # failure mode for a health check.
+            raise ValueError(
+                "spec.anomaly requires spec.tpu.observability."
+                "timeseriesRing > 0 (the detector compares replicas over "
+                "their time-series ring snapshots; without rings there "
+                "is nothing to detect from)"
+            )
         multiplex = MultiplexSpec.from_spec(spec.get("multiplex"))
         if multiplex.enabled:
             if backend != "tpu":
@@ -1719,4 +1833,5 @@ class OperatorConfig:
             slo=SloSpec.from_spec(spec.get("slo")),
             planner=PlannerSpec.from_spec(spec.get("planner")),
             multiplex=multiplex,
+            anomaly=anomaly,
         )

@@ -1,0 +1,207 @@
+"""fleet_top — live terminal view of the fleet anomaly observatory.
+
+Renders ``GET /debug/fleet-overview`` from the operator's metrics
+listener (``--metrics-port`` + ``--fleet-trace-sources``): one row per
+fleet source with a sparkline of its recent ring samples, the latest
+headline numbers, the operator's anomaly verdict for that replica, and
+the router's circuit view of each backend.  A dark source renders as a
+``DARK`` row — with the observatory, a replica that stops answering IS
+the finding, not a rendering error.
+
+No dependencies beyond the standard library (urllib + ANSI escapes), so
+it runs anywhere the operator port is reachable:
+
+    python scripts/fleet_top.py --url http://127.0.0.1:8080
+    python scripts/fleet_top.py --url ... --once          # one frame
+    python scripts/fleet_top.py --url ... --once --json   # raw payload
+
+Sparklines show the newest ``--width`` ring buckets oldest→newest,
+scaled to the row's own max (the number printed beside the line).
+Replica rows plot per-second ITL p99 ms; router backend rows plot
+proxy-leg p99 ms.  ``·`` marks a second with no samples.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals: list, width: int) -> str:
+    """Newest ``width`` values, None = no-sample dot, scaled to max."""
+    vals = vals[-width:]
+    present = [v for v in vals if v is not None]
+    top = max(present) if present else 0.0
+    out = []
+    for v in vals:
+        if v is None:
+            out.append("·")
+        elif top <= 0:
+            out.append(BLOCKS[0])
+        else:
+            out.append(BLOCKS[min(len(BLOCKS) - 1, int(v / top * (len(BLOCKS) - 1)))])
+    return "".join(out).ljust(width, " ")
+
+
+def replica_row(snapshot: dict | None, width: int) -> tuple[str, str]:
+    """(sparkline, headline) for a server ring snapshot."""
+    if not snapshot:
+        return "·" * width, "ring off"
+    samples = snapshot.get("samples") or []
+    itl = [
+        (s["itl"]["p99_ms"] if s.get("itl", {}).get("n") else None) for s in samples
+    ]
+    last = samples[-1] if samples else {}
+    parts = []
+    present = [v for v in itl if v is not None]
+    if present:
+        parts.append(f"itl p99 {present[-1]:.1f}ms (max {max(present):.1f})")
+    if last.get("mfu") is not None:
+        parts.append(f"mfu {last['mfu']:.2f}")
+    if last.get("queue_depth") is not None:
+        parts.append(f"q {last['queue_depth']}")
+    shed = sum(s.get("shed", 0) for s in samples)
+    if shed:
+        parts.append(f"shed {shed}")
+    return sparkline(itl, width), "  ".join(parts) or "idle"
+
+
+def backend_rows(snapshot: dict | None, width: int) -> list[tuple[str, str, str]]:
+    """[(backend, sparkline, headline)] for a router ring snapshot."""
+    if not snapshot:
+        return []
+    rows = []
+    for name, ring in sorted((snapshot.get("backends") or {}).items()):
+        samples = ring.get("samples") or []
+        legs = [(s["p99_ms"] if s.get("n") else None) for s in samples]
+        parts = []
+        present = [v for v in legs if v is not None]
+        if present:
+            parts.append(f"leg p99 {present[-1]:.1f}ms (max {max(present):.1f})")
+        errors = sum(s.get("errors", 0) for s in samples)
+        failovers = sum(s.get("failovers", 0) for s in samples)
+        if errors:
+            parts.append(f"err {errors}")
+        if failovers:
+            parts.append(f"fo {failovers}")
+        rows.append((name, sparkline(legs, width), "  ".join(parts) or "idle"))
+    return rows
+
+
+def verdict_index(overview: dict) -> dict[str, list[str]]:
+    """replica/backend name -> compact verdict strings, across models."""
+    out: dict[str, list[str]] = {}
+    for model, mv in sorted((overview.get("models") or {}).items()):
+        for v in mv.get("anomalies") or []:
+            arrow = "↑" if v.get("direction") == "high" else "↓"
+            tag = f"{v['kind'].upper()} {v.get('series', '?')}{arrow}"
+            if v.get("z") is not None:
+                tag += f" z={v['z']:.1f}"
+            if v.get("driftPct") is not None:
+                tag += f" {v['driftPct']:+.0f}%"
+            out.setdefault(v["replica"], []).append(tag)
+    return out
+
+
+def render(overview: dict, width: int) -> str:
+    verdicts = verdict_index(overview)
+    lines = []
+    models = overview.get("models") or {}
+    for model, mv in sorted(models.items()):
+        n = len(mv.get("anomalies") or [])
+        mux = mv.get("multiplex") or {}
+        mux_note = f"  mux={mux.get('attached', mux)}" if mux else ""
+        lines.append(f"model {model}: {n} verdict(s){mux_note}")
+    if not models:
+        lines.append("no CRs with spec.anomaly published yet")
+    lines.append("")
+    # /debug/fleet-overview serves sources as a name-keyed dict; accept
+    # a list of {"name": ...} dicts too so saved payloads replay.
+    raw = overview.get("sources") or {}
+    if isinstance(raw, dict):
+        sources = sorted(raw.items())
+    else:
+        sources = [(s.get("name", "?"), s) for s in raw]
+    name_w = max([12] + [len(name) + 4 for name, _ in sources])
+    for name, src in sources:
+        kind = src.get("kind", "replica")
+        if src.get("error"):
+            lines.append(
+                f"{name:<{name_w}} {'DARK':<{width}} {src['error']}"
+            )
+            continue
+        if kind == "router":
+            lines.append(f"{name:<{name_w}} [router]")
+            circuits = src.get("circuits") or {}
+            for backend, line, head in backend_rows(src.get("timeseries"), width):
+                circ = circuits.get(backend, {})
+                mark = "✓" if circ.get("healthy", True) else "✗OPEN"
+                flag = "  ".join(verdicts.get(backend, []))
+                lines.append(
+                    f"  {backend:<{name_w - 2}} {line} {mark:<5} {head}"
+                    + (f"  << {flag}" if flag else "")
+                )
+        else:
+            line, head = replica_row(src.get("timeseries"), width)
+            flag = "  ".join(verdicts.get(name, []))
+            lines.append(
+                f"{name:<{name_w}} {line} {head}" + (f"  << {flag}" if flag else "")
+            )
+    return "\n".join(lines)
+
+
+def fetch(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser("fleet_top")
+    ap.add_argument(
+        "--url",
+        default="http://127.0.0.1:8080",
+        help="operator metrics listener base URL",
+    )
+    ap.add_argument("--interval", type=float, default=2.0, help="refresh seconds")
+    ap.add_argument("--width", type=int, default=32, help="sparkline buckets shown")
+    ap.add_argument("--once", action="store_true", help="render one frame and exit")
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="with --once: print the raw /debug/fleet-overview payload",
+    )
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    endpoint = args.url.rstrip("/") + "/debug/fleet-overview"
+    while True:
+        try:
+            overview = fetch(endpoint, args.timeout)
+        except urllib.error.HTTPError as e:
+            print(f"fleet_top: {endpoint}: HTTP {e.code}: {e.read().decode()!r}",
+                  file=sys.stderr)
+            return 1
+        except Exception as e:
+            print(f"fleet_top: {endpoint}: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(overview, indent=2))
+        else:
+            frame = render(overview, args.width)
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                frame = f"fleet_top  {time.strftime('%H:%M:%S')}\n\n" + frame
+            print(frame, flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

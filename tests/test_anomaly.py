@@ -1,0 +1,639 @@
+"""Fleet anomaly observatory (ISSUE 20): time-series rings +
+operator/anomaly.py peer straggler / baseline-drift detection.
+
+Layers pinned here:
+
+- ``robust_z`` / ``slope`` / ``detect()``: the pure statistics — MAD
+  modified z-score with the meanAD fallback, the min-peers hard gate,
+  drift vs anchored baselines, deterministic verdict ordering.
+- ``TimeseriesRing``: fixed-memory FIFO bound, snapshot contract (open
+  bucket flagged, lifecycle marks), and the disabled-by-default pins.
+- Extraction helpers: ``replica_series`` / ``router_series`` /
+  ``baseline_of`` turning ring snapshots into detect()'s named windows.
+- Reconciler ``_anomaly_step``: journal + status.anomalies + event on a
+  verdict-set SHAPE transition only (PromotionHold-style dedupe),
+  explicit-null status clearing, restart-safe dedupe rebuild, and the
+  straggler feed into the multiplexer / localplane victim choice —
+  verdict-off = byte-identical decisions.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpumlops.clients.base import MLFLOWMODEL, ObjectRef
+from tpumlops.clients.fakes import FakeKube, FakeMetrics, FakeRegistry
+from tpumlops.operator import anomaly
+from tpumlops.operator.anomaly import (
+    AnomalyRecord,
+    AnomalyVerdict,
+    baseline_of,
+    detect,
+    replica_series,
+    robust_z,
+    router_series,
+    slope,
+)
+from tpumlops.operator.multiplexer import MuxModel, MuxReplica, plan
+from tpumlops.operator.reconciler import Reconciler
+from tpumlops.server.timeseries import BUCKET_SAMPLE_CAP, TimeseriesRing
+from tpumlops.utils.clock import FakeClock
+from tpumlops.utils.config import AnomalySpec, OperatorConfig
+
+# ---------------------------------------------------------------------------
+# robust_z / slope: the statistics
+# ---------------------------------------------------------------------------
+
+
+def test_robust_z_flags_single_outlier_with_jittered_peers():
+    # Realistic inter-replica jitter: MAD is nonzero, the outlier's
+    # modified z-score explodes far past any sane threshold.
+    peers = [10.0, 10.5, 9.8, 100.0]
+    z = robust_z(100.0, peers)
+    assert z is not None and z > 50
+    # A healthy member of the same pool stays inside the band.
+    z_ok = robust_z(10.5, peers)
+    assert z_ok is not None and abs(z_ok) < 2
+
+
+def test_robust_z_meanad_fallback_when_mad_collapses():
+    # Two identical healthy peers + one outlier: the MAD is 0 (the
+    # median deviation is the ZERO gap), the meanAD fallback still
+    # scores the outlier instead of dividing by zero.
+    z = robust_z(100.0, [10.0, 10.0, 100.0])
+    assert z == pytest.approx((100.0 - 10.0) / (1.253314 * 30.0), rel=1e-6)
+
+
+def test_robust_z_identical_values_have_no_outlier():
+    assert robust_z(5.0, [5.0, 5.0, 5.0]) is None
+
+
+def test_slope_least_squares():
+    assert slope([1.0, 2.0, 3.0, 4.0]) == pytest.approx(1.0)
+    assert slope([7.0, 7.0, 7.0]) == 0.0
+    assert slope([3.0]) == 0.0
+    assert slope([]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# detect(): pure verdict pass
+# ---------------------------------------------------------------------------
+
+
+def _windows(**per_replica):
+    """replica -> itl_p99_ms window samples."""
+    return {name: {"itl_p99_ms": vals} for name, vals in per_replica.items()}
+
+
+def test_detect_flags_straggler_high():
+    spec = AnomalySpec(enabled=True)
+    verdicts = detect(
+        _windows(r0=[10.0, 10.2], r1=[10.4], r2=[9.9], slow=[60.0, 62.0]),
+        spec,
+    )
+    assert [v.replica for v in verdicts] == ["slow"]
+    v = verdicts[0]
+    assert v.kind == "straggler" and v.series == "itl_p99_ms"
+    assert v.direction == "high"
+    assert v.z is not None and abs(v.z) > spec.mad_threshold
+    assert v.peers == 4 and v.peer_median == pytest.approx(10.25)
+
+
+def test_detect_flags_straggler_low_direction():
+    # A replica whose MFU cratered relative to peers: direction "low".
+    windows = {
+        name: {"mfu": [val]}
+        for name, val in
+        [("r0", 0.50), ("r1", 0.49), ("r2", 0.51), ("dead", 0.05)]
+    }
+    verdicts = detect(windows, AnomalySpec(enabled=True))
+    assert [(v.replica, v.direction) for v in verdicts] == [("dead", "low")]
+
+
+def test_detect_min_peers_is_a_hard_gate():
+    # Two replicas, wildly apart: a pair has no meaningful median/MAD —
+    # NO verdict rather than a coin flip over which one is "slow".
+    assert detect(_windows(a=[10.0], b=[500.0]), AnomalySpec(enabled=True)) == ()
+
+
+def test_detect_drift_against_anchored_baseline():
+    spec = AnomalySpec(enabled=True, drift_pct=25.0)
+    windows = _windows(r0=[20.0], r1=[10.0], r2=[10.1])
+    baselines = {"r0": {"itl_p99_ms": 10.0}, "r1": {"itl_p99_ms": 10.0}}
+    verdicts = detect(windows, spec, baselines)
+    drift = [v for v in verdicts if v.kind == "drift"]
+    assert [(v.replica, v.direction) for v in drift] == [("r0", "high")]
+    assert drift[0].baseline == 10.0
+    assert drift[0].drift_pct == pytest.approx(100.0)
+    # Within the band, a zero baseline, or driftPct 0: all silent.
+    assert detect(_windows(r0=[11.0]), spec, {"r0": {"itl_p99_ms": 10.0}}) == ()
+    assert detect(_windows(r0=[90.0]), spec, {"r0": {"itl_p99_ms": 0.0}}) == ()
+    # driftPct 0 disables the drift pass entirely (the straggler pass
+    # may still fire on the same window — separate verdict kinds).
+    spec_off = AnomalySpec(enabled=True, drift_pct=0.0)
+    assert all(
+        v.kind != "drift" for v in detect(windows, spec_off, baselines)
+    )
+
+
+def test_detect_ordering_is_deterministic_stragglers_first():
+    spec = AnomalySpec(enabled=True)
+    windows = {
+        "r0": {"itl_p99_ms": [10.0], "queue_depth": [2.0]},
+        "r1": {"itl_p99_ms": [10.4], "queue_depth": [3.0]},
+        "r2": {"itl_p99_ms": [9.9], "queue_depth": [2.0]},
+        "slow": {"itl_p99_ms": [60.0], "queue_depth": [40.0]},
+    }
+    baselines = {"slow": {"itl_p99_ms": 10.0}}
+    verdicts = detect(windows, spec, baselines)
+    assert [(v.kind, v.series, v.replica) for v in verdicts] == [
+        ("straggler", "itl_p99_ms", "slow"),
+        ("straggler", "queue_depth", "slow"),
+        ("drift", "itl_p99_ms", "slow"),
+    ]
+
+
+def test_verdict_shape_ignores_live_statistics():
+    a = AnomalyVerdict("r1", "straggler", "itl_p99_ms", 60.0, "high", z=12.0)
+    b = AnomalyVerdict("r1", "straggler", "itl_p99_ms", 74.0, "high", z=29.0)
+    assert a.shape == b.shape == ("r1", "straggler", "itl_p99_ms", "high")
+
+
+def test_verdict_and_record_dict_contracts():
+    v = AnomalyVerdict(
+        "r1", "straggler", "itl_p99_ms", 60.123456, "high",
+        z=12.345678, peer_median=10.05, peers=4,
+    )
+    d = v.as_dict()
+    assert d == {
+        "replica": "r1", "kind": "straggler", "series": "itl_p99_ms",
+        "value": 60.1235, "direction": "high", "z": 12.35,
+        "peerMedian": 10.05, "peers": 4,
+    }
+    drift = AnomalyVerdict(
+        "r0", "drift", "mfu", 0.2, "low", baseline=0.5, drift_pct=-60.0
+    ).as_dict()
+    assert drift["baseline"] == 0.5 and drift["driftPct"] == -60.0
+    assert "z" not in drift and "peers" not in drift
+    rec = AnomalyRecord(wall=1700000000.0, action="detected",
+                        verdicts=(v,), replicas=4).as_dict()
+    assert rec["kind"] == "anomaly" and rec["ts"] == 1700000000.0
+    assert rec["action"] == "detected" and rec["replicas"] == 4
+    assert rec["verdicts"] == [v.as_dict()]
+    assert rec["time"].startswith("2023-11-")
+
+
+# ---------------------------------------------------------------------------
+# TimeseriesRing: bound, FIFO, snapshot contract
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_fifo_bounded_at_capacity():
+    clock = {"t": 1000.0}
+    ring = TimeseriesRing(capacity=4, clock=lambda: clock["t"])
+    for sec in range(10):
+        clock["t"] = 1000.0 + sec
+        ring.observe_itl(0.005 * (sec + 1))
+    snap = ring.snapshot()
+    assert snap["capacity"] == 4 and snap["resolution_s"] == 1
+    closed = [s for s in snap["samples"] if not s.get("open")]
+    open_ = [s for s in snap["samples"] if s.get("open")]
+    # Newest 4 finalized seconds survive; second 9 is still open.
+    assert [s["t"] for s in closed] == [1005, 1006, 1007, 1008]
+    assert [s["t"] for s in open_] == [1009]
+    assert closed[-1]["itl"]["n"] == 1
+    assert closed[-1]["itl"]["p99_ms"] == pytest.approx(45.0)
+
+
+def test_ring_bucket_sample_cap_bounds_memory_not_counts():
+    clock = {"t": 2000.0}
+    ring = TimeseriesRing(capacity=4, clock=lambda: clock["t"])
+    for i in range(BUCKET_SAMPLE_CAP + 50):
+        ring.observe_tick("decode", 0.001)
+        ring.observe_itl(0.001)
+    clock["t"] = 2002.0
+    snap = ring.snapshot()
+    s = snap["samples"][0]
+    # The COUNT is exact past the cap; quantiles are over the first CAP
+    # observations (the documented error bar).
+    assert s["ticks"]["decode"]["n"] == BUCKET_SAMPLE_CAP + 50
+    assert s["itl"]["n"] == BUCKET_SAMPLE_CAP + 50
+
+
+def test_ring_marks_and_zero_capacity_rejected():
+    clock = {"t": 3000.0}
+    ring = TimeseriesRing(capacity=8, clock=lambda: clock["t"])
+    ring.mark("attach")
+    clock["t"] = 3001.0
+    snap = ring.snapshot()
+    assert snap["samples"][0]["marks"] == ["attach"]
+    with pytest.raises(ValueError, match="capacity"):
+        TimeseriesRing(capacity=0)
+
+
+def test_ring_disabled_is_the_default():
+    from tpumlops.utils.config import ObservabilitySpec
+
+    assert ObservabilitySpec().timeseries_ring == 0
+    assert (
+        ObservabilitySpec.from_spec({"traceRing": 64}).timeseries_ring == 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# Extraction helpers: snapshots -> named windows
+# ---------------------------------------------------------------------------
+
+
+def _server_snap(itl_ms, seconds=4, queue=2, t0=100, marks_at=None):
+    samples = []
+    for i in range(seconds):
+        s = {
+            "t": t0 + i,
+            "ticks": {"decode": {"n": 8, "wall_p50_ms": 1.0, "wall_p99_ms": 2.0}},
+            "itl": {"n": 8, "p50_ms": itl_ms, "p99_ms": itl_ms * 1.5},
+            "queue_depth": queue + i,
+            "active_slots": 2,
+            "shed": 0,
+            "poison": 0,
+        }
+        if marks_at is not None and i == marks_at:
+            s["marks"] = ["attach"]
+        samples.append(s)
+    samples.append({"t": t0 + seconds, "ticks": {}, "itl": {"n": 0, "p50_ms": 0, "p99_ms": 0},
+                    "queue_depth": None, "active_slots": None, "shed": 0,
+                    "poison": 0, "open": True})
+    return {"capacity": 64, "resolution_s": 1, "samples": samples}
+
+
+def test_replica_series_extraction():
+    series = replica_series(_server_snap(10.0, seconds=4), window_s=30)
+    assert series["itl_p50_ms"] == [10.0] * 4
+    assert series["itl_p99_ms"] == [15.0] * 4
+    assert series["queue_depth"] == [2, 3, 4, 5]
+    # Derived queue slope: one value, the window's growth per second.
+    assert series["queue_depth_slope"] == [pytest.approx(1.0)]
+    assert series["shed"] == [0.0] * 4
+    # The open bucket never contributes (partial second).
+    assert all(len(v) <= 4 for v in series.values())
+    # Zero-ITL seconds are absent, not zero (no requests != fast).
+    empty = replica_series(
+        {"samples": [{"t": 1, "itl": {"n": 0, "p50_ms": 0, "p99_ms": 0}}]}, 30
+    )
+    assert "itl_p50_ms" not in empty
+
+
+def test_router_series_extraction_merges_by_backend():
+    snap = {
+        "capacity": 64, "resolution_s": 1,
+        "router": {"samples": [{"t": 5, "parks": 1}]},
+        "backends": {
+            "r1": {"samples": [
+                {"t": 5, "n": 3, "p50_ms": 20.0, "p99_ms": 30.0,
+                 "errors": 1, "failovers": 0},
+                {"t": 6, "n": 0, "p50_ms": 0.0, "p99_ms": 0.0,
+                 "errors": 0, "failovers": 2, "open": True},
+            ]},
+            "idle": {"samples": []},
+        },
+    }
+    out = router_series(snap, window_s=30)
+    assert set(out) == {"r1"}
+    assert out["r1"]["router_leg_p50_ms"] == [20.0]
+    assert out["r1"]["router_leg_p99_ms"] == [30.0]
+    assert out["r1"]["router_errors"] == [1.0]
+    # The open bucket's failovers never made it in.
+    assert out["r1"]["router_failovers"] == [0.0]
+
+
+def test_baseline_of_anchors_on_newest_mark():
+    snap = _server_snap(10.0, seconds=6, marks_at=2)
+    base = baseline_of(snap, baseline_s=30)
+    assert base["itl_p99_ms"] == pytest.approx(15.0)
+    assert "queue_depth_slope" not in base  # a slope is not a level
+    # Markless ring: nothing to anchor on.
+    assert baseline_of(_server_snap(10.0), baseline_s=30) == {}
+
+
+# ---------------------------------------------------------------------------
+# Config validation
+# ---------------------------------------------------------------------------
+
+_TPU_RING = {
+    "meshShape": {"tp": 1},
+    "observability": {"timeseriesRing": 64},
+}
+
+
+def test_anomaly_spec_validation():
+    with pytest.raises(ValueError, match="minPeers"):
+        AnomalySpec.from_spec({"minPeers": 2})
+    with pytest.raises(ValueError, match="madThreshold"):
+        AnomalySpec.from_spec({"madThreshold": 0})
+    with pytest.raises(ValueError, match="spec.anomaly"):
+        AnomalySpec.from_spec({"zThreshold": 3.0})
+    spec = AnomalySpec.from_spec({})
+    assert spec.enabled and spec.mad_threshold == 3.5 and spec.min_peers == 3
+
+
+def test_anomaly_requires_timeseries_ring():
+    base = {"modelName": "iris", "modelAlias": "champion", "minioSecret": "m"}
+    with pytest.raises(ValueError, match="timeseriesRing"):
+        OperatorConfig.from_spec(
+            {**base, "backend": "tpu",
+             "tpu": {"meshShape": {"tp": 1}}, "anomaly": {}}
+        )
+    cfg = OperatorConfig.from_spec(
+        {**base, "backend": "tpu", "tpu": _TPU_RING, "anomaly": {}}
+    )
+    assert cfg.anomaly.enabled
+
+
+# ---------------------------------------------------------------------------
+# Multiplexer / localplane straggler feeds
+# ---------------------------------------------------------------------------
+
+
+def _mux_world():
+    models = [MuxModel(name="m", uri="/m", weight=1.0, parked=3)]
+    replicas = [
+        MuxReplica(name="r1", url="http://r1"),
+        MuxReplica(name="r2", url="http://r2"),
+    ]
+    return models, replicas
+
+
+def test_plan_empty_straggler_set_is_byte_identical():
+    models, replicas = _mux_world()
+    base = plan("p", models, replicas, 100.0)
+    assert base.moves  # the comparison below must not be vacuous
+    assert plan("p", models, replicas, 100.0, stragglers=frozenset()) == base
+
+
+def test_plan_demotes_straggler_as_attach_target():
+    models, replicas = _mux_world()
+    # Both replicas free: r1 wins by name tiebreak... unless flagged.
+    moves = plan("p", models, replicas, 100.0).moves
+    assert [(m.replica.name, m.replace) for m in moves] == [("r1", False)]
+    moves = plan(
+        "p", models, replicas, 100.0, stragglers=frozenset({"r1"})
+    ).moves
+    assert [(m.replica.name, m.replace) for m in moves] == [("r2", False)]
+
+
+def test_localplane_drains_straggler_first(monkeypatch):
+    from tpumlops.clients.localplane import LocalReplicaSet
+
+    class _H:
+        def __init__(self, port):
+            self.port = port
+
+    rs = LocalReplicaSet({"v1": "file:///x"}, "iris")
+    handles = [_H(7001), _H(7002), _H(7003)]
+    rs._replicas["v1"] = list(handles)
+    drained = []
+    monkeypatch.setattr(
+        rs, "_drain_stop", lambda pred, h: drained.append(h.port)
+    )
+    manifest = {"spec": {"predictors": [{"name": "v1", "replicas": 2}]}}
+    # No verdicts: newest drained, exactly the pre-observatory order.
+    rs.sync_manifest(manifest)
+    assert drained == [7003]
+    # Flagged straggler: it becomes the victim even though it is not
+    # the newest handle.
+    drained.clear()
+    rs._replicas["v1"] = list(handles)
+    rs.set_stragglers({7001})
+    rs.sync_manifest(manifest)
+    assert drained == [7001]
+
+
+# ---------------------------------------------------------------------------
+# Reconciler integration: _anomaly_step
+# ---------------------------------------------------------------------------
+
+NS, NAME = "models", "iris"
+
+
+def cr_ref():
+    return ObjectRef(namespace=NS, name=NAME, **MLFLOWMODEL)
+
+
+ANOMALY_SPEC = {
+    "backend": "tpu",
+    "tpu": _TPU_RING,
+    "observability": {"historyLimit": 20},
+    "anomaly": {},
+}
+
+
+def make_world(spec_extra=None, ring_sources=None):
+    kube = FakeKube()
+    registry = FakeRegistry()
+    metrics = FakeMetrics()
+    clock = FakeClock()
+    spec = {"modelName": "iris", "modelAlias": "champion", "minioSecret": "m"}
+    spec.update(spec_extra or {})
+    kube.create(
+        cr_ref(),
+        {
+            "apiVersion": "mlflow.nizepart.com/v1alpha1",
+            "kind": "MlflowModel",
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": spec,
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec = Reconciler(
+        NAME, NS, kube, registry, metrics, clock, ring_sources=ring_sources
+    )
+    return kube, rec
+
+
+def _obs(slow_ms=None):
+    """A 4-replica fleet observation; ``slow_ms`` makes r-slow lag."""
+    replicas = {
+        "r0": _server_snap(10.0),
+        "r1": _server_snap(10.4),
+        "r2": _server_snap(9.9),
+        "r-slow": _server_snap(slow_ms if slow_ms else 10.2),
+    }
+    return {"replicas": replicas, "router": None}
+
+
+def test_reconciler_journals_and_publishes_then_dedupes_then_clears():
+    observations = [_obs(slow_ms=80.0)]
+    kube, rec = make_world(ANOMALY_SPEC, ring_sources=lambda: observations[0])
+    out = rec.reconcile(kube.get(cr_ref()))
+    assert out.anomaly and out.anomaly[0].action == "detected"
+    status = kube.get(cr_ref())["status"]
+    verdicts = status["anomalies"]
+    assert {v["replica"] for v in verdicts} == {"r-slow"}
+    assert {v["kind"] for v in verdicts} == {"straggler"}
+    assert all(v["direction"] == "high" for v in verdicts)
+    journal = [h for h in status["history"] if h.get("kind") == "anomaly"]
+    assert [j["action"] for j in journal] == ["detected"]
+    assert journal[0]["replicas"] == 4
+    assert kube.event_reasons().count("AnomalyDetected") == 1
+
+    # Standing verdict: the SAME shape is silent — no new record, no
+    # event, however much the live z jitters.
+    observations[0] = _obs(slow_ms=95.0)
+    out = rec.reconcile(kube.get(cr_ref()))
+    assert out.anomaly is None
+    status = kube.get(cr_ref())["status"]
+    assert [h["action"] for h in status["history"]
+            if h.get("kind") == "anomaly"] == ["detected"]
+    assert kube.event_reasons().count("AnomalyDetected") == 1
+
+    # Recovery: verdicts clear -> one "cleared" record, empty status list.
+    observations[0] = _obs()
+    out = rec.reconcile(kube.get(cr_ref()))
+    assert out.anomaly and out.anomaly[0].action == "cleared"
+    status = kube.get(cr_ref())["status"]
+    assert status["anomalies"] == []
+    assert [h["action"] for h in status["history"]
+            if h.get("kind") == "anomaly"] == ["detected", "cleared"]
+
+
+def test_reconciler_restart_rebuilds_dedupe_from_status():
+    observations = [_obs(slow_ms=80.0)]
+    kube, rec = make_world(ANOMALY_SPEC, ring_sources=lambda: observations[0])
+    rec.reconcile(kube.get(cr_ref()))
+    # A fresh reconciler (operator restart) sees the SAME standing
+    # verdict: silence, not a duplicate journal record.
+    rec2 = Reconciler(
+        NAME, NS, kube, FakeRegistry(), FakeMetrics(), FakeClock(),
+        ring_sources=lambda: observations[0],
+    )
+    rec2.registry.register(
+        "iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model"
+    )
+    rec2.registry.set_alias("iris", "champion", "1")
+    rec2.reconcile(kube.get(cr_ref()))
+    status = kube.get(cr_ref())["status"]
+    assert [h["action"] for h in status["history"]
+            if h.get("kind") == "anomaly"] == ["detected"]
+
+
+def test_reconciler_disabled_is_byte_for_byte_then_clears():
+    # Never enabled: no anomalies key anywhere near status.
+    kube, rec = make_world({"backend": "tpu", "tpu": _TPU_RING})
+    rec.reconcile(kube.get(cr_ref()))
+    assert "anomalies" not in kube.get(cr_ref())["status"]
+    # Enabled then disabled: one explicit null clears the stale key.
+    kube2, rec2 = make_world(
+        ANOMALY_SPEC, ring_sources=lambda: _obs(slow_ms=80.0)
+    )
+    rec2.reconcile(kube2.get(cr_ref()))
+    assert kube2.get(cr_ref())["status"]["anomalies"]
+    obj = kube2.get(cr_ref())
+    del obj["spec"]["anomaly"]
+    kube2.replace(cr_ref(), obj)
+    rec2.reconcile(kube2.get(cr_ref()))
+    assert kube2.get(cr_ref())["status"]["anomalies"] is None
+
+
+def test_reconciler_unwired_sources_and_fetch_failure_are_inert():
+    # spec.anomaly without ring_sources: nothing to observe, no writes.
+    kube, rec = make_world(ANOMALY_SPEC, ring_sources=None)
+    rec.reconcile(kube.get(cr_ref()))
+    assert "anomalies" not in kube.get(cr_ref())["status"]
+
+    def boom():
+        raise OSError("fleet unreachable")
+
+    kube2, rec2 = make_world(ANOMALY_SPEC, ring_sources=boom)
+    out = rec2.reconcile(kube2.get(cr_ref()))  # must not raise
+    assert "anomalies" not in kube2.get(cr_ref())["status"]
+    assert out.anomaly is None
+
+
+def test_reconciler_router_vantage_detects_proxy_slowness():
+    # Server-side rings all look healthy; ONLY the router's leg ring
+    # sees the injected transit delay (the ChaosProxy inject_slow
+    # shape) — detect() flags the straggler from that vantage alone.
+    def leg(ms):
+        return {"samples": [
+            {"t": 10 + i, "n": 4, "p50_ms": ms, "p99_ms": ms * 1.2,
+             "errors": 0, "failovers": 0}
+            for i in range(3)
+        ]}
+
+    obs = {
+        "replicas": {
+            "r0": _server_snap(10.0),
+            "r1": _server_snap(10.3),
+            "r2": _server_snap(9.8),
+        },
+        "router": {
+            "capacity": 64, "resolution_s": 1,
+            "router": {"samples": []},
+            "backends": {"r0": leg(21.0), "r1": leg(350.0), "r2": leg(20.0)},
+        },
+    }
+    kube, rec = make_world(ANOMALY_SPEC, ring_sources=lambda: obs)
+    rec.reconcile(kube.get(cr_ref()))
+    verdicts = kube.get(cr_ref())["status"]["anomalies"]
+    assert {v["replica"] for v in verdicts} == {"r1"}
+    assert {v["series"] for v in verdicts} <= {
+        "router_leg_p50_ms", "router_leg_p99_ms"
+    }
+
+
+def test_reconciler_feeds_stragglers_to_mux_coordinator():
+    class _FakeCoord:
+        def __init__(self):
+            self.stragglers = None
+
+        def register(self, name, uri, weight):
+            pass
+
+        def set_stragglers(self, names):
+            self.stragglers = frozenset(names)
+
+        def pump(self):
+            pass
+
+        def take_records(self, name):
+            return []
+
+        def model_status(self, name):
+            return {
+                "pool": "shared-a", "weight": 1.0, "poolReplicas": 0,
+                "attachedReplicas": [], "parked": 0, "score": 0.0,
+            }
+
+    coord = _FakeCoord()
+    kube = FakeKube()
+    registry = FakeRegistry()
+    spec = dict(ANOMALY_SPEC)
+    spec.update(
+        {"modelName": "iris", "modelAlias": "champion", "minioSecret": "m",
+         # The pool attaches by snapshot restore: multiplex requires it.
+         "tpu": {**_TPU_RING, "snapshot": {"enabled": True, "dir": "/s"}},
+         "multiplex": {"poolRef": "shared-a"}}
+    )
+    kube.create(
+        cr_ref(),
+        {
+            "apiVersion": "mlflow.nizepart.com/v1alpha1",
+            "kind": "MlflowModel",
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": spec,
+        },
+    )
+    registry.register("iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model")
+    registry.set_alias("iris", "champion", "1")
+    rec = Reconciler(
+        NAME, NS, kube, registry, FakeMetrics(), FakeClock(),
+        mux_pools={"shared-a": coord},
+        ring_sources=lambda: _obs(slow_ms=80.0),
+    )
+    # First pass: verdicts are computed AFTER the mux pump — the feed
+    # reaches the coordinator on the NEXT step (one-poll delay).
+    rec.reconcile(kube.get(cr_ref()))
+    assert coord.stragglers == frozenset()
+    rec.reconcile(kube.get(cr_ref()))
+    assert coord.stragglers == frozenset({"r-slow"})

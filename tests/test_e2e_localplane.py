@@ -1880,6 +1880,259 @@ def test_fleet_trace_park_relay_failover_stitches_to_one_trace(llm_models):
             h.stop()
 
 
+def test_fleet_anomaly_observatory_flags_injected_straggler(llm_models):
+    """ISSUE 20 e2e: three live replicas behind the native router, one
+    wrapped in a ChaosProxy that holds every response in transit.  The
+    slow replica's OWN ring looks healthy (the delay is on the wire),
+    so detection must come from the router's leg-latency ring — the
+    operator fetches both vantages over live HTTP, flags the proxied
+    replica, journals the verdict, publishes ``status.anomalies``, and
+    ``fleet_top.py`` renders the verdict off ``/debug/fleet-overview``.
+    """
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    import urllib.error
+    import urllib.request
+
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.chaos import (
+        ChaosProxy,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.fakes import (
+        FakeKube,
+        FakeMetrics,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
+        RouterAdmin,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator import (
+        anomaly,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.reconciler import (
+        Reconciler,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.operator.telemetry import (
+        OperatorTelemetry,
+    )
+    from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.utils.config import (
+        TpuSpec,
+    )
+
+    def tpu_spec(ring: int):
+        spec = {"meshShape": {"tp": 1}, "maxBatchSize": 2, "maxSlots": 2}
+        if ring:
+            spec["observability"] = {"timeseriesRing": 64}
+        return TpuSpec.from_spec(spec)
+
+    # r0 carries a live server ring (exercises the replica fetch path;
+    # with ONE ring-bearing replica its server series stay under the
+    # min-peers gate, so they cannot vote).  r1/r2 run ring-off: their
+    # 404s must read as "ring off", never as errors.
+    handles, ports = [], {}
+    for name, ring in (("r0", 64), ("r1", 0), ("r2", 0)):
+        port = free_port()
+        handles.append(
+            start_model_server(
+                llm_models["1"], name, port, model_name="llm",
+                namespace="models", tpu=tpu_spec(ring),
+            )
+        )
+        ports[name] = port
+    chaos = ChaosProxy(ports["r1"])
+    chaos.inject_slow(0.35, times=10_000)  # every r1 leg +350 ms
+    router = RouterProcess(
+        port=free_port(),
+        backends={
+            "r0": ("127.0.0.1", ports["r0"], 100),
+            "r1": ("127.0.0.1", chaos.port, 100),
+            "r2": ("127.0.0.1", ports["r2"], 100),
+        },
+        namespace="models",
+        deployment="llm",
+        timeseries_ring=64,
+    ).start()
+    httpd = None
+
+    def generate(port: int, timeout: float = 180.0):
+        body = _json.dumps(
+            {"prompt_ids": [11, 3, 4], "max_new_tokens": 4}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v2/models/llm/generate",
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _json.loads(resp.read())
+
+    try:
+        # Warm every replica DIRECTLY (past the proxy) so first-request
+        # compile time never lands in a router leg bucket — the legs
+        # must differ only by the injected transit delay.
+        for name in ("r0", "r1", "r2"):
+            generate(ports[name])
+
+        # Drive weighted-random traffic until every backend has legs on
+        # the router ring (the detector needs all three as peers).
+        admin = RouterAdmin(router.port)
+
+        def leg_counts():
+            try:
+                snap = admin.timeseries()
+            except urllib.error.HTTPError:
+                return {}
+            return {
+                b: sum(s["n"] for s in ring.get("samples", []))
+                for b, ring in (snap.get("backends") or {}).items()
+            }
+
+        for _ in range(60):
+            generate(router.port)
+            counts = leg_counts()
+            if len(counts) == 3 and all(
+                n >= 2 for n in counts.values()
+            ):
+                break
+        else:
+            raise AssertionError(f"traffic never spread: {leg_counts()}")
+        time.sleep(1.2)  # roll the second: buckets close
+
+        # The operator observes the fleet over live HTTP only.
+        sources = [
+            {"name": "r0", "base_url": f"http://127.0.0.1:{ports['r0']}"},
+            {"name": "r1", "base_url": f"http://127.0.0.1:{ports['r1']}"},
+            {"name": "r2", "base_url": f"http://127.0.0.1:{ports['r2']}"},
+            {"name": "router", "kind": "router",
+             "base_url": f"http://127.0.0.1:{router.port}"},
+        ]
+        kube = FakeKube()
+        registry = FakeRegistry()
+        kube.create(
+            cr_ref(),
+            {
+                "apiVersion": "mlflow.nizepart.com/v1alpha1",
+                "kind": "MlflowModel",
+                "metadata": {"name": "iris", "namespace": "models"},
+                "spec": {
+                    "modelName": "iris", "modelAlias": "champion",
+                    "minioSecret": "m", "backend": "tpu",
+                    "tpu": {
+                        "meshShape": {"tp": 1},
+                        "observability": {"timeseriesRing": 64},
+                    },
+                    "observability": {"historyLimit": 20},
+                    # Drift is unit-tested; the e2e pins the straggler
+                    # path (a warmup-marked baseline would race it).
+                    "anomaly": {"driftPct": 0},
+                },
+            },
+        )
+        registry.register(
+            "iris", "1", "mlflow-artifacts:/1/aaa/artifacts/model"
+        )
+        registry.set_alias("iris", "champion", "1")
+        rec = Reconciler(
+            "iris", "models", kube, registry, FakeMetrics(), SystemClock(),
+            ring_sources=anomaly.ring_sources_from(sources),
+        )
+        out = rec.reconcile(kube.get(cr_ref()))
+        status = get_status(kube)
+        verdicts = status.get("anomalies") or []
+        assert verdicts, "no verdicts from live rings"
+        assert {v["replica"] for v in verdicts} == {"r1"}
+        assert all(v["series"].startswith("router_leg_") for v in verdicts)
+        assert all(v["direction"] == "high" for v in verdicts)
+        assert all(abs(v["z"]) > 3.5 for v in verdicts)
+        journal = [
+            h for h in status.get("history") or []
+            if h.get("kind") == "anomaly"
+        ]
+        assert [j["action"] for j in journal] == ["detected"]
+        assert journal[0]["replicas"] == 3  # router legs: r0, r1, r2
+        assert "AnomalyDetected" in kube.event_reasons()
+
+        # Standing verdict: a second poll of the SAME live fleet is
+        # silent (shape-deduped), not a duplicate record.
+        out = rec.reconcile(kube.get(cr_ref()))
+        status = get_status(kube)
+        assert [
+            h["action"] for h in status["history"]
+            if h.get("kind") == "anomaly"
+        ] == ["detected"]
+
+        # Vantage sanity: r0 serves a live ring, r1's own ring is OFF
+        # (the slowness was invisible server-side by construction).
+        r0_ring = _json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ports['r0']}/debug/timeseries",
+                timeout=10,
+            ).read()
+        )
+        assert r0_ring["samples"]
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ports['r1']}/debug/timeseries",
+                timeout=10,
+            )
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # The overview endpoint aggregates the same picture...
+        telemetry = OperatorTelemetry()
+        telemetry.record_outcome("models", "iris", out, 0.01)
+        tel_port = free_port()
+        httpd = telemetry.serve(
+            tel_port, addr="127.0.0.1",
+            fleet_trace_sources=lambda: sources,
+        )
+        base = f"http://127.0.0.1:{tel_port}"
+        overview = _json.loads(
+            urllib.request.urlopen(
+                base + "/debug/fleet-overview", timeout=30
+            ).read()
+        )
+        srcs = overview["sources"]
+        assert srcs["r0"]["timeseries"]["samples"]
+        assert srcs["r1"]["timeseries"] is None  # ring off, NOT an error
+        assert "error" not in srcs["r1"]
+        assert srcs["r2"]["timeseries"] is None
+        assert srcs["router"]["timeseries"]["backends"]["r1"]["samples"]
+        assert set(srcs["router"]["circuits"]) == {"r0", "r1", "r2"}
+        model = overview["models"]["models/iris"]
+        assert {v["replica"] for v in model["anomalies"]} == {"r1"}
+
+        # ...and fleet_top renders the verdict from that endpoint alone.
+        script = os.path.join(
+            os.path.dirname(__file__), os.pardir, "scripts", "fleet_top.py"
+        )
+        run = subprocess.run(
+            [sys.executable, script, "--url", base, "--once", "--json"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert run.returncode == 0, run.stderr
+        payload = _json.loads(run.stdout)
+        assert {
+            v["replica"]
+            for v in payload["models"]["models/iris"]["anomalies"]
+        } == {"r1"}
+        run = subprocess.run(
+            [sys.executable, script, "--url", base, "--once"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert run.returncode == 0, run.stderr
+        assert "STRAGGLER" in run.stdout
+        assert "ring off" in run.stdout  # r1/r2 rows, honestly labeled
+        assert "DARK" not in run.stdout  # nobody is unreachable
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        router.stop()
+        chaos.stop()
+        for h in handles:
+            h.stop()
+
+
 # ---------------------------------------------------------------------------
 # Multi-model multiplexing e2e: FOUR CRs share a TWO-replica warm pool.
 # Nothing scripted — live warm-pool servers (booted, NO weights), the

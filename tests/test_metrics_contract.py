@@ -115,6 +115,11 @@ EXPECTED_SERVER_DEVICE = {
 _OP_IDENT = ("namespace", "name")
 
 EXPECTED_OPERATOR = {
+    # Fleet anomaly observatory (spec.anomaly; operator/anomaly.py) —
+    # no samples until a CR arms the detector.
+    "tpumlops_operator_anomaly_active": ("gauge", _OP_IDENT + ("kind",)),
+    "tpumlops_operator_anomaly_events": (
+        "counter", _OP_IDENT + ("kind",)),
     # Replica autoscaler (operator/autoscaler.py): controlled + wanted
     # counts, applied scalings by direction, holds by typed reason.
     "tpumlops_operator_autoscale_desired_replicas": ("gauge", _OP_IDENT),

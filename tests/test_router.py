@@ -18,6 +18,7 @@ import urllib.request
 import pytest
 
 from research_and_development_of_kubernetes_operator_for_machine_learning_pipelines_tpu.clients.router import (
+    RouterAdmin,
     RouterProcess,
     build_router,
 )
@@ -1609,6 +1610,57 @@ def test_typed_sheds_carry_request_id_with_journey_ring_on(binary):
             assert e.headers.get("X-Request-Id") is None
     finally:
         router.stop()
+
+
+def test_router_timeseries_ring_on_and_off(binary):
+    """ISSUE 20: ``--timeseries-ring N`` serves per-backend per-second
+    leg latency rings at /router/debug/timeseries (the anomaly
+    observatory's router vantage); without the flag the endpoint 404s
+    and the wire stays byte-for-byte."""
+    srv, p = start_backend("a")
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", p, 100)},
+        binary=binary,
+        timeseries_ring=8,
+    ).start()
+    try:
+        for _ in range(5):
+            ask(router.port)
+        _t.sleep(1.2)
+        ask(router.port)  # roll the second so a closed bucket exists
+        snap = RouterAdmin(router.port).timeseries()
+        assert snap["capacity"] == 8 and snap["resolution_s"] == 1
+        assert "samples" in snap["router"]
+        samples = snap["backends"]["a"]["samples"]
+        assert sum(s["n"] for s in samples) >= 6
+        with_latency = [s for s in samples if s["n"]]
+        assert all(s["p99_ms"] >= s["p50_ms"] > 0 for s in with_latency)
+        assert all(
+            s["errors"] == 0 and s["failovers"] == 0 for s in samples
+        )
+        # operator/anomaly.py consumes this shape directly.
+        from tpumlops.operator.anomaly import router_series
+
+        series = router_series(snap, window_s=60)
+        if any(not s.get("open") and s["n"] for s in samples):
+            assert series["a"]["router_leg_p99_ms"]
+    finally:
+        router.stop()
+    # Ring off (the default): 404, nothing else changes.
+    router = RouterProcess(
+        port=free_port(),
+        backends={"a": ("127.0.0.1", p, 100)},
+        binary=binary,
+    ).start()
+    try:
+        ask(router.port)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            RouterAdmin(router.port).timeseries()
+        assert exc.value.code == 404
+    finally:
+        router.stop()
+        srv.shutdown()
 
 
 # ---------------------------------------------------------------------------

@@ -738,6 +738,19 @@ def test_debug_spans_endpoint(iris_server):
     }
 
 
+def test_debug_timeseries_disabled_is_404_naming_the_flag(iris_server):
+    """ISSUE 20 pin: with spec.tpu.observability.timeseriesRing unset
+    (the default) the ring endpoint 404s and the body names BOTH the
+    spec key and the CLI flag — the operator's ring fetch treats the
+    404 as ring-off, never as an error."""
+    handle, *_ = iris_server
+    resp = httpx.get(handle.base + "/debug/timeseries", timeout=10)
+    assert resp.status_code == 404
+    body = resp.json()
+    assert "timeseriesRing" in body["error"]
+    assert "--timeseries-ring" in body["error"]
+
+
 def _metric_total(text: str, family: str) -> float:
     """Sum every sample of ``family`` in a Prometheus exposition."""
     total = 0.0
@@ -842,6 +855,32 @@ def test_debug_profile_endpoint(iris_server):
         handle.base + "/debug/profile", json={"duration_s": 0.1}, timeout=30
     )
     assert again.status_code == 200
+
+
+def test_profile_capture_gc_keeps_newest_dirs(tmp_path):
+    """ISSUE 20 satellite: /debug/profile keeps only the newest
+    PROFILE_KEEP_DIRS capture dirs — unbounded /tmp growth was the
+    leak; the evicted names come back in the endpoint response."""
+    import os
+
+    from tpumlops.server.app import PROFILE_KEEP_DIRS, _gc_profile_dirs
+
+    assert PROFILE_KEEP_DIRS == 8
+    root = tmp_path / "prof"
+    root.mkdir()
+    for i in range(11):
+        d = root / f"cap-{i:02d}"
+        d.mkdir()
+        os.utime(d, (1000 + i, 1000 + i))
+    evicted = _gc_profile_dirs(str(root), keep=8)
+    assert sorted(evicted) == ["cap-00", "cap-01", "cap-02"]
+    assert sorted(p.name for p in root.iterdir()) == [
+        f"cap-{i:02d}" for i in range(3, 11)
+    ]
+    # Idempotent once under the cap; a missing root is a no-op, never
+    # an endpoint error.
+    assert _gc_profile_dirs(str(root), keep=8) == []
+    assert _gc_profile_dirs(str(tmp_path / "nope")) == []
 
 
 def test_bert_server_buckets_variable_lengths(tmp_path):
